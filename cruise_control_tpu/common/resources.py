@@ -1,0 +1,85 @@
+"""Resource taxonomy for the TPU-native cluster model.
+
+Mirrors the semantics of the reference's resource enum
+(``cruise-control/.../common/Resource.java:17-27``): four balanced resources with
+fixed array ids, host-vs-broker scoping flags, and the float-summation epsilon
+policy tuned for ~800K-replica models.
+
+Array layout convention used across the whole framework: every per-entity load or
+capacity tensor has a trailing axis of size ``NUM_RESOURCES`` indexed by these ids.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+# Resource ids — identical to Resource.java ids so dumps/diffs line up.
+CPU = 0
+NW_IN = 1
+NW_OUT = 2
+DISK = 3
+NUM_RESOURCES = 4
+
+# Extra broker-level metric column (not a balanced "Resource" in the reference,
+# but KafkaMetricDef's LEADER_BYTES_IN model metric, used by
+# LeaderBytesInDistributionGoal). Broker metric tensors that carry it use
+# NUM_BROKER_METRICS columns.
+LEADER_BYTES_IN = 4
+NUM_BROKER_METRICS = 5
+
+RESOURCE_NAMES = ("cpu", "networkInbound", "networkOutbound", "disk")
+
+# Host-level resources: CPU, NW_IN, NW_OUT (capacity goals aggregate over the
+# host for these); broker-level resources: CPU, DISK (Resource.java:18-21).
+IS_HOST_RESOURCE = np.array([True, True, True, False])
+IS_BROKER_RESOURCE = np.array([True, False, False, True])
+
+# Absolute epsilon floor per resource (Resource.java:18-21 last ctor arg).
+RESOURCE_EPSILON = np.array([0.001, 10.0, 10.0, 100.0])
+
+# Relative epsilon: acceptable nuance from float summation, 0.08% of the sum of
+# compared values (Resource.java:27).
+EPSILON_PERCENT = 0.0008
+
+# Priority order used by BalancingConstraint for resource balancing
+# (BalancingConstraint.java:40): DISK, NW_IN, NW_OUT, CPU.
+RESOURCE_BALANCE_PRIORITY = (DISK, NW_IN, NW_OUT, CPU)
+
+
+def epsilon(resource: int, value1, value2):
+    """Comparison tolerance for a resource, matching Resource.java:87-89."""
+    return np.maximum(RESOURCE_EPSILON[resource], EPSILON_PERCENT * (value1 + value2))
+
+
+@dataclasses.dataclass(frozen=True)
+class BalancingConstraint:
+    """Balance/capacity thresholds (analyzer/BalancingConstraint.java:22-66).
+
+    Defaults mirror KafkaCruiseControlConfig.java:1344-1460: balance thresholds
+    1.10 (topic-replica 3.00), capacity thresholds 0.8, low-utilization 0.0,
+    max 10_000 replicas per broker, goal-violation distribution multiplier 1.0.
+    Array fields are indexed by resource id.
+    """
+
+    resource_balance_percentage: tuple = (1.10, 1.10, 1.10, 1.10)
+    capacity_threshold: tuple = (0.8, 0.8, 0.8, 0.8)
+    low_utilization_threshold: tuple = (0.0, 0.0, 0.0, 0.0)
+    replica_balance_percentage: float = 1.10
+    leader_replica_balance_percentage: float = 1.10
+    topic_replica_balance_percentage: float = 3.00
+    goal_violation_distribution_threshold_multiplier: float = 1.00
+    max_replicas_per_broker: int = 10_000
+
+    def balance_percentage_array(self) -> np.ndarray:
+        return np.asarray(self.resource_balance_percentage, dtype=np.float32)
+
+    def capacity_threshold_array(self) -> np.ndarray:
+        return np.asarray(self.capacity_threshold, dtype=np.float32)
+
+    def low_utilization_threshold_array(self) -> np.ndarray:
+        return np.asarray(self.low_utilization_threshold, dtype=np.float32)
+
+
+DEFAULT_BALANCING_CONSTRAINT = BalancingConstraint()

@@ -1,0 +1,401 @@
+"""Array-resident cluster model (struct-of-arrays).
+
+TPU-native mirror of the reference's object graph ``ClusterModel`` →
+``Rack``/``Host``/``Broker``/``Disk`` → ``Partition``/``Replica``/``Load``
+(``cruise-control/.../model/ClusterModel.java``). Instead of a mutable object
+tree, the model is split into:
+
+- :class:`ClusterTopology` — everything immutable during an optimization:
+  broker topology (rack/host ids, capacities, liveness), the partition/replica
+  index structure, and loads.
+
+- :class:`Assignment` — the decision variables: ``broker_of`` (replica →
+  broker) and ``leader_of`` (partition → leader replica index).
+
+Load representation. Every replica carries a *base* (follower-role) load vector
+``replica_base_load[R, 4]``; the extra load carried by whichever replica
+currently leads is partition-intrinsic: ``leader_extra[P, 4]`` with nonzero
+entries only for NW_OUT (the whole outbound rate moves with leadership) and CPU
+(the leader-vs-follower CPU delta). This encodes the reference's mutation ops
+as pure array updates:
+
+- ``relocateReplica`` (``ClusterModel.java:347``) = one ``broker_of`` scatter;
+  the replica's base load (plus leader extra if it leads) travels with it.
+- ``relocateLeadership`` (``ClusterModel.java:374``: transfers the whole
+  NW_OUT plus a CPU fraction via ``Replica.leaderLoadDelta``,
+  ``Replica.java:226-275``) = one ``leader_of`` scatter, because effective
+  load is ``base + is_leader * leader_extra``.
+
+For monitor-built models this is exact: follower loads are derived from the
+leader's metrics with FOLLOWER_BYTES_OUT = 0 (``MonitorUtils.java:66-76``), so
+the leadership delta is partition-intrinsic. (For hand-built models whose
+followers carry nonzero NW_OUT, the reference's repeated in-place deltas are
+path-dependent; we pin the delta to the initial leader's, which matches the
+reference for every first-hop transfer.)
+
+Everything here is jit/vmap-compatible; topology arrays are closed over as
+constants, assignments are traced.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.common import resources as res
+
+
+def _pytree_dataclass(cls):
+    """Register a dataclass whose fields are all pytree children."""
+    fields = [f.name for f in dataclasses.fields(cls)]
+    jax.tree_util.register_pytree_with_keys(
+        cls,
+        lambda obj: (
+            [(jax.tree_util.GetAttrKey(n), getattr(obj, n)) for n in fields],
+            None,
+        ),
+        lambda aux, children: cls(**dict(zip(fields, children))),
+    )
+    return cls
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterTopology:
+    """Immutable problem description, all numpy (host) arrays.
+
+    Shapes: B brokers, H hosts, K racks, P partitions, R replicas, T topics.
+    Replicas are grouped by partition: ``replicas_of_partition`` is a
+    ``(P, max_rf)`` index matrix padded with -1, in Kafka replica-list order
+    (slot 0 is the *preferred* leader — what PreferredLeaderElectionGoal
+    targets); the *initial* leader's slot is ``initial_leader_slot``.
+    """
+
+    # --- broker topology (ClusterModel.createBroker/createRack) ---
+    rack_of_broker: np.ndarray        # i32[B]
+    host_of_broker: np.ndarray        # i32[B]
+    capacity: np.ndarray              # f32[B, 4] broker capacity per resource
+    broker_alive: np.ndarray          # bool[B]  (state ALIVE or NEW)
+    broker_new: np.ndarray            # bool[B]  (state NEW: destination-only for balancing)
+    broker_demoted: np.ndarray        # bool[B]  (state DEMOTED: leadership must leave)
+    broker_bad_disks: np.ndarray      # bool[B]  (state BAD_DISKS)
+    # --- partition / replica structure ---
+    partition_of_replica: np.ndarray  # i32[R]
+    topic_of_partition: np.ndarray    # i32[P]
+    replicas_of_partition: np.ndarray  # i32[P, max_rf], -1 padded
+    rf_of_partition: np.ndarray       # i32[P]
+    initial_leader_slot: np.ndarray   # i64[P] slot of the initial leader
+    # Replica is offline at the *initial* assignment (on a dead broker or dead
+    # disk, ClusterModel.selfHealingEligibleReplicas); must be moved.
+    replica_offline: np.ndarray       # bool[R]
+    # --- loads (see module docstring) ---
+    replica_base_load: np.ndarray     # f32[R, 4] follower-role load
+    leader_extra: np.ndarray          # f32[P, 4] extra load carried by the leader
+    leader_bytes_in: np.ndarray       # f32[P] model metric LEADER_BYTES_IN
+    # --- names for decoding back to proposals ---
+    topic_names: tuple = ()
+    partition_index: Optional[np.ndarray] = None  # i32[P] kafka partition number
+    broker_ids: Optional[np.ndarray] = None       # i32[B] external broker ids
+    host_names: tuple = ()
+    rack_names: tuple = ()
+
+    # ---- sizes ----
+    @property
+    def num_brokers(self) -> int:
+        return int(self.capacity.shape[0])
+
+    @property
+    def num_hosts(self) -> int:
+        return int(self.host_of_broker.max()) + 1 if self.host_of_broker.size else 0
+
+    @property
+    def num_racks(self) -> int:
+        return int(self.rack_of_broker.max()) + 1 if self.rack_of_broker.size else 0
+
+    @property
+    def num_partitions(self) -> int:
+        return int(self.topic_of_partition.shape[0])
+
+    @property
+    def num_replicas(self) -> int:
+        return int(self.partition_of_replica.shape[0])
+
+    @property
+    def num_topics(self) -> int:
+        return len(self.topic_names) if self.topic_names else int(self.topic_of_partition.max()) + 1
+
+    @property
+    def max_rf(self) -> int:
+        return int(self.replicas_of_partition.shape[1])
+
+    @property
+    def topic_of_replica(self) -> np.ndarray:
+        return self.topic_of_partition[self.partition_of_replica]
+
+    def host_capacity(self) -> np.ndarray:
+        """f32[H, 4] — host capacity sums its *alive* brokers' capacities
+        (the reference removes a broker's capacity from its host on DEAD)."""
+        hcap = np.zeros((self.num_hosts, res.NUM_RESOURCES), dtype=np.float32)
+        np.add.at(hcap, self.host_of_broker,
+                  np.where(self.broker_alive[:, None], self.capacity, 0.0))
+        return hcap
+
+    def replica_load(self, is_leader: np.ndarray) -> np.ndarray:
+        """f32[R, 4] effective load of each replica given leader flags."""
+        extra = self.leader_extra[self.partition_of_replica]
+        return self.replica_base_load + np.where(is_leader[:, None], extra, 0.0)
+
+
+@_pytree_dataclass
+@dataclasses.dataclass(frozen=True)
+class Assignment:
+    """Decision variables: placement + leadership (device arrays inside jit)."""
+
+    broker_of: jax.Array  # i32[R]
+    leader_of: jax.Array  # i32[P] — global replica index of the leader
+
+    def is_leader(self, partition_of_replica) -> jax.Array:
+        """bool[R] — replica r leads iff leader_of[its partition] == r."""
+        r = jnp.arange(self.broker_of.shape[0], dtype=jnp.int32)
+        return jnp.asarray(self.leader_of)[partition_of_replica] == r
+
+    def leader_broker(self) -> jax.Array:
+        """i32[P] — broker hosting each partition's leader."""
+        return jnp.asarray(self.broker_of)[self.leader_of]
+
+
+def initial_assignment(topo: ClusterTopology, broker_of: np.ndarray,
+                       leader_position: Optional[np.ndarray] = None) -> Assignment:
+    """Assignment for the topology's initial placement (recorded leader slots)."""
+    pos = topo.initial_leader_slot if leader_position is None else leader_position
+    leader_of = topo.replicas_of_partition[np.arange(topo.num_partitions), pos]
+    return Assignment(
+        broker_of=jnp.asarray(broker_of, dtype=jnp.int32),
+        leader_of=jnp.asarray(leader_of, dtype=jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# CPU model (model/ModelParameters.java:21-29)
+# ---------------------------------------------------------------------------
+
+CPU_WEIGHT_LEADER_BYTES_IN = 0.7
+CPU_WEIGHT_LEADER_BYTES_OUT = 0.15
+CPU_WEIGHT_FOLLOWER_BYTES_IN = 0.15
+
+
+def follower_cpu_util(leader_bytes_in, leader_bytes_out, leader_cpu):
+    """ModelUtils.getFollowerCpuUtilFromLeaderLoad (ModelUtils.java:45-66)."""
+    denom = (CPU_WEIGHT_LEADER_BYTES_IN * leader_bytes_in
+             + CPU_WEIGHT_LEADER_BYTES_OUT * leader_bytes_out)
+    num = CPU_WEIGHT_FOLLOWER_BYTES_IN * leader_bytes_in
+    denom = np.asarray(denom, dtype=np.float64)
+    safe = np.where(denom > 0, denom, 1.0)
+    return np.where(denom > 0, leader_cpu * num / safe, 0.0)
+
+
+def leadership_extra_from_leader_load(leader_load: np.ndarray) -> np.ndarray:
+    """Leadership delta from the leader's as-is load (Replica.java:226-275):
+    the whole NW_OUT plus leaderCpu − followerCpu(formula)."""
+    leader_load = np.asarray(leader_load, dtype=np.float32)
+    extra = np.zeros_like(leader_load)
+    extra[..., res.NW_OUT] = leader_load[..., res.NW_OUT]
+    extra[..., res.CPU] = leader_load[..., res.CPU] - follower_cpu_util(
+        leader_load[..., res.NW_IN], leader_load[..., res.NW_OUT], leader_load[..., res.CPU])
+    return extra
+
+
+def derive_follower_load(leader_load: np.ndarray) -> np.ndarray:
+    """Follower load from leader load (MonitorUtils.java:66-76)."""
+    return np.asarray(leader_load, dtype=np.float32) - leadership_extra_from_leader_load(leader_load)
+
+
+# ---------------------------------------------------------------------------
+# Builder: friendly mutation-style API used by fixtures and the monitor.
+# ---------------------------------------------------------------------------
+
+
+class ClusterModelBuilder:
+    """Incremental builder mirroring ClusterModel's creation API:
+    ``createRack``/``createBroker`` (``ClusterModel.java:845,867``),
+    ``createReplica`` + ``setReplicaLoad`` (``ClusterModel.java:746,684``) —
+    lowering to the array topology at ``build()`` time.
+    """
+
+    def __init__(self):
+        self._racks: list = []
+        self._hosts: dict = {}
+        self._brokers: list = []
+        self._broker_index: dict = {}
+        self._topics: list = []
+        self._topic_index: dict = {}
+        self._partitions: dict = {}
+
+    # -- topology --
+    def create_rack(self, rack: str) -> str:
+        if rack not in self._racks:
+            self._racks.append(rack)
+        return rack
+
+    def create_broker(self, rack: str, host: str, broker_id: int, capacity,
+                      alive: bool = True, new: bool = False, demoted: bool = False,
+                      bad_disks: bool = False) -> int:
+        """capacity: dict {resource_id: value} or sequence of 4 values."""
+        self.create_rack(rack)
+        if host not in self._hosts:
+            self._hosts[host] = {"rack": rack}
+        cap = np.zeros(res.NUM_RESOURCES, dtype=np.float32)
+        if isinstance(capacity, dict):
+            for k, v in capacity.items():
+                cap[k] = v
+        else:
+            cap[:] = np.asarray(capacity, dtype=np.float32)
+        if broker_id in self._broker_index:
+            raise ValueError(f"duplicate broker id {broker_id}")
+        idx = len(self._brokers)
+        self._brokers.append(dict(id=broker_id, rack=rack, host=host, capacity=cap,
+                                  alive=alive, new=new, demoted=demoted, bad_disks=bad_disks))
+        self._broker_index[broker_id] = idx
+        return broker_id
+
+    def set_broker_state(self, broker_id: int, *, alive=None, new=None, demoted=None, bad_disks=None):
+        b = self._brokers[self._broker_index[broker_id]]
+        for k, v in (("alive", alive), ("new", new), ("demoted", demoted), ("bad_disks", bad_disks)):
+            if v is not None:
+                b[k] = v
+
+    # -- partitions --
+    def create_replica(self, broker_id: int, topic: str, partition: int,
+                       index: int, is_leader: bool, offline: bool = False):
+        """Mirror of ClusterModel.createReplica: register a replica at a list
+        position; exactly one replica per partition must be the leader."""
+        if topic not in self._topic_index:
+            self._topic_index[topic] = len(self._topics)
+            self._topics.append(topic)
+        key = (topic, partition)
+        part = self._partitions.setdefault(
+            key, dict(topic=topic, partition=partition, replicas={}, leader_index=None))
+        if index in part["replicas"]:
+            raise ValueError(f"duplicate replica index {index} for {key}")
+        part["replicas"][index] = dict(broker=broker_id, load=None, offline=offline)
+        if is_leader:
+            if part["leader_index"] is not None:
+                raise ValueError(f"two leaders for {key}")
+            part["leader_index"] = index
+
+    def set_replica_load(self, broker_id: int, topic: str, partition: int, load,
+                         leader_bytes_in: float = None):
+        """Mirror of ClusterModel.setReplicaLoad; load = 4-vector or dict."""
+        part = self._partitions[(topic, partition)]
+        vec = np.zeros(res.NUM_RESOURCES, dtype=np.float32)
+        if isinstance(load, dict):
+            for k, v in load.items():
+                vec[k] = v
+        else:
+            vec[:] = np.asarray(load, dtype=np.float32)
+        for rep in part["replicas"].values():
+            if rep["broker"] == broker_id:
+                rep["load"] = vec
+                if leader_bytes_in is not None:
+                    part["leader_bytes_in"] = np.float32(leader_bytes_in)
+                return
+        raise ValueError(f"no replica of ({topic},{partition}) on broker {broker_id}")
+
+    def create_partition(self, topic: str, partition: int, leader_broker: int,
+                         follower_brokers, leader_load, leader_bytes_in: float = 0.0,
+                         offline=()):
+        """Convenience: leader + followers with reference-derived follower
+        loads (MonitorUtils.java:66-76)."""
+        ll = np.zeros(res.NUM_RESOURCES, dtype=np.float32)
+        if isinstance(leader_load, dict):
+            for k, v in leader_load.items():
+                ll[k] = v
+        else:
+            ll[:] = np.asarray(leader_load, dtype=np.float32)
+        fl = derive_follower_load(ll)
+        self.create_replica(leader_broker, topic, partition, 0, True,
+                            offline=leader_broker in offline)
+        self.set_replica_load(leader_broker, topic, partition, ll, leader_bytes_in)
+        for j, b in enumerate(follower_brokers):
+            self.create_replica(b, topic, partition, j + 1, False, offline=b in offline)
+            self.set_replica_load(b, topic, partition, fl)
+
+    def build(self) -> tuple:
+        """Lower to (ClusterTopology, Assignment)."""
+        B = len(self._brokers)
+        host_names = sorted(self._hosts)
+        host_idx = {h: i for i, h in enumerate(host_names)}
+        rack_idx = {r: i for i, r in enumerate(self._racks)}
+        rack_of_broker = np.array([rack_idx[b["rack"]] for b in self._brokers], dtype=np.int32)
+        host_of_broker = np.array([host_idx[b["host"]] for b in self._brokers], dtype=np.int32)
+        capacity = (np.stack([b["capacity"] for b in self._brokers]).astype(np.float32)
+                    if B else np.zeros((0, res.NUM_RESOURCES), np.float32))
+        broker_ids = np.array([b["id"] for b in self._brokers], dtype=np.int32)
+
+        parts = sorted(self._partitions.values(),
+                       key=lambda d: (self._topic_index[d["topic"]], d["partition"]))
+        P = len(parts)
+        max_rf = max((len(p["replicas"]) for p in parts), default=1)
+        partition_of_replica, broker_of, replica_offline, base_loads = [], [], [], []
+        replicas_of_partition = np.full((P, max_rf), -1, dtype=np.int32)
+        leader_position = np.zeros(P, dtype=np.int64)
+        rf = np.zeros(P, dtype=np.int32)
+        topic_of_partition = np.zeros(P, dtype=np.int32)
+        partition_index = np.zeros(P, dtype=np.int32)
+        leader_extra = np.zeros((P, res.NUM_RESOURCES), dtype=np.float32)
+        leader_bytes_in = np.zeros(P, dtype=np.float32)
+        r = 0
+        for pi, p in enumerate(parts):
+            topic_of_partition[pi] = self._topic_index[p["topic"]]
+            partition_index[pi] = p["partition"]
+            leader_bytes_in[pi] = p.get("leader_bytes_in", 0.0)
+            indices = sorted(p["replicas"])
+            if p["leader_index"] is None:
+                raise ValueError(f"partition ({p['topic']},{p['partition']}) has no leader")
+            rf[pi] = len(indices)
+            for slot, idx in enumerate(indices):
+                rep = p["replicas"][idx]
+                load = rep["load"] if rep["load"] is not None else np.zeros(res.NUM_RESOURCES, np.float32)
+                if idx == p["leader_index"]:
+                    leader_position[pi] = slot
+                    extra = leadership_extra_from_leader_load(load)
+                    leader_extra[pi] = extra
+                    base_loads.append(load - extra)
+                else:
+                    base_loads.append(load)
+                replicas_of_partition[pi, slot] = r
+                partition_of_replica.append(pi)
+                bidx = self._broker_index[rep["broker"]]
+                broker_of.append(bidx)
+                replica_offline.append(rep["offline"] or not self._brokers[bidx]["alive"])
+                r += 1
+
+        topo = ClusterTopology(
+            rack_of_broker=rack_of_broker,
+            host_of_broker=host_of_broker,
+            capacity=capacity,
+            broker_alive=np.array([b["alive"] for b in self._brokers]),
+            broker_new=np.array([b["new"] for b in self._brokers]),
+            broker_demoted=np.array([b["demoted"] for b in self._brokers]),
+            broker_bad_disks=np.array([b["bad_disks"] for b in self._brokers]),
+            partition_of_replica=np.asarray(partition_of_replica, dtype=np.int32),
+            topic_of_partition=topic_of_partition,
+            replicas_of_partition=replicas_of_partition,
+            rf_of_partition=rf,
+            initial_leader_slot=leader_position,
+            replica_offline=np.asarray(replica_offline, dtype=bool),
+            replica_base_load=(np.stack(base_loads).astype(np.float32)
+                               if base_loads else np.zeros((0, res.NUM_RESOURCES), np.float32)),
+            leader_extra=leader_extra,
+            leader_bytes_in=leader_bytes_in,
+            topic_names=tuple(self._topics),
+            partition_index=partition_index,
+            broker_ids=broker_ids,
+            host_names=tuple(host_names),
+            rack_names=tuple(self._racks),
+        )
+        assignment = initial_assignment(topo, np.asarray(broker_of, dtype=np.int32))
+        return topo, assignment

@@ -1,0 +1,303 @@
+"""Test/bench cluster fixtures.
+
+Array-model ports of the reference's fixture generators:
+``DeterministicCluster`` (``cruise-control/src/test/java/.../common/
+DeterministicCluster.java``) and ``RandomCluster``
+(``.../model/RandomCluster.java``), with the same cluster shapes, capacities,
+and load values so goal behavior is comparable case-by-case. Fixtures are part
+of the framework (used by bench + property tests), mirroring how the reference's
+BASELINE configs name these generators.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import numpy as np
+
+from cruise_control_tpu.common import resources as res
+from cruise_control_tpu.common.resources import CPU, DISK, NW_IN, NW_OUT
+from cruise_control_tpu.models.cluster import ClusterModelBuilder
+
+# TestConstants.java:39-42
+LARGE_BROKER_CAPACITY = 300_000.0
+MEDIUM_BROKER_CAPACITY = 200_000.0
+TYPICAL_CPU_CAPACITY = 100.0
+SMALL_BROKER_CAPACITY = 10.0
+
+# TestConstants.BROKER_CAPACITY (TestConstants.java:75-88)
+BROKER_CAPACITY = {
+    CPU: TYPICAL_CPU_CAPACITY,
+    NW_IN: LARGE_BROKER_CAPACITY,
+    NW_OUT: MEDIUM_BROKER_CAPACITY,
+    DISK: LARGE_BROKER_CAPACITY,
+}
+
+# DeterministicCluster.RACK_BY_BROKER (DeterministicCluster.java:27-33):
+# brokers 0,1 on rack 0; broker 2 on rack 1.
+RACK_BY_BROKER = {0: 0, 1: 0, 2: 1}
+
+
+def _homogeneous(rack_by_broker, capacity=None):
+    """DeterministicCluster.getHomogeneousCluster: one host per broker."""
+    b = ClusterModelBuilder()
+    if capacity is None:
+        capacity = BROKER_CAPACITY
+    for broker_id, rack in sorted(rack_by_broker.items()):
+        b.create_broker(f"rack{rack}", f"host{broker_id}", broker_id, capacity)
+    return b
+
+
+def _load(cpu, nw_in, nw_out, disk):
+    """getAggregatedMetricValues argument order (cpu, nwIn, nwOut, disk)."""
+    vec = np.zeros(res.NUM_RESOURCES, dtype=np.float32)
+    vec[CPU], vec[NW_IN], vec[NW_OUT], vec[DISK] = cpu, nw_in, nw_out, disk
+    return vec
+
+
+def small_cluster_model():
+    """DeterministicCluster.smallClusterModel (DeterministicCluster.java:300):
+    3 brokers / 2 racks, topics T1 (2 partitions) and T2 (3), rf=2."""
+    b = _homogeneous(RACK_BY_BROKER)
+    reps = [
+        # (topic, partition, [(broker, index, is_leader, load)...])
+        ("T1", 0, [(0, 0, True, _load(20.0, 100.0, 130.0, 75.0)),
+                   (2, 1, False, _load(5.0, 100.0, 0.0, 75.0))]),
+        ("T1", 1, [(1, 0, True, _load(15.0, 90.0, 110.0, 55.0)),
+                   (0, 1, False, _load(4.5, 90.0, 0.0, 55.0))]),
+        ("T2", 0, [(1, 0, True, _load(5.0, 5.0, 6.0, 5.0)),
+                   (2, 1, False, _load(4.0, 5.0, 0.0, 5.0))]),
+        ("T2", 1, [(0, 0, True, _load(25.0, 25.0, 45.0, 55.0)),
+                   (2, 1, False, _load(10.5, 25.0, 0.0, 55.0))]),
+        ("T2", 2, [(0, 0, True, _load(20.0, 45.0, 120.0, 95.0)),
+                   (1, 1, False, _load(8.0, 45.0, 0.0, 95.0))]),
+    ]
+    for topic, part, replicas in reps:
+        for broker, idx, lead, load in replicas:
+            b.create_replica(broker, topic, part, idx, lead)
+        for broker, idx, lead, load in replicas:
+            b.set_replica_load(broker, topic, part, load)
+    return b.build()
+
+
+def medium_cluster_model():
+    """DeterministicCluster.mediumClusterModel (DeterministicCluster.java:421):
+    3 brokers / 2 racks, topics A(3 parts), B, C, D, rf=2."""
+    b = _homogeneous(RACK_BY_BROKER)
+    reps = [
+        ("A", 0, [(1, 0, True, _load(5.0, 4.0, 10.0, 10.0)),
+                  (0, 1, False, _load(5.0, 5.0, 0.0, 4.0))]),
+        ("A", 1, [(0, 0, True, _load(5.0, 3.0, 10.0, 8.0)),
+                  (2, 1, False, _load(3.0, 4.0, 0.0, 6.0))]),
+        ("A", 2, [(0, 0, True, _load(5.0, 2.0, 10.0, 6.0)),
+                  (2, 1, False, _load(4.0, 5.0, 0.0, 3.0))]),
+        ("B", 0, [(1, 0, True, _load(5.0, 4.0, 10.0, 7.0)),
+                  (2, 1, False, _load(2.0, 2.0, 0.0, 5.0))]),
+        ("C", 0, [(2, 0, True, _load(1.0, 8.0, 10.0, 4.0)),
+                  (1, 1, False, _load(5.0, 6.0, 0.0, 4.0))]),
+        ("D", 0, [(1, 0, True, _load(5.0, 5.0, 10.0, 6.0)),
+                  (2, 1, False, _load(2.0, 8.0, 0.0, 7.0))]),
+    ]
+    for topic, part, replicas in reps:
+        for broker, idx, lead, load in replicas:
+            b.create_replica(broker, topic, part, idx, lead)
+        for broker, idx, lead, load in replicas:
+            b.set_replica_load(broker, topic, part, load)
+    return b.build()
+
+
+def unbalanced():
+    """DeterministicCluster.unbalanced (DeterministicCluster.java:142): both
+    single-replica partitions (T1-0, T2-0) lead on broker 0."""
+    b = _homogeneous(RACK_BY_BROKER)
+    load = _load(TYPICAL_CPU_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2,
+                 MEDIUM_BROKER_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2)
+    for topic in ("T1", "T2"):
+        b.create_replica(0, topic, 0, 0, True)
+        b.set_replica_load(0, topic, 0, load)
+    return b.build()
+
+
+def unbalanced2():
+    """DeterministicCluster.unbalanced2 (:111): unbalanced + four more
+    single-replica partitions, three of them on broker 0."""
+    b = _homogeneous(RACK_BY_BROKER)
+    base = _load(TYPICAL_CPU_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2,
+                 MEDIUM_BROKER_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2)
+    extra = _load(LARGE_BROKER_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2,
+                  MEDIUM_BROKER_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2)
+    for topic in ("T1", "T2"):
+        b.create_replica(0, topic, 0, 0, True)
+        b.set_replica_load(0, topic, 0, base)
+    for broker, topic, part in [(1, "T1", 1), (0, "T2", 1), (0, "T1", 2), (0, "T2", 2)]:
+        b.create_replica(broker, topic, part, 0, True)
+        b.set_replica_load(broker, topic, part, extra)
+    return b.build()
+
+
+def unbalanced3():
+    """DeterministicCluster.unbalanced3 (:76): rf=2, leaders at index 1."""
+    b = _homogeneous(RACK_BY_BROKER)
+    load = _load(TYPICAL_CPU_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2,
+                 MEDIUM_BROKER_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2)
+    for topic in ("T1", "T2"):
+        b.create_replica(1, topic, 0, 0, False)
+        b.create_replica(0, topic, 0, 1, True)
+        b.set_replica_load(0, topic, 0, load)
+        b.set_replica_load(1, topic, 0, load)
+    return b.build()
+
+
+def rack_aware_satisfiable():
+    """DeterministicCluster.rackAwareSatisfiable (:171): one rf=2 partition
+    with both replicas on rack 0 — fixable by one move to rack 1."""
+    b = _homogeneous(RACK_BY_BROKER)
+    b.create_replica(0, "T1", 0, 0, True)
+    b.create_replica(1, "T1", 0, 1, False)
+    b.set_replica_load(0, "T1", 0, _load(40.0, 100.0, 130.0, 75.0))
+    b.set_replica_load(1, "T1", 0, _load(5.0, 100.0, 0.0, 75.0))
+    return b.build()
+
+
+def rack_aware_unsatisfiable():
+    """DeterministicCluster.rackAwareUnsatisfiable (:199): rf=3 over 2 racks."""
+    b = _homogeneous(RACK_BY_BROKER)
+    b.create_replica(0, "T1", 0, 0, True)
+    b.create_replica(1, "T1", 0, 1, False)
+    b.create_replica(2, "T1", 0, 2, False)
+    b.set_replica_load(0, "T1", 0, _load(40.0, 100.0, 130.0, 75.0))
+    b.set_replica_load(1, "T1", 0, _load(5.0, 100.0, 0.0, 75.0))
+    b.set_replica_load(2, "T1", 0, _load(60.0, 100.0, 130.0, 75.0))
+    return b.build()
+
+
+def dead_broker():
+    """DeterministicCluster.deadBroker (:350): 5 brokers / 5 racks, 8 rf=2
+    partitions, broker 0 dead (its replicas offline)."""
+    b = _homogeneous({i: i for i in range(5)})
+    reps = [
+        ("T1", 0, [(1, 0, True, _load(20.0, 100.0, 200.0, 100.0)),
+                   (2, 1, False, _load(15.0, 100.0, 0.0, 100.0))]),
+        ("T1", 1, [(1, 0, True, _load(20.0, 90.0, 180.0, 100.0)),
+                   (3, 1, False, _load(15.0, 90.0, 0.0, 100.0))]),
+        ("T1", 2, [(1, 0, True, _load(15.0, 75.0, 150.0, 100.0)),
+                   (4, 1, False, _load(12.0, 75.0, 0.0, 100.0))]),
+        ("T1", 3, [(2, 0, True, _load(15.0, 60.0, 120.0, 100.0)),
+                   (0, 1, False, _load(12.5, 60.0, 0.0, 100.0))]),
+        ("T2", 0, [(1, 0, True, _load(18.0, 100.0, 200.0, 100.0)),
+                   (2, 1, False, _load(14.0, 100.0, 0.0, 100.0))]),
+        ("T2", 1, [(1, 0, True, _load(18.0, 90.0, 180.0, 100.0)),
+                   (3, 1, False, _load(14.0, 90.0, 0.0, 100.0))]),
+        ("T2", 2, [(1, 0, True, _load(12.0, 75.0, 150.0, 100.0)),
+                   (4, 1, False, _load(10.0, 75.0, 0.0, 100.0))]),
+        ("T2", 3, [(3, 0, True, _load(12.0, 60.0, 120.0, 100.0)),
+                   (0, 1, False, _load(10.5, 60.0, 0.0, 100.0))]),
+    ]
+    b.set_broker_state(0, alive=False)
+    for topic, part, replicas in reps:
+        for broker, idx, lead, load in replicas:
+            b.create_replica(broker, topic, part, idx, lead, offline=(broker == 0))
+        for broker, idx, lead, load in replicas:
+            b.set_replica_load(broker, topic, part, load)
+    return b.build()
+
+
+# ---------------------------------------------------------------------------
+# RandomCluster port (model/RandomCluster.java:36-92, ClusterProperty.java:7-19,
+# TestConstants.java:17-60).
+# ---------------------------------------------------------------------------
+
+
+class Distribution(enum.Enum):
+    UNIFORM = "uniform"
+    LINEAR = "linear"
+    EXPONENTIAL = "exponential"
+
+
+@dataclasses.dataclass
+class ClusterProperties:
+    """ClusterProperty defaults from TestConstants.BASE_PROPERTIES
+    (TestConstants.java:52-66): 10 racks / 40 brokers / 50,001 replicas over
+    3,000 topics at rf=3."""
+
+    num_racks: int = 10
+    num_brokers: int = 40
+    num_dead_brokers: int = 0
+    num_brokers_with_bad_disk: int = 0
+    num_replicas: int = 50_001
+    num_topics: int = 3_000
+    min_replication: int = 3
+    max_replication: int = 3
+    mean_cpu: float = 0.01
+    mean_disk: float = 100.0
+    mean_nw_in: float = 100.0
+    mean_nw_out: float = 100.0
+
+
+def random_cluster(props: ClusterProperties = None, seed: int = 3140,
+                   distribution: Distribution = Distribution.EXPONENTIAL,
+                   capacity=None):
+    """Property-driven random cluster in the spirit of RandomCluster.java.
+
+    Brokers round-robin over racks (one host per broker); topics get random
+    popularity; partition leader loads are drawn per ``distribution`` around
+    the configured means (UNIFORM: ±50%, LINEAR: proportional to index,
+    EXPONENTIAL: exp-distributed), follower loads reference-derived. Returns
+    (topology, assignment).
+    """
+    props = props or ClusterProperties()
+    rng = np.random.default_rng(seed)
+    b = ClusterModelBuilder()
+    if capacity is None:
+        capacity = BROKER_CAPACITY
+    for i in range(props.num_brokers):
+        b.create_broker(f"rack{i % props.num_racks}", f"host{i}", i, capacity)
+    unhealthy = rng.choice(props.num_brokers,
+                           size=props.num_dead_brokers + props.num_brokers_with_bad_disk,
+                           replace=False)
+    dead = set(int(i) for i in unhealthy[:props.num_dead_brokers])
+    for i in dead:
+        b.set_broker_state(i, alive=False)
+    bad_disk = set(int(i) for i in unhealthy[props.num_dead_brokers:])
+    for i in bad_disk:
+        b.set_broker_state(i, bad_disks=True)
+
+    # split replicas into partitions: rf uniform in [min, max]
+    rf = rng.integers(props.min_replication, props.max_replication + 1,
+                      size=props.num_replicas)  # upper bound on partitions
+    cum = np.cumsum(rf)
+    n_parts = int(np.searchsorted(cum, props.num_replicas)) + 1
+    rf = rf[:n_parts]
+    # topic popularity: partitions distributed over topics (some topics big)
+    n_topics = min(props.num_topics, n_parts)
+    popularity = rng.exponential(1.0, size=n_topics)
+    topic_of_part = rng.choice(n_topics, size=n_parts, p=popularity / popularity.sum())
+
+    means = np.zeros(res.NUM_RESOURCES)
+    means[CPU], means[DISK] = props.mean_cpu, props.mean_disk
+    means[NW_IN], means[NW_OUT] = props.mean_nw_in, props.mean_nw_out
+    if distribution is Distribution.UNIFORM:
+        loads = rng.uniform(0.5, 1.5, size=(n_parts, res.NUM_RESOURCES)) * means
+    elif distribution is Distribution.LINEAR:
+        ramp = np.linspace(0.1, 1.9, n_parts)[:, None]
+        loads = ramp * means
+    else:
+        loads = rng.exponential(1.0, size=(n_parts, res.NUM_RESOURCES)) * means
+    loads = loads.astype(np.float32)
+
+    part_counter: dict = {}
+    for pi in range(n_parts):
+        topic = f"topic{topic_of_part[pi]}"
+        pidx = part_counter.get(topic, 0)
+        part_counter[topic] = pidx + 1
+        brokers = rng.choice(props.num_brokers, size=int(rf[pi]), replace=False)
+        lead_load = loads[pi].copy()
+        # Replicas on bad-disk brokers are offline with probability ~1/3,
+        # mirroring markDiskDead-style fixtures.
+        offline = tuple(int(x) for x in brokers
+                        if int(x) in bad_disk and rng.random() < (1 / 3))
+        b.create_partition(topic, pidx, int(brokers[0]), [int(x) for x in brokers[1:]],
+                           lead_load, leader_bytes_in=float(lead_load[NW_IN]),
+                           offline=offline)
+    return b.build()

@@ -1,0 +1,178 @@
+"""ClusterModelStats parity kernel.
+
+Reproduces ``model/ClusterModelStats.java:74-460`` as one jittable function:
+AVG/MAX/MIN/ST_DEV of utilization per resource over alive brokers, potential
+NW_OUT stats, replica / leader-replica / topic-replica count stats, balanced
+broker counts, and scalar counters. Used by goal stats-comparators, the
+REGRESSION check of the optimization verifier, and response builders.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.common import resources as res
+from cruise_control_tpu.common.resources import BalancingConstraint
+from cruise_control_tpu.models.cluster import Assignment
+from cruise_control_tpu.ops.aggregates import (
+    BrokerAggregates,
+    DeviceTopology,
+    broker_resource_utilization,
+    broker_scope_capacity,
+    compute_aggregates,
+)
+
+_BIG = jnp.float32(3.4e38)
+
+
+class ClusterStats(NamedTuple):
+    """Array mirror of ClusterModelStats (ClusterModelStats.java:26-46)."""
+
+    # per-resource [4]: AVG is total-load/numAliveBrokers
+    # (ClusterModelStats.java:304); MAX/MIN are hottest/coldest alive-broker
+    # *absolute* utilization at host scope for host resources (:291-300).
+    resource_avg: jax.Array
+    resource_max: jax.Array
+    resource_min: jax.Array
+    resource_std: jax.Array
+    num_balanced_brokers: jax.Array       # i32[4]
+    # potential nw-out over alive brokers (ClusterModelStats.java:320-348)
+    potential_nw_out_avg: jax.Array
+    potential_nw_out_max: jax.Array
+    potential_nw_out_min: jax.Array
+    potential_nw_out_std: jax.Array
+    num_brokers_under_potential_nw_out: jax.Array
+    # replica count stats (ClusterModelStats.java:353-414): MAX/MIN over all
+    # brokers, AVG/ST_DEV over alive brokers.
+    replica_avg: jax.Array
+    replica_max: jax.Array
+    replica_min: jax.Array
+    replica_std: jax.Array
+    leader_avg: jax.Array
+    leader_max: jax.Array
+    leader_min: jax.Array
+    leader_std: jax.Array
+    # topic replica stats (ClusterModelStats.java:417-460): AVG and ST_DEV are
+    # means over topics; MAX/MIN extrema over (topic, broker).
+    topic_replica_avg: jax.Array
+    topic_replica_max: jax.Array
+    topic_replica_min: jax.Array
+    topic_replica_std: jax.Array
+    # scalars
+    num_partitions_with_offline_replicas: jax.Array
+
+
+def compute_cluster_stats(dt: DeviceTopology, assign: Assignment,
+                          constraint: BalancingConstraint, num_topics: int,
+                          agg: BrokerAggregates | None = None) -> ClusterStats:
+    if agg is None:
+        agg = compute_aggregates(dt, assign, num_topics)
+    alive = dt.broker_alive
+    n_alive = jnp.maximum(jnp.sum(alive.astype(jnp.float32)), 1.0)
+
+    util = broker_resource_utilization(dt, agg)          # [B,4] scoped utilization
+    cap = broker_scope_capacity(dt)                      # [B,4]
+    total_load = jnp.sum(agg.broker_load, axis=0)        # [4]
+    total_capacity = jnp.sum(jnp.where(alive[:, None], dt.capacity, 0.0), axis=0)
+    avg_pct = total_load / total_capacity                # avgUtilizationPercentage
+
+    bal = jnp.asarray(constraint.balance_percentage_array())
+    upper = avg_pct * bal
+    lower = avg_pct * jnp.maximum(0.0, 2.0 - bal)
+    pct = util / cap
+    balanced = (pct >= lower[None, :]) & (pct <= upper[None, :]) & alive[:, None]
+    num_balanced = jnp.sum(balanced.astype(jnp.int32), axis=0)
+
+    res_max = jnp.max(jnp.where(alive[:, None], util, 0.0), axis=0)
+    res_min = jnp.min(jnp.where(alive[:, None], util, _BIG), axis=0)
+    var = jnp.sum(jnp.where(alive[:, None], (util - avg_pct[None, :] * cap) ** 2, 0.0), axis=0)
+    res_std = jnp.sqrt(var / n_alive)
+    res_avg = total_load / n_alive
+
+    # potential NW_OUT (ClusterModelStats.java:320-348)
+    pot = agg.potential_nw_out
+    pot_total = jnp.sum(jnp.where(alive, pot, 0.0))
+    nw_out_cap = total_capacity[res.NW_OUT]
+    pot_avg_pct = pot_total / nw_out_cap
+    cap_thresh = float(constraint.capacity_threshold[res.NW_OUT])
+    b_nw_cap = dt.capacity[:, res.NW_OUT]
+    under = (pot / b_nw_cap <= cap_thresh) & alive
+    pot_var = jnp.sum(jnp.where(alive, (pot - pot_avg_pct * b_nw_cap) ** 2, 0.0))
+
+    def _count_stats(count):
+        cnt = count.astype(jnp.float32)
+        avg = jnp.sum(cnt) / n_alive
+        mx = jnp.max(cnt)
+        mn = jnp.min(cnt)
+        sd = jnp.sqrt(jnp.sum(jnp.where(alive, (cnt - avg) ** 2, 0.0)) / n_alive)
+        return avg, mx, mn, sd
+
+    rep_avg, rep_max, rep_min, rep_std = _count_stats(agg.replica_count)
+    led_avg, led_max, led_min, led_std = _count_stats(agg.leader_count)
+
+    # topic replica stats: per-topic avg & stdev over alive brokers, then
+    # averaged over topics; max/min over all (topic, broker) pairs.
+    tc = agg.topic_count.astype(jnp.float32)             # [B, T]
+    per_topic_total = jnp.sum(tc, axis=0)                # [T]
+    per_topic_avg = per_topic_total / n_alive
+    t_var = jnp.sum(jnp.where(alive[:, None], (tc - per_topic_avg[None, :]) ** 2, 0.0), axis=0) / n_alive
+    topic_avg = jnp.mean(per_topic_avg)
+    topic_std = jnp.mean(jnp.sqrt(t_var))
+    topic_max = jnp.max(tc)
+    topic_min = jnp.min(tc)
+
+    # partitions with offline replicas
+    p_off = jax.ops.segment_max(
+        dt.replica_offline.astype(jnp.int32), dt.partition_of_replica,
+        num_segments=dt.num_partitions)
+    n_off = jnp.sum(p_off)
+
+    return ClusterStats(
+        resource_avg=res_avg, resource_max=res_max, resource_min=res_min,
+        resource_std=res_std, num_balanced_brokers=num_balanced,
+        potential_nw_out_avg=pot_total / n_alive,
+        potential_nw_out_max=jnp.max(jnp.where(alive, pot, 0.0)),
+        potential_nw_out_min=jnp.min(jnp.where(alive, pot, _BIG)),
+        potential_nw_out_std=jnp.sqrt(pot_var / n_alive),
+        num_brokers_under_potential_nw_out=jnp.sum(under.astype(jnp.int32)),
+        replica_avg=rep_avg, replica_max=rep_max, replica_min=rep_min, replica_std=rep_std,
+        leader_avg=led_avg, leader_max=led_max, leader_min=led_min, leader_std=led_std,
+        topic_replica_avg=topic_avg, topic_replica_max=topic_max,
+        topic_replica_min=topic_min, topic_replica_std=topic_std,
+        num_partitions_with_offline_replicas=n_off,
+    )
+
+
+def sanity_check(dt: DeviceTopology, assign: Assignment, num_topics: int) -> dict:
+    """Invariant cross-validation, the analogue of ClusterModel.sanityCheck
+    (ClusterModel.java:1081-1231): load sums agree between replica-level and
+    broker/host/cluster-level aggregation, exactly one leader per partition and
+    it is one of the partition's replicas, every replica's broker is in range.
+
+    Returns a dict of boolean/float diagnostics (host-side friendly).
+    """
+    agg = compute_aggregates(dt, assign, num_topics)
+    p = dt.partition_of_replica
+    eff = dt.replica_base_load + jnp.where(
+        assign.is_leader(p)[:, None], dt.leader_extra[p], 0.0)
+    total_from_replicas = jnp.sum(eff, axis=0)
+    total_from_brokers = jnp.sum(agg.broker_load, axis=0)
+    total_from_hosts = jnp.sum(agg.host_load, axis=0)
+    eps = jnp.maximum(jnp.asarray(res.RESOURCE_EPSILON, jnp.float32),
+                      res.EPSILON_PERCENT * (total_from_replicas + total_from_brokers))
+    leader_part = p[assign.leader_of]
+    leader_valid = jnp.all(leader_part == jnp.arange(dt.num_partitions))
+    brokers_in_range = jnp.all((assign.broker_of >= 0) & (assign.broker_of < dt.num_brokers))
+    count_ok = jnp.sum(agg.replica_count) == dt.num_replicas
+    leader_count_ok = jnp.sum(agg.leader_count) == dt.num_partitions
+    return {
+        "load_broker_consistent": bool(jnp.all(jnp.abs(total_from_replicas - total_from_brokers) <= eps)),
+        "load_host_consistent": bool(jnp.all(jnp.abs(total_from_replicas - total_from_hosts) <= eps)),
+        "one_leader_per_partition": bool(leader_valid),
+        "brokers_in_range": bool(brokers_in_range),
+        "replica_count_consistent": bool(count_ok),
+        "leader_count_consistent": bool(leader_count_ok),
+    }

@@ -1,0 +1,31 @@
+"""Test environment: 8-device virtual CPU mesh.
+
+Sharding/collective paths are exercised on a virtual 8-device CPU mesh; the
+real TPU chip is reserved for bench runs (bench.py). The container's axon
+sitecustomize registers a TPU-tunnel PJRT backend at interpreter startup whose
+client creation dials a remote tunnel — unregister it here so CPU-only tests
+never pay that cost.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+try:
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    # jax was already imported at interpreter startup (sitecustomize), so the
+    # env var alone is too late — update the live config too.
+    jax.config.update("jax_platforms", "cpu")
+    for _plat in ("axon", "tpu"):
+        _xb._backend_factories.pop(_plat, None)
+except Exception:
+    pass
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

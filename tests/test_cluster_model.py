@@ -1,0 +1,147 @@
+"""Array ClusterModel: builder, aggregates, stats, sanity check.
+
+Oracle strategy mirrors the reference's model tests: hand-built deterministic
+fixtures with known loads, cross-checked against straight numpy computation.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cruise_control_tpu.common import resources as res
+from cruise_control_tpu.common.resources import CPU, DISK, NW_IN, NW_OUT, DEFAULT_BALANCING_CONSTRAINT
+from cruise_control_tpu.models import fixtures
+from cruise_control_tpu.models.cluster import Assignment, derive_follower_load
+from cruise_control_tpu.ops.aggregates import (
+    compute_aggregates, device_topology, partition_rack_excess,
+    broker_resource_utilization)
+from cruise_control_tpu.ops.stats import compute_cluster_stats, sanity_check
+
+
+def _numpy_broker_load(topo, assign):
+    is_leader = np.zeros(topo.num_replicas, dtype=bool)
+    is_leader[np.asarray(assign.leader_of)] = True
+    eff = topo.replica_load(is_leader)
+    bl = np.zeros((topo.num_brokers, res.NUM_RESOURCES), dtype=np.float64)
+    np.add.at(bl, np.asarray(assign.broker_of), eff)
+    return bl
+
+
+@pytest.mark.parametrize("fixture", [
+    fixtures.small_cluster_model, fixtures.medium_cluster_model,
+    fixtures.unbalanced, fixtures.unbalanced2, fixtures.unbalanced3,
+    fixtures.rack_aware_satisfiable, fixtures.rack_aware_unsatisfiable,
+    fixtures.dead_broker,
+])
+def test_aggregates_match_numpy_oracle(fixture):
+    topo, assign = fixture()
+    dt = device_topology(topo)
+    agg = compute_aggregates(dt, assign, topo.num_topics)
+    np.testing.assert_allclose(np.asarray(agg.broker_load),
+                               _numpy_broker_load(topo, assign), rtol=1e-5)
+    assert int(jnp.sum(agg.replica_count)) == topo.num_replicas
+    assert int(jnp.sum(agg.leader_count)) == topo.num_partitions
+    checks = sanity_check(dt, assign, topo.num_topics)
+    assert all(checks.values()), checks
+
+
+def test_small_cluster_loads():
+    """Broker loads of smallClusterModel (DeterministicCluster.java:300-336)."""
+    topo, assign = fixtures.small_cluster_model()
+    dt = device_topology(topo)
+    agg = compute_aggregates(dt, assign, topo.num_topics)
+    bl = np.asarray(agg.broker_load)
+    # Broker 0 leads T1-0 (20,100,130,75), T2-1 (25,25,45,55), T2-2
+    # (20,45,120,95) and follows T1-1 (4.5,90,0,55).
+    np.testing.assert_allclose(bl[0], [20 + 25 + 20 + 4.5, 100 + 25 + 45 + 90,
+                                       130 + 45 + 120 + 0, 75 + 55 + 95 + 55], rtol=1e-6)
+    # Broker 1 leads T1-1, T2-0 and follows T2-2.
+    np.testing.assert_allclose(bl[1], [15 + 5 + 8.0, 90 + 5 + 45,
+                                       110 + 6 + 0, 55 + 5 + 95], rtol=1e-6)
+    # replica counts: B0 has 4 replicas, B1 has 3, B2 has 3
+    np.testing.assert_array_equal(np.asarray(agg.replica_count), [4, 3, 3])
+    np.testing.assert_array_equal(np.asarray(agg.leader_count), [3, 2, 0])
+
+
+def test_leadership_relocation_load_delta():
+    """relocateLeadership moves NW_OUT fully + CPU delta (ClusterModel.java:374)."""
+    topo, assign = fixtures.small_cluster_model()
+    dt = device_topology(topo)
+    # T1-0: leader on broker 0 (replica 0), follower on broker 2 (replica 1).
+    new_leader_of = np.asarray(assign.leader_of).copy()
+    new_leader_of[0] = 1
+    moved = Assignment(broker_of=assign.broker_of, leader_of=jnp.asarray(new_leader_of))
+    before = np.asarray(compute_aggregates(dt, assign, topo.num_topics).broker_load)
+    after = np.asarray(compute_aggregates(dt, moved, topo.num_topics).broker_load)
+    delta_b2 = after[2] - before[2]
+    # NW_OUT fully moves: leader had 130.
+    assert delta_b2[NW_OUT] == pytest.approx(130.0, rel=1e-6)
+    # DISK and NW_IN unchanged.
+    assert delta_b2[DISK] == pytest.approx(0.0, abs=1e-4)
+    assert delta_b2[NW_IN] == pytest.approx(0.0, abs=1e-4)
+    # CPU moves by leader delta; broker totals conserve.
+    np.testing.assert_allclose(after.sum(axis=0), before.sum(axis=0), rtol=1e-5)
+
+
+def test_follower_load_derivation():
+    """MonitorUtils.java:66-76 derivation formulas."""
+    leader = np.zeros(4, np.float32)
+    leader[CPU], leader[NW_IN], leader[NW_OUT], leader[DISK] = 10.0, 100.0, 50.0, 500.0
+    foll = derive_follower_load(leader)
+    assert foll[NW_OUT] == 0.0
+    assert foll[NW_IN] == 100.0
+    assert foll[DISK] == 500.0
+    expected_cpu = 10.0 * (0.15 * 100.0) / (0.7 * 100.0 + 0.15 * 50.0)
+    assert foll[CPU] == pytest.approx(expected_cpu, rel=1e-5)
+
+
+def test_rack_excess():
+    topo, assign = fixtures.rack_aware_satisfiable()
+    dt = device_topology(topo)
+    excess = np.asarray(partition_rack_excess(dt, assign.broker_of))
+    assert excess.sum() == 1.0  # both replicas on rack 0
+    topo2, assign2 = fixtures.rack_aware_unsatisfiable()
+    dt2 = device_topology(topo2)
+    excess2 = np.asarray(partition_rack_excess(dt2, assign2.broker_of))
+    assert excess2.sum() == 1.0  # 3 replicas over 2 racks
+
+    topo3, assign3 = fixtures.small_cluster_model()
+    dt3 = device_topology(topo3)
+    # T1-0 on brokers {0,2}: racks {0,1} ok. T1-1 on {1,0}: both rack 0 -> 1.
+    # T2-0 on {1,2}: ok. T2-1 on {0,2}: ok. T2-2 on {0,1}: both rack 0 -> 1.
+    assert np.asarray(partition_rack_excess(dt3, assign3.broker_of)).sum() == 2.0
+
+
+def test_cluster_stats_small():
+    topo, assign = fixtures.small_cluster_model()
+    dt = device_topology(topo)
+    stats = compute_cluster_stats(dt, assign, DEFAULT_BALANCING_CONSTRAINT, topo.num_topics)
+    bl = _numpy_broker_load(topo, assign)
+    # AVG = total / numAliveBrokers (ClusterModelStats.java:304)
+    np.testing.assert_allclose(np.asarray(stats.resource_avg), bl.sum(axis=0) / 3, rtol=1e-5)
+    # DISK is broker-scope: max over brokers' own loads
+    assert float(stats.resource_max[DISK]) == pytest.approx(bl[:, DISK].max(), rel=1e-5)
+    assert float(stats.replica_max) == 4.0
+    assert float(stats.replica_min) == 3.0
+    assert int(stats.num_partitions_with_offline_replicas) == 0
+
+
+def test_dead_broker_offline_partitions():
+    topo, assign = fixtures.dead_broker()
+    dt = device_topology(topo)
+    stats = compute_cluster_stats(dt, assign, DEFAULT_BALANCING_CONSTRAINT, topo.num_topics)
+    # broker 0 holds followers of T1-3 and T2-3
+    assert int(stats.num_partitions_with_offline_replicas) == 2
+
+
+def test_random_cluster_builds_and_checks():
+    props = fixtures.ClusterProperties(num_racks=4, num_brokers=8, num_replicas=600,
+                                       num_topics=20)
+    topo, assign = fixtures.random_cluster(props, seed=7)
+    assert topo.num_replicas == 600 or abs(topo.num_replicas - 600) <= 3
+    dt = device_topology(topo)
+    checks = sanity_check(dt, assign, topo.num_topics)
+    assert all(checks.values()), checks
+    util = np.asarray(broker_resource_utilization(dt, compute_aggregates(dt, assign, topo.num_topics)))
+    assert util.shape == (8, 4)
+    assert (util >= 0).all()
