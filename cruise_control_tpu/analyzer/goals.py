@@ -1,0 +1,529 @@
+"""Goal penalty library — every reference Goal as a jittable penalty term.
+
+The reference expresses each goal as an imperative rebalance procedure plus an
+``actionAcceptance`` veto (``analyzer/goals/Goal.java:38-148``,
+``AbstractGoal.java:68-109``). Here each goal is a *pure function* of the
+cluster state: ``violations`` (how many hard/soft constraint units are broken
+— the number the reference's goal-violation detector would report) and
+``cost`` (a continuous measure of how far out of spec the state is, used to
+drive the stochastic optimizer and to rank states like each goal's
+``ClusterModelStatsComparator``).
+
+Key fact exploited throughout: replica and leadership moves *conserve* total
+cluster load, total replica count, total leader count, and per-topic totals.
+Every threshold the reference computes from averages (balance bands,
+capacity limits, per-topic bands — e.g. ``ResourceDistributionGoal.java:50-56``,
+``ReplicaDistributionAbstractGoal.java:23-27``) is therefore a constant of the
+optimization, precomputed once into :class:`GoalThresholds`. Per-broker cost
+contributions then decompose as sums over brokers, which is what makes the
+annealer's O(1) incremental delta evaluation exact.
+
+Goal inventory and priority order mirror ``config/cruisecontrol.properties:99``
+(default.goals, 15 goals) and ``KafkaCruiseControlConfig.java:1521-1562``
+(goals / hard.goals).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.common import resources as res
+from cruise_control_tpu.common.resources import BalancingConstraint
+from cruise_control_tpu.models.cluster import Assignment, ClusterTopology
+from cruise_control_tpu.ops.aggregates import (
+    BrokerAggregates,
+    DeviceTopology,
+    compute_aggregates,
+    partition_rack_excess,
+)
+
+# ---------------------------------------------------------------------------
+# Goal registry (names match the reference's class simple names).
+# ---------------------------------------------------------------------------
+
+#: goals config order (KafkaCruiseControlConfig.java:1521-1544)
+DEFAULT_GOALS = (
+    "RackAwareGoal",
+    "ReplicaCapacityGoal",
+    "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal",
+    "NetworkOutboundCapacityGoal",
+    "CpuCapacityGoal",
+    "ReplicaDistributionGoal",
+    "PotentialNwOutGoal",
+    "DiskUsageDistributionGoal",
+    "NetworkInboundUsageDistributionGoal",
+    "NetworkOutboundUsageDistributionGoal",
+    "CpuUsageDistributionGoal",
+    "TopicReplicaDistributionGoal",
+    "LeaderReplicaDistributionGoal",
+    "LeaderBytesInDistributionGoal",
+)
+
+#: hard.goals (KafkaCruiseControlConfig.java:1552-1560)
+HARD_GOALS = frozenset({
+    "RackAwareGoal",
+    "ReplicaCapacityGoal",
+    "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal",
+    "NetworkOutboundCapacityGoal",
+    "CpuCapacityGoal",
+})
+
+#: anomaly.detection.goals (cruisecontrol.properties:214)
+ANOMALY_DETECTION_GOALS = tuple(g for g in DEFAULT_GOALS if g in HARD_GOALS)
+
+#: extra goals supported on request (goals config tail)
+EXTRA_GOALS = ("PreferredLeaderElectionGoal",)
+
+ALL_GOALS = DEFAULT_GOALS + EXTRA_GOALS
+
+_CAPACITY_GOAL_RESOURCE = {
+    "DiskCapacityGoal": res.DISK,
+    "NetworkInboundCapacityGoal": res.NW_IN,
+    "NetworkOutboundCapacityGoal": res.NW_OUT,
+    "CpuCapacityGoal": res.CPU,
+}
+_DISTRIBUTION_GOAL_RESOURCE = {
+    "DiskUsageDistributionGoal": res.DISK,
+    "NetworkInboundUsageDistributionGoal": res.NW_IN,
+    "NetworkOutboundUsageDistributionGoal": res.NW_OUT,
+    "CpuUsageDistributionGoal": res.CPU,
+}
+
+
+def is_hard(goal: str) -> bool:
+    return goal in HARD_GOALS
+
+
+# ---------------------------------------------------------------------------
+# Optimization options → device masks
+# (analyzer/OptimizationOptions.java:14-21 lowered to arrays)
+# ---------------------------------------------------------------------------
+
+
+class DeviceOptions(NamedTuple):
+    """Array form of OptimizationOptions, consumed by penalties + move sampling."""
+
+    replica_movable: jax.Array        # bool[R] may relocate (excluded topics pinned
+                                      # unless offline; immigrant-only mode)
+    leadership_movable: jax.Array     # bool[R] replica may gain/lose leadership
+    move_dest_ok: jax.Array           # bool[B] may receive replicas
+    leader_dest_ok: jax.Array         # bool[B] may receive leadership
+
+
+def build_options(
+    topo: ClusterTopology,
+    excluded_topics: Sequence[str] = (),
+    excluded_brokers_for_leadership: Sequence[int] = (),
+    excluded_brokers_for_replica_move: Sequence[int] = (),
+    requested_destination_broker_ids: Sequence[int] = (),
+    only_move_immigrant_replicas: bool = False,
+) -> DeviceOptions:
+    """Lower OptimizationOptions semantics to masks.
+
+    - Excluded topics' replicas stay put unless offline (the reference still
+      self-heals them off dead brokers/disks: ``GoalUtils.java`` eligibility).
+    - Excluded brokers for replica move / leadership cannot *receive* replicas
+      / leadership but their existing load may move away.
+    - ``requested_destination_broker_ids`` restricts move destinations (the
+      add-broker path).
+    - Immigrant-only: only replicas whose current broker differs from the
+      original placement may move — at the start of an optimization nothing
+      is immigrant, so only offline replicas move (self-healing semantics).
+    """
+    topic_ids = {t: i for i, t in enumerate(topo.topic_names)}
+    excluded_tids = np.array(
+        sorted(topic_ids[t] for t in excluded_topics if t in topic_ids), dtype=np.int32)
+    replica_topics = topo.topic_of_partition[topo.partition_of_replica]
+    excluded_replica = np.isin(replica_topics, excluded_tids)
+    movable = ~excluded_replica | topo.replica_offline
+    if only_move_immigrant_replicas:
+        movable = movable & topo.replica_offline
+
+    id_to_idx = {int(b): i for i, b in enumerate(
+        topo.broker_ids if topo.broker_ids is not None else np.arange(topo.num_brokers))}
+    B = topo.num_brokers
+    move_dest = np.asarray(topo.broker_alive).copy()
+    for b in excluded_brokers_for_replica_move:
+        if b in id_to_idx:
+            move_dest[id_to_idx[b]] = False
+    if requested_destination_broker_ids:
+        req = np.zeros(B, dtype=bool)
+        for b in requested_destination_broker_ids:
+            if b in id_to_idx:
+                req[id_to_idx[b]] = True
+        move_dest &= req
+    # NEW brokers are always eligible destinations; demoted/bad-disk brokers
+    # keep replica eligibility but demoted brokers must not receive leadership.
+    leader_dest = np.asarray(topo.broker_alive) & ~np.asarray(topo.broker_demoted)
+    for b in excluded_brokers_for_leadership:
+        if b in id_to_idx:
+            leader_dest[id_to_idx[b]] = False
+    leadership_movable = ~excluded_replica | topo.replica_offline
+    return DeviceOptions(
+        replica_movable=jnp.asarray(movable),
+        leadership_movable=jnp.asarray(leadership_movable),
+        move_dest_ok=jnp.asarray(move_dest),
+        leader_dest_ok=jnp.asarray(leader_dest),
+    )
+
+
+def default_options(topo: ClusterTopology) -> DeviceOptions:
+    return build_options(topo)
+
+
+# ---------------------------------------------------------------------------
+# Thresholds: every constant of the optimization, computed once.
+# ---------------------------------------------------------------------------
+
+
+class GoalThresholds(NamedTuple):
+    alive: jax.Array                  # bool[B]
+    n_alive: jax.Array                # f32 scalar
+    broker_capacity: jax.Array        # f32[B,4]
+    # CapacityGoal: utilization limit = capacity * capacity_threshold
+    # (goals/CapacityGoal.java:38-42); host scope for CPU/NW, broker for DISK/CPU.
+    cap_limit_broker: jax.Array       # f32[B,4]
+    cap_limit_host: jax.Array         # f32[H,4]
+    # ResourceDistributionGoal band on broker utilization *percentage*
+    # around avgUtilizationPercentage (ResourceDistributionGoal.java:50-56).
+    dist_upper_pct: jax.Array         # f32[4]
+    dist_lower_pct: jax.Array         # f32[4]
+    low_util: jax.Array               # bool[4] whole-resource low-utilization short-circuit
+    # Replica-count bands (ReplicaDistributionAbstractGoal.java:23-27).
+    replica_upper: jax.Array          # f32 scalar
+    replica_lower: jax.Array
+    leader_upper: jax.Array
+    leader_lower: jax.Array
+    topic_upper: jax.Array            # f32[T]
+    topic_lower: jax.Array            # f32[T]
+    max_replicas_per_broker: jax.Array  # f32 scalar (ReplicaCapacityGoal.java:41)
+    # PotentialNwOutGoal limit per broker (PotentialNwOutGoal.java:37-42).
+    pot_nw_out_limit: jax.Array       # f32[B]
+    # LeaderBytesInDistributionGoal threshold (LeaderBytesInDistributionGoal.java:39-43):
+    # brokers above avg*balance% of leader bytes-in are overloaded.
+    lbi_upper: jax.Array              # f32 scalar
+
+
+def compute_thresholds(dt: DeviceTopology, constraint: BalancingConstraint,
+                       initial: BrokerAggregates) -> GoalThresholds:
+    """Precompute all goal constants from the initial aggregates.
+
+    Totals are move-invariant, so these are exact for the whole optimization.
+    """
+    alive = dt.broker_alive
+    alive_f = alive.astype(jnp.float32)
+    n_alive = jnp.maximum(jnp.sum(alive_f), 1.0)
+    cap_thresh = jnp.asarray(constraint.capacity_threshold_array())
+    total_load = jnp.sum(initial.broker_load, axis=0)          # [4]
+    total_cap = jnp.sum(dt.capacity * alive_f[:, None], axis=0)
+    avg_pct = total_load / jnp.maximum(total_cap, 1e-30)
+
+    bal = jnp.asarray(constraint.balance_percentage_array())
+    dist_upper = avg_pct * bal
+    dist_lower = avg_pct * jnp.maximum(0.0, 2.0 - bal)
+    low_util = avg_pct < jnp.asarray(constraint.low_utilization_threshold_array())
+
+    n_replicas = jnp.sum(initial.replica_count).astype(jnp.float32)
+    n_parts = jnp.float32(dt.num_partitions)
+    rep_avg = n_replicas / n_alive
+    led_avg = n_parts / n_alive
+    rp = jnp.float32(constraint.replica_balance_percentage)
+    lp = jnp.float32(constraint.leader_replica_balance_percentage)
+    tp = jnp.float32(constraint.topic_replica_balance_percentage)
+    topic_total = jnp.sum(initial.topic_count, axis=0).astype(jnp.float32)  # [T]
+    topic_avg = topic_total / n_alive
+
+    host_cap = dt.host_capacity
+    pot_limit = dt.capacity[:, res.NW_OUT] * cap_thresh[res.NW_OUT]
+    lbi_total = jnp.sum(jnp.where(alive, initial.leader_bytes_in, 0.0))
+    lbi_avg = lbi_total / n_alive
+
+    return GoalThresholds(
+        alive=alive,
+        n_alive=n_alive,
+        broker_capacity=dt.capacity,
+        cap_limit_broker=dt.capacity * cap_thresh[None, :],
+        cap_limit_host=host_cap * cap_thresh[None, :],
+        dist_upper_pct=dist_upper,
+        dist_lower_pct=dist_lower,
+        low_util=low_util,
+        replica_upper=jnp.ceil(rep_avg * rp),
+        replica_lower=jnp.floor(rep_avg * jnp.maximum(0.0, 2.0 - rp)),
+        leader_upper=jnp.ceil(led_avg * lp),
+        leader_lower=jnp.floor(led_avg * jnp.maximum(0.0, 2.0 - lp)),
+        topic_upper=jnp.ceil(topic_avg * tp),
+        topic_lower=jnp.floor(topic_avg * jnp.maximum(0.0, 2.0 - tp)),
+        max_replicas_per_broker=jnp.float32(constraint.max_replicas_per_broker),
+        pot_nw_out_limit=pot_limit,
+        # LeaderBytesInDistributionGoal reuses the NW_IN balance percentage.
+        lbi_upper=lbi_avg * bal[res.NW_IN],
+    )
+
+
+# ---------------------------------------------------------------------------
+# Per-broker decomposed cost terms (shared by full eval and SA deltas).
+# ---------------------------------------------------------------------------
+
+
+class BrokerTerms(NamedTuple):
+    """Per-broker (violations, cost) contributions for the decomposable goals.
+
+    Shapes: violations i32/f32[B, G_b], cost f32[B, G_b] where the per-broker
+    goal columns are ordered by :data:`BROKER_TERM_GOALS`.
+    """
+
+    violations: jax.Array
+    cost: jax.Array
+
+
+#: decomposable-as-sum-over-brokers goals, column order of BrokerTerms
+BROKER_TERM_GOALS = (
+    "ReplicaCapacityGoal",
+    "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal",
+    "NetworkOutboundCapacityGoal",
+    "CpuCapacityGoal",
+    "ReplicaDistributionGoal",
+    "PotentialNwOutGoal",
+    "DiskUsageDistributionGoal",
+    "NetworkInboundUsageDistributionGoal",
+    "NetworkOutboundUsageDistributionGoal",
+    "CpuUsageDistributionGoal",
+    "LeaderReplicaDistributionGoal",
+    "LeaderBytesInDistributionGoal",
+    "_DeadBrokerPlacement",           # internal hard term: replicas must leave
+                                      # dead brokers (self-healing eligibility,
+                                      # GoalUtils.legitMove dest-alive check)
+)
+_BT = {g: i for i, g in enumerate(BROKER_TERM_GOALS)}
+NUM_BROKER_TERMS = len(BROKER_TERM_GOALS)
+
+
+def broker_terms(th: GoalThresholds, broker_load: jax.Array,
+                 replica_count: jax.Array, leader_count: jax.Array,
+                 potential_nw_out: jax.Array,
+                 leader_bytes_in: jax.Array) -> BrokerTerms:
+    """Per-broker contributions; every argument is per-broker ([B,...] or,
+    under vmap for a single broker, scalar rows).
+
+    Capacity goals contribute only their *broker-scope* part here (CPU, DISK
+    per Resource.java:17-21); the host-scope part of CPU/NW_IN/NW_OUT is
+    evaluated per host by :func:`host_terms` so multi-broker hosts are counted
+    exactly once.
+    """
+    alive_f = th.alive.astype(jnp.float32)
+
+    viol = [None] * NUM_BROKER_TERMS
+    cost = [None] * NUM_BROKER_TERMS
+
+    # -- ReplicaCapacityGoal (hard): count ≤ max.replicas.per.broker;
+    # dead brokers must hold 0 replicas (handled by _DeadBrokerPlacement).
+    rc = replica_count.astype(jnp.float32)
+    over = jnp.maximum(rc - th.max_replicas_per_broker, 0.0) * alive_f
+    viol[_BT["ReplicaCapacityGoal"]] = (over > 0).astype(jnp.float32)
+    cost[_BT["ReplicaCapacityGoal"]] = over / jnp.maximum(th.max_replicas_per_broker, 1.0)
+
+    # -- CapacityGoals (hard), broker-scope part only
+    # (CapacityGoal.java:38-42, Resource.java:17-21).
+    for goal, r in _CAPACITY_GOAL_RESOURCE.items():
+        lim_b = th.cap_limit_broker[..., r]
+        if res.IS_BROKER_RESOURCE[r]:
+            over_b = jnp.maximum(broker_load[..., r] - lim_b, 0.0) * alive_f
+        else:
+            over_b = jnp.zeros_like(lim_b)
+        viol[_BT[goal]] = (over_b > 0).astype(jnp.float32)
+        cost[_BT[goal]] = over_b / jnp.maximum(lim_b, 1e-30)
+
+    # -- ResourceDistributionGoals (soft): broker utilization pct within
+    # [avg·(2−B), avg·B] (ResourceDistributionGoal.java:50-56); low-utilization
+    # short-circuit zeroes the term.
+    pct = broker_load / jnp.maximum(th.broker_capacity, 1e-30)   # [...,4]
+    over_u = jnp.maximum(pct - th.dist_upper_pct, 0.0)
+    under_l = jnp.maximum(th.dist_lower_pct - pct, 0.0)
+    out = (over_u + under_l) * alive_f[..., None]
+    out = jnp.where(th.low_util, 0.0, out)
+    for goal, r in _DISTRIBUTION_GOAL_RESOURCE.items():
+        viol[_BT[goal]] = (out[..., r] > 1e-9).astype(jnp.float32)
+        cost[_BT[goal]] = out[..., r] / jnp.maximum(th.dist_upper_pct[r], 1e-30)
+
+    # -- ReplicaDistributionGoal / LeaderReplicaDistributionGoal (soft).
+    for goal, cnt, hi, lo in (
+            ("ReplicaDistributionGoal", rc, th.replica_upper, th.replica_lower),
+            ("LeaderReplicaDistributionGoal", leader_count.astype(jnp.float32),
+             th.leader_upper, th.leader_lower)):
+        out_c = (jnp.maximum(cnt - hi, 0.0) + jnp.maximum(lo - cnt, 0.0)) * alive_f
+        viol[_BT[goal]] = (out_c > 0).astype(jnp.float32)
+        cost[_BT[goal]] = out_c / jnp.maximum(hi, 1.0)
+
+    # -- PotentialNwOutGoal (soft): potential NW_OUT ≤ capacity·threshold.
+    pot_over = jnp.maximum(potential_nw_out - th.pot_nw_out_limit, 0.0) * alive_f
+    viol[_BT["PotentialNwOutGoal"]] = (pot_over > 0).astype(jnp.float32)
+    cost[_BT["PotentialNwOutGoal"]] = pot_over / jnp.maximum(th.pot_nw_out_limit, 1e-30)
+
+    # -- LeaderBytesInDistributionGoal (soft): leader bytes-in ≤ avg·balance%.
+    lbi_over = jnp.maximum(leader_bytes_in - th.lbi_upper, 0.0) * alive_f
+    viol[_BT["LeaderBytesInDistributionGoal"]] = (lbi_over > 0).astype(jnp.float32)
+    cost[_BT["LeaderBytesInDistributionGoal"]] = lbi_over / jnp.maximum(th.lbi_upper, 1e-30)
+
+    # -- _DeadBrokerPlacement (hard, internal): any replica on a dead broker.
+    dead_cnt = rc * (1.0 - alive_f)
+    viol[_BT["_DeadBrokerPlacement"]] = dead_cnt
+    cost[_BT["_DeadBrokerPlacement"]] = dead_cnt
+
+    return BrokerTerms(
+        violations=jnp.stack(viol, axis=-1),
+        cost=jnp.stack(cost, axis=-1),
+    )
+
+
+#: host-scope capacity columns, order of host_terms output
+HOST_TERM_GOALS = ("CpuCapacityGoal", "NetworkInboundCapacityGoal",
+                   "NetworkOutboundCapacityGoal")
+_HOST_TERM_RESOURCES = (res.CPU, res.NW_IN, res.NW_OUT)
+
+
+def host_terms(th: GoalThresholds, host_load: jax.Array):
+    """Host-scope capacity overage, one row per host ([H, 3] viol/cost).
+
+    A host whose brokers are all dead has zero capacity (host capacity sums
+    alive brokers, ClusterModel DEAD handling); any load still on it is a
+    violation — which is what self-healing wants.
+    """
+    lim = th.cap_limit_host[..., _HOST_TERM_RESOURCES]
+    u = host_load[..., _HOST_TERM_RESOURCES]
+    over = jnp.maximum(u - lim, 0.0)
+    return (over > 0).astype(jnp.float32), over / jnp.maximum(lim, 1e-30)
+
+
+# ---------------------------------------------------------------------------
+# Full-state evaluation: all goals at once.
+# ---------------------------------------------------------------------------
+
+
+class GoalPenalties(NamedTuple):
+    """Per-goal totals, aligned with the ``goal_names`` passed to the eval."""
+
+    violations: jax.Array  # f32[G]
+    cost: jax.Array        # f32[G]
+
+
+def topic_distribution_penalty(topic_count: jax.Array, th: GoalThresholds):
+    """TopicReplicaDistributionGoal (goals/TopicReplicaDistributionGoal.java:45-55):
+    per-(topic, broker) replica counts within the per-topic band.
+    ``topic_count`` is the [B, T] histogram from BrokerAggregates (the
+    annealer uses a CSR-windowed delta instead)."""
+    counts = topic_count.astype(jnp.float32)
+    alive_f = th.alive.astype(jnp.float32)[:, None]
+    out = (jnp.maximum(counts - th.topic_upper[None, :], 0.0)
+           + jnp.maximum(th.topic_lower[None, :] - counts, 0.0)) * alive_f
+    violations = jnp.sum((out > 0).astype(jnp.float32))
+    cost = jnp.sum(out / jnp.maximum(th.topic_upper[None, :], 1.0))
+    return violations, cost
+
+
+def rack_aware_penalty(dt: DeviceTopology, broker_of: jax.Array):
+    """RackAwareGoal (goals/RackAwareGoal.java:161-259): replicas of a
+    partition beyond one per rack."""
+    excess = partition_rack_excess(dt, broker_of)
+    total = jnp.sum(excess)
+    return total, total
+
+
+def preferred_leader_penalty(dt: DeviceTopology, assign: Assignment):
+    """PreferredLeaderElectionGoal (goals/PreferredLeaderElectionGoal.java:31):
+    leadership should sit on the replica-list head."""
+    first = dt.replicas_of_partition[:, 0]
+    mism = jnp.sum((assign.leader_of != first).astype(jnp.float32))
+    return mism, mism
+
+
+def full_goal_penalties(dt: DeviceTopology, assign: Assignment,
+                        th: GoalThresholds, num_topics: int,
+                        goal_names: Sequence[str],
+                        initial_broker_of: Optional[jax.Array] = None,
+                        agg: Optional[BrokerAggregates] = None) -> GoalPenalties:
+    """Evaluate every requested goal on a full state. jit/vmap-safe."""
+    if agg is None:
+        agg = compute_aggregates(dt, assign, num_topics)
+    bt = broker_terms(
+        th,
+        agg.broker_load,
+        agg.replica_count,
+        agg.leader_count,
+        agg.potential_nw_out,
+        agg.leader_bytes_in,
+    )
+    per_goal_viol = jnp.sum(bt.violations, axis=0)
+    per_goal_cost = jnp.sum(bt.cost, axis=0)
+    h_viol, h_cost = host_terms(th, agg.host_load)      # [H, 3]
+    host_viol = jnp.sum(h_viol, axis=0)
+    host_cost = jnp.sum(h_cost, axis=0)
+    host_col = {g: i for i, g in enumerate(HOST_TERM_GOALS)}
+
+    viols, costs = [], []
+    for g in goal_names:
+        if g == "RackAwareGoal":
+            v, c = rack_aware_penalty(dt, assign.broker_of)
+        elif g == "TopicReplicaDistributionGoal":
+            v, c = topic_distribution_penalty(agg.topic_count, th)
+        elif g == "PreferredLeaderElectionGoal":
+            v, c = preferred_leader_penalty(dt, assign)
+        elif g in _BT:
+            v, c = per_goal_viol[_BT[g]], per_goal_cost[_BT[g]]
+            if g in host_col:
+                v = v + host_viol[host_col[g]]
+                c = c + host_cost[host_col[g]]
+        else:
+            raise ValueError(f"unknown goal {g}")
+        viols.append(v)
+        costs.append(c)
+    # self-healing: offline replicas still on their original broker are hard
+    # violations folded into _DeadBrokerPlacement accounting.
+    dead = per_goal_viol[_BT["_DeadBrokerPlacement"]]
+    if initial_broker_of is not None:
+        # dead-disk replicas on *alive* brokers must also leave their original
+        # broker; dead-broker occupancy is already counted above.
+        unmoved_off = jnp.sum(
+            (dt.replica_offline & (assign.broker_of == initial_broker_of)
+             & dt.broker_alive[assign.broker_of]).astype(jnp.float32))
+        dead = dead + unmoved_off
+    viols.append(dead)
+    costs.append(per_goal_cost[_BT["_DeadBrokerPlacement"]]
+                 + (dead - per_goal_viol[_BT["_DeadBrokerPlacement"]]))
+    return GoalPenalties(violations=jnp.stack(viols), cost=jnp.stack(costs))
+
+
+# The trailing synthetic term appended by full_goal_penalties:
+SELF_HEALING_TERM = "_SelfHealingPlacement"
+
+
+def goal_weights(goal_names: Sequence[str], hard_weight: float = 1e7,
+                 soft_base: float = 2.0) -> np.ndarray:
+    """Lexicographic-approximating weights: hard goals get ``hard_weight``;
+    soft goals geometric by priority (earlier = heavier), mirroring the
+    sequential veto order of GoalOptimizer (GoalOptimizer.java:429) and the
+    priority weights of the balancedness score (KafkaCruiseControlUtils.java:530).
+    The appended self-healing term is hard."""
+    soft_rank = 0
+    n_soft = sum(1 for g in goal_names if not is_hard(g))
+    w = []
+    for g in goal_names:
+        if is_hard(g):
+            w.append(hard_weight)
+        else:
+            w.append(float(soft_base ** (n_soft - 1 - soft_rank)))
+            soft_rank += 1
+    w.append(hard_weight)  # _SelfHealingPlacement
+    return np.asarray(w, dtype=np.float32)
+
+
+def scalar_objective(pen: GoalPenalties, weights: jax.Array) -> jax.Array:
+    """Single scalar the annealer minimizes: weighted cost, with violations
+    of hard terms already dominating through their weights."""
+    return jnp.sum(pen.cost * weights)
