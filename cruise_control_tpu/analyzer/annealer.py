@@ -1,0 +1,442 @@
+"""Parallel-tempering annealer: the TPU-scale optimizer engine.
+
+Replaces the reference's single-threaded heuristic sweep
+(``GoalOptimizer.java:429`` × ``AbstractGoal.java:81-86``) with thousands of
+Metropolis chains exploring batched replica-move / leadership-move actions
+(mirroring ``ActionType``: INTER_BROKER_REPLICA_MOVEMENT,
+LEADERSHIP_MOVEMENT) over the weighted goal objective — the BASELINE.json
+north-star design.
+
+Architecture (all shapes static, everything inside one jit):
+
+- Each chain carries the assignment plus *running aggregates* (per-broker
+  load/counts, per-host load, optional dense per-(broker,topic) counts) so a
+  proposed action's objective delta is O(max_rf) — independent of R and B.
+  Total load/counts are move-invariant, so goal thresholds are constants
+  (:mod:`goals`) and per-broker costs decompose exactly.
+- Multi-try Metropolis: each step draws ``tries_move`` candidate replica
+  moves and ``tries_lead`` leadership moves, takes the best delta, and
+  accepts it at the chain's temperature. Rejected/no-op steps apply a
+  degenerate scatter (src == dst) so control flow stays vmappable.
+- Parallel tempering: chains sit on a geometric temperature ladder; every
+  ``swap_interval`` steps adjacent chains exchange *temperatures* with the
+  usual PT acceptance, letting hot explorers hand good states down to cold
+  exploiters.
+- The final answer is the best chain re-scored with the exact full
+  evaluation (:func:`objective.evaluate_objective`), so incremental float
+  drift can never corrupt the reported result.
+
+Sharding: chains are embarrassingly parallel — `optimize_anneal` accepts a
+``jax.sharding.Mesh`` and shards the chain axis with pjit; see
+``parallel/sharding.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.analyzer import goals as G
+from cruise_control_tpu.analyzer import objective as OBJ
+from cruise_control_tpu.common import resources as res
+from cruise_control_tpu.models.cluster import Assignment
+from cruise_control_tpu.ops.aggregates import DeviceTopology, compute_aggregates
+
+_INF = jnp.float32(3.0e38)
+
+
+@dataclasses.dataclass(frozen=True)
+class AnnealConfig:
+    num_chains: int = 64
+    steps: int = 4096
+    swap_interval: int = 64
+    tries_move: int = 4
+    tries_lead: int = 2
+    t_min: float = 1e-3
+    t_max: float = 64.0
+    #: include the dense [B,T] topic-count aggregate (memory B·T per chain)
+    topic_term_limit: int = 2_000_000
+    #: greedy-at-T≈0 fraction of chains (pure descent)
+    cold_fraction: float = 0.25
+
+
+class ChainState(NamedTuple):
+    broker_of: jax.Array         # i32[R]
+    leader_of: jax.Array         # i32[P]
+    broker_load: jax.Array       # f32[B,4]
+    host_load: jax.Array         # f32[H,4]
+    replica_count: jax.Array     # f32[B]
+    leader_count: jax.Array      # f32[B]
+    potential_nw_out: jax.Array  # f32[B]
+    leader_bytes_in: jax.Array   # f32[B]
+    topic_count: jax.Array       # f32[B,T] or f32[1,1] when disabled
+    energy: jax.Array            # f32 — incremental objective estimate
+
+
+class AnnealResult(NamedTuple):
+    assignment: Assignment
+    energy: jax.Array
+    chain_energies: jax.Array
+
+
+_band_cost = G.band_cost
+
+
+def _chain_energy(dt: DeviceTopology, th: G.GoalThresholds,
+                  w: OBJ.ObjectiveWeights, st: ChainState,
+                  initial_broker_of: jax.Array, use_topic: bool) -> jax.Array:
+    """Decomposed objective from the running aggregates (init/rescore)."""
+    f = OBJ.broker_cost(th, w, st.broker_load, st.replica_count,
+                        st.leader_count, st.potential_nw_out, st.leader_bytes_in)
+    h = OBJ.host_cost(th, w, st.host_load)
+    e = jnp.sum(f) + jnp.sum(h)
+    from cruise_control_tpu.ops.aggregates import partition_rack_excess
+    e = e + w.rack * jnp.sum(partition_rack_excess(dt, st.broker_of))
+    if use_topic:
+        alive_f = th.alive.astype(jnp.float32)[:, None]
+        out = (_band_cost(st.topic_count, th.topic_upper[None, :],
+                          th.topic_lower[None, :]) * alive_f)
+        e = e + w.topic * jnp.sum(out)
+    unhealed = jnp.sum((dt.replica_offline
+                        & (st.broker_of == initial_broker_of)
+                        & dt.broker_alive[st.broker_of]).astype(jnp.float32))
+    return e + w.healing * unhealed
+
+
+def _move_delta(dt: DeviceTopology, th: G.GoalThresholds, w: OBJ.ObjectiveWeights,
+                opts: G.DeviceOptions, st: ChainState,
+                initial_broker_of: jax.Array, use_topic: bool,
+                r: jax.Array, b: jax.Array) -> jax.Array:
+    """Objective delta of moving replica r to broker b. O(max_rf)."""
+    p = dt.partition_of_replica[r]
+    a = st.broker_of[r]
+    is_leader = st.leader_of[p] == r
+    eff = dt.replica_base_load[r] + jnp.where(is_leader, dt.leader_extra[p],
+                                              jnp.zeros(res.NUM_RESOURCES))
+    pl = (dt.leader_extra[p, res.NW_OUT]
+          + dt.replica_base_load[st.leader_of[p], res.NW_OUT])
+    lbi = jnp.where(is_leader, dt.leader_bytes_in[p], 0.0)
+    lead_f = is_leader.astype(jnp.float32)
+
+    ab = jnp.stack([a, b])
+    th_ab = OBJ.gather_thresholds(th, ab)
+    f0 = OBJ.broker_cost(th_ab, w, st.broker_load[ab], st.replica_count[ab],
+                         st.leader_count[ab], st.potential_nw_out[ab],
+                         st.leader_bytes_in[ab])
+    sgn = jnp.array([-1.0, 1.0])
+    f1 = OBJ.broker_cost(
+        th_ab, w,
+        st.broker_load[ab] + sgn[:, None] * eff[None, :],
+        st.replica_count[ab] + sgn,
+        st.leader_count[ab] + sgn * lead_f,
+        st.potential_nw_out[ab] + sgn * pl,
+        st.leader_bytes_in[ab] + sgn * lbi,
+    )
+    delta = jnp.sum(f1 - f0)
+
+    ha, hb = dt.host_of_broker[a], dt.host_of_broker[b]
+    hab = jnp.stack([ha, hb])
+    th_h = OBJ.gather_host_thresholds(th, hab)
+    h0 = OBJ.host_cost(th_h, w, st.host_load[hab])
+    h1 = OBJ.host_cost(th_h, w, st.host_load[hab] + sgn[:, None] * eff[None, :])
+    delta = delta + jnp.where(ha != hb, jnp.sum(h1 - h0), 0.0)
+
+    # rack: Δexcess = occ(dest rack) − occ(src rack) over the *other* replicas
+    reps = dt.replicas_of_partition[p]                      # [m]
+    valid_sib = (reps >= 0) & (reps != r)
+    sib_rack = dt.rack_of_broker[st.broker_of[jnp.clip(reps, 0)]]
+    occ_a = jnp.any(valid_sib & (sib_rack == dt.rack_of_broker[a]))
+    occ_b = jnp.any(valid_sib & (sib_rack == dt.rack_of_broker[b]))
+    delta = delta + w.rack * (occ_b.astype(jnp.float32) - occ_a.astype(jnp.float32))
+
+    if use_topic:
+        t = dt.topic_of_partition[p]
+        n_a, n_b = st.topic_count[a, t], st.topic_count[b, t]
+        u, l = th.topic_upper[t], th.topic_lower[t]
+        delta = delta + w.topic * (
+            _band_cost(n_a - 1.0, u, l) - _band_cost(n_a, u, l)
+            + _band_cost(n_b + 1.0, u, l) - _band_cost(n_b, u, l))
+
+    on_init = a == initial_broker_of[r]
+    heals = dt.replica_offline[r] & on_init & dt.broker_alive[a]
+    back = dt.replica_offline[r] & (b == initial_broker_of[r])
+    delta = delta + w.healing * (back.astype(jnp.float32) - heals.astype(jnp.float32))
+
+    # legality: no duplicate replica of p on b; eligible dest; movable replica
+    sib_on_b = jnp.any(valid_sib & (st.broker_of[jnp.clip(reps, 0)] == b))
+    ok = (opts.replica_movable[r] & opts.move_dest_ok[b] & (b != a) & ~sib_on_b)
+    return jnp.where(ok, delta, _INF)
+
+
+def _lead_delta(dt: DeviceTopology, th: G.GoalThresholds, w: OBJ.ObjectiveWeights,
+                opts: G.DeviceOptions, st: ChainState,
+                p: jax.Array, slot: jax.Array) -> jax.Array:
+    """Objective delta of moving partition p's leadership to slot. O(max_rf)."""
+    reps = dt.replicas_of_partition[p]                      # [m]
+    valid = reps >= 0
+    cand = reps[slot]
+    cur = st.leader_of[p]
+    a = st.broker_of[cur]
+    b = st.broker_of[jnp.clip(cand, 0)]
+    extra = dt.leader_extra[p]
+    lbi = dt.leader_bytes_in[p]
+    d_pl = (dt.replica_base_load[jnp.clip(cand, 0), res.NW_OUT]
+            - dt.replica_base_load[cur, res.NW_OUT])
+
+    mem_b = st.broker_of[jnp.clip(reps, 0)]                 # [m]
+    th_m = OBJ.gather_thresholds(th, mem_b)
+    sgn = ((mem_b == b).astype(jnp.float32) - (mem_b == a).astype(jnp.float32))
+    f0 = OBJ.broker_cost(th_m, w, st.broker_load[mem_b], st.replica_count[mem_b],
+                         st.leader_count[mem_b], st.potential_nw_out[mem_b],
+                         st.leader_bytes_in[mem_b])
+    f1 = OBJ.broker_cost(
+        th_m, w,
+        st.broker_load[mem_b] + sgn[:, None] * extra[None, :],
+        st.replica_count[mem_b],
+        st.leader_count[mem_b] + sgn,
+        st.potential_nw_out[mem_b] + d_pl,
+        st.leader_bytes_in[mem_b] + sgn * lbi,
+    )
+    delta = jnp.sum(jnp.where(valid, f1 - f0, 0.0))
+
+    ha, hb = dt.host_of_broker[a], dt.host_of_broker[b]
+    hab = jnp.stack([ha, hb])
+    th_h = OBJ.gather_host_thresholds(th, hab)
+    sgn_h = jnp.array([-1.0, 1.0])
+    h0 = OBJ.host_cost(th_h, w, st.host_load[hab])
+    h1 = OBJ.host_cost(th_h, w, st.host_load[hab] + sgn_h[:, None] * extra[None, :])
+    delta = delta + jnp.where(ha != hb, jnp.sum(h1 - h0), 0.0)
+
+    first = reps[0]
+    d_ple = w.preferred_leader * ((cur == first).astype(jnp.float32)
+                                  - (cand == first).astype(jnp.float32))
+    delta = delta + d_ple
+
+    ok = (valid[slot] & (cand != cur)
+          & opts.leader_dest_ok[b] & opts.leadership_movable[jnp.clip(cand, 0)]
+          & ~dt.replica_offline[jnp.clip(cand, 0)] & dt.broker_alive[b])
+    return jnp.where(ok, delta, _INF)
+
+
+def _apply_move(dt: DeviceTopology, st: ChainState, r, b, use_topic) -> ChainState:
+    """Apply replica move (no-op when b == current broker)."""
+    p = dt.partition_of_replica[r]
+    a = st.broker_of[r]
+    is_leader = st.leader_of[p] == r
+    eff = dt.replica_base_load[r] + jnp.where(is_leader, dt.leader_extra[p],
+                                              jnp.zeros(res.NUM_RESOURCES))
+    pl = (dt.leader_extra[p, res.NW_OUT]
+          + dt.replica_base_load[st.leader_of[p], res.NW_OUT])
+    lbi = jnp.where(is_leader, dt.leader_bytes_in[p], 0.0)
+    lead_f = is_leader.astype(jnp.float32)
+    ha, hb = dt.host_of_broker[a], dt.host_of_broker[b]
+    t = dt.topic_of_partition[p]
+    tc = st.topic_count
+    if use_topic:
+        tc = tc.at[a, t].add(-1.0).at[b, t].add(1.0)
+    return st._replace(
+        broker_of=st.broker_of.at[r].set(b),
+        broker_load=st.broker_load.at[a].add(-eff).at[b].add(eff),
+        host_load=st.host_load.at[ha].add(-eff).at[hb].add(eff),
+        replica_count=st.replica_count.at[a].add(-1.0).at[b].add(1.0),
+        leader_count=st.leader_count.at[a].add(-lead_f).at[b].add(lead_f),
+        potential_nw_out=st.potential_nw_out.at[a].add(-pl).at[b].add(pl),
+        leader_bytes_in=st.leader_bytes_in.at[a].add(-lbi).at[b].add(lbi),
+        topic_count=tc,
+    )
+
+
+def _apply_lead(dt: DeviceTopology, st: ChainState, p, slot) -> ChainState:
+    """Apply leadership move (no-op when the slot holds the current leader)."""
+    cand = dt.replicas_of_partition[p, slot]
+    cur = st.leader_of[p]
+    new_leader = jnp.where(cand >= 0, cand, cur)
+    a = st.broker_of[cur]
+    b = st.broker_of[new_leader]
+    extra = jnp.where(new_leader != cur, dt.leader_extra[p],
+                      jnp.zeros(res.NUM_RESOURCES))
+    lbi = jnp.where(new_leader != cur, dt.leader_bytes_in[p], 0.0)
+    d_pl = jnp.where(new_leader != cur,
+                     dt.replica_base_load[new_leader, res.NW_OUT]
+                     - dt.replica_base_load[cur, res.NW_OUT], 0.0)
+    ha, hb = dt.host_of_broker[a], dt.host_of_broker[b]
+    reps = dt.replicas_of_partition[p]
+    valid = reps >= 0
+    mem_b = st.broker_of[jnp.clip(reps, 0)]
+    pot = st.potential_nw_out.at[mem_b].add(jnp.where(valid, d_pl, 0.0))
+    one = (new_leader != cur).astype(jnp.float32)
+    return st._replace(
+        leader_of=st.leader_of.at[p].set(new_leader),
+        broker_load=st.broker_load.at[a].add(-extra).at[b].add(extra),
+        host_load=st.host_load.at[ha].add(-extra).at[hb].add(extra),
+        leader_count=st.leader_count.at[a].add(-one).at[b].add(one),
+        potential_nw_out=pot,
+        leader_bytes_in=st.leader_bytes_in.at[a].add(-lbi).at[b].add(lbi),
+    )
+
+
+def optimize_anneal(dt: DeviceTopology, assign: Assignment,
+                    th: G.GoalThresholds, weights: OBJ.ObjectiveWeights,
+                    opts: G.DeviceOptions, num_topics: int,
+                    config: Optional[AnnealConfig] = None, seed: int = 0,
+                    goal_names: Sequence[str] = G.DEFAULT_GOALS,
+                    initial_broker_of: Optional[jax.Array] = None,
+                    mesh: Optional[jax.sharding.Mesh] = None) -> AnnealResult:
+    cfg = config or AnnealConfig()
+    C = cfg.num_chains
+    R, P, B = dt.num_replicas, dt.num_partitions, dt.num_brokers
+    use_topic = bool(B * num_topics <= cfg.topic_term_limit)
+    if initial_broker_of is None:
+        initial_broker_of = jnp.asarray(assign.broker_of, jnp.int32)
+
+    # Empty candidate pools degrade to a single always-illegal index (the
+    # legality masks turn those proposals into +inf deltas) so leadership-only
+    # optimization still runs.
+    movable_np = np.flatnonzero(np.asarray(jax.device_get(opts.replica_movable)))
+    dest_np = np.flatnonzero(np.asarray(jax.device_get(opts.move_dest_ok)))
+    movable_idx = jnp.asarray(movable_np if movable_np.size else np.array([0]), jnp.int32)
+    dest_idx = jnp.asarray(dest_np if dest_np.size else np.array([0]), jnp.int32)
+
+    agg = compute_aggregates(dt, assign, num_topics)
+    base = ChainState(
+        broker_of=jnp.asarray(assign.broker_of, jnp.int32),
+        leader_of=jnp.asarray(assign.leader_of, jnp.int32),
+        broker_load=agg.broker_load,
+        host_load=agg.host_load,
+        replica_count=agg.replica_count.astype(jnp.float32),
+        leader_count=agg.leader_count.astype(jnp.float32),
+        potential_nw_out=agg.potential_nw_out,
+        leader_bytes_in=agg.leader_bytes_in,
+        topic_count=(agg.topic_count.astype(jnp.float32) if use_topic
+                     else jnp.zeros((1, 1), jnp.float32)),
+        energy=jnp.float32(0.0),
+    )
+    e0 = _chain_energy(dt, th, weights, base, initial_broker_of, use_topic)
+    base = base._replace(energy=e0)
+    chains = jax.tree.map(lambda x: jnp.broadcast_to(x, (C,) + x.shape), base)
+
+    # temperature ladder: a cold block at ~0 (pure descent) + geometric ladder
+    n_cold = max(1, int(C * cfg.cold_fraction))
+    ladder = np.concatenate([
+        np.full(n_cold, cfg.t_min, np.float32),
+        np.geomspace(cfg.t_min, cfg.t_max, max(C - n_cold, 1)).astype(np.float32)[:C - n_cold],
+    ])[:C]
+    temps0 = jnp.asarray(ladder)
+
+    def step(st: ChainState, temp, key):
+        k1, k2, k3, k4, k5, k6 = jax.random.split(key, 6)
+        # --- candidate replica moves
+        r_c = movable_idx[jax.random.randint(k1, (cfg.tries_move,), 0, movable_idx.size)]
+        b_c = dest_idx[jax.random.randint(k2, (cfg.tries_move,), 0, dest_idx.size)]
+        d_move = jax.vmap(
+            lambda r, b: _move_delta(dt, th, weights, opts, st,
+                                     initial_broker_of, use_topic, r, b)
+        )(r_c, b_c)
+        # --- candidate leadership moves
+        p_c = jax.random.randint(k3, (cfg.tries_lead,), 0, P)
+        s_c = jax.random.randint(k4, (cfg.tries_lead,), 0, dt.max_rf)
+        d_lead = jax.vmap(
+            lambda p, s: _lead_delta(dt, th, weights, opts, st, p, s)
+        )(p_c, s_c)
+
+        deltas = jnp.concatenate([d_move, d_lead])
+        best = jnp.argmin(deltas)
+        d = deltas[best]
+        accept = (d < 0) | (jax.random.uniform(k5) < jnp.exp(
+            -jnp.minimum(d, 80.0 * temp) / jnp.maximum(temp, 1e-9)))
+        accept = accept & (d < _INF)
+
+        is_move = best < cfg.tries_move
+        mi = jnp.minimum(best, cfg.tries_move - 1)
+        li = jnp.clip(best - cfg.tries_move, 0, cfg.tries_lead - 1)
+        r_sel = r_c[mi]
+        # no-op encodings: move to current broker / re-elect current leader
+        b_sel = jnp.where(accept & is_move, b_c[mi], st.broker_of[r_sel])
+        p_sel = p_c[li]
+        cur_slot = jnp.argmax(dt.replicas_of_partition[p_sel] == st.leader_of[p_sel])
+        s_sel = jnp.where(accept & ~is_move, s_c[li], cur_slot)
+
+        st = _apply_move(dt, st, r_sel, b_sel, use_topic)
+        st = _apply_lead(dt, st, p_sel, s_sel)
+        st = st._replace(energy=st.energy + jnp.where(accept, d, 0.0))
+        return st
+
+    def chain_round(st: ChainState, temp, key):
+        keys = jax.random.split(key, cfg.swap_interval)
+
+        def body(s, k):
+            return step(s, temp, k), None
+
+        st, _ = jax.lax.scan(body, st, keys)
+        return st
+
+    def pt_round(carry, inp):
+        chains, temps = carry
+        rnd, key = inp
+        kc = jax.random.split(jax.random.fold_in(key, 1), C)
+        chains = jax.vmap(chain_round, in_axes=(0, 0, 0))(chains, temps, kc)
+        # temperature swap between ladder-adjacent chains (even/odd alternation)
+        order = jnp.argsort(temps)
+        e_sorted = chains.energy[order]
+        t_sorted = temps[order]
+        off = rnd % 2
+        i = jnp.arange(C)
+        partner = jnp.where((i - off) % 2 == 0, i + 1, i - 1)
+        partner = jnp.clip(partner, 0, C - 1)
+        d_swap = ((e_sorted - e_sorted[partner])
+                  * (1.0 / jnp.maximum(t_sorted, 1e-9)
+                     - 1.0 / jnp.maximum(t_sorted[partner], 1e-9)))
+        u = jax.random.uniform(jax.random.fold_in(key, 2), (C,))
+        u_pair = u[jnp.minimum(i, partner)]  # both sides draw the same uniform
+        do = (partner != i) & ((d_swap > 0)
+                               | (u_pair < jnp.exp(jnp.minimum(d_swap, 0.0))))
+        do = do & do[partner]
+        new_t_sorted = jnp.where(do, t_sorted[partner], t_sorted)
+        temps = temps.at[order].set(new_t_sorted)
+        return (chains, temps), None
+
+    n_rounds = max(1, cfg.steps // cfg.swap_interval)
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_rounds)
+
+    if mesh is not None:
+        # chains are embarrassingly parallel: shard the chain axis across the
+        # mesh; XLA inserts the (cheap) collectives for the PT temperature
+        # swap and the final argmin.
+        from jax.sharding import NamedSharding, PartitionSpec
+        axis = mesh.axis_names[0]
+        chains = jax.tree.map(
+            lambda x: jax.device_put(x, NamedSharding(
+                mesh, PartitionSpec(axis, *([None] * (x.ndim - 1))))),
+            chains)
+        temps0 = jax.device_put(temps0, NamedSharding(mesh, PartitionSpec(axis)))
+
+    @jax.jit
+    def run(chains, temps):
+        (chains, temps), _ = jax.lax.scan(
+            pt_round, (chains, temps), (jnp.arange(n_rounds), keys))
+        return chains, temps
+
+    chains, temps = run(chains, temps0)
+
+    # exact rescore of every chain, pick the best
+    def exact(bof, lof):
+        a = Assignment(broker_of=bof, leader_of=lof)
+        return OBJ.evaluate_objective(
+            dt, a, th, weights, tuple(goal_names), num_topics,
+            initial_broker_of).value
+
+    # sequential per chain: the exact eval builds a dense [B,T] histogram,
+    # which must not be materialized C times at once.
+    energies = jax.jit(lambda b, l: jax.lax.map(
+        lambda bl: exact(bl[0], bl[1]), (b, l)))(chains.broker_of, chains.leader_of)
+    best = int(jnp.argmin(energies))
+    return AnnealResult(
+        assignment=Assignment(broker_of=chains.broker_of[best],
+                              leader_of=chains.leader_of[best]),
+        energy=energies[best],
+        chain_energies=energies,
+    )

@@ -100,6 +100,13 @@ def is_hard(goal: str) -> bool:
     return goal in HARD_GOALS
 
 
+def band_cost(n, upper, lower):
+    """Out-of-band distance normalized by the upper bound — the shared soft
+    band-penalty shape used by the goal terms and both engines' deltas."""
+    return (jnp.maximum(n - upper, 0.0)
+            + jnp.maximum(lower - n, 0.0)) / jnp.maximum(upper, 1.0)
+
+
 # ---------------------------------------------------------------------------
 # Optimization options → device masks
 # (analyzer/OptimizationOptions.java:14-21 lowered to arrays)
@@ -205,6 +212,9 @@ class GoalThresholds(NamedTuple):
     max_replicas_per_broker: jax.Array  # f32 scalar (ReplicaCapacityGoal.java:41)
     # PotentialNwOutGoal limit per broker (PotentialNwOutGoal.java:37-42).
     pot_nw_out_limit: jax.Array       # f32[B]
+    # Cost normalization floor per resource (mean alive-broker limit) so
+    # zero-capacity rows (dead hosts) yield large-but-finite costs.
+    cost_scale: jax.Array             # f32[4]
     # LeaderBytesInDistributionGoal threshold (LeaderBytesInDistributionGoal.java:39-43):
     # brokers above avg*balance% of leader bytes-in are overloaded.
     lbi_upper: jax.Array              # f32 scalar
@@ -261,6 +271,7 @@ def compute_thresholds(dt: DeviceTopology, constraint: BalancingConstraint,
         topic_lower=jnp.floor(topic_avg * jnp.maximum(0.0, 2.0 - tp)),
         max_replicas_per_broker=jnp.float32(constraint.max_replicas_per_broker),
         pot_nw_out_limit=pot_limit,
+        cost_scale=jnp.maximum(total_cap * cap_thresh / n_alive, 1e-6),
         # LeaderBytesInDistributionGoal reuses the NW_IN balance percentage.
         lbi_upper=lbi_avg * bal[res.NW_IN],
     )
@@ -336,9 +347,11 @@ def broker_terms(th: GoalThresholds, broker_load: jax.Array,
         if res.IS_BROKER_RESOURCE[r]:
             over_b = jnp.maximum(broker_load[..., r] - lim_b, 0.0) * alive_f
         else:
-            over_b = jnp.zeros_like(lim_b)
+            over_b = jnp.zeros_like(broker_load[..., r])
         viol[_BT[goal]] = (over_b > 0).astype(jnp.float32)
-        cost[_BT[goal]] = over_b / jnp.maximum(lim_b, 1e-30)
+        # normalize by the broker's own limit; fall back to the cluster mean
+        # only for degenerate (zero-capacity) rows so costs stay finite.
+        cost[_BT[goal]] = over_b / jnp.where(lim_b > 0, lim_b, th.cost_scale[r])
 
     # -- ResourceDistributionGoals (soft): broker utilization pct within
     # [avg·(2−B), avg·B] (ResourceDistributionGoal.java:50-56); low-utilization
@@ -364,21 +377,26 @@ def broker_terms(th: GoalThresholds, broker_load: jax.Array,
     # -- PotentialNwOutGoal (soft): potential NW_OUT ≤ capacity·threshold.
     pot_over = jnp.maximum(potential_nw_out - th.pot_nw_out_limit, 0.0) * alive_f
     viol[_BT["PotentialNwOutGoal"]] = (pot_over > 0).astype(jnp.float32)
-    cost[_BT["PotentialNwOutGoal"]] = pot_over / jnp.maximum(th.pot_nw_out_limit, 1e-30)
+    cost[_BT["PotentialNwOutGoal"]] = pot_over / jnp.where(
+        th.pot_nw_out_limit > 0, th.pot_nw_out_limit, th.cost_scale[res.NW_OUT])
 
     # -- LeaderBytesInDistributionGoal (soft): leader bytes-in ≤ avg·balance%.
     lbi_over = jnp.maximum(leader_bytes_in - th.lbi_upper, 0.0) * alive_f
     viol[_BT["LeaderBytesInDistributionGoal"]] = (lbi_over > 0).astype(jnp.float32)
-    cost[_BT["LeaderBytesInDistributionGoal"]] = lbi_over / jnp.maximum(th.lbi_upper, 1e-30)
+    cost[_BT["LeaderBytesInDistributionGoal"]] = lbi_over / jnp.where(
+        th.lbi_upper > 0, th.lbi_upper, 1.0)
 
     # -- _DeadBrokerPlacement (hard, internal): any replica on a dead broker.
     dead_cnt = rc * (1.0 - alive_f)
     viol[_BT["_DeadBrokerPlacement"]] = dead_cnt
     cost[_BT["_DeadBrokerPlacement"]] = dead_cnt
 
+    # batched callers (greedy's hypothetical [R,B] evals) broadcast different
+    # argument shapes per term — unify before stacking.
+    shape = jnp.broadcast_shapes(*(v.shape for v in viol))
     return BrokerTerms(
-        violations=jnp.stack(viol, axis=-1),
-        cost=jnp.stack(cost, axis=-1),
+        violations=jnp.stack([jnp.broadcast_to(v, shape) for v in viol], axis=-1),
+        cost=jnp.stack([jnp.broadcast_to(c, shape) for c in cost], axis=-1),
     )
 
 
@@ -398,7 +416,8 @@ def host_terms(th: GoalThresholds, host_load: jax.Array):
     lim = th.cap_limit_host[..., _HOST_TERM_RESOURCES]
     u = host_load[..., _HOST_TERM_RESOURCES]
     over = jnp.maximum(u - lim, 0.0)
-    return (over > 0).astype(jnp.float32), over / jnp.maximum(lim, 1e-30)
+    scale = th.cost_scale[jnp.asarray(_HOST_TERM_RESOURCES)]
+    return (over > 0).astype(jnp.float32), over / jnp.where(lim > 0, lim, scale)
 
 
 # ---------------------------------------------------------------------------

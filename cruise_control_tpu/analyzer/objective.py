@@ -1,0 +1,125 @@
+"""Weighted scalar objective over the goal penalty terms.
+
+Bridges :mod:`cruise_control_tpu.analyzer.goals` (per-goal penalties) and the
+two search engines (greedy descent, annealer). The objective is
+
+    O(state) = Σ_goals w_g · cost_g(state)
+
+with hierarchical weights approximating the reference's sequential
+goal-priority semantics (``GoalOptimizer.java:429``: earlier goals veto later
+actions; hard goals always win). It decomposes as
+
+    O = Σ_b f_broker(b) + Σ_h f_host(h) + w_rack·excess + topic term + healing
+
+which is what both engines exploit: greedy evaluates f on batched hypothetical
+loads; the annealer maintains running aggregates and evaluates f only on
+touched brokers/hosts.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.analyzer import goals as G
+from cruise_control_tpu.common import resources as res
+from cruise_control_tpu.models.cluster import Assignment
+from cruise_control_tpu.ops.aggregates import (
+    BrokerAggregates,
+    DeviceTopology,
+    compute_aggregates,
+)
+
+
+class ObjectiveWeights(NamedTuple):
+    """Per-term weights in the decomposed layout."""
+
+    broker_terms: jax.Array   # f32[NUM_BROKER_TERMS] (0 where goal not selected)
+    host_terms: jax.Array     # f32[3] (CpuCapacity, NwInCapacity, NwOutCapacity)
+    rack: jax.Array           # f32 scalar
+    topic: jax.Array          # f32 scalar
+    healing: jax.Array        # f32 scalar (offline replicas must relocate)
+    preferred_leader: jax.Array  # f32 scalar
+    per_goal: jax.Array       # f32[G+1] — goal_weights vector for full evals
+
+
+def build_weights(goal_names: Sequence[str],
+                  hard_weight: float = 1e7,
+                  soft_base: float = 2.0) -> ObjectiveWeights:
+    """Map a priority-ordered goal list to decomposed term weights."""
+    w = G.goal_weights(goal_names, hard_weight, soft_base)  # [G+1]
+    by_goal = {g: float(w[i]) for i, g in enumerate(goal_names)}
+    bt = np.zeros(G.NUM_BROKER_TERMS, np.float32)
+    for g, i in ((g, G.BROKER_TERM_GOALS.index(g)) for g in goal_names
+                 if g in G.BROKER_TERM_GOALS):
+        bt[i] = by_goal[g]
+    bt[G.BROKER_TERM_GOALS.index("_DeadBrokerPlacement")] = hard_weight
+    ht = np.array([by_goal.get(g, 0.0) for g in G.HOST_TERM_GOALS], np.float32)
+    return ObjectiveWeights(
+        broker_terms=jnp.asarray(bt),
+        host_terms=jnp.asarray(ht),
+        rack=jnp.float32(by_goal.get("RackAwareGoal", 0.0)),
+        topic=jnp.float32(by_goal.get("TopicReplicaDistributionGoal", 0.0)),
+        healing=jnp.float32(hard_weight),
+        preferred_leader=jnp.float32(by_goal.get("PreferredLeaderElectionGoal", 0.0)),
+        per_goal=jnp.asarray(w),
+    )
+
+
+def broker_cost(th: G.GoalThresholds, weights: ObjectiveWeights,
+                broker_load: jax.Array, replica_count: jax.Array,
+                leader_count: jax.Array, potential_nw_out: jax.Array,
+                leader_bytes_in: jax.Array) -> jax.Array:
+    """Weighted per-broker cost; broadcasts over any leading batch dims.
+
+    All per-broker inputs must be *gathered for the same broker index* so the
+    alive/capacity threshold rows line up: callers evaluating hypothetical
+    loads for broker b pass ``th`` rows for b via :func:`gather_thresholds`.
+    """
+    bt = G.broker_terms(th, broker_load, replica_count, leader_count,
+                        potential_nw_out, leader_bytes_in)
+    return jnp.sum(bt.cost * weights.broker_terms, axis=-1)
+
+
+def gather_thresholds(th: G.GoalThresholds, idx: jax.Array) -> G.GoalThresholds:
+    """Threshold rows for specific brokers (for batched hypothetical evals)."""
+    return th._replace(
+        alive=th.alive[idx],
+        broker_capacity=th.broker_capacity[idx],
+        cap_limit_broker=th.cap_limit_broker[idx],
+        pot_nw_out_limit=th.pot_nw_out_limit[idx],
+    )
+
+
+def host_cost(th: G.GoalThresholds, weights: ObjectiveWeights,
+              host_load: jax.Array) -> jax.Array:
+    """Weighted per-host cost; broadcasts over leading batch dims (rows of
+    ``host_load`` must correspond to rows of ``th.cap_limit_host``)."""
+    _, cost = G.host_terms(th, host_load)
+    return jnp.sum(cost * weights.host_terms, axis=-1)
+
+
+def gather_host_thresholds(th: G.GoalThresholds, hidx: jax.Array) -> G.GoalThresholds:
+    return th._replace(cap_limit_host=th.cap_limit_host[hidx])
+
+
+class ObjectiveState(NamedTuple):
+    """Everything needed to score a full state in one pass."""
+
+    value: jax.Array          # f32 scalar — the weighted objective
+    penalties: G.GoalPenalties
+
+
+def evaluate_objective(dt: DeviceTopology, assign: Assignment,
+                       th: G.GoalThresholds, weights: ObjectiveWeights,
+                       goal_names: Sequence[str], num_topics: int,
+                       initial_broker_of: Optional[jax.Array] = None,
+                       agg: Optional[BrokerAggregates] = None) -> ObjectiveState:
+    """Exact full-state objective (used for scoring/ranking final states and
+    for periodic drift correction of the annealer's running aggregates)."""
+    pen = G.full_goal_penalties(dt, assign, th, num_topics, goal_names,
+                                initial_broker_of=initial_broker_of, agg=agg)
+    return ObjectiveState(value=jnp.sum(pen.cost * weights.per_goal), penalties=pen)

@@ -1,0 +1,226 @@
+"""Goal optimizer orchestration: model → engine → OptimizerResult.
+
+The facade the rest of the framework calls, mirroring
+``GoalOptimizer.optimizations(...)`` (``analyzer/GoalOptimizer.java:408-467``)
+→ ``OptimizerResult`` (``analyzer/OptimizerResult.java:41-53``): run the goal
+list over a cluster model, produce execution proposals plus per-goal
+violation summaries, before/after stats, and the balancedness score
+(``KafkaCruiseControlUtils.java:530``).
+
+Engine selection: the deterministic greedy engine (exact incremental deltas,
+O(R·B) per round) for models up to ``GREEDY_LIMIT`` candidate pairs; the
+annealer (vmapped parallel-tempering chains) beyond. If the annealer leaves
+hard-goal violations and the model fits the greedy engine, a deterministic
+greedy polish finishes the repair.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.analyzer import goals as G
+from cruise_control_tpu.analyzer import greedy as GR
+from cruise_control_tpu.analyzer import objective as OBJ
+from cruise_control_tpu.analyzer import proposals as PR
+from cruise_control_tpu.common.resources import BalancingConstraint
+from cruise_control_tpu.models.cluster import Assignment, ClusterTopology
+from cruise_control_tpu.ops.aggregates import compute_aggregates, device_topology
+from cruise_control_tpu.ops.stats import compute_cluster_stats
+
+#: R·B above which greedy's move matrix is considered too large
+GREEDY_LIMIT = 40_000_000
+
+#: balancedness defaults (KafkaCruiseControlConfig goal.balancedness.*)
+PRIORITY_WEIGHT = 1.1
+STRICTNESS_WEIGHT = 1.5
+MAX_BALANCEDNESS_SCORE = 100.0
+
+
+def balancedness_cost_by_goal(goal_names: Sequence[str],
+                              priority_weight: float = PRIORITY_WEIGHT,
+                              strictness_weight: float = STRICTNESS_WEIGHT
+                              ) -> Dict[str, float]:
+    """Per-goal share of the 100-point balancedness budget
+    (KafkaCruiseControlUtils.balancednessCostByGoal, :530)."""
+    costs: Dict[str, float] = {}
+    weight_sum = 0.0
+    prev = 1.0 / priority_weight
+    for g in reversed(list(goal_names)):
+        cur = priority_weight * prev
+        cost = cur * (strictness_weight if G.is_hard(g) else 1.0)
+        weight_sum += cost
+        costs[g] = cost
+        prev = cur
+    return {g: MAX_BALANCEDNESS_SCORE * c / weight_sum for g, c in costs.items()}
+
+
+@dataclasses.dataclass
+class GoalSummary:
+    name: str
+    hard: bool
+    violations_before: float
+    violations_after: float
+    cost_before: float
+    cost_after: float
+
+    @property
+    def violated_before(self) -> bool:
+        return self.violations_before > 0
+
+    @property
+    def violated_after(self) -> bool:
+        return self.violations_after > 0
+
+
+@dataclasses.dataclass
+class OptimizerResult:
+    """Mirror of OptimizerResult.java:41-53."""
+
+    proposals: List[PR.ExecutionProposal]
+    goal_summaries: List[GoalSummary]
+    stats_before: dict
+    stats_after: dict
+    balancedness_before: float
+    balancedness_after: float
+    num_replica_movements: int
+    num_leadership_movements: int
+    inter_broker_data_to_move: float
+    engine: str
+    wall_time_s: float
+    final_assignment: Assignment = None
+
+    @property
+    def violated_goals_before(self) -> List[str]:
+        return [s.name for s in self.goal_summaries if s.violated_before]
+
+    @property
+    def violated_goals_after(self) -> List[str]:
+        return [s.name for s in self.goal_summaries if s.violated_after]
+
+    def to_json(self) -> dict:
+        return {
+            "proposals": [p.to_json() for p in self.proposals],
+            "goalSummary": [
+                {"goal": s.name, "status": ("VIOLATED" if s.violated_after
+                                            else "NO-ACTION" if not s.violated_before
+                                            else "FIXED")}
+                for s in self.goal_summaries],
+            "violatedGoalsBefore": self.violated_goals_before,
+            "violatedGoalsAfter": self.violated_goals_after,
+            "balancednessBefore": self.balancedness_before,
+            "balancednessAfter": self.balancedness_after,
+            "numReplicaMovements": self.num_replica_movements,
+            "numLeadershipMovements": self.num_leadership_movements,
+            "interBrokerDataToMoveMB": self.inter_broker_data_to_move,
+            "engine": self.engine,
+            "wallTimeSeconds": self.wall_time_s,
+        }
+
+
+def _stats_dict(dt, assign, constraint, num_topics) -> dict:
+    st = compute_cluster_stats(dt, assign, constraint, num_topics)
+    return {k: np.asarray(v).tolist() for k, v in st._asdict().items()}
+
+
+def _balancedness(goal_names, violations) -> float:
+    costs = balancedness_cost_by_goal(goal_names)
+    score = MAX_BALANCEDNESS_SCORE
+    for g, v in zip(goal_names, violations):
+        if v > 0:
+            score -= costs[g]
+    return max(score, 0.0)
+
+
+def optimize(topo: ClusterTopology, assign: Assignment,
+             goal_names: Sequence[str] = G.DEFAULT_GOALS,
+             constraint: Optional[BalancingConstraint] = None,
+             options: Optional[G.DeviceOptions] = None,
+             engine: str = "auto",
+             anneal_config: Optional["AnnealConfig"] = None,
+             seed: int = 0,
+             mesh: Optional["jax.sharding.Mesh"] = None) -> OptimizerResult:
+    """Full optimization pass. ``engine``: auto | greedy | anneal."""
+    from cruise_control_tpu.analyzer import annealer as AN  # cycle-free import
+
+    t0 = time.time()
+    constraint = constraint or BalancingConstraint()
+    opts = options if options is not None else G.default_options(topo)
+    goal_names = tuple(goal_names)
+    dt = device_topology(topo)
+    num_topics = topo.num_topics
+    agg0 = compute_aggregates(dt, assign, num_topics)
+    th = G.compute_thresholds(dt, constraint, agg0)
+    weights = OBJ.build_weights(goal_names)
+    init_broker = jnp.asarray(assign.broker_of, jnp.int32)
+
+    before = OBJ.evaluate_objective(dt, assign, th, weights, goal_names,
+                                    num_topics, init_broker, agg0)
+    stats_before = _stats_dict(dt, assign, constraint, num_topics)
+
+    if engine == "auto":
+        engine = ("greedy" if topo.num_replicas * topo.num_brokers <= GREEDY_LIMIT
+                  else "anneal")
+
+    if engine == "greedy":
+        gres = GR.optimize_greedy(dt, assign, th, weights, opts, num_topics)
+        final = gres.assignment
+    elif engine == "anneal":
+        ares = AN.optimize_anneal(dt, assign, th, weights, opts, num_topics,
+                                  config=anneal_config, seed=seed,
+                                  goal_names=goal_names,
+                                  initial_broker_of=init_broker,
+                                  mesh=mesh)
+        final = ares.assignment
+        # hard-goal polish: if stochastic search left hard violations and the
+        # model fits the greedy engine, finish with deterministic descent.
+        interim = OBJ.evaluate_objective(dt, final, th, weights, goal_names,
+                                         num_topics, init_broker)
+        hard_mask = np.array([G.is_hard(g) for g in goal_names] + [True])
+        if (np.asarray(interim.penalties.violations)[hard_mask].sum() > 0
+                and topo.num_replicas * topo.num_brokers <= GREEDY_LIMIT):
+            gres = GR.optimize_greedy(dt, final, th, weights, opts, num_topics)
+            final = gres.assignment
+    else:
+        raise ValueError(f"unknown engine {engine!r}")
+
+    after = OBJ.evaluate_objective(dt, final, th, weights, goal_names,
+                                   num_topics, init_broker)
+    stats_after = _stats_dict(dt, final, constraint, num_topics)
+    props = PR.diff(topo, assign, final)
+    # movement counts derived from the proposal diff so both engines report
+    # the same thing the executor will do.
+    n_moves = sum(len(p.replicas_to_add) for p in props)
+    n_lead = sum(1 for p in props if p.has_leader_action)
+
+    names_ext = goal_names + (G.SELF_HEALING_TERM,)
+    vb = np.asarray(before.penalties.violations)
+    va = np.asarray(after.penalties.violations)
+    cb = np.asarray(before.penalties.cost)
+    ca = np.asarray(after.penalties.cost)
+    summaries = [
+        GoalSummary(name=g, hard=G.is_hard(g) or g == G.SELF_HEALING_TERM,
+                    violations_before=float(vb[i]), violations_after=float(va[i]),
+                    cost_before=float(cb[i]), cost_after=float(ca[i]))
+        for i, g in enumerate(names_ext)]
+
+    return OptimizerResult(
+        proposals=props,
+        goal_summaries=summaries,
+        stats_before=stats_before,
+        stats_after=stats_after,
+        balancedness_before=_balancedness(goal_names, vb),
+        balancedness_after=_balancedness(goal_names, va),
+        num_replica_movements=n_moves,
+        num_leadership_movements=n_lead,
+        inter_broker_data_to_move=float(sum(p.inter_broker_data_to_move()
+                                            for p in props)),
+        engine=engine,
+        wall_time_s=time.time() - t0,
+        final_assignment=final,
+    )

@@ -1,0 +1,123 @@
+"""Proposal decoding: assignment diff → ExecutionProposal set.
+
+Reproduces ``AnalyzerUtils.getDiff`` (``analyzer/AnalyzerUtils.java:57-124``)
+and the ``ExecutionProposal`` contract (``executor/ExecutionProposal.java:22-113``):
+for every partition whose replica set or leader changed between the initial
+and optimized assignments, emit old/new replica broker lists (leader first),
+the partition's data size (DISK load), and the derived add/remove/move sets
+the executor batches on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.common import resources as res
+from cruise_control_tpu.models.cluster import Assignment, ClusterTopology
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecutionProposal:
+    """One partition's reassignment (ExecutionProposal.java:22-38)."""
+
+    topic: str
+    partition: int
+    old_leader: int                 # external broker id
+    old_replicas: Tuple[int, ...]   # leader first
+    new_replicas: Tuple[int, ...]   # leader first
+    data_size: float                # partition DISK footprint (for strategies)
+
+    @property
+    def topic_partition(self) -> str:
+        return f"{self.topic}-{self.partition}"
+
+    @property
+    def replicas_to_add(self) -> Tuple[int, ...]:
+        return tuple(b for b in self.new_replicas if b not in self.old_replicas)
+
+    @property
+    def replicas_to_remove(self) -> Tuple[int, ...]:
+        return tuple(b for b in self.old_replicas if b not in self.new_replicas)
+
+    @property
+    def has_replica_action(self) -> bool:
+        return set(self.old_replicas) != set(self.new_replicas)
+
+    @property
+    def has_leader_action(self) -> bool:
+        return self.old_leader != self.new_replicas[0]
+
+    def inter_broker_data_to_move(self) -> float:
+        return self.data_size * len(self.replicas_to_add)
+
+    def is_completed(self, current_replicas: Sequence[int]) -> bool:
+        """The reassignment finished (ExecutionProposal completion predicate)."""
+        return tuple(current_replicas) == self.new_replicas
+
+    def can_be_aborted(self, current_replicas: Sequence[int]) -> bool:
+        """Abortable while the old replicas are all still present."""
+        return all(b in current_replicas for b in self.old_replicas)
+
+    def to_json(self) -> dict:
+        return {
+            "topicPartition": {"topic": self.topic, "partition": self.partition},
+            "oldLeader": self.old_leader,
+            "oldReplicas": list(self.old_replicas),
+            "newReplicas": list(self.new_replicas),
+        }
+
+
+def _broker_ids(topo: ClusterTopology) -> np.ndarray:
+    if topo.broker_ids is not None:
+        return np.asarray(topo.broker_ids)
+    return np.arange(topo.num_brokers, dtype=np.int32)
+
+
+def diff(topo: ClusterTopology, initial: Assignment, final: Assignment
+         ) -> List[ExecutionProposal]:
+    """Set of proposals for every changed partition (AnalyzerUtils.getDiff).
+
+    Replica-list order: the new leader first, then the surviving replicas in
+    their original slot order (the reference preserves insertion order with
+    leadership at the head, which PLE and the executor rely on).
+    """
+    ids = _broker_ids(topo)
+    init_b = np.asarray(initial.broker_of)
+    fin_b = np.asarray(final.broker_of)
+    init_l = np.asarray(initial.leader_of)
+    fin_l = np.asarray(final.leader_of)
+    reps = topo.replicas_of_partition
+    proposals: List[ExecutionProposal] = []
+    # partition disk size: the initial leader replica's DISK load
+    disk = (topo.replica_base_load[init_l, res.DISK]
+            + topo.leader_extra[:, res.DISK])                # [P]
+
+    for p in range(topo.num_partitions):
+        slots = reps[p][reps[p] >= 0]
+        old_brokers = init_b[slots]
+        new_brokers = fin_b[slots]
+        old_leader_r, new_leader_r = init_l[p], fin_l[p]
+        if np.array_equal(old_brokers, new_brokers) and old_leader_r == new_leader_r:
+            continue
+
+        def ordered(brokers, leader_replica):
+            lead_slot = int(np.where(slots == leader_replica)[0][0])
+            order = [lead_slot] + [i for i in range(len(slots)) if i != lead_slot]
+            return tuple(int(ids[brokers[i]]) for i in order)
+
+        old_list = ordered(old_brokers, old_leader_r)
+        new_list = ordered(new_brokers, new_leader_r)
+        proposals.append(ExecutionProposal(
+            topic=topo.topic_names[topo.topic_of_partition[p]]
+            if topo.topic_names else str(int(topo.topic_of_partition[p])),
+            partition=int(topo.partition_index[p])
+            if topo.partition_index is not None else p,
+            old_leader=int(ids[init_b[old_leader_r]]),
+            old_replicas=old_list,
+            new_replicas=new_list,
+            data_size=float(disk[p]),
+        ))
+    return proposals

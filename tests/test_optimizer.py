@@ -1,0 +1,136 @@
+"""Optimizer invariants, modeled on the reference's OptimizationVerifier
+(analyzer/OptimizationVerifier.java:53-339): goal violations cleared or
+reduced, hard goals never violated at the end, dead brokers evacuated,
+proposals well-formed, model invariants (sanity_check) preserved.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import annealer as AN
+from cruise_control_tpu.analyzer import goals as G
+from cruise_control_tpu.analyzer import optimizer as OPT
+from cruise_control_tpu.analyzer import proposals as PR
+from cruise_control_tpu.models import fixtures
+from cruise_control_tpu.models.cluster import Assignment
+from cruise_control_tpu.ops.aggregates import device_topology
+from cruise_control_tpu.ops.stats import sanity_check
+
+
+def _hard_violations_after(result):
+    return {s.name: s.violations_after for s in result.goal_summaries if s.hard}
+
+
+def _check_invariants(topo, assign, result):
+    # model invariants hold on the final assignment
+    dt = device_topology(topo)
+    checks = sanity_check(dt, result.final_assignment, topo.num_topics)
+    assert all(checks.values()), checks
+    # replicas of a partition sit on distinct brokers
+    fb = np.asarray(result.final_assignment.broker_of)
+    for p in range(topo.num_partitions):
+        slots = topo.replicas_of_partition[p]
+        slots = slots[slots >= 0]
+        brokers = fb[slots]
+        assert len(set(brokers.tolist())) == len(brokers), f"dup brokers p={p}"
+    # no replica on a dead broker
+    assert topo.broker_alive[fb].all()
+
+
+def test_greedy_unbalanced():
+    topo, assign = fixtures.unbalanced()
+    r = OPT.optimize(topo, assign)
+    assert r.engine == "greedy"
+    assert r.num_replica_movements >= 1
+    assert r.balancedness_after > r.balancedness_before
+    _check_invariants(topo, assign, r)
+
+
+def test_greedy_fixes_rack_awareness():
+    topo, assign = fixtures.rack_aware_satisfiable()
+    r = OPT.optimize(topo, assign)
+    assert _hard_violations_after(r)["RackAwareGoal"] == 0
+    _check_invariants(topo, assign, r)
+
+
+def test_greedy_heals_dead_broker():
+    topo, assign = fixtures.dead_broker()
+    r = OPT.optimize(topo, assign)
+    hv = _hard_violations_after(r)
+    assert hv[G.SELF_HEALING_TERM] == 0
+    assert all(v == 0 for v in hv.values()), hv
+    _check_invariants(topo, assign, r)
+    # the two replicas formerly on broker 0 moved somewhere alive
+    moved = np.asarray(r.final_assignment.broker_of)[topo.replica_offline]
+    assert (moved != 0).all()
+
+
+def test_greedy_no_hard_regression_on_small():
+    topo, assign = fixtures.small_cluster_model()
+    r = OPT.optimize(topo, assign)
+    hv = _hard_violations_after(r)
+    assert all(v == 0 for v in hv.values()), hv
+    _check_invariants(topo, assign, r)
+
+
+def test_proposals_format():
+    topo, assign = fixtures.small_cluster_model()
+    # hand-move one replica: T1-0 follower from broker 2 to broker 1
+    fb = np.asarray(assign.broker_of).copy()
+    p0 = 0
+    slots = topo.replicas_of_partition[p0]
+    follower = [s for s in slots if s >= 0
+                and s != int(np.asarray(assign.leader_of)[p0])][0]
+    old_b = fb[follower]
+    fb[follower] = 1 if old_b != 1 else 2
+    final = Assignment(jnp.asarray(fb), assign.leader_of)
+    props = PR.diff(topo, assign, final)
+    assert len(props) == 1
+    pr = props[0]
+    assert pr.topic == "T1" and pr.partition == 0
+    assert pr.old_leader == pr.old_replicas[0]
+    assert set(pr.replicas_to_add) == {int(fb[follower])}
+    assert set(pr.replicas_to_remove) == {int(old_b)}
+    j = pr.to_json()
+    assert j["topicPartition"] == {"topic": "T1", "partition": 0}
+
+
+def test_proposals_leadership_only():
+    topo, assign = fixtures.unbalanced3()
+    first = topo.replicas_of_partition[:, 0]
+    final = Assignment(assign.broker_of, jnp.asarray(first))
+    props = PR.diff(topo, assign, final)
+    assert len(props) == 2
+    for p in props:
+        assert p.has_leader_action and not p.has_replica_action
+
+
+def test_balancedness_costs_sum_to_100():
+    costs = OPT.balancedness_cost_by_goal(G.DEFAULT_GOALS)
+    assert sum(costs.values()) == pytest.approx(100.0)
+    # hard goals cost more than equal-priority soft goals would
+    assert costs["RackAwareGoal"] > costs["ReplicaDistributionGoal"]
+
+
+def test_annealer_small_random():
+    topo, assign = fixtures.random_cluster(fixtures.ClusterProperties(
+        num_racks=3, num_brokers=9, num_replicas=300, num_topics=12), seed=7)
+    r = OPT.optimize(topo, assign, engine="anneal",
+                     anneal_config=AN.AnnealConfig(num_chains=8, steps=1024,
+                                                   swap_interval=64))
+    hv = _hard_violations_after(r)
+    assert all(v == 0 for v in hv.values()), hv
+    assert r.balancedness_after >= r.balancedness_before
+    _check_invariants(topo, assign, r)
+
+
+def test_annealer_heals_dead_brokers():
+    topo, assign = fixtures.random_cluster(fixtures.ClusterProperties(
+        num_racks=3, num_brokers=9, num_replicas=200, num_topics=8,
+        num_dead_brokers=1), seed=11)
+    r = OPT.optimize(topo, assign, engine="anneal",
+                     anneal_config=AN.AnnealConfig(num_chains=8, steps=1024,
+                                                   swap_interval=64))
+    assert _hard_violations_after(r)[G.SELF_HEALING_TERM] == 0
+    _check_invariants(topo, assign, r)
