@@ -95,13 +95,19 @@ def diff(topo: ClusterTopology, initial: Assignment, final: Assignment
     disk = (topo.replica_base_load[init_l, res.DISK]
             + topo.leader_extra[:, res.DISK])                # [P]
 
-    for p in range(topo.num_partitions):
+    # vectorized changed-partition scan: the per-partition loop below only
+    # visits partitions the optimizer actually touched.
+    valid = reps >= 0
+    safe = np.maximum(reps, 0)
+    ib = np.where(valid, init_b[safe], -1)
+    fb2 = np.where(valid, fin_b[safe], -1)
+    changed = (ib != fb2).any(axis=1) | (init_l != fin_l)
+
+    for p in np.flatnonzero(changed):
         slots = reps[p][reps[p] >= 0]
         old_brokers = init_b[slots]
         new_brokers = fin_b[slots]
         old_leader_r, new_leader_r = init_l[p], fin_l[p]
-        if np.array_equal(old_brokers, new_brokers) and old_leader_r == new_leader_r:
-            continue
 
         def ordered(brokers, leader_replica):
             lead_slot = int(np.where(slots == leader_replica)[0][0])

@@ -209,6 +209,109 @@ def dead_broker():
 # ---------------------------------------------------------------------------
 
 
+def synthetic_cluster(num_brokers: int = 2_600, num_replicas: int = 500_000,
+                      num_racks: int = 40, rf: int = 3, num_topics: int = 30_000,
+                      seed: int = 0, mean_nw_in: float = 50.0,
+                      mean_nw_out: float = 50.0, mean_disk: float = 100.0,
+                      mean_cpu: float = 0.01, capacity=None,
+                      rack_aware_placement: bool = True):
+    """LinkedIn-scale synthetic model, built as arrays (no per-partition Python
+    loop) — the BASELINE.json configs' 2.6K-broker / 500K-replica regime.
+
+    Placement mimics a real Kafka cluster (rack-aware round-robin like
+    Kafka's assigner, exponential per-partition load skew), so the
+    optimizer's job is *rebalance*, matching the reference benchmark
+    scenario. Returns (ClusterTopology, Assignment).
+    """
+    from cruise_control_tpu.models.cluster import (
+        ClusterTopology, initial_assignment, leadership_extra_from_leader_load)
+
+    rng = np.random.default_rng(seed)
+    B, K = num_brokers, num_racks
+    P = num_replicas // rf
+    R = P * rf
+
+    rack_of_broker = (np.arange(B) % K).astype(np.int32)
+    host_of_broker = np.arange(B, dtype=np.int32)   # one host per broker
+    if capacity is None:
+        capacity = np.array([BROKER_CAPACITY[i] for i in range(res.NUM_RESOURCES)],
+                            np.float32)
+    cap = np.broadcast_to(np.asarray(capacity, np.float32), (B, res.NUM_RESOURCES)).copy()
+
+    # brokers grouped by rack for rack-aware placement
+    order = np.argsort(rack_of_broker, kind="stable").astype(np.int32)
+    counts = np.bincount(rack_of_broker, minlength=K)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+
+    if rack_aware_placement:
+        assert rf <= K, "rack-aware placement needs rf <= num_racks"
+        # pick rf distinct racks per partition (rotate a random start), then a
+        # random broker within each rack
+        start_rack = rng.integers(0, K, size=P)
+        rack_pick = (start_rack[:, None] + np.arange(rf)[None, :]) % K  # [P, rf]
+        within = rng.integers(0, 1 << 30, size=(P, rf))
+        broker_of = order[starts[rack_pick] + within % counts[rack_pick]].astype(np.int32)
+    else:
+        # fully random distinct brokers via iterative resampling
+        broker_of = rng.integers(0, B, size=(P, rf)).astype(np.int32)
+        for _ in range(8):
+            dup = np.zeros((P, rf), bool)
+            for j in range(1, rf):
+                dup[:, j] = (broker_of[:, :j] == broker_of[:, j:j + 1]).any(axis=1)
+            if not dup.any():
+                break
+            broker_of[dup] = rng.integers(0, B, size=int(dup.sum()))
+    broker_of = broker_of.reshape(-1)                                  # [R]
+
+    # topics: exponential popularity over partitions
+    popularity = rng.exponential(1.0, size=num_topics)
+    topic_of_partition = rng.choice(
+        num_topics, size=P, p=popularity / popularity.sum()).astype(np.int32)
+    # leader loads: exponential skew around the means
+    means = np.zeros(res.NUM_RESOURCES, np.float32)
+    means[res.CPU], means[res.DISK] = mean_cpu, mean_disk
+    means[res.NW_IN], means[res.NW_OUT] = mean_nw_in, mean_nw_out
+    leader_load = (rng.exponential(1.0, size=(P, res.NUM_RESOURCES))
+                   .astype(np.float32) * means)
+    extra = leadership_extra_from_leader_load(leader_load)             # [P, 4]
+    base_leader = leader_load - extra
+    # follower base = derived follower load == base_leader (by construction)
+    replica_base_load = np.repeat(base_leader, rf, axis=0)             # [R, 4]
+
+    replicas_of_partition = np.arange(R, dtype=np.int32).reshape(P, rf)
+    # per-topic running partition numbers
+    order_p = np.argsort(topic_of_partition, kind="stable")
+    st = topic_of_partition[order_p]
+    first = np.concatenate([[True], st[1:] != st[:-1]]) if P else np.zeros(0, bool)
+    grp_start = np.maximum.accumulate(np.where(first, np.arange(P), 0))
+    partition_index = np.zeros(P, np.int32)
+    partition_index[order_p] = (np.arange(P) - grp_start).astype(np.int32)
+    topo = ClusterTopology(
+        rack_of_broker=rack_of_broker,
+        host_of_broker=host_of_broker,
+        capacity=cap,
+        broker_alive=np.ones(B, bool),
+        broker_new=np.zeros(B, bool),
+        broker_demoted=np.zeros(B, bool),
+        broker_bad_disks=np.zeros(B, bool),
+        partition_of_replica=np.repeat(np.arange(P, dtype=np.int32), rf),
+        topic_of_partition=topic_of_partition,
+        replicas_of_partition=replicas_of_partition,
+        rf_of_partition=np.full(P, rf, np.int32),
+        initial_leader_slot=np.zeros(P, np.int64),
+        replica_offline=np.zeros(R, bool),
+        replica_base_load=replica_base_load,
+        leader_extra=extra,
+        leader_bytes_in=leader_load[:, res.NW_IN].copy(),
+        topic_names=tuple(f"topic{i}" for i in range(num_topics)),
+        partition_index=partition_index,
+        broker_ids=np.arange(B, dtype=np.int32),
+        host_names=tuple(f"host{i}" for i in range(B)),
+        rack_names=tuple(f"rack{i}" for i in range(K)),
+    )
+    return topo, initial_assignment(topo, broker_of)
+
+
 class Distribution(enum.Enum):
     UNIFORM = "uniform"
     LINEAR = "linear"
