@@ -52,10 +52,11 @@ _INF = jnp.float32(3.0e38)
 @dataclasses.dataclass(frozen=True)
 class AnnealConfig:
     num_chains: int = 64
-    steps: int = 4096
+    steps: int = 2048
     swap_interval: int = 64
-    tries_move: int = 4
-    tries_lead: int = 2
+    tries_move: int = 32
+    tries_lead: int = 8
+    tries_swap: int = 16
     t_min: float = 1e-3
     t_max: float = 64.0
     #: include the dense [B,T] topic-count aggregate (memory B·T per chain)
@@ -222,55 +223,161 @@ def _lead_delta(dt: DeviceTopology, th: G.GoalThresholds, w: OBJ.ObjectiveWeight
     return jnp.where(ok, delta, _INF)
 
 
-def _apply_move(dt: DeviceTopology, st: ChainState, r, b, use_topic) -> ChainState:
-    """Apply replica move (no-op when b == current broker)."""
-    p = dt.partition_of_replica[r]
-    a = st.broker_of[r]
-    is_leader = st.leader_of[p] == r
-    eff = dt.replica_base_load[r] + jnp.where(is_leader, dt.leader_extra[p],
-                                              jnp.zeros(res.NUM_RESOURCES))
+def _swap_delta(dt: DeviceTopology, th: G.GoalThresholds, w: OBJ.ObjectiveWeights,
+                opts: G.DeviceOptions, st: ChainState,
+                initial_broker_of: jax.Array, use_topic: bool,
+                r1: jax.Array, r2: jax.Array) -> jax.Array:
+    """Objective delta of exchanging replicas r1 ↔ r2 between their brokers
+    (ActionType.INTER_BROKER_REPLICA_SWAP). O(max_rf)."""
+    p1 = dt.partition_of_replica[r1]
+    p2 = dt.partition_of_replica[r2]
+    a = st.broker_of[r1]
+    b = st.broker_of[r2]
+
+    def rep_stats(rr, pp):
+        is_l = st.leader_of[pp] == rr
+        eff = dt.replica_base_load[rr] + jnp.where(
+            is_l, dt.leader_extra[pp], jnp.zeros(res.NUM_RESOURCES))
+        pl = (dt.leader_extra[pp, res.NW_OUT]
+              + dt.replica_base_load[st.leader_of[pp], res.NW_OUT])
+        lbi = jnp.where(is_l, dt.leader_bytes_in[pp], 0.0)
+        return eff, pl, lbi, is_l.astype(jnp.float32)
+
+    e1, pl1, lbi1, l1 = rep_stats(r1, p1)
+    e2, pl2, lbi2, l2 = rep_stats(r2, p2)
+    de = e2 - e1      # net load change on a (b gets -de)
+    dpl = pl2 - pl1
+    dlbi = lbi2 - lbi1
+    dl = l2 - l1
+
+    ab = jnp.stack([a, b])
+    sgn = jnp.array([1.0, -1.0])
+    th_ab = OBJ.gather_thresholds(th, ab)
+    f0 = OBJ.broker_cost(th_ab, w, st.broker_load[ab], st.replica_count[ab],
+                         st.leader_count[ab], st.potential_nw_out[ab],
+                         st.leader_bytes_in[ab])
+    f1 = OBJ.broker_cost(
+        th_ab, w,
+        st.broker_load[ab] + sgn[:, None] * de[None, :],
+        st.replica_count[ab],
+        st.leader_count[ab] + sgn * dl,
+        st.potential_nw_out[ab] + sgn * dpl,
+        st.leader_bytes_in[ab] + sgn * dlbi,
+    )
+    delta = jnp.sum(f1 - f0)
+
+    ha, hb = dt.host_of_broker[a], dt.host_of_broker[b]
+    hab = jnp.stack([ha, hb])
+    th_h = OBJ.gather_host_thresholds(th, hab)
+    h0 = OBJ.host_cost(th_h, w, st.host_load[hab])
+    h1 = OBJ.host_cost(th_h, w, st.host_load[hab] + sgn[:, None] * de[None, :])
+    delta = delta + jnp.where(ha != hb, jnp.sum(h1 - h0), 0.0)
+
+    # rack deltas, one per partition
+    def rack_delta(rr, pp, src_b, dst_b):
+        reps = dt.replicas_of_partition[pp]
+        valid_sib = (reps >= 0) & (reps != rr)
+        sib_rack = dt.rack_of_broker[st.broker_of[jnp.clip(reps, 0)]]
+        occ_s = jnp.any(valid_sib & (sib_rack == dt.rack_of_broker[src_b]))
+        occ_d = jnp.any(valid_sib & (sib_rack == dt.rack_of_broker[dst_b]))
+        return occ_d.astype(jnp.float32) - occ_s.astype(jnp.float32)
+
+    delta = delta + w.rack * (rack_delta(r1, p1, a, b) + rack_delta(r2, p2, b, a))
+
+    if use_topic:
+        t1 = dt.topic_of_partition[p1]
+        t2 = dt.topic_of_partition[p2]
+
+        def topic_delta(t, frm, to):
+            n_f, n_t = st.topic_count[frm, t], st.topic_count[to, t]
+            u, l = th.topic_upper[t], th.topic_lower[t]
+            return (_band_cost(n_f - 1.0, u, l) - _band_cost(n_f, u, l)
+                    + _band_cost(n_t + 1.0, u, l) - _band_cost(n_t, u, l))
+
+        same_topic = t1 == t2
+        delta = delta + jnp.where(
+            same_topic, 0.0,
+            w.topic * (topic_delta(t1, a, b) + topic_delta(t2, b, a)))
+
+    def heal_delta(rr, src_b, dst_b):
+        on_init = src_b == initial_broker_of[rr]
+        heals = dt.replica_offline[rr] & on_init & dt.broker_alive[src_b]
+        back = dt.replica_offline[rr] & (dst_b == initial_broker_of[rr])
+        return back.astype(jnp.float32) - heals.astype(jnp.float32)
+
+    delta = delta + w.healing * (heal_delta(r1, a, b) + heal_delta(r2, b, a))
+
+    def sib_on(rr, pp, broker):
+        reps = dt.replicas_of_partition[pp]
+        valid_sib = (reps >= 0) & (reps != rr)
+        return jnp.any(valid_sib & (st.broker_of[jnp.clip(reps, 0)] == broker))
+
+    ok = (opts.replica_movable[r1] & opts.replica_movable[r2]
+          & opts.move_dest_ok[a] & opts.move_dest_ok[b]
+          & (a != b) & (p1 != p2)
+          & ~sib_on(r1, p1, b) & ~sib_on(r2, p2, a))
+    return jnp.where(ok, delta, _INF)
+
+
+def _apply_moves(dt: DeviceTopology, st: ChainState, r_vec, b_vec,
+                 use_topic) -> ChainState:
+    """Apply a batch of replica moves in one scatter pass.
+
+    ``b_vec[k] == current broker`` encodes a no-op (its ± contributions
+    cancel); the conflict-free selection guarantees accepted moves touch
+    disjoint brokers/hosts/partitions, so scatter-adds commute exactly.
+    """
+    p = dt.partition_of_replica[r_vec]
+    a = st.broker_of[r_vec]
+    is_leader = st.leader_of[p] == r_vec
+    eff = dt.replica_base_load[r_vec] + jnp.where(
+        is_leader[:, None], dt.leader_extra[p], 0.0)          # [K,4]
     pl = (dt.leader_extra[p, res.NW_OUT]
           + dt.replica_base_load[st.leader_of[p], res.NW_OUT])
     lbi = jnp.where(is_leader, dt.leader_bytes_in[p], 0.0)
     lead_f = is_leader.astype(jnp.float32)
-    ha, hb = dt.host_of_broker[a], dt.host_of_broker[b]
-    t = dt.topic_of_partition[p]
+    one = jnp.ones_like(lead_f)
+    ha, hb = dt.host_of_broker[a], dt.host_of_broker[b_vec]
     tc = st.topic_count
     if use_topic:
-        tc = tc.at[a, t].add(-1.0).at[b, t].add(1.0)
+        t = dt.topic_of_partition[p]
+        tc = tc.at[a, t].add(-1.0).at[b_vec, t].add(1.0)
     return st._replace(
-        broker_of=st.broker_of.at[r].set(b),
-        broker_load=st.broker_load.at[a].add(-eff).at[b].add(eff),
+        # delta-add instead of set: no-ops contribute 0, so a duplicate
+        # sampled replica (one accepted, one no-op) still lands exactly once
+        broker_of=st.broker_of.at[r_vec].add(b_vec - a),
+        broker_load=st.broker_load.at[a].add(-eff).at[b_vec].add(eff),
         host_load=st.host_load.at[ha].add(-eff).at[hb].add(eff),
-        replica_count=st.replica_count.at[a].add(-1.0).at[b].add(1.0),
-        leader_count=st.leader_count.at[a].add(-lead_f).at[b].add(lead_f),
-        potential_nw_out=st.potential_nw_out.at[a].add(-pl).at[b].add(pl),
-        leader_bytes_in=st.leader_bytes_in.at[a].add(-lbi).at[b].add(lbi),
+        replica_count=st.replica_count.at[a].add(-one).at[b_vec].add(one),
+        leader_count=st.leader_count.at[a].add(-lead_f).at[b_vec].add(lead_f),
+        potential_nw_out=st.potential_nw_out.at[a].add(-pl).at[b_vec].add(pl),
+        leader_bytes_in=st.leader_bytes_in.at[a].add(-lbi).at[b_vec].add(lbi),
         topic_count=tc,
     )
 
 
-def _apply_lead(dt: DeviceTopology, st: ChainState, p, slot) -> ChainState:
-    """Apply leadership move (no-op when the slot holds the current leader)."""
-    cand = dt.replicas_of_partition[p, slot]
-    cur = st.leader_of[p]
-    new_leader = jnp.where(cand >= 0, cand, cur)
+def _apply_leads(dt: DeviceTopology, st: ChainState, p_vec, new_leader_vec
+                 ) -> ChainState:
+    """Apply a batch of leadership moves (``new_leader == current`` = no-op)."""
+    cur = st.leader_of[p_vec]
+    new_leader = new_leader_vec
+    changed = new_leader != cur
     a = st.broker_of[cur]
     b = st.broker_of[new_leader]
-    extra = jnp.where(new_leader != cur, dt.leader_extra[p],
-                      jnp.zeros(res.NUM_RESOURCES))
-    lbi = jnp.where(new_leader != cur, dt.leader_bytes_in[p], 0.0)
-    d_pl = jnp.where(new_leader != cur,
+    extra = jnp.where(changed[:, None], dt.leader_extra[p_vec], 0.0)  # [K,4]
+    lbi = jnp.where(changed, dt.leader_bytes_in[p_vec], 0.0)
+    d_pl = jnp.where(changed,
                      dt.replica_base_load[new_leader, res.NW_OUT]
                      - dt.replica_base_load[cur, res.NW_OUT], 0.0)
     ha, hb = dt.host_of_broker[a], dt.host_of_broker[b]
-    reps = dt.replicas_of_partition[p]
+    reps = dt.replicas_of_partition[p_vec]                    # [K, m]
     valid = reps >= 0
     mem_b = st.broker_of[jnp.clip(reps, 0)]
-    pot = st.potential_nw_out.at[mem_b].add(jnp.where(valid, d_pl, 0.0))
-    one = (new_leader != cur).astype(jnp.float32)
+    pot = st.potential_nw_out.at[mem_b.reshape(-1)].add(
+        jnp.where(valid, d_pl[:, None], 0.0).reshape(-1))
+    one = changed.astype(jnp.float32)
     return st._replace(
-        leader_of=st.leader_of.at[p].set(new_leader),
+        leader_of=st.leader_of.at[p_vec].add(new_leader - cur),
         broker_load=st.broker_load.at[a].add(-extra).at[b].add(extra),
         host_load=st.host_load.at[ha].add(-extra).at[hb].add(extra),
         leader_count=st.leader_count.at[a].add(-one).at[b].add(one),
@@ -327,42 +434,124 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
     ])[:C]
     temps0 = jnp.asarray(ladder)
 
+    Km, Kl, Ks = cfg.tries_move, cfg.tries_lead, cfg.tries_swap
+    m = dt.max_rf
+
+    def _pressure(st, brokers):
+        """Max resource-utilization fraction — power-of-two-choices key."""
+        load = st.broker_load[brokers]
+        cap = jnp.maximum(th.broker_capacity[brokers], 1e-30)
+        return jnp.max(load / cap, axis=-1)
+
     def step(st: ChainState, temp, key):
-        k1, k2, k3, k4, k5, k6 = jax.random.split(key, 6)
-        # --- candidate replica moves
-        r_c = movable_idx[jax.random.randint(k1, (cfg.tries_move,), 0, movable_idx.size)]
-        b_c = dest_idx[jax.random.randint(k2, (cfg.tries_move,), 0, dest_idx.size)]
+        ks = jax.random.split(key, 11)
+        # --- candidate replica moves: two-choice biased source (hotter
+        # broker) and destination (colder broker)
+        r1 = movable_idx[jax.random.randint(ks[0], (Km,), 0, movable_idx.size)]
+        r2 = movable_idx[jax.random.randint(ks[1], (Km,), 0, movable_idx.size)]
+        hot = _pressure(st, st.broker_of[r1]) >= _pressure(st, st.broker_of[r2])
+        r_c = jnp.where(hot, r1, r2)
+        b1 = dest_idx[jax.random.randint(ks[2], (Km,), 0, dest_idx.size)]
+        b2 = dest_idx[jax.random.randint(ks[3], (Km,), 0, dest_idx.size)]
+        cold = _pressure(st, b1) <= _pressure(st, b2)
+        b_c = jnp.where(cold, b1, b2)
         d_move = jax.vmap(
             lambda r, b: _move_delta(dt, th, weights, opts, st,
                                      initial_broker_of, use_topic, r, b)
         )(r_c, b_c)
         # --- candidate leadership moves
-        p_c = jax.random.randint(k3, (cfg.tries_lead,), 0, P)
-        s_c = jax.random.randint(k4, (cfg.tries_lead,), 0, dt.max_rf)
+        p_c = jax.random.randint(ks[4], (Kl,), 0, P)
+        s_c = jax.random.randint(ks[5], (Kl,), 0, m)
         d_lead = jax.vmap(
             lambda p, s: _lead_delta(dt, th, weights, opts, st, p, s)
         )(p_c, s_c)
 
-        deltas = jnp.concatenate([d_move, d_lead])
-        best = jnp.argmin(deltas)
-        d = deltas[best]
-        accept = (d < 0) | (jax.random.uniform(k5) < jnp.exp(
-            -jnp.minimum(d, 80.0 * temp) / jnp.maximum(temp, 1e-9)))
-        accept = accept & (d < _INF)
+        # --- candidate swaps: hot-biased r1, cold-biased r2
+        w1 = movable_idx[jax.random.randint(ks[7], (Ks,), 0, movable_idx.size)]
+        w2 = movable_idx[jax.random.randint(ks[8], (Ks,), 0, movable_idx.size)]
+        hot_w = _pressure(st, st.broker_of[w1]) >= _pressure(st, st.broker_of[w2])
+        s_r1 = jnp.where(hot_w, w1, w2)
+        w3 = movable_idx[jax.random.randint(ks[9], (Ks,), 0, movable_idx.size)]
+        w4 = movable_idx[jax.random.randint(ks[10], (Ks,), 0, movable_idx.size)]
+        cold_w = _pressure(st, st.broker_of[w3]) <= _pressure(st, st.broker_of[w4])
+        s_r2 = jnp.where(cold_w, w3, w4)
+        d_swap = jax.vmap(
+            lambda x, y: _swap_delta(dt, th, weights, opts, st,
+                                     initial_broker_of, use_topic, x, y)
+        )(s_r1, s_r2)
 
-        is_move = best < cfg.tries_move
-        mi = jnp.minimum(best, cfg.tries_move - 1)
-        li = jnp.clip(best - cfg.tries_move, 0, cfg.tries_lead - 1)
-        r_sel = r_c[mi]
-        # no-op encodings: move to current broker / re-elect current leader
-        b_sel = jnp.where(accept & is_move, b_c[mi], st.broker_of[r_sel])
-        p_sel = p_c[li]
-        cur_slot = jnp.argmax(dt.replicas_of_partition[p_sel] == st.leader_of[p_sel])
-        s_sel = jnp.where(accept & ~is_move, s_c[li], cur_slot)
+        # --- conflict-free selection: proposals touching disjoint brokers /
+        # hosts / partitions (and topics, when the topic term is on) have
+        # exactly additive deltas. Conservative rule: in delta-sorted order a
+        # proposal survives only if it conflicts with NO earlier candidate.
+        K = Km + Kl + Ks
+        deltas = jnp.concatenate([d_move, d_lead, d_swap])
+        mm = max(m, 2)
 
-        st = _apply_move(dt, st, r_sel, b_sel, use_topic)
-        st = _apply_lead(dt, st, p_sel, s_sel)
-        st = st._replace(energy=st.energy + jnp.where(accept, d, 0.0))
+        def padset(x, width=mm):   # pad id-set rows to a common width with -1
+            return jnp.pad(x, ((0, 0), (0, width - x.shape[1])),
+                           constant_values=-1)
+
+        mv_brokers = padset(jnp.stack([st.broker_of[r_c], b_c], axis=1))
+        ld_reps = dt.replicas_of_partition[p_c]                        # [Kl,m]
+        ld_brokers = padset(jnp.where(ld_reps >= 0,
+                                      st.broker_of[jnp.clip(ld_reps, 0)], -1))
+        sw_brokers = padset(jnp.stack([st.broker_of[s_r1],
+                                       st.broker_of[s_r2]], axis=1))
+        touched_b = jnp.concatenate([mv_brokers, ld_brokers, sw_brokers])
+        touched_h = jnp.where(touched_b >= 0,
+                              dt.host_of_broker[jnp.clip(touched_b, 0)], -1)
+        p_of_r = dt.partition_of_replica
+        neg1 = jnp.full((Km,), -1, jnp.int32)
+        negl = jnp.full((Kl,), -1, jnp.int32)
+        part = jnp.concatenate([
+            jnp.stack([p_of_r[r_c], neg1], axis=1),
+            jnp.stack([p_c, negl], axis=1),
+            jnp.stack([p_of_r[s_r1], p_of_r[s_r2]], axis=1)])          # [K,2]
+        if use_topic:
+            t_of_p = dt.topic_of_partition
+            topic = jnp.concatenate([
+                jnp.stack([t_of_p[p_of_r[r_c]], neg1], axis=1),
+                jnp.stack([negl, negl], axis=1),
+                jnp.stack([t_of_p[p_of_r[s_r1]], t_of_p[p_of_r[s_r2]]], axis=1)])
+        else:
+            topic = jnp.full((K, 2), -1, jnp.int32)
+
+        def overlap(x):   # [K,w] padded-id sets → bool[K,K] any shared id
+            eq = (x[:, None, :, None] == x[None, :, None, :])
+            eq &= (x[:, None, :, None] >= 0)
+            return jnp.any(eq, axis=(2, 3))
+
+        conflict = (overlap(touched_b) | overlap(touched_h)
+                    | overlap(part) | overlap(topic))
+
+        order = jnp.argsort(deltas)
+        rank = jnp.zeros(K, jnp.int32).at[order].set(jnp.arange(K, dtype=jnp.int32))
+        earlier = rank[None, :] < rank[:, None]       # j earlier than i
+        blocked = jnp.any(conflict & earlier, axis=1)
+        selected = ~blocked
+
+        u = jax.random.uniform(ks[6], (K,))
+        mh = (deltas < 0) | (u < jnp.exp(-jnp.minimum(deltas, 80.0 * temp)
+                                         / jnp.maximum(temp, 1e-9)))
+        accept = selected & mh & (deltas < _INF)
+
+        acc_mv = accept[:Km]
+        acc_ld = accept[Km:Km + Kl]
+        acc_sw = accept[Km + Kl:]
+        # swap = two moves appended to the move batch
+        all_r = jnp.concatenate([r_c, s_r1, s_r2])
+        all_b = jnp.concatenate([
+            jnp.where(acc_mv, b_c, st.broker_of[r_c]),
+            jnp.where(acc_sw, st.broker_of[s_r2], st.broker_of[s_r1]),
+            jnp.where(acc_sw, st.broker_of[s_r1], st.broker_of[s_r2])])
+        cand = dt.replicas_of_partition[p_c, s_c]
+        cur = st.leader_of[p_c]
+        new_leader = jnp.where(acc_ld & (cand >= 0), cand, cur)
+
+        st = _apply_moves(dt, st, all_r, all_b, use_topic)
+        st = _apply_leads(dt, st, p_c, new_leader)
+        st = st._replace(energy=st.energy + jnp.sum(jnp.where(accept, deltas, 0.0)))
         return st
 
     def chain_round(st: ChainState, temp, key):

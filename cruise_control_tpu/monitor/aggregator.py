@@ -1,0 +1,293 @@
+"""Windowed metric-sample aggregation, array-resident.
+
+Rebuild of the core aggregation engine
+(``cruise-control-core/.../MetricSampleAggregator.java:84``,
+``RawMetricValues.java``): samples land in a cyclic buffer of N time windows
+per entity; aggregation applies each metric's strategy (AVG / MAX / LATEST),
+extrapolates windows with too-few samples, stamps generations, and accounts
+completeness. Unlike the reference's per-entity object maps, state is flat
+ndarrays [E, W, M] — aggregation over 100K entities is a handful of
+vectorized reductions.
+
+Extrapolation semantics (``RawMetricValues.java`` / ``Extrapolation.java``):
+- window with >= min_samples_per_window samples: valid, no extrapolation
+- window with some-but-too-few samples: AVG_AVAILABLE (use what's there)
+- empty window with both neighbors having enough samples: AVG_ADJACENT
+- otherwise: NO_VALID_EXTRAPOLATION — the window is invalid for the entity;
+  an entity with more than ``max_allowed_extrapolations`` extrapolated
+  windows is likewise invalid.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+from typing import Dict, Hashable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.monitor import metricdef as md
+
+
+class Extrapolation(enum.Enum):
+    NONE = "NONE"
+    AVG_AVAILABLE = "AVG_AVAILABLE"
+    AVG_ADJACENT = "AVG_ADJACENT"
+    NO_VALID_EXTRAPOLATION = "NO_VALID_EXTRAPOLATION"
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelCompletenessRequirements:
+    """monitor/ModelCompletenessRequirements.java: validity contract."""
+
+    min_required_num_windows: int = 1
+    min_monitored_partitions_percentage: float = 0.0
+    include_all_topics: bool = False
+
+    def stronger(self, other: "ModelCompletenessRequirements"):
+        return ModelCompletenessRequirements(
+            max(self.min_required_num_windows, other.min_required_num_windows),
+            max(self.min_monitored_partitions_percentage,
+                other.min_monitored_partitions_percentage),
+            self.include_all_topics or other.include_all_topics,
+        )
+
+
+@dataclasses.dataclass
+class AggregationResult:
+    """ValuesAndExtrapolations for all valid entities at once."""
+
+    entities: List[Hashable]              # valid entities, row-aligned
+    values: np.ndarray                    # f64[Ev, Wv, M] aggregated per window
+    window_times: np.ndarray              # i64[Wv] window start ms (oldest first)
+    extrapolations: np.ndarray            # i8[Ev, Wv] Extrapolation ordinal
+    completeness: "Completeness"
+    generation: int
+
+
+@dataclasses.dataclass
+class Completeness:
+    """MetricSampleCompleteness: per-window and overall coverage."""
+
+    valid_entity_ratio_per_window: np.ndarray  # f32[Wv]
+    valid_entity_ratio: float
+    valid_entity_groups: int
+    num_valid_windows: int
+    num_valid_entities: int
+
+
+class MetricSampleAggregator:
+    """Cyclic-window aggregator for one entity class (partition or broker).
+
+    ``group_of`` maps an entity to its group (topic for partitions) for
+    ENTITY_GROUP granularity completeness (AggregationOptions granularity,
+    ``MetricSampleAggregator.java:54-68``).
+    """
+
+    def __init__(self, num_windows: int = 5, window_ms: int = 60_000,
+                 min_samples_per_window: int = 3,
+                 max_allowed_extrapolations: int = 5,
+                 num_metrics: int = md.NUM_MODEL_METRICS,
+                 strategies: Optional[Sequence[md.Strategy]] = None):
+        self.num_windows = num_windows
+        self.window_ms = window_ms
+        self.min_samples = min_samples_per_window
+        self.max_extrapolations = max_allowed_extrapolations
+        self.M = num_metrics
+        if strategies is None:
+            strategies = [md.METRIC_STRATEGY[md.ModelMetric(i)]
+                          for i in range(num_metrics)]
+        self._strategies = list(strategies)
+        self._avg_cols = np.array([i for i, s in enumerate(self._strategies)
+                                   if s == md.Strategy.AVG], dtype=np.int64)
+        self._max_cols = np.array([i for i, s in enumerate(self._strategies)
+                                   if s == md.Strategy.MAX], dtype=np.int64)
+        self._latest_cols = np.array([i for i, s in enumerate(self._strategies)
+                                      if s == md.Strategy.LATEST], dtype=np.int64)
+
+        self._lock = threading.RLock()
+        self._entity_rows: Dict[Hashable, int] = {}
+        self._entities: List[Hashable] = []
+        self._group_of: Dict[Hashable, Hashable] = {}
+        cap = 64
+        W1 = num_windows + 1  # + current (incomplete) window
+        self._sum = np.zeros((cap, W1, self.M))
+        self._max = np.full((cap, W1, self.M), -np.inf)
+        self._latest = np.zeros((cap, W1, self.M))
+        self._latest_t = np.full((cap, W1), -1, np.int64)
+        self._count = np.zeros((cap, W1), np.int32)
+        self._oldest_window: Optional[int] = None  # window index (time//window_ms)
+        self.generation = 0
+
+    # -- bookkeeping --------------------------------------------------------
+
+    def _row(self, entity: Hashable, group: Hashable) -> int:
+        row = self._entity_rows.get(entity)
+        if row is None:
+            row = len(self._entities)
+            if row == self._sum.shape[0]:
+                grow = lambda a, fill: np.concatenate(
+                    [a, np.full_like(a, fill)], axis=0)
+                self._sum = grow(self._sum, 0.0)
+                self._max = grow(self._max, -np.inf)
+                self._latest = grow(self._latest, 0.0)
+                self._latest_t = grow(self._latest_t, -1)
+                self._count = grow(self._count, 0)
+            self._entity_rows[entity] = row
+            self._entities.append(entity)
+            self.generation += 1
+        self._group_of[entity] = group
+        return row
+
+    def _slot(self, widx: int) -> int:
+        """Cyclic slot for a window index; rolls the buffer forward."""
+        W1 = self.num_windows + 1
+        if self._oldest_window is None:
+            self._oldest_window = widx
+        if widx < self._oldest_window:
+            return -1  # too old, dropped
+        newest = self._oldest_window + self.num_windows
+        if widx > newest:
+            shift = widx - newest
+            self._roll(shift)
+            self._oldest_window += shift
+        return (widx - self._oldest_window) % W1 if False else widx % W1
+
+    def _roll(self, shift: int):
+        """Zero the slots that cycle out (they become future windows)."""
+        W1 = self.num_windows + 1
+        shift = min(shift, W1)
+        for s in range(shift):
+            slot = (self._oldest_window + s) % W1
+            self._sum[:, slot] = 0.0
+            self._max[:, slot] = -np.inf
+            self._latest[:, slot] = 0.0
+            self._latest_t[:, slot] = -1
+            self._count[:, slot] = 0
+        self.generation += 1
+
+    # -- ingest -------------------------------------------------------------
+
+    def add_sample(self, entity: Hashable, time_ms: int,
+                   values: np.ndarray, group: Hashable = None) -> bool:
+        """Record one sample; values is an M-vector (NaN = absent)."""
+        with self._lock:
+            row = self._row(entity, group)
+            widx = int(time_ms) // self.window_ms
+            slot = self._slot(widx)
+            if slot < 0:
+                return False
+            v = np.asarray(values, dtype=np.float64)
+            present = ~np.isnan(v)
+            vv = np.where(present, v, 0.0)
+            self._sum[row, slot] += vv
+            self._max[row, slot] = np.maximum(self._max[row, slot],
+                                              np.where(present, v, -np.inf))
+            newer = time_ms >= self._latest_t[row, slot]
+            if newer:
+                self._latest[row, slot] = np.where(present, v,
+                                                   self._latest[row, slot])
+                self._latest_t[row, slot] = time_ms
+            self._count[row, slot] += 1
+            return True
+
+    # -- aggregate ----------------------------------------------------------
+
+    def _stable_slots(self, now_ms: int) -> np.ndarray:
+        """Slots of the N completed windows, oldest first."""
+        W1 = self.num_windows + 1
+        cur = int(now_ms) // self.window_ms
+        if self._oldest_window is None:
+            return np.zeros(0, np.int64)
+        first = max(self._oldest_window, cur - self.num_windows)
+        widxs = np.arange(first, cur)
+        return widxs
+
+    def aggregate(self, now_ms: int,
+                  requirements: ModelCompletenessRequirements = ModelCompletenessRequirements(),
+                  ) -> AggregationResult:
+        """Aggregate all completed windows (newest-to-oldest trimmed to the
+        cyclic capacity), extrapolating sparse windows per entity."""
+        with self._lock:
+            E = len(self._entities)
+            widxs = self._stable_slots(now_ms)
+            Wv = len(widxs)
+            W1 = self.num_windows + 1
+            if E == 0 or Wv == 0:
+                return AggregationResult(
+                    entities=[], values=np.zeros((0, Wv, self.M)),
+                    window_times=widxs * self.window_ms,
+                    extrapolations=np.zeros((0, Wv), np.int8),
+                    completeness=Completeness(np.zeros(Wv, np.float32), 0.0, 0, 0, 0),
+                    generation=self.generation)
+
+            slots = (widxs % W1).astype(np.int64)
+            cnt = self._count[:E][:, slots]                     # [E, Wv]
+            ssum = self._sum[:E][:, slots]
+            smax = self._max[:E][:, slots]
+            slatest = self._latest[:E][:, slots]
+
+            safe_cnt = np.maximum(cnt, 1)[:, :, None]
+            vals = np.zeros((E, Wv, self.M))
+            if self._avg_cols.size:
+                vals[:, :, self._avg_cols] = ssum[:, :, self._avg_cols] / safe_cnt
+            if self._max_cols.size:
+                vals[:, :, self._max_cols] = np.where(
+                    np.isfinite(smax[:, :, self._max_cols]),
+                    smax[:, :, self._max_cols], 0.0)
+            if self._latest_cols.size:
+                vals[:, :, self._latest_cols] = slatest[:, :, self._latest_cols]
+
+            full = cnt >= self.min_samples                       # [E, Wv]
+            some = cnt > 0
+            extra = np.zeros((E, Wv), np.int8)
+            extra[some & ~full] = 1                              # AVG_AVAILABLE
+            # AVG_ADJACENT for empty windows with both neighbors full
+            left = np.roll(full, 1, axis=1)
+            left[:, 0] = False
+            right = np.roll(full, -1, axis=1)
+            right[:, -1] = False
+            adj = ~some & left & right
+            if adj.any():
+                lv = np.roll(vals, 1, axis=1)
+                rv = np.roll(vals, -1, axis=1)
+                vals[adj] = 0.5 * (lv[adj] + rv[adj])
+                extra[adj] = 2                                   # AVG_ADJACENT
+            invalid = ~some & ~adj
+            extra[invalid] = 3                                   # NO_VALID_EXTRAPOLATION
+
+            n_extrap = ((extra == 1) | (extra == 2)).sum(axis=1)
+            entity_valid = (~invalid.any(axis=1)) & (n_extrap <= self.max_extrapolations)
+
+            ratio_per_window = (some | adj)[entity_valid].mean(axis=0).astype(np.float32) \
+                if entity_valid.any() else np.zeros(Wv, np.float32)
+            valid_ratio = float(entity_valid.mean())
+            groups = {self._group_of.get(e) for i, e in enumerate(self._entities)
+                      if entity_valid[i]}
+
+            rows = np.flatnonzero(entity_valid)
+            return AggregationResult(
+                entities=[self._entities[i] for i in rows],
+                values=vals[rows],
+                window_times=widxs * self.window_ms,
+                extrapolations=extra[rows],
+                completeness=Completeness(
+                    valid_entity_ratio_per_window=ratio_per_window,
+                    valid_entity_ratio=valid_ratio,
+                    valid_entity_groups=len(groups),
+                    num_valid_windows=Wv,
+                    num_valid_entities=int(entity_valid.sum()),
+                ),
+                generation=self.generation,
+            )
+
+    def meets(self, result: AggregationResult,
+              req: ModelCompletenessRequirements) -> bool:
+        c = result.completeness
+        return (c.num_valid_windows >= req.min_required_num_windows
+                and c.valid_entity_ratio >= req.min_monitored_partitions_percentage)
+
+    @property
+    def num_entities(self) -> int:
+        return len(self._entities)

@@ -1,0 +1,133 @@
+"""Metric taxonomy: raw broker/topic/partition metrics → model metrics.
+
+Mirrors the reference's two-level metric system:
+
+- 63 raw metric types shipped by the in-broker reporter
+  (``cruise-control-metrics-reporter/.../metric/RawMetricType.java:26-96``),
+  each scoped BROKER / TOPIC / PARTITION.
+- ~14 model metrics with an aggregation strategy (AVG / MAX / LATEST) and an
+  optional balanced-resource binding
+  (``monitor/metricdefinition/KafkaMetricDef.java:42-135``).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Dict, Optional
+
+from cruise_control_tpu.common import resources as res
+
+
+class MetricScope(enum.Enum):
+    BROKER = "BROKER"
+    TOPIC = "TOPIC"
+    PARTITION = "PARTITION"
+
+
+class Strategy(enum.Enum):
+    AVG = "AVG"
+    MAX = "MAX"
+    LATEST = "LATEST"
+
+
+# --- raw metric types (RawMetricType.java ids) -----------------------------
+
+_BROKER_TIME_METRICS = [
+    "BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS", "BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS",
+    "BROKER_FOLLOWER_FETCH_REQUEST_QUEUE_TIME_MS", "BROKER_PRODUCE_TOTAL_TIME_MS",
+    "BROKER_CONSUMER_FETCH_TOTAL_TIME_MS", "BROKER_FOLLOWER_FETCH_TOTAL_TIME_MS",
+    "BROKER_PRODUCE_LOCAL_TIME_MS", "BROKER_CONSUMER_FETCH_LOCAL_TIME_MS",
+    "BROKER_FOLLOWER_FETCH_LOCAL_TIME_MS",
+]
+
+RAW_METRIC_TYPES: Dict[str, MetricScope] = {}
+
+
+def _raw(name: str, scope: MetricScope):
+    RAW_METRIC_TYPES[name] = scope
+
+
+for _n in ("ALL_TOPIC_BYTES_IN", "ALL_TOPIC_BYTES_OUT", "BROKER_CPU_UTIL",
+           "ALL_TOPIC_REPLICATION_BYTES_IN", "ALL_TOPIC_REPLICATION_BYTES_OUT",
+           "ALL_TOPIC_PRODUCE_REQUEST_RATE", "ALL_TOPIC_FETCH_REQUEST_RATE",
+           "ALL_TOPIC_MESSAGES_IN_PER_SEC", "BROKER_PRODUCE_REQUEST_RATE",
+           "BROKER_CONSUMER_FETCH_REQUEST_RATE", "BROKER_FOLLOWER_FETCH_REQUEST_RATE",
+           "BROKER_REQUEST_HANDLER_AVG_IDLE_PERCENT", "BROKER_REQUEST_QUEUE_SIZE",
+           "BROKER_RESPONSE_QUEUE_SIZE", "BROKER_LOG_FLUSH_RATE"):
+    _raw(_n, MetricScope.BROKER)
+for _base in _BROKER_TIME_METRICS:
+    for _suffix in ("_MAX", "_MEAN", "_50TH", "_999TH"):
+        _raw(_base + _suffix, MetricScope.BROKER)
+for _suffix in ("_MAX", "_MEAN", "_50TH", "_999TH"):
+    _raw("BROKER_LOG_FLUSH_TIME_MS" + _suffix, MetricScope.BROKER)
+for _n in ("TOPIC_BYTES_IN", "TOPIC_BYTES_OUT", "TOPIC_REPLICATION_BYTES_IN",
+           "TOPIC_REPLICATION_BYTES_OUT", "TOPIC_PRODUCE_REQUEST_RATE",
+           "TOPIC_FETCH_REQUEST_RATE", "TOPIC_MESSAGES_IN_PER_SEC"):
+    _raw(_n, MetricScope.TOPIC)
+_raw("PARTITION_SIZE", MetricScope.PARTITION)
+
+
+# --- model metrics (KafkaMetricDef) ----------------------------------------
+
+class ModelMetric(enum.IntEnum):
+    """Common (partition-level) model metrics; ids are array columns."""
+
+    CPU_USAGE = 0
+    DISK_USAGE = 1
+    LEADER_BYTES_IN = 2
+    LEADER_BYTES_OUT = 3
+    PRODUCE_RATE = 4
+    FETCH_RATE = 5
+    MESSAGE_IN_RATE = 6
+    REPLICATION_BYTES_IN_RATE = 7
+    REPLICATION_BYTES_OUT_RATE = 8
+
+
+NUM_MODEL_METRICS = len(ModelMetric)
+
+#: aggregation strategy per model metric (KafkaMetricDef.java:44-52)
+METRIC_STRATEGY: Dict[ModelMetric, Strategy] = {
+    ModelMetric.CPU_USAGE: Strategy.AVG,
+    ModelMetric.DISK_USAGE: Strategy.LATEST,
+    ModelMetric.LEADER_BYTES_IN: Strategy.AVG,
+    ModelMetric.LEADER_BYTES_OUT: Strategy.AVG,
+    ModelMetric.PRODUCE_RATE: Strategy.AVG,
+    ModelMetric.FETCH_RATE: Strategy.AVG,
+    ModelMetric.MESSAGE_IN_RATE: Strategy.AVG,
+    ModelMetric.REPLICATION_BYTES_IN_RATE: Strategy.AVG,
+    ModelMetric.REPLICATION_BYTES_OUT_RATE: Strategy.AVG,
+}
+
+#: balanced-resource binding (KafkaMetricDef resource column)
+METRIC_RESOURCE: Dict[ModelMetric, Optional[int]] = {
+    ModelMetric.CPU_USAGE: res.CPU,
+    ModelMetric.DISK_USAGE: res.DISK,
+    ModelMetric.LEADER_BYTES_IN: res.NW_IN,
+    ModelMetric.LEADER_BYTES_OUT: res.NW_OUT,
+    ModelMetric.PRODUCE_RATE: None,
+    ModelMetric.FETCH_RATE: None,
+    ModelMetric.MESSAGE_IN_RATE: None,
+    ModelMetric.REPLICATION_BYTES_IN_RATE: res.NW_IN,
+    ModelMetric.REPLICATION_BYTES_OUT_RATE: res.NW_OUT,
+}
+
+#: raw → model mapping for partition/topic-scope ingestion
+# (KafkaMetricDef.java TYPE_TO_DEF static block)
+RAW_TO_MODEL: Dict[str, ModelMetric] = {
+    "TOPIC_BYTES_IN": ModelMetric.LEADER_BYTES_IN,
+    "TOPIC_BYTES_OUT": ModelMetric.LEADER_BYTES_OUT,
+    "TOPIC_REPLICATION_BYTES_IN": ModelMetric.REPLICATION_BYTES_IN_RATE,
+    "TOPIC_REPLICATION_BYTES_OUT": ModelMetric.REPLICATION_BYTES_OUT_RATE,
+    "TOPIC_PRODUCE_REQUEST_RATE": ModelMetric.PRODUCE_RATE,
+    "TOPIC_FETCH_REQUEST_RATE": ModelMetric.FETCH_RATE,
+    "TOPIC_MESSAGES_IN_PER_SEC": ModelMetric.MESSAGE_IN_RATE,
+    "PARTITION_SIZE": ModelMetric.DISK_USAGE,
+    "ALL_TOPIC_BYTES_IN": ModelMetric.LEADER_BYTES_IN,
+    "ALL_TOPIC_BYTES_OUT": ModelMetric.LEADER_BYTES_OUT,
+    "ALL_TOPIC_REPLICATION_BYTES_IN": ModelMetric.REPLICATION_BYTES_IN_RATE,
+    "ALL_TOPIC_REPLICATION_BYTES_OUT": ModelMetric.REPLICATION_BYTES_OUT_RATE,
+    "ALL_TOPIC_PRODUCE_REQUEST_RATE": ModelMetric.PRODUCE_RATE,
+    "ALL_TOPIC_FETCH_REQUEST_RATE": ModelMetric.FETCH_RATE,
+    "ALL_TOPIC_MESSAGES_IN_PER_SEC": ModelMetric.MESSAGE_IN_RATE,
+    "BROKER_CPU_UTIL": ModelMetric.CPU_USAGE,
+}
