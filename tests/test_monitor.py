@@ -1,0 +1,241 @@
+"""Monitor subsystem tests, modeled on the reference's
+MetricSampleAggregatorTest / LoadMonitorTest patterns: window rolling,
+extrapolation, completeness gating, capacity resolution, end-to-end model
+building from a fake metadata source + synthetic sampler.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.common import resources as res
+from cruise_control_tpu.models.cluster import derive_follower_load
+from cruise_control_tpu.monitor import metricdef as md
+from cruise_control_tpu.monitor.aggregator import (
+    MetricSampleAggregator,
+    ModelCompletenessRequirements,
+)
+from cruise_control_tpu.monitor.capacity import (
+    FileCapacityResolver,
+    StaticCapacityResolver,
+)
+from cruise_control_tpu.monitor.load_monitor import (
+    LoadMonitor,
+    MonitorState,
+    NotEnoughValidWindowsError,
+    StaticMetadataSource,
+)
+from cruise_control_tpu.monitor.sample_store import FileSampleStore
+from cruise_control_tpu.monitor.sampler import (
+    BrokerMetadata,
+    ClusterMetadata,
+    PartitionMetadata,
+    PartitionMetricSample,
+    SyntheticLoadSampler,
+)
+
+W = 60_000  # window ms
+
+
+def _sample(topic, part, t, nw_in=100.0, disk=50.0):
+    m = np.full(md.NUM_MODEL_METRICS, np.nan)
+    m[md.ModelMetric.LEADER_BYTES_IN] = nw_in
+    m[md.ModelMetric.DISK_USAGE] = disk
+    return (topic, part), t, m
+
+
+def test_aggregator_windows_and_strategies():
+    agg = MetricSampleAggregator(num_windows=3, window_ms=W,
+                                 min_samples_per_window=1)
+    e = ("t", 0)
+    # window 0: two samples -> AVG averages, LATEST takes newest
+    agg.add_sample(e, 10_000, _sample("t", 0, 10_000, nw_in=100.0, disk=10.0)[2], group="t")
+    agg.add_sample(e, 20_000, _sample("t", 0, 20_000, nw_in=200.0, disk=30.0)[2], group="t")
+    # windows 1, 2
+    agg.add_sample(e, W + 5_000, _sample("t", 0, W + 5_000, nw_in=300.0, disk=40.0)[2], group="t")
+    agg.add_sample(e, 2 * W + 5_000, _sample("t", 0, 2 * W + 5_000, nw_in=400.0, disk=50.0)[2], group="t")
+    r = agg.aggregate(now_ms=3 * W)
+    assert r.completeness.num_valid_windows == 3
+    assert len(r.entities) == 1
+    v = r.values[0]  # [W=3, M]
+    assert v[0, md.ModelMetric.LEADER_BYTES_IN] == pytest.approx(150.0)  # AVG
+    assert v[0, md.ModelMetric.DISK_USAGE] == pytest.approx(30.0)        # LATEST
+    assert v[1, md.ModelMetric.LEADER_BYTES_IN] == pytest.approx(300.0)
+    assert v[2, md.ModelMetric.LEADER_BYTES_IN] == pytest.approx(400.0)
+
+
+def test_aggregator_avg_adjacent_extrapolation():
+    agg = MetricSampleAggregator(num_windows=3, window_ms=W,
+                                 min_samples_per_window=1)
+    e = ("t", 0)
+    agg.add_sample(e, 5_000, _sample("t", 0, 5_000, nw_in=100.0)[2], group="t")
+    # window 1 empty
+    agg.add_sample(e, 2 * W + 5_000, _sample("t", 0, 0, nw_in=300.0)[2], group="t")
+    r = agg.aggregate(now_ms=3 * W)
+    assert len(r.entities) == 1
+    v = r.values[0]
+    # middle window borrowed from neighbors: (100+300)/2
+    assert v[1, md.ModelMetric.LEADER_BYTES_IN] == pytest.approx(200.0)
+    assert r.extrapolations[0, 1] == 2  # AVG_ADJACENT
+
+
+def test_aggregator_invalid_entity_dropped():
+    agg = MetricSampleAggregator(num_windows=3, window_ms=W,
+                                 min_samples_per_window=1)
+    # entity with only one sample in the first of 3 windows -> two empty
+    # windows in a row cannot extrapolate -> entity invalid
+    agg.add_sample(("t", 0), 5_000, _sample("t", 0, 0)[2], group="t")
+    # a healthy entity with samples in all windows
+    for w in range(3):
+        agg.add_sample(("t", 1), w * W + 5_000, _sample("t", 1, 0)[2], group="t")
+    r = agg.aggregate(now_ms=3 * W)
+    assert r.entities == [("t", 1)]
+    assert r.completeness.valid_entity_ratio == pytest.approx(0.5)
+
+
+def test_aggregator_window_rolling_drops_oldest():
+    agg = MetricSampleAggregator(num_windows=2, window_ms=W,
+                                 min_samples_per_window=1)
+    e = ("t", 0)
+    agg.add_sample(e, 5_000, _sample("t", 0, 0, nw_in=1.0)[2], group="t")
+    gen0 = agg.generation
+    # jump 5 windows ahead: the old window cycles out, generation bumps
+    agg.add_sample(e, 5 * W + 5_000, _sample("t", 0, 0, nw_in=5.0)[2], group="t")
+    assert agg.generation > gen0
+    r = agg.aggregate(now_ms=6 * W)
+    assert r.completeness.num_valid_windows == 2
+
+
+def test_capacity_file_resolver_formats(tmp_path):
+    plain = {"brokerCapacities": [
+        {"brokerId": "-1", "capacity": {"DISK": "100000", "CPU": "100",
+                                        "NW_IN": "10000", "NW_OUT": "10000"}},
+        {"brokerId": "0", "capacity": {"DISK": "500000", "CPU": "100",
+                                       "NW_IN": "50000", "NW_OUT": "50000"}},
+    ]}
+    p = tmp_path / "capacity.json"
+    p.write_text(json.dumps(plain))
+    r = FileCapacityResolver(str(p))
+    assert r.capacity_for_broker(0).capacity[res.DISK] == 500000
+    assert r.capacity_for_broker(7).capacity[res.DISK] == 100000  # default
+
+    jbod = {"brokerCapacities": [
+        {"brokerId": "-1", "capacity": {
+            "DISK": {"/d1": "100000", "/d2": "50000"},
+            "CPU": "100", "NW_IN": "10000", "NW_OUT": "10000"}},
+    ]}
+    p2 = tmp_path / "capacityJBOD.json"
+    p2.write_text(json.dumps(jbod))
+    r2 = FileCapacityResolver(str(p2))
+    info = r2.capacity_for_broker(3)
+    assert info.is_jbod
+    assert info.capacity[res.DISK] == 150000
+    assert info.disk_capacity_by_logdir == {"/d1": 100000.0, "/d2": 50000.0}
+
+    cores = {"brokerCapacities": [
+        {"brokerId": "-1", "num.cores": "8",
+         "capacity": {"DISK": "100000", "NW_IN": "10000", "NW_OUT": "10000"}},
+    ]}
+    p3 = tmp_path / "capacityCores.json"
+    p3.write_text(json.dumps(cores))
+    assert FileCapacityResolver(str(p3)).capacity_for_broker(0).capacity[res.CPU] == 800.0
+
+
+def _metadata(num_brokers=4, num_parts=8, rf=2, dead=()):
+    brokers = [BrokerMetadata(i, rack=f"r{i % 2}", host=f"h{i}",
+                              alive=i not in dead)
+               for i in range(num_brokers)]
+    parts = []
+    for p in range(num_parts):
+        reps = tuple((p + j) % num_brokers for j in range(rf))
+        parts.append(PartitionMetadata(topic="T", partition=p,
+                                       leader=reps[0], replicas=reps))
+    return ClusterMetadata(brokers=brokers, partitions=parts, generation=1)
+
+
+def _filled_monitor(metadata, windows=3):
+    lm = LoadMonitor(StaticMetadataSource(metadata), SyntheticLoadSampler(seed=5),
+                     num_windows=windows, window_ms=W)
+    for w in range(windows + 1):
+        lm.sample_once(now_ms=w * W + 30_000)
+    return lm
+
+
+def test_load_monitor_builds_model():
+    metadata = _metadata()
+    lm = _filled_monitor(metadata)
+    topo, assign = lm.cluster_model(
+        now_ms=4 * W,
+        requirements=ModelCompletenessRequirements(min_required_num_windows=2))
+    assert topo.num_brokers == 4
+    assert topo.num_partitions == 8
+    assert topo.num_replicas == 16
+    # follower load derivation: follower NW_OUT must be 0
+    from cruise_control_tpu.ops.aggregates import device_topology
+    from cruise_control_tpu.ops.stats import sanity_check
+    dt = device_topology(topo)
+    checks = sanity_check(dt, assign, topo.num_topics)
+    assert all(checks.values()), checks
+    is_leader = np.zeros(topo.num_replicas, bool)
+    is_leader[np.asarray(assign.leader_of)] = True
+    assert (topo.replica_base_load[~is_leader][:, res.NW_OUT] >= 0).all()
+
+
+def test_load_monitor_dead_broker_offline_replicas():
+    metadata = _metadata(dead=(1,))
+    lm = _filled_monitor(metadata)
+    topo, assign = lm.cluster_model(now_ms=4 * W)
+    assert not topo.broker_alive[[b == 1 for b in topo.broker_ids]].any()
+    on_dead = np.asarray(assign.broker_of) == list(topo.broker_ids).index(1)
+    assert topo.replica_offline[on_dead].all()
+
+
+def test_load_monitor_completeness_gate():
+    metadata = _metadata()
+    lm = LoadMonitor(StaticMetadataSource(metadata), SyntheticLoadSampler(),
+                     num_windows=5, window_ms=W)
+    lm.sample_once(now_ms=30_000)
+    with pytest.raises(NotEnoughValidWindowsError):
+        lm.cluster_model(
+            now_ms=W,
+            requirements=ModelCompletenessRequirements(min_required_num_windows=3))
+
+
+def test_load_monitor_pause_resume_state():
+    lm = LoadMonitor(StaticMetadataSource(_metadata()), SyntheticLoadSampler())
+    assert lm.state == MonitorState.NOT_STARTED
+    lm._state = MonitorState.RUNNING
+    lm.pause("maintenance")
+    assert lm.state == MonitorState.PAUSED
+    lm.resume("done")
+    assert lm.state == MonitorState.RUNNING
+    snap = lm.state_snapshot(now_ms=W)
+    assert snap["state"] == "RUNNING"
+
+
+def test_sample_store_roundtrip(tmp_path):
+    store = FileSampleStore(str(tmp_path))
+    metadata = _metadata()
+    sampler = SyntheticLoadSampler(seed=5)
+    ps, bs = sampler.get_samples(metadata, 0, W)
+    store.store_samples(ps, bs)
+    got_p, got_b = [], []
+    n = store.load_samples(got_p.append, got_b.append)
+    assert n == len(ps) + len(bs)
+    assert got_p[0].topic == ps[0].topic
+    np.testing.assert_allclose(got_p[0].metrics, ps[0].metrics)
+    assert got_b[0].broker_id == bs[0].broker_id
+
+
+def test_monitor_to_optimizer_end_to_end():
+    """Full slice: metadata + synthetic samples -> model -> optimization."""
+    from cruise_control_tpu.analyzer import optimizer as OPT
+    metadata = _metadata(num_brokers=6, num_parts=40, rf=2)
+    lm = _filled_monitor(metadata)
+    topo, assign = lm.cluster_model(now_ms=4 * W)
+    r = OPT.optimize(topo, assign)
+    assert r.balancedness_after >= r.balancedness_before
+    hard = [s for s in r.goal_summaries if s.hard]
+    assert all(s.violations_after == 0 for s in hard)
