@@ -1,0 +1,346 @@
+"""Executor: drives accepted proposals against the live cluster.
+
+Rebuild of ``executor/Executor.java:69-1100``: three phases per execution —
+inter-broker replica moves (batched by per-broker concurrency,
+``Executor.java:932``), intra-broker moves (:995), leadership moves (:1050) —
+with progress polling, graceful/forced stop, replication throttling, and
+notifier callbacks. The cluster-side apply API is the pluggable
+:class:`ClusterAdapter` — the seam the reference implements with the Scala
+ZK bridge (``ExecutorUtils.scala:22-34``) + AdminClient; tests use
+:class:`FakeClusterAdapter`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.executor.tasks import (
+    ExecutionTask,
+    ExecutionTaskPlanner,
+    ExecutionTaskTracker,
+    ReplicaMovementStrategy,
+    TaskState,
+    TaskType,
+)
+
+
+class ExecutorState(enum.Enum):
+    NO_TASK_IN_PROGRESS = "NO_TASK_IN_PROGRESS"
+    STARTING_EXECUTION = "STARTING_EXECUTION"
+    INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS = \
+        "INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS"
+    INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS = \
+        "INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS"
+    LEADER_MOVEMENT_TASK_IN_PROGRESS = "LEADER_MOVEMENT_TASK_IN_PROGRESS"
+    STOPPING_EXECUTION = "STOPPING_EXECUTION"
+
+
+class ClusterAdapter:
+    """The cluster-side apply seam (ExecutorUtils.scala / ExecutorAdminUtils).
+
+    A Kafka implementation submits reassignments via the admin/ZK API; the
+    fake applies them after a configurable number of polls.
+    """
+
+    def execute_replica_reassignments(self, tasks: Sequence[ExecutionTask]) -> None:
+        raise NotImplementedError
+
+    def execute_preferred_leader_elections(self, tasks: Sequence[ExecutionTask]) -> None:
+        raise NotImplementedError
+
+    def current_replicas(self, topic_partition: str) -> Tuple[int, ...]:
+        raise NotImplementedError
+
+    def current_leader(self, topic_partition: str) -> int:
+        raise NotImplementedError
+
+    def in_progress_reassignments(self) -> Set[str]:
+        raise NotImplementedError
+
+    def set_replication_throttles(self, rate_bytes_per_sec: int,
+                                  topic_partitions: Sequence[str]) -> None:
+        pass
+
+    def clear_replication_throttles(self) -> None:
+        pass
+
+    def dead_brokers(self) -> Set[int]:
+        return set()
+
+
+class FakeClusterAdapter(ClusterAdapter):
+    """In-memory cluster: reassignments complete after ``latency_polls``
+    polls — the test double standing in for the embedded-broker harness."""
+
+    def __init__(self, replicas_by_tp: Dict[str, Tuple[int, ...]],
+                 leaders_by_tp: Optional[Dict[str, int]] = None,
+                 latency_polls: int = 1):
+        self.replicas: Dict[str, Tuple[int, ...]] = dict(replicas_by_tp)
+        self.leaders: Dict[str, int] = dict(leaders_by_tp or {
+            tp: reps[0] for tp, reps in replicas_by_tp.items()})
+        self.latency = latency_polls
+        self._pending: Dict[str, Tuple[int, Tuple[int, ...]]] = {}
+        self._pending_ple: Dict[str, Tuple[int, int]] = {}
+        self.throttle: Optional[int] = None
+        self.throttled_tps: List[str] = []
+        self._dead: Set[int] = set()
+
+    # -- adapter API --
+    def execute_replica_reassignments(self, tasks):
+        for t in tasks:
+            self._pending[t.proposal.topic_partition] = (
+                self.latency, t.proposal.new_replicas)
+
+    def execute_preferred_leader_elections(self, tasks):
+        for t in tasks:
+            self._pending_ple[t.proposal.topic_partition] = (
+                self.latency, t.proposal.new_replicas[0])
+
+    def current_replicas(self, tp):
+        self._tick(tp)
+        return self.replicas.get(tp, ())
+
+    def current_leader(self, tp):
+        self._tick(tp)
+        return self.leaders.get(tp, -1)
+
+    def in_progress_reassignments(self):
+        return set(self._pending)
+
+    def set_replication_throttles(self, rate, tps):
+        self.throttle = rate
+        self.throttled_tps = list(tps)
+
+    def clear_replication_throttles(self):
+        self.throttle = None
+        self.throttled_tps = []
+
+    def dead_brokers(self):
+        return set(self._dead)
+
+    def kill_broker(self, broker_id: int):
+        self._dead.add(broker_id)
+
+    def _tick(self, tp):
+        if tp in self._pending:
+            n, target = self._pending[tp]
+            if n <= 1:
+                self.replicas[tp] = target
+                if self.leaders.get(tp) not in target:
+                    self.leaders[tp] = target[0]
+                del self._pending[tp]
+            else:
+                self._pending[tp] = (n - 1, target)
+        if tp in self._pending_ple:
+            n, leader = self._pending_ple[tp]
+            if n <= 1:
+                self.leaders[tp] = leader
+                del self._pending_ple[tp]
+            else:
+                self._pending_ple[tp] = (n - 1, leader)
+
+
+class ExecutorNotifier:
+    """SPI (executor/ExecutorNotifier.java)."""
+
+    def on_execution_finished(self, summary: dict):
+        pass
+
+    def on_execution_stopped(self, summary: dict):
+        pass
+
+
+@dataclasses.dataclass
+class ExecutorConfig:
+    num_concurrent_partition_movements_per_broker: int = 5
+    num_concurrent_leader_movements: int = 1000
+    execution_progress_check_interval_ms: int = 10
+    max_execution_progress_check_rounds: int = 10_000
+    default_replication_throttle: Optional[int] = None
+    leadership_movement_timeout_rounds: int = 100
+
+
+class Executor:
+    """Applies proposals; one execution at a time (Executor.java:383)."""
+
+    def __init__(self, adapter: ClusterAdapter,
+                 config: Optional[ExecutorConfig] = None,
+                 notifier: Optional[ExecutorNotifier] = None,
+                 strategy: Optional[ReplicaMovementStrategy] = None):
+        self.adapter = adapter
+        self.config = config or ExecutorConfig()
+        self.notifier = notifier or ExecutorNotifier()
+        self._strategy = strategy
+        self._state = ExecutorState.NO_TASK_IN_PROGRESS
+        self._stop_requested = threading.Event()
+        self._lock = threading.Lock()
+        self.tracker = ExecutionTaskTracker()
+        self._planner: Optional[ExecutionTaskPlanner] = None
+        self.recently_removed_brokers: Set[int] = set()
+        self.recently_demoted_brokers: Set[int] = set()
+        self._execution_history: List[dict] = []
+
+    # -- state --
+    @property
+    def state(self) -> ExecutorState:
+        return self._state
+
+    @property
+    def has_ongoing_execution(self) -> bool:
+        return self._state != ExecutorState.NO_TASK_IN_PROGRESS
+
+    def state_snapshot(self) -> dict:
+        return {
+            "state": self._state.value,
+            "taskCounts": self.tracker.snapshot(),
+            "finishedDataMovementMB": self.tracker.finished_data_movement_mb,
+            "recentlyRemovedBrokers": sorted(self.recently_removed_brokers),
+            "recentlyDemotedBrokers": sorted(self.recently_demoted_brokers),
+        }
+
+    def stop_execution(self, forced: bool = False):
+        """Graceful stop: in-flight tasks drain; pending are cancelled
+        (Executor.java stopExecution)."""
+        self._stop_requested.set()
+        if self.has_ongoing_execution:
+            self._state = ExecutorState.STOPPING_EXECUTION
+
+    # -- execution --
+    def execute_proposals(self, proposals: Sequence[ExecutionProposal],
+                          removed_brokers: Iterable[int] = (),
+                          demoted_brokers: Iterable[int] = (),
+                          replication_throttle: Optional[int] = None,
+                          concurrency: Optional[int] = None) -> dict:
+        """Synchronous execution of a proposal set; returns the summary.
+        (The async layer runs this in an operation thread.)"""
+        with self._lock:
+            if self.has_ongoing_execution:
+                raise RuntimeError("An execution is already in progress")
+            self._state = ExecutorState.STARTING_EXECUTION
+        self._stop_requested.clear()
+        t0 = time.time()
+        planner = ExecutionTaskPlanner(self._strategy)
+        planner.add_proposals(proposals)
+        self._planner = planner
+        self.tracker = ExecutionTaskTracker()
+        self.tracker.register(planner.replica_tasks)
+        self.tracker.register(planner.leadership_tasks)
+        self.recently_removed_brokers |= set(removed_brokers)
+        self.recently_demoted_brokers |= set(demoted_brokers)
+
+        throttle = (replication_throttle
+                    if replication_throttle is not None
+                    else self.config.default_replication_throttle)
+        moved_tps = [t.proposal.topic_partition for t in planner.replica_tasks]
+        if throttle is not None and moved_tps:
+            self.adapter.set_replication_throttles(throttle, moved_tps)
+
+        try:
+            self._state = ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
+            self._move_replicas(planner, concurrency)
+            self._state = ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS
+            self._move_leadership(planner)
+        finally:
+            if throttle is not None and moved_tps:
+                self.adapter.clear_replication_throttles()
+            summary = {
+                "stopped": self._stop_requested.is_set(),
+                "taskCounts": self.tracker.snapshot(),
+                "durationSeconds": round(time.time() - t0, 3),
+            }
+            self._execution_history.append(summary)
+            self._state = ExecutorState.NO_TASK_IN_PROGRESS
+            self._planner = None
+            if self._stop_requested.is_set():
+                self.notifier.on_execution_stopped(summary)
+            else:
+                self.notifier.on_execution_finished(summary)
+        return summary
+
+    # -- phases --
+    def _move_replicas(self, planner: ExecutionTaskPlanner,
+                       concurrency: Optional[int]):
+        """Phase 1 (Executor.java:932): batches bounded by per-broker
+        concurrency; poll until batch completes; dead-broker tasks die."""
+        per_broker = (concurrency
+                      or self.config.num_concurrent_partition_movements_per_broker)
+        while not self._stop_requested.is_set():
+            batch = planner.next_replica_batch(
+                per_broker, self.tracker.in_flight_by_broker)
+            if not batch:
+                break
+            now = int(time.time() * 1000)
+            for t in batch:
+                t.transition(TaskState.IN_PROGRESS, now)
+                self.tracker.mark(t, TaskState.PENDING)
+            self.adapter.execute_replica_reassignments(batch)
+            self._wait_for(batch, self._replica_task_done)
+
+    def _move_leadership(self, planner: ExecutionTaskPlanner):
+        """Phase 3 (Executor.java:1050)."""
+        while not self._stop_requested.is_set():
+            batch = planner.next_leadership_batch(
+                self.config.num_concurrent_leader_movements)
+            if not batch:
+                break
+            now = int(time.time() * 1000)
+            for t in batch:
+                t.transition(TaskState.IN_PROGRESS, now)
+                self.tracker.mark(t, TaskState.PENDING)
+            self.adapter.execute_preferred_leader_elections(batch)
+            self._wait_for(batch, self._leader_task_done)
+
+    def _replica_task_done(self, task: ExecutionTask) -> Optional[TaskState]:
+        tp = task.proposal.topic_partition
+        current = self.adapter.current_replicas(tp)
+        if task.proposal.is_completed(current):
+            return TaskState.COMPLETED
+        dead = self.adapter.dead_brokers()
+        if dead & set(task.proposal.new_replicas):
+            return TaskState.DEAD
+        return None
+
+    def _leader_task_done(self, task: ExecutionTask) -> Optional[TaskState]:
+        tp = task.proposal.topic_partition
+        if self.adapter.current_leader(tp) == task.proposal.new_replicas[0]:
+            return TaskState.COMPLETED
+        if self.adapter.current_leader(tp) in self.adapter.dead_brokers():
+            return TaskState.DEAD
+        return None
+
+    def _wait_for(self, batch: List[ExecutionTask],
+                  done_fn: Callable[[ExecutionTask], Optional[TaskState]]):
+        """Progress polling (Executor.java waitForExecutionTaskToFinish)."""
+        rounds = 0
+        open_tasks = list(batch)
+        while open_tasks and rounds < self.config.max_execution_progress_check_rounds:
+            rounds += 1
+            now = int(time.time() * 1000)
+            still = []
+            force_stop = self._stop_requested.is_set()
+            for t in open_tasks:
+                outcome = done_fn(t)
+                if outcome is None and force_stop:
+                    # graceful stop: abort what can be aborted
+                    if t.proposal.can_be_aborted(
+                            self.adapter.current_replicas(
+                                t.proposal.topic_partition)):
+                        t.transition(TaskState.ABORTING, now)
+                        self.tracker.mark(t, TaskState.IN_PROGRESS)
+                        t.transition(TaskState.ABORTED, now)
+                        self.tracker.mark(t, TaskState.ABORTING)
+                        continue
+                if outcome is None:
+                    still.append(t)
+                else:
+                    prev = t.state
+                    t.transition(outcome, now)
+                    self.tracker.mark(t, prev)
+            open_tasks = still
+            if open_tasks:
+                time.sleep(self.config.execution_progress_check_interval_ms / 1000.0)

@@ -1,0 +1,240 @@
+"""Execution tasks: lifecycle, planning, and movement strategies.
+
+Mirrors ``executor/ExecutionTask.java`` (state machine PENDING → IN_PROGRESS
+→ {COMPLETED, ABORTING → ABORTED, DEAD}), ``executor/ExecutionTaskPlanner.java:44-110``
+(per-broker sorted pending task sets ordered by a pluggable strategy chain)
+and ``executor/strategy/*.java`` (Base, PostponeUrp, PrioritizeLarge,
+PrioritizeSmall).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import itertools
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+
+
+class TaskState(enum.Enum):
+    PENDING = "PENDING"
+    IN_PROGRESS = "IN_PROGRESS"
+    ABORTING = "ABORTING"
+    ABORTED = "ABORTED"
+    DEAD = "DEAD"
+    COMPLETED = "COMPLETED"
+
+
+class TaskType(enum.Enum):
+    INTER_BROKER_REPLICA_ACTION = "INTER_BROKER_REPLICA_ACTION"
+    INTRA_BROKER_REPLICA_ACTION = "INTRA_BROKER_REPLICA_ACTION"
+    LEADER_ACTION = "LEADER_ACTION"
+
+
+_VALID_TRANSITIONS = {
+    TaskState.PENDING: {TaskState.IN_PROGRESS},
+    TaskState.IN_PROGRESS: {TaskState.ABORTING, TaskState.DEAD,
+                            TaskState.COMPLETED},
+    TaskState.ABORTING: {TaskState.ABORTED, TaskState.DEAD},
+    TaskState.ABORTED: set(),
+    TaskState.DEAD: set(),
+    TaskState.COMPLETED: set(),
+}
+
+
+@dataclasses.dataclass
+class ExecutionTask:
+    """One unit of work the executor drives to completion."""
+
+    execution_id: int
+    proposal: ExecutionProposal
+    task_type: TaskType
+    state: TaskState = TaskState.PENDING
+    start_time_ms: int = -1
+    end_time_ms: int = -1
+    alert_time_ms: int = -1
+
+    def transition(self, to: TaskState, now_ms: int = -1):
+        if to not in _VALID_TRANSITIONS[self.state]:
+            raise ValueError(f"illegal transition {self.state} -> {to} "
+                             f"for task {self.execution_id}")
+        self.state = to
+        if to == TaskState.IN_PROGRESS:
+            self.start_time_ms = now_ms
+        elif to in (TaskState.COMPLETED, TaskState.ABORTED, TaskState.DEAD):
+            self.end_time_ms = now_ms
+
+    @property
+    def done(self) -> bool:
+        return self.state in (TaskState.COMPLETED, TaskState.ABORTED,
+                              TaskState.DEAD)
+
+    def brokers_involved(self) -> Set[int]:
+        return set(self.proposal.old_replicas) | set(self.proposal.new_replicas)
+
+
+# ---------------------------------------------------------------------------
+# Movement strategies (executor/strategy/*.java)
+# ---------------------------------------------------------------------------
+
+
+class ReplicaMovementStrategy:
+    """Orders inter-broker movement tasks; chained like the reference's
+    ``chain(...)`` (AbstractReplicaMovementStrategy)."""
+
+    name = "BaseReplicaMovementStrategy"
+
+    def sort_key(self, task: ExecutionTask, urp: Set[str]):
+        return task.execution_id
+
+    def chain(self, nxt: "ReplicaMovementStrategy") -> "ReplicaMovementStrategy":
+        outer = self
+
+        class _Chained(ReplicaMovementStrategy):
+            name = f"{outer.name}->{nxt.name}"
+
+            def sort_key(self, task, urp):
+                return (outer.sort_key(task, urp), nxt.sort_key(task, urp))
+
+        return _Chained()
+
+
+class BaseReplicaMovementStrategy(ReplicaMovementStrategy):
+    """Execution-id order (executor/strategy/BaseReplicaMovementStrategy.java)."""
+
+
+class PostponeUrpReplicaMovementStrategy(ReplicaMovementStrategy):
+    """Move partitions with no under-replicated state first
+    (PostponeUrpReplicaMovementStrategy.java)."""
+
+    name = "PostponeUrpReplicaMovementStrategy"
+
+    def sort_key(self, task, urp):
+        return 1 if task.proposal.topic_partition in urp else 0
+
+
+class PrioritizeLargeReplicaMovementStrategy(ReplicaMovementStrategy):
+    """Large replicas first (PrioritizeLargeReplicaMovementStrategy.java)."""
+
+    name = "PrioritizeLargeReplicaMovementStrategy"
+
+    def sort_key(self, task, urp):
+        return -task.proposal.data_size
+
+
+class PrioritizeSmallReplicaMovementStrategy(ReplicaMovementStrategy):
+    """Small replicas first (PrioritizeSmallReplicaMovementStrategy.java)."""
+
+    name = "PrioritizeSmallReplicaMovementStrategy"
+
+    def sort_key(self, task, urp):
+        return task.proposal.data_size
+
+
+STRATEGIES = {cls.name if hasattr(cls, "name") else cls.__name__: cls
+              for cls in (BaseReplicaMovementStrategy,
+                          PostponeUrpReplicaMovementStrategy,
+                          PrioritizeLargeReplicaMovementStrategy,
+                          PrioritizeSmallReplicaMovementStrategy)}
+
+
+# ---------------------------------------------------------------------------
+# Planner (executor/ExecutionTaskPlanner.java)
+# ---------------------------------------------------------------------------
+
+
+class ExecutionTaskPlanner:
+    """Splits proposals into replica-move / leadership task pools and hands
+    out per-round batches honoring per-broker concurrency."""
+
+    def __init__(self, strategy: Optional[ReplicaMovementStrategy] = None):
+        self._strategy = strategy or BaseReplicaMovementStrategy()
+        self._id_gen = itertools.count()
+        self.replica_tasks: List[ExecutionTask] = []
+        self.leadership_tasks: List[ExecutionTask] = []
+        self.intra_broker_tasks: List[ExecutionTask] = []
+
+    def add_proposals(self, proposals: Iterable[ExecutionProposal],
+                      urp: Optional[Set[str]] = None):
+        urp = urp or set()
+        for p in proposals:
+            if p.has_replica_action:
+                self.replica_tasks.append(ExecutionTask(
+                    next(self._id_gen), p, TaskType.INTER_BROKER_REPLICA_ACTION))
+            elif p.has_leader_action:
+                self.leadership_tasks.append(ExecutionTask(
+                    next(self._id_gen), p, TaskType.LEADER_ACTION))
+        self.replica_tasks.sort(
+            key=lambda t: (self._strategy.sort_key(t, urp), t.execution_id))
+
+    def next_replica_batch(self, concurrency_per_broker: int,
+                           in_flight_by_broker: Dict[int, int]) -> List[ExecutionTask]:
+        """Pending movement tasks whose brokers have spare concurrency
+        (ExecutionTaskPlanner.getInterBrokerReplicaMovementTasks)."""
+        batch: List[ExecutionTask] = []
+        counts = dict(in_flight_by_broker)
+        for t in self.replica_tasks:
+            if t.state != TaskState.PENDING:
+                continue
+            brokers = t.brokers_involved()
+            if all(counts.get(b, 0) < concurrency_per_broker for b in brokers):
+                for b in brokers:
+                    counts[b] = counts.get(b, 0) + 1
+                batch.append(t)
+        return batch
+
+    def next_leadership_batch(self, max_batch: int) -> List[ExecutionTask]:
+        out = [t for t in self.leadership_tasks
+               if t.state == TaskState.PENDING][:max_batch]
+        return out
+
+    @property
+    def remaining(self) -> int:
+        return sum(1 for t in itertools.chain(
+            self.replica_tasks, self.leadership_tasks, self.intra_broker_tasks)
+            if not t.done)
+
+
+# ---------------------------------------------------------------------------
+# Tracker (executor/ExecutionTaskManager.java / ExecutionTaskTracker.java)
+# ---------------------------------------------------------------------------
+
+
+class ExecutionTaskTracker:
+    """Counts tasks by (type, state) and in-flight per broker."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.by_state: Dict[TaskType, Dict[TaskState, int]] = {
+            t: {s: 0 for s in TaskState} for t in TaskType}
+        self.in_flight_by_broker: Dict[int, int] = {}
+        self.finished_data_movement_mb = 0.0
+
+    def mark(self, task: ExecutionTask, frm: TaskState):
+        with self._lock:
+            self.by_state[task.task_type][frm] -= 1 if self.by_state[
+                task.task_type][frm] > 0 else 0
+            self.by_state[task.task_type][task.state] += 1
+            if task.task_type == TaskType.INTER_BROKER_REPLICA_ACTION:
+                delta = (1 if task.state == TaskState.IN_PROGRESS
+                         else -1 if frm == TaskState.IN_PROGRESS else 0)
+                if delta:
+                    for b in task.brokers_involved():
+                        self.in_flight_by_broker[b] = max(
+                            0, self.in_flight_by_broker.get(b, 0) + delta)
+            if (task.state == TaskState.COMPLETED
+                    and task.task_type == TaskType.INTER_BROKER_REPLICA_ACTION):
+                self.finished_data_movement_mb += task.proposal.inter_broker_data_to_move()
+
+    def register(self, tasks: Iterable[ExecutionTask]):
+        with self._lock:
+            for t in tasks:
+                self.by_state[t.task_type][t.state] += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                t.value: {s.value: n for s, n in states.items() if n}
+                for t, states in self.by_state.items()}

@@ -1,0 +1,176 @@
+"""Executor tests, modeled on the reference's ExecutorTest (which runs real
+reassignments against embedded brokers — here against FakeClusterAdapter):
+full execution lifecycle, strategies ordering, concurrency bounds, stop
+semantics, dead-broker task death, throttling.
+"""
+
+import threading
+import time
+
+import pytest
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.executor.executor import (
+    Executor,
+    ExecutorConfig,
+    ExecutorNotifier,
+    ExecutorState,
+    FakeClusterAdapter,
+)
+from cruise_control_tpu.executor.tasks import (
+    ExecutionTask,
+    ExecutionTaskPlanner,
+    PostponeUrpReplicaMovementStrategy,
+    PrioritizeLargeReplicaMovementStrategy,
+    PrioritizeSmallReplicaMovementStrategy,
+    TaskState,
+    TaskType,
+)
+
+
+def _proposal(topic, part, old, new, size=10.0):
+    return ExecutionProposal(topic=topic, partition=part, old_leader=old[0],
+                             old_replicas=tuple(old), new_replicas=tuple(new),
+                             data_size=size)
+
+
+def _adapter_for(proposals, latency=1):
+    return FakeClusterAdapter(
+        {p.topic_partition: p.old_replicas for p in proposals},
+        latency_polls=latency)
+
+
+def test_execute_replica_and_leadership_moves():
+    props = [
+        _proposal("t", 0, [0, 1], [2, 1]),        # replica move
+        _proposal("t", 1, [1, 0], [0, 1]),        # leadership-only change
+    ]
+    adapter = _adapter_for(props, latency=2)
+    ex = Executor(adapter, ExecutorConfig(execution_progress_check_interval_ms=1))
+    summary = ex.execute_proposals(props)
+    assert adapter.replicas["t-0"] == (2, 1)
+    assert adapter.leaders["t-1"] == 0
+    counts = summary["taskCounts"]
+    assert counts["INTER_BROKER_REPLICA_ACTION"]["COMPLETED"] == 1
+    assert counts["LEADER_ACTION"]["COMPLETED"] == 1
+    assert not summary["stopped"]
+    assert ex.state == ExecutorState.NO_TASK_IN_PROGRESS
+
+
+def test_concurrency_bound_per_broker():
+    # 6 moves all involving broker 0: with concurrency 2, batches of <=2
+    props = [_proposal("t", i, [0, 1], [2 + (i % 3), 1]) for i in range(6)]
+    adapter = _adapter_for(props)
+    planner = ExecutionTaskPlanner()
+    planner.add_proposals(props)
+    batch = planner.next_replica_batch(2, {})
+    involved0 = [t for t in batch if 0 in t.brokers_involved()]
+    assert len(involved0) <= 2
+
+
+def test_strategy_ordering():
+    small = _proposal("t", 0, [0], [1], size=1.0)
+    big = _proposal("t", 1, [0], [2], size=100.0)
+    planner = ExecutionTaskPlanner(PrioritizeLargeReplicaMovementStrategy())
+    planner.add_proposals([small, big])
+    assert planner.replica_tasks[0].proposal.data_size == 100.0
+    planner = ExecutionTaskPlanner(PrioritizeSmallReplicaMovementStrategy())
+    planner.add_proposals([small, big])
+    assert planner.replica_tasks[0].proposal.data_size == 1.0
+    # chained: postpone URP first, then size
+    urp = {"t-1"}
+    chained = PostponeUrpReplicaMovementStrategy().chain(
+        PrioritizeLargeReplicaMovementStrategy())
+    planner = ExecutionTaskPlanner(chained)
+    planner.add_proposals([small, big], urp=urp)
+    assert planner.replica_tasks[0].proposal.topic_partition == "t-0"
+
+
+def test_task_state_machine():
+    t = ExecutionTask(0, _proposal("t", 0, [0], [1]),
+                      TaskType.INTER_BROKER_REPLICA_ACTION)
+    with pytest.raises(ValueError):
+        t.transition(TaskState.COMPLETED)      # PENDING -> COMPLETED illegal
+    t.transition(TaskState.IN_PROGRESS, 1)
+    t.transition(TaskState.ABORTING, 2)
+    t.transition(TaskState.ABORTED, 3)
+    assert t.done
+    with pytest.raises(ValueError):
+        t.transition(TaskState.IN_PROGRESS)
+
+
+def test_dead_broker_kills_task():
+    props = [_proposal("t", 0, [0, 1], [2, 1])]
+    adapter = _adapter_for(props, latency=10_000)   # never completes
+    adapter.kill_broker(2)
+    ex = Executor(adapter, ExecutorConfig(execution_progress_check_interval_ms=1))
+    summary = ex.execute_proposals(props)
+    assert summary["taskCounts"]["INTER_BROKER_REPLICA_ACTION"]["DEAD"] == 1
+
+
+def test_stop_execution_aborts_pending():
+    props = [_proposal("t", i, [0, 1], [2, 1]) for i in range(4)]
+    adapter = _adapter_for(props, latency=50)
+    ex = Executor(adapter, ExecutorConfig(
+        execution_progress_check_interval_ms=5,
+        num_concurrent_partition_movements_per_broker=1))
+    done = {}
+
+    def run():
+        done["summary"] = ex.execute_proposals(props)
+
+    th = threading.Thread(target=run)
+    th.start()
+    time.sleep(0.05)
+    ex.stop_execution()
+    th.join(timeout=30)
+    assert done["summary"]["stopped"]
+    counts = done["summary"]["taskCounts"]["INTER_BROKER_REPLICA_ACTION"]
+    assert counts.get("ABORTED", 0) + counts.get("COMPLETED", 0) >= 1
+    assert counts.get("PENDING", 0) >= 1   # later tasks never started
+
+
+def test_replication_throttle_set_and_cleared():
+    props = [_proposal("t", 0, [0, 1], [2, 1])]
+    adapter = _adapter_for(props)
+    seen = {}
+
+    class SpyAdapter(FakeClusterAdapter):
+        def set_replication_throttles(self, rate, tps):
+            seen["rate"] = rate
+            seen["tps"] = list(tps)
+            super().set_replication_throttles(rate, tps)
+
+    adapter = SpyAdapter({p.topic_partition: p.old_replicas for p in props})
+    ex = Executor(adapter, ExecutorConfig(execution_progress_check_interval_ms=1))
+    ex.execute_proposals(props, replication_throttle=12345)
+    assert seen == {"rate": 12345, "tps": ["t-0"]}
+    assert adapter.throttle is None          # cleared after execution
+
+
+def test_notifier_called():
+    calls = []
+
+    class N(ExecutorNotifier):
+        def on_execution_finished(self, summary):
+            calls.append("finished")
+
+    props = [_proposal("t", 0, [0, 1], [2, 1])]
+    ex = Executor(_adapter_for(props),
+                  ExecutorConfig(execution_progress_check_interval_ms=1),
+                  notifier=N())
+    ex.execute_proposals(props)
+    assert calls == ["finished"]
+
+
+def test_rejects_concurrent_executions():
+    props = [_proposal("t", 0, [0, 1], [2, 1]) for _ in range(1)]
+    adapter = _adapter_for(props, latency=100)
+    ex = Executor(adapter, ExecutorConfig(execution_progress_check_interval_ms=5))
+    th = threading.Thread(target=lambda: ex.execute_proposals(props))
+    th.start()
+    time.sleep(0.03)
+    with pytest.raises(RuntimeError):
+        ex.execute_proposals(props)
+    ex.stop_execution()
+    th.join(timeout=30)
