@@ -1,0 +1,255 @@
+"""Anomaly types and the self-healing notifier.
+
+Mirrors ``detector/*.java`` payloads (BrokerFailures, GoalViolations,
+DiskFailures, KafkaMetricAnomaly, SlowBrokers — each with a ``fix()`` that
+dispatches the corresponding operation) and the ``AnomalyNotifier`` SPI with
+``SelfHealingNotifier`` semantics (``detector/notifier/SelfHealingNotifier.java:24-128``):
+per-type self-healing enable flags, broker-failure alert and self-healing
+thresholds, and IGNORE / CHECK(delay) / FIX verdicts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from typing import Dict, List, Optional, Protocol, Sequence, Set
+
+
+class AnomalyType(enum.Enum):
+    GOAL_VIOLATION = "GOAL_VIOLATION"
+    BROKER_FAILURE = "BROKER_FAILURE"
+    METRIC_ANOMALY = "METRIC_ANOMALY"
+    DISK_FAILURE = "DISK_FAILURE"
+    TOPIC_ANOMALY = "TOPIC_ANOMALY"
+
+    @property
+    def priority(self) -> int:
+        # detector/AnomalyType priority: lower = handled first
+        return {"BROKER_FAILURE": 0, "DISK_FAILURE": 1, "METRIC_ANOMALY": 2,
+                "GOAL_VIOLATION": 3, "TOPIC_ANOMALY": 4}[self.value]
+
+
+class AnomalyAction(enum.Enum):
+    IGNORE = "IGNORE"
+    CHECK = "CHECK"
+    FIX = "FIX"
+
+
+@dataclasses.dataclass
+class NotifierResult:
+    action: AnomalyAction
+    delay_ms: int = 0
+
+
+class SelfHealingContext(Protocol):
+    """What an anomaly fix needs from the service facade: the async
+    runnables' surface (rebalance / remove / demote / fix offline)."""
+
+    def rebalance(self, self_healing: bool = True, **kw) -> dict: ...
+    def remove_brokers(self, broker_ids: Sequence[int],
+                       self_healing: bool = True, **kw) -> dict: ...
+    def demote_brokers(self, broker_ids: Sequence[int],
+                       self_healing: bool = True, **kw) -> dict: ...
+    def fix_offline_replicas(self, self_healing: bool = True, **kw) -> dict: ...
+
+
+@dataclasses.dataclass
+class Anomaly:
+    """Base anomaly (core detector/Anomaly.java)."""
+
+    anomaly_type: AnomalyType
+    detection_time_ms: int
+    anomaly_id: str = ""
+
+    def __post_init__(self):
+        if not self.anomaly_id:
+            self.anomaly_id = f"{self.anomaly_type.value}-{self.detection_time_ms}"
+
+    def fix(self, context: SelfHealingContext) -> Optional[dict]:
+        raise NotImplementedError
+
+    def summary(self) -> dict:
+        return {"type": self.anomaly_type.value, "id": self.anomaly_id,
+                "detectionMs": self.detection_time_ms}
+
+
+@dataclasses.dataclass
+class BrokerFailures(Anomaly):
+    """detector/BrokerFailures.java — fix = remove the failed brokers."""
+
+    failed_brokers_by_time: Dict[int, int] = dataclasses.field(default_factory=dict)
+
+    def fix(self, context):
+        return context.remove_brokers(sorted(self.failed_brokers_by_time),
+                                      self_healing=True)
+
+    def summary(self):
+        return {**super().summary(),
+                "failedBrokers": self.failed_brokers_by_time}
+
+
+@dataclasses.dataclass
+class GoalViolations(Anomaly):
+    """detector/GoalViolations.java — fix = self-healing rebalance."""
+
+    fixable_violated_goals: List[str] = dataclasses.field(default_factory=list)
+    unfixable_violated_goals: List[str] = dataclasses.field(default_factory=list)
+
+    def fix(self, context):
+        if not self.fixable_violated_goals:
+            return None
+        return context.rebalance(self_healing=True)
+
+    def summary(self):
+        return {**super().summary(),
+                "fixableViolatedGoals": self.fixable_violated_goals,
+                "unfixableViolatedGoals": self.unfixable_violated_goals}
+
+
+@dataclasses.dataclass
+class DiskFailures(Anomaly):
+    """detector/DiskFailures.java — fix = move replicas off dead disks."""
+
+    failed_disks_by_broker: Dict[int, List[str]] = dataclasses.field(
+        default_factory=dict)
+
+    def fix(self, context):
+        return context.fix_offline_replicas(self_healing=True)
+
+    def summary(self):
+        return {**super().summary(), "failedDisks": self.failed_disks_by_broker}
+
+
+@dataclasses.dataclass
+class MetricAnomaly(Anomaly):
+    """detector/KafkaMetricAnomaly.java — broker metric out of history band."""
+
+    broker_id: int = -1
+    metric: str = ""
+    description: str = ""
+
+    def fix(self, context):
+        return None           # metric anomalies alert; no automatic fix
+
+    def summary(self):
+        return {**super().summary(), "broker": self.broker_id,
+                "metric": self.metric, "description": self.description}
+
+
+@dataclasses.dataclass
+class SlowBrokers(Anomaly):
+    """detector/SlowBrokers.java — demote, or remove when persistent."""
+
+    slow_brokers_by_time: Dict[int, int] = dataclasses.field(default_factory=dict)
+    remove_slow_brokers: bool = False
+
+    def fix(self, context):
+        ids = sorted(self.slow_brokers_by_time)
+        if self.remove_slow_brokers:
+            return context.remove_brokers(ids, self_healing=True)
+        return context.demote_brokers(ids, self_healing=True)
+
+    def summary(self):
+        return {**super().summary(), "slowBrokers": self.slow_brokers_by_time,
+                "remove": self.remove_slow_brokers}
+
+
+# ---------------------------------------------------------------------------
+# Notifiers
+# ---------------------------------------------------------------------------
+
+
+class AnomalyNotifier:
+    """SPI: decide what to do about an anomaly (AnomalyNotifier.java)."""
+
+    def on_anomaly(self, anomaly: Anomaly) -> NotifierResult:
+        raise NotImplementedError
+
+    def self_healing_enabled(self) -> Dict[AnomalyType, bool]:
+        return {t: False for t in AnomalyType}
+
+    def set_self_healing_for(self, anomaly_type: AnomalyType, enabled: bool):
+        pass
+
+
+class SelfHealingNotifier(AnomalyNotifier):
+    """detector/notifier/SelfHealingNotifier.java:50-128.
+
+    Broker failures: alert after ``broker_failure_alert_threshold_ms``,
+    self-heal after ``self_healing_threshold_ms`` (CHECK with delay until
+    then). Other anomaly types: FIX immediately when enabled, IGNORE
+    otherwise.
+    """
+
+    def __init__(self, broker_failure_alert_threshold_ms: int = 900_000,
+                 self_healing_threshold_ms: int = 1_800_000,
+                 enabled: Optional[Dict[AnomalyType, bool]] = None,
+                 now_fn=lambda: int(time.time() * 1000)):
+        self.alert_threshold_ms = broker_failure_alert_threshold_ms
+        self.self_healing_threshold_ms = self_healing_threshold_ms
+        self._enabled = {t: False for t in AnomalyType}
+        if enabled:
+            self._enabled.update(enabled)
+        self._now = now_fn
+        self.alerts: List[dict] = []
+
+    def self_healing_enabled(self):
+        return dict(self._enabled)
+
+    def set_self_healing_for(self, anomaly_type, enabled):
+        self._enabled[anomaly_type] = bool(enabled)
+
+    def alert(self, anomaly: Anomaly, auto_fix_triggered: bool):
+        self.alerts.append({"anomaly": anomaly.summary(),
+                            "autoFixTriggered": auto_fix_triggered,
+                            "time": self._now()})
+
+    def on_anomaly(self, anomaly: Anomaly) -> NotifierResult:
+        if isinstance(anomaly, BrokerFailures):
+            return self._on_broker_failure(anomaly)
+        if not self._enabled.get(anomaly.anomaly_type, False):
+            return NotifierResult(AnomalyAction.IGNORE)
+        self.alert(anomaly, auto_fix_triggered=True)
+        return NotifierResult(AnomalyAction.FIX)
+
+    def _on_broker_failure(self, anomaly: BrokerFailures) -> NotifierResult:
+        now = self._now()
+        if not anomaly.failed_brokers_by_time:
+            return NotifierResult(AnomalyAction.IGNORE)
+        earliest = min(anomaly.failed_brokers_by_time.values())
+        alert_time = earliest + self.alert_threshold_ms
+        fix_time = earliest + self.self_healing_threshold_ms
+        enabled = self._enabled.get(AnomalyType.BROKER_FAILURE, False)
+        if now < alert_time:
+            return NotifierResult(AnomalyAction.CHECK, delay_ms=alert_time - now)
+        if now < fix_time:
+            self.alert(anomaly, auto_fix_triggered=False)
+            if enabled:
+                return NotifierResult(AnomalyAction.CHECK, delay_ms=fix_time - now)
+            return NotifierResult(AnomalyAction.IGNORE)
+        if enabled:
+            self.alert(anomaly, auto_fix_triggered=True)
+            return NotifierResult(AnomalyAction.FIX)
+        self.alert(anomaly, auto_fix_triggered=False)
+        return NotifierResult(AnomalyAction.IGNORE)
+
+
+class SlackSelfHealingNotifier(SelfHealingNotifier):
+    """notifier/SlackSelfHealingNotifier.java — posts alerts to a webhook.
+    The HTTP post is injectable (and a no-op by default in offline envs)."""
+
+    def __init__(self, webhook_url: str = "", channel: str = "",
+                 post_fn=None, **kw):
+        super().__init__(**kw)
+        self.webhook_url = webhook_url
+        self.channel = channel
+        self._post = post_fn or (lambda url, payload: None)
+
+    def alert(self, anomaly, auto_fix_triggered):
+        super().alert(anomaly, auto_fix_triggered)
+        if self.webhook_url:
+            self._post(self.webhook_url, {
+                "channel": self.channel,
+                "text": f"[cruise-control-tpu] {anomaly.summary()} "
+                        f"autoFix={auto_fix_triggered}"})

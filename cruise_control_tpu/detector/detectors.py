@@ -1,0 +1,383 @@
+"""Anomaly detectors + the detector service loop.
+
+Rebuild of ``detector/AnomalyDetector.java:46-404`` (anomaly priority queue,
+scheduled detector sweeps, handler consulting the notifier and triggering
+self-healing) and the individual finders:
+
+- :class:`BrokerFailureDetector` — liveness diff against the metadata source
+  with a persisted failed-broker record surviving restarts
+  (``BrokerFailureDetector.java:42-202``; file instead of ZK).
+- :class:`GoalViolationDetector` — optimizes the detection goals on a fresh
+  model and reports violated goals (``GoalViolationDetector.java:48+``).
+- :class:`DiskFailureDetector` — logdir-state diff via an adapter callback
+  (``DiskFailureDetector.java:35-85``).
+- :class:`MetricAnomalyDetector` with the core percentile finder
+  (``PercentileMetricAnomalyFinder.java``).
+- :class:`SlowBrokerFinder` — log-flush-time vs own history and peers,
+  demotion → removal escalation (``SlowBrokerFinder.java:38-77``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.detector.anomalies import (
+    Anomaly,
+    AnomalyAction,
+    AnomalyNotifier,
+    AnomalyType,
+    BrokerFailures,
+    DiskFailures,
+    GoalViolations,
+    MetricAnomaly,
+    SelfHealingContext,
+    SlowBrokers,
+)
+
+_now_ms = lambda: int(time.time() * 1000)
+
+
+class BrokerFailureDetector:
+    """Detects brokers that left the cluster; persists first-seen failure
+    times so detection survives restarts (ZK record → JSON file)."""
+
+    def __init__(self, metadata_source, persist_path: Optional[str] = None,
+                 now_fn=_now_ms):
+        self._metadata_source = metadata_source
+        self._path = persist_path
+        self._now = now_fn
+        self._failed_by_time: Dict[int, int] = {}
+        if persist_path and os.path.exists(persist_path):
+            with open(persist_path) as f:
+                self._failed_by_time = {int(k): int(v)
+                                        for k, v in json.load(f).items()}
+
+    def detect(self) -> Optional[BrokerFailures]:
+        md = self._metadata_source.get_metadata()
+        now = self._now()
+        alive = {b.broker_id for b in md.brokers if b.alive}
+        known = {b.broker_id for b in md.brokers}
+        failed = known - alive
+        changed = False
+        for b in failed:
+            if b not in self._failed_by_time:
+                self._failed_by_time[b] = now
+                changed = True
+        for b in list(self._failed_by_time):
+            if b in alive:
+                del self._failed_by_time[b]
+                changed = True
+        if changed and self._path:
+            with open(self._path, "w") as f:
+                json.dump({str(k): v for k, v in self._failed_by_time.items()}, f)
+        if self._failed_by_time:
+            return BrokerFailures(AnomalyType.BROKER_FAILURE, now,
+                                  failed_brokers_by_time=dict(self._failed_by_time))
+        return None
+
+
+class GoalViolationDetector:
+    """Runs the anomaly-detection goal list against a fresh model."""
+
+    def __init__(self, load_monitor, goal_names: Optional[Sequence[str]] = None,
+                 now_fn=_now_ms):
+        from cruise_control_tpu.analyzer import goals as G
+        self._lm = load_monitor
+        self._goals = tuple(goal_names or G.ANOMALY_DETECTION_GOALS)
+        self._now = now_fn
+
+    def detect(self) -> Optional[GoalViolations]:
+        from cruise_control_tpu.analyzer import goals as G
+        from cruise_control_tpu.analyzer import objective as OBJ
+        from cruise_control_tpu.common.resources import BalancingConstraint
+        from cruise_control_tpu.monitor.load_monitor import NotEnoughValidWindowsError
+        from cruise_control_tpu.ops.aggregates import (
+            compute_aggregates, device_topology)
+        import jax.numpy as jnp
+        try:
+            topo, assign = self._lm.cluster_model(now_ms=self._now())
+        except NotEnoughValidWindowsError:
+            return None
+        dt = device_topology(topo)
+        agg = compute_aggregates(dt, assign, topo.num_topics)
+        th = G.compute_thresholds(dt, BalancingConstraint(), agg)
+        pen = G.full_goal_penalties(dt, assign, th, topo.num_topics,
+                                    self._goals,
+                                    initial_broker_of=jnp.asarray(assign.broker_of),
+                                    agg=agg)
+        viol = np.asarray(pen.violations)
+        violated = [g for i, g in enumerate(self._goals) if viol[i] > 0]
+        if viol[-1] > 0:           # offline/self-healing term
+            violated.append("OfflineReplicas")
+        if violated:
+            return GoalViolations(AnomalyType.GOAL_VIOLATION, self._now(),
+                                  fixable_violated_goals=violated)
+        return None
+
+
+class DiskFailureDetector:
+    """Diffs logdir liveness via a callback returning
+    {broker_id: {logdir: alive}} (AdminClient describeLogDirs seam)."""
+
+    def __init__(self, logdirs_fn: Callable[[], Dict[int, Dict[str, bool]]],
+                 now_fn=_now_ms):
+        self._logdirs_fn = logdirs_fn
+        self._now = now_fn
+
+    def detect(self) -> Optional[DiskFailures]:
+        failed: Dict[int, List[str]] = {}
+        for broker, dirs in self._logdirs_fn().items():
+            dead = [d for d, ok in dirs.items() if not ok]
+            if dead:
+                failed[broker] = dead
+        if failed:
+            return DiskFailures(AnomalyType.DISK_FAILURE, self._now(),
+                                failed_disks_by_broker=failed)
+        return None
+
+
+def percentile_anomalies(history: np.ndarray, current: float,
+                         upper_percentile: float = 95.0,
+                         lower_percentile: float = 2.0,
+                         upper_margin: float = 0.5,
+                         lower_margin: float = 0.2) -> Optional[str]:
+    """core PercentileMetricAnomalyFinder.java: current value beyond
+    [P_low·(1−margin·…), P_high·(1+margin)] of its own history."""
+    history = np.asarray(history, dtype=np.float64)
+    if history.size < 3:
+        return None
+    hi = np.percentile(history, upper_percentile)
+    lo = np.percentile(history, lower_percentile)
+    if current > hi * (1 + upper_margin):
+        return (f"value {current:.3f} above {upper_percentile:.0f}th "
+                f"percentile {hi:.3f} * {1 + upper_margin:.2f}")
+    if current < lo * lower_margin:
+        return (f"value {current:.3f} below {lower_percentile:.0f}th "
+                f"percentile {lo:.3f} * {lower_margin:.2f}")
+    return None
+
+
+class MetricAnomalyDetector:
+    """Compares each broker's current metric value with its own history
+    (MetricAnomalyDetector.java:29-72 + percentile finder)."""
+
+    def __init__(self, broker_history_fn: Callable[[], Dict[int, Dict[str, np.ndarray]]],
+                 metrics: Sequence[str] = ("cpu",), now_fn=_now_ms, **finder_kw):
+        self._history_fn = broker_history_fn
+        self._metrics = metrics
+        self._now = now_fn
+        self._finder_kw = finder_kw
+
+    def detect(self) -> List[MetricAnomaly]:
+        out: List[MetricAnomaly] = []
+        for broker, series in self._history_fn().items():
+            for metric in self._metrics:
+                vals = np.asarray(series.get(metric, ()))
+                if vals.size < 4:
+                    continue
+                desc = percentile_anomalies(vals[:-1], float(vals[-1]),
+                                            **self._finder_kw)
+                if desc:
+                    out.append(MetricAnomaly(
+                        AnomalyType.METRIC_ANOMALY, self._now(),
+                        broker_id=broker, metric=metric, description=desc))
+        return out
+
+
+class SlowBrokerFinder:
+    """detector/SlowBrokerFinder.java:38-77: the derived metric
+    log-flush-time × (1 / bytes-in) compared against the broker's own
+    history and against peers; persistent slowness escalates demote →
+    remove. History is supplied by a callback
+    {broker: {"flush_time": [...], "bytes_in": [...]}}."""
+
+    def __init__(self, broker_history_fn, self_history_margin: float = 1.5,
+                 peer_margin: float = 2.0, score_threshold: int = 3,
+                 removal_threshold: int = 6, now_fn=_now_ms):
+        self._history_fn = broker_history_fn
+        self._self_margin = self_history_margin
+        self._peer_margin = peer_margin
+        self._score_threshold = score_threshold
+        self._removal_threshold = removal_threshold
+        self._scores: Dict[int, int] = {}
+        self._first_seen: Dict[int, int] = {}
+        self._now = now_fn
+
+    @staticmethod
+    def _slowness(series: dict) -> Optional[float]:
+        ft = np.asarray(series.get("flush_time", ()), dtype=np.float64)
+        bi = np.asarray(series.get("bytes_in", ()), dtype=np.float64)
+        if ft.size == 0 or bi.size == 0:
+            return None
+        return float(ft[-1] / max(bi[-1], 1.0))
+
+    def detect(self) -> Optional[SlowBrokers]:
+        hist = self._history_fn()
+        current: Dict[int, float] = {}
+        for broker, series in hist.items():
+            s = self._slowness(series)
+            if s is not None:
+                current[broker] = s
+        if len(current) < 2:
+            return None
+        values = np.asarray(list(current.values()))
+        peer_median = float(np.median(values))
+        now = self._now()
+        slow_now: Set[int] = set()
+        for broker, s in current.items():
+            ft = np.asarray(hist[broker].get("flush_time", ()), dtype=np.float64)
+            bi = np.asarray(hist[broker].get("bytes_in", ()), dtype=np.float64)
+            n = min(ft.size, bi.size)
+            own_hist = ft[:n - 1] / np.maximum(bi[:n - 1], 1.0) if n > 1 else np.array([])
+            own_slow = (own_hist.size >= 3
+                        and s > self._self_margin * float(np.mean(own_hist)))
+            peer_slow = s > self._peer_margin * peer_median
+            if own_slow and peer_slow:
+                slow_now.add(broker)
+        for b in slow_now:
+            self._scores[b] = self._scores.get(b, 0) + 1
+            self._first_seen.setdefault(b, now)
+        for b in list(self._scores):
+            if b not in slow_now:
+                self._scores[b] -= 1
+                if self._scores[b] <= 0:
+                    del self._scores[b]
+                    self._first_seen.pop(b, None)
+        demote = {b: self._first_seen[b] for b, sc in self._scores.items()
+                  if sc >= self._score_threshold}
+        if not demote:
+            return None
+        remove = all(sc >= self._removal_threshold
+                     for b, sc in self._scores.items() if b in demote)
+        return SlowBrokers(AnomalyType.METRIC_ANOMALY, now,
+                           slow_brokers_by_time=demote,
+                           remove_slow_brokers=remove)
+
+
+# ---------------------------------------------------------------------------
+# AnomalyDetector service (detector/AnomalyDetector.java)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(order=True)
+class _Queued:
+    priority: int
+    seq: int
+    anomaly: Anomaly = dataclasses.field(compare=False)
+
+
+class AnomalyDetectorService:
+    """Priority queue + scheduler + handler. Detector sweeps run on timers;
+    the handler consults the notifier and triggers ``anomaly.fix(context)``
+    for FIX verdicts, skipping while an execution is ongoing
+    (AnomalyDetector.java:266-320, 391-404)."""
+
+    def __init__(self, notifier: AnomalyNotifier,
+                 context: Optional[SelfHealingContext] = None,
+                 has_ongoing_execution: Callable[[], bool] = lambda: False,
+                 detectors: Optional[Dict[str, Callable[[], object]]] = None,
+                 interval_ms: int = 300_000, now_fn=_now_ms):
+        self.notifier = notifier
+        self.context = context
+        self._has_exec = has_ongoing_execution
+        self.detectors = detectors or {}
+        self.interval_ms = interval_ms
+        self._queue: List[_Queued] = []
+        self._seq = 0
+        self._lock = threading.RLock()
+        self._shutdown = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._now = now_fn
+        self.history: List[dict] = []
+        self.metrics = {"anomalies_detected": 0, "fixes_triggered": 0,
+                        "fixes_failed": 0, "ignored": 0, "checks": 0}
+
+    # -- queue --
+    def enqueue(self, anomaly: Anomaly):
+        with self._lock:
+            heapq.heappush(self._queue, _Queued(
+                anomaly.anomaly_type.priority, self._seq, anomaly))
+            self._seq += 1
+            self.metrics["anomalies_detected"] += 1
+
+    def sweep(self) -> int:
+        """One detection pass over all registered detectors."""
+        n = 0
+        for name, det in self.detectors.items():
+            try:
+                found = det()
+            except Exception:
+                continue
+            if found is None:
+                continue
+            for a in (found if isinstance(found, list) else [found]):
+                self.enqueue(a)
+                n += 1
+        return n
+
+    def handle_pending(self) -> int:
+        """Drain the queue through the notifier (AnomalyHandlerTask)."""
+        handled = 0
+        while True:
+            with self._lock:
+                if not self._queue:
+                    break
+                item = heapq.heappop(self._queue)
+            a = item.anomaly
+            if self._has_exec():
+                self.metrics["checks"] += 1
+                self.history.append({"anomaly": a.summary(),
+                                     "action": "DELAYED_ONGOING_EXECUTION"})
+                continue
+            result = self.notifier.on_anomaly(a)
+            record = {"anomaly": a.summary(), "action": result.action.value}
+            if result.action == AnomalyAction.FIX and self.context is not None:
+                try:
+                    fix_result = a.fix(self.context)
+                    record["fixResult"] = bool(fix_result)
+                    self.metrics["fixes_triggered"] += 1
+                except Exception as e:   # fix failures must not kill the loop
+                    record["fixError"] = str(e)
+                    self.metrics["fixes_failed"] += 1
+            elif result.action == AnomalyAction.IGNORE:
+                self.metrics["ignored"] += 1
+            else:
+                self.metrics["checks"] += 1
+            self.history.append(record)
+            handled += 1
+        return handled
+
+    # -- service loop --
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="anomaly-detector")
+        self._thread.start()
+
+    def shutdown(self):
+        self._shutdown.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _run(self):
+        while not self._shutdown.wait(self.interval_ms / 1000.0):
+            self.sweep()
+            self.handle_pending()
+
+    def state_snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "selfHealingEnabled": {
+                    t.value: v for t, v in
+                    self.notifier.self_healing_enabled().items()},
+                "recentAnomalies": self.history[-20:],
+                "metrics": dict(self.metrics),
+                "queuedAnomalies": len(self._queue),
+            }

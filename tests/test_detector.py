@@ -1,0 +1,229 @@
+"""Detector tests, modeled on AnomalyDetectorTest / SelfHealingNotifierTest
+(fake time, queue/handler assertions) and BrokerFailureDetectorTest
+(persisted failure record)."""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.detector.anomalies import (
+    AnomalyAction,
+    AnomalyType,
+    BrokerFailures,
+    GoalViolations,
+    SelfHealingNotifier,
+    SlackSelfHealingNotifier,
+)
+from cruise_control_tpu.detector.detectors import (
+    AnomalyDetectorService,
+    BrokerFailureDetector,
+    DiskFailureDetector,
+    GoalViolationDetector,
+    MetricAnomalyDetector,
+    SlowBrokerFinder,
+    percentile_anomalies,
+)
+from cruise_control_tpu.monitor.load_monitor import LoadMonitor, StaticMetadataSource
+from cruise_control_tpu.monitor.sampler import (
+    BrokerMetadata,
+    ClusterMetadata,
+    PartitionMetadata,
+    SyntheticLoadSampler,
+)
+
+W = 60_000
+
+
+class FakeTime:
+    def __init__(self, t0=0):
+        self.t = t0
+
+    def __call__(self):
+        return self.t
+
+
+def _metadata(dead=()):
+    brokers = [BrokerMetadata(i, rack=f"r{i % 2}", host=f"h{i}",
+                              alive=i not in dead) for i in range(4)]
+    parts = [PartitionMetadata("T", p, leader=(p % 4 if p % 4 not in dead
+                                               else (p + 1) % 4),
+                               replicas=(p % 4, (p + 1) % 4))
+             for p in range(8)]
+    return ClusterMetadata(brokers=brokers, partitions=parts, generation=1)
+
+
+def test_broker_failure_detector_persistence(tmp_path):
+    clock = FakeTime(1000)
+    path = str(tmp_path / "failed_brokers.json")
+    src = StaticMetadataSource(_metadata(dead=(2,)))
+    d = BrokerFailureDetector(src, persist_path=path, now_fn=clock)
+    a = d.detect()
+    assert a is not None and a.failed_brokers_by_time == {2: 1000}
+    # restart: record survives, original failure time kept
+    clock.t = 5000
+    d2 = BrokerFailureDetector(src, persist_path=path, now_fn=clock)
+    a2 = d2.detect()
+    assert a2.failed_brokers_by_time == {2: 1000}
+    # broker recovers: record cleared
+    src.metadata = _metadata()
+    assert d2.detect() is None
+
+
+def test_self_healing_notifier_thresholds():
+    clock = FakeTime(0)
+    n = SelfHealingNotifier(broker_failure_alert_threshold_ms=100,
+                            self_healing_threshold_ms=200,
+                            enabled={AnomalyType.BROKER_FAILURE: True},
+                            now_fn=clock)
+    a = BrokerFailures(AnomalyType.BROKER_FAILURE, 0,
+                       failed_brokers_by_time={1: 0})
+    clock.t = 50
+    r = n.on_anomaly(a)
+    assert r.action == AnomalyAction.CHECK and r.delay_ms == 50
+    clock.t = 150
+    r = n.on_anomaly(a)
+    assert r.action == AnomalyAction.CHECK   # alerted, waiting for fix window
+    assert n.alerts and n.alerts[-1]["autoFixTriggered"] is False
+    clock.t = 250
+    r = n.on_anomaly(a)
+    assert r.action == AnomalyAction.FIX
+    assert n.alerts[-1]["autoFixTriggered"] is True
+
+
+def test_self_healing_notifier_disabled_ignores():
+    clock = FakeTime(1_000_000)
+    n = SelfHealingNotifier(now_fn=clock)
+    a = BrokerFailures(AnomalyType.BROKER_FAILURE, 0,
+                       failed_brokers_by_time={1: 0})
+    assert n.on_anomaly(a).action == AnomalyAction.IGNORE
+    g = GoalViolations(AnomalyType.GOAL_VIOLATION, 0,
+                       fixable_violated_goals=["RackAwareGoal"])
+    assert n.on_anomaly(g).action == AnomalyAction.IGNORE
+    n.set_self_healing_for(AnomalyType.GOAL_VIOLATION, True)
+    assert n.on_anomaly(g).action == AnomalyAction.FIX
+
+
+def test_slack_notifier_posts():
+    posts = []
+    n = SlackSelfHealingNotifier(
+        webhook_url="http://hook", channel="#ops",
+        post_fn=lambda url, payload: posts.append((url, payload)),
+        enabled={AnomalyType.GOAL_VIOLATION: True})
+    g = GoalViolations(AnomalyType.GOAL_VIOLATION, 0,
+                       fixable_violated_goals=["RackAwareGoal"])
+    n.on_anomaly(g)
+    assert posts and posts[0][0] == "http://hook"
+
+
+def test_percentile_finder():
+    hist = np.full(20, 10.0)
+    assert percentile_anomalies(hist, 16.0) is not None   # > P95 * 1.5
+    assert percentile_anomalies(hist, 11.0) is None
+    assert percentile_anomalies(hist, 1.0) is not None    # < P2 * 0.2
+
+
+def test_metric_anomaly_detector():
+    history = {0: {"cpu": np.array([10.0] * 10 + [50.0])},
+               1: {"cpu": np.array([10.0] * 11)}}
+    d = MetricAnomalyDetector(lambda: history, now_fn=FakeTime(1))
+    found = d.detect()
+    assert len(found) == 1 and found[0].broker_id == 0
+
+
+def test_disk_failure_detector():
+    d = DiskFailureDetector(lambda: {0: {"/d1": True, "/d2": False},
+                                     1: {"/d1": True}}, now_fn=FakeTime(1))
+    a = d.detect()
+    assert a.failed_disks_by_broker == {0: ["/d2"]}
+
+
+def test_slow_broker_finder_escalation():
+    clock = FakeTime(0)
+    flush = {b: [10.0] * 8 for b in range(3)}
+    bytes_in = {b: [1000.0] * 8 for b in range(3)}
+
+    def hist():
+        return {b: {"flush_time": flush[b], "bytes_in": bytes_in[b]}
+                for b in range(3)}
+
+    f = SlowBrokerFinder(hist, score_threshold=2, removal_threshold=4,
+                         now_fn=clock)
+    assert f.detect() is None
+    # broker 2 becomes persistently slow
+    for i in range(4):
+        flush[2] = flush[2] + [500.0]
+        bytes_in[2] = bytes_in[2] + [1000.0]
+        for b in (0, 1):
+            flush[b] = flush[b] + [10.0]
+            bytes_in[b] = bytes_in[b] + [1000.0]
+        clock.t += 1000
+        a = f.detect()
+    assert a is not None and 2 in a.slow_brokers_by_time
+    assert a.remove_slow_brokers    # escalated past removal threshold
+
+
+def test_goal_violation_detector_end_to_end():
+    md = _metadata(dead=(1,))
+    lm = LoadMonitor(StaticMetadataSource(md), SyntheticLoadSampler(seed=3),
+                     num_windows=3, window_ms=W)
+    for w in range(4):
+        lm.sample_once(now_ms=w * W + 30_000)
+    d = GoalViolationDetector(lm, now_fn=FakeTime(4 * W))
+    a = d.detect()
+    assert a is not None
+    assert "OfflineReplicas" in a.fixable_violated_goals
+
+
+class _Ctx:
+    def __init__(self):
+        self.calls = []
+
+    def rebalance(self, **kw):
+        self.calls.append("rebalance")
+        return {"ok": True}
+
+    def remove_brokers(self, ids, **kw):
+        self.calls.append(("remove", tuple(ids)))
+        return {"ok": True}
+
+    def demote_brokers(self, ids, **kw):
+        self.calls.append(("demote", tuple(ids)))
+        return {"ok": True}
+
+    def fix_offline_replicas(self, **kw):
+        self.calls.append("fix_offline")
+        return {"ok": True}
+
+
+def test_detector_service_fix_path():
+    clock = FakeTime(1_000_000)
+    notifier = SelfHealingNotifier(
+        broker_failure_alert_threshold_ms=0, self_healing_threshold_ms=0,
+        enabled={t: True for t in AnomalyType}, now_fn=clock)
+    ctx = _Ctx()
+    failures = {"v": BrokerFailures(AnomalyType.BROKER_FAILURE, 0,
+                                    failed_brokers_by_time={3: 0})}
+    svc = AnomalyDetectorService(
+        notifier, context=ctx,
+        detectors={"broker_failure": lambda: failures["v"]},
+        now_fn=clock)
+    assert svc.sweep() == 1
+    assert svc.handle_pending() == 1
+    assert ctx.calls == [("remove", (3,))]
+    assert svc.metrics["fixes_triggered"] == 1
+    snap = svc.state_snapshot()
+    assert snap["recentAnomalies"][-1]["action"] == "FIX"
+
+
+def test_detector_service_delays_during_execution():
+    clock = FakeTime(1_000_000)
+    notifier = SelfHealingNotifier(enabled={t: True for t in AnomalyType},
+                                   now_fn=clock)
+    ctx = _Ctx()
+    svc = AnomalyDetectorService(
+        notifier, context=ctx, has_ongoing_execution=lambda: True,
+        detectors={}, now_fn=clock)
+    svc.enqueue(GoalViolations(AnomalyType.GOAL_VIOLATION, 0,
+                               fixable_violated_goals=["RackAwareGoal"]))
+    svc.handle_pending()
+    assert ctx.calls == []
+    assert svc.history[-1]["action"] == "DELAYED_ONGOING_EXECUTION"
