@@ -525,9 +525,12 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
         conflict = (overlap(touched_b) | overlap(touched_h)
                     | overlap(part) | overlap(topic))
 
-        order = jnp.argsort(deltas)
-        rank = jnp.zeros(K, jnp.int32).at[order].set(jnp.arange(K, dtype=jnp.int32))
-        earlier = rank[None, :] < rank[:, None]       # j earlier than i
+        # "j precedes i" in delta order, computed pairwise (no sort — TPU
+        # sorts are many bitonic passes and dominated the step cost)
+        idx = jnp.arange(K)
+        earlier = ((deltas[None, :] < deltas[:, None])
+                   | ((deltas[None, :] == deltas[:, None])
+                      & (idx[None, :] < idx[:, None])))
         blocked = jnp.any(conflict & earlier, axis=1)
         selected = ~blocked
 

@@ -191,6 +191,7 @@ def default_options(topo: ClusterTopology) -> DeviceOptions:
 
 class GoalThresholds(NamedTuple):
     alive: jax.Array                  # bool[B]
+    demoted: jax.Array                # bool[B]
     n_alive: jax.Array                # f32 scalar
     broker_capacity: jax.Array        # f32[B,4]
     # CapacityGoal: utilization limit = capacity * capacity_threshold
@@ -256,6 +257,7 @@ def compute_thresholds(dt: DeviceTopology, constraint: BalancingConstraint,
 
     return GoalThresholds(
         alive=alive,
+        demoted=dt.broker_demoted,
         n_alive=n_alive,
         broker_capacity=dt.capacity,
         cap_limit_broker=dt.capacity * cap_thresh[None, :],
@@ -311,6 +313,10 @@ BROKER_TERM_GOALS = (
     "_DeadBrokerPlacement",           # internal hard term: replicas must leave
                                       # dead brokers (self-healing eligibility,
                                       # GoalUtils.legitMove dest-alive check)
+    "_DemotedLeadership",             # internal hard term: leadership must
+                                      # leave DEMOTED brokers (DemoteBroker /
+                                      # PreferredLeaderElectionGoal demotion
+                                      # mode)
 )
 _BT = {g: i for i, g in enumerate(BROKER_TERM_GOALS)}
 NUM_BROKER_TERMS = len(BROKER_TERM_GOALS)
@@ -390,6 +396,11 @@ def broker_terms(th: GoalThresholds, broker_load: jax.Array,
     dead_cnt = rc * (1.0 - alive_f)
     viol[_BT["_DeadBrokerPlacement"]] = dead_cnt
     cost[_BT["_DeadBrokerPlacement"]] = dead_cnt
+
+    # -- _DemotedLeadership (hard, internal): leadership on demoted brokers.
+    dem_cnt = leader_count.astype(jnp.float32) * th.demoted.astype(jnp.float32)
+    viol[_BT["_DemotedLeadership"]] = dem_cnt
+    cost[_BT["_DemotedLeadership"]] = dem_cnt
 
     # batched callers (greedy's hypothetical [R,B] evals) broadcast different
     # argument shapes per term — unify before stacking.

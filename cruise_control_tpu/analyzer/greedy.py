@@ -216,6 +216,7 @@ def _leadership_deltas(dt: DeviceTopology, th: G.GoalThresholds,
     th_mem = OBJ.gather_thresholds(th, mem_b)
     th_mem = th_mem._replace(
         alive=th_mem.alive[:, None, :],
+        demoted=th_mem.demoted[:, None, :],
         broker_capacity=th_mem.broker_capacity[:, None, :, :],
         cap_limit_broker=th_mem.cap_limit_broker[:, None, :, :],
         pot_nw_out_limit=th_mem.pot_nw_out_limit[:, None, :],

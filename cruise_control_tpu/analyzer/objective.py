@@ -57,6 +57,7 @@ def build_weights(goal_names: Sequence[str],
                  if g in G.BROKER_TERM_GOALS):
         bt[i] = by_goal[g]
     bt[G.BROKER_TERM_GOALS.index("_DeadBrokerPlacement")] = hard_weight
+    bt[G.BROKER_TERM_GOALS.index("_DemotedLeadership")] = hard_weight
     ht = np.array([by_goal.get(g, 0.0) for g in G.HOST_TERM_GOALS], np.float32)
     return ObjectiveWeights(
         broker_terms=jnp.asarray(bt),
@@ -88,6 +89,7 @@ def gather_thresholds(th: G.GoalThresholds, idx: jax.Array) -> G.GoalThresholds:
     """Threshold rows for specific brokers (for batched hypothetical evals)."""
     return th._replace(
         alive=th.alive[idx],
+        demoted=th.demoted[idx],
         broker_capacity=th.broker_capacity[idx],
         cap_limit_broker=th.cap_limit_broker[idx],
         pot_nw_out_limit=th.pot_nw_out_limit[idx],

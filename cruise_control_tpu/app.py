@@ -1,0 +1,407 @@
+"""Service facade: the TPU Cruise Control application object.
+
+Rebuild of ``KafkaCruiseControl.java:64-731`` + the proposal-cache side of
+``GoalOptimizer.java`` (precompute/caching keyed by model generation,
+``GoalOptimizer.java:126-325``): wires LoadMonitor, the optimizer, the
+Executor, and the AnomalyDetector service; exposes the operations the REST
+runnables call (``servlet/handler/async/runnable/*.java``): rebalance,
+proposals, add/remove/demote brokers, fix offline replicas, pause/resume
+sampling, stop execution. Implements
+:class:`~cruise_control_tpu.detector.anomalies.SelfHealingContext` so
+anomaly fixes run through the exact same paths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.analyzer import goals as G
+from cruise_control_tpu.analyzer import optimizer as OPT
+from cruise_control_tpu.analyzer.annealer import AnnealConfig
+from cruise_control_tpu.common.config import CruiseControlConfig
+from cruise_control_tpu.detector.anomalies import AnomalyType, SelfHealingNotifier
+from cruise_control_tpu.detector.detectors import (
+    AnomalyDetectorService,
+    BrokerFailureDetector,
+    GoalViolationDetector,
+)
+from cruise_control_tpu.executor.executor import (
+    ClusterAdapter,
+    Executor,
+    ExecutorConfig,
+    FakeClusterAdapter,
+)
+from cruise_control_tpu.models.cluster import Assignment, ClusterTopology
+from cruise_control_tpu.monitor.aggregator import ModelCompletenessRequirements
+from cruise_control_tpu.monitor.load_monitor import LoadMonitor, MetadataSource
+from cruise_control_tpu.monitor.sampler import MetricSampler
+
+
+@dataclasses.dataclass
+class CachedProposals:
+    result: OPT.OptimizerResult
+    generation: "object"
+    computed_at_ms: int
+
+
+class CruiseControlApp:
+    """The running service: all subsystems + operation surface."""
+
+    def __init__(self, config: CruiseControlConfig,
+                 metadata_source: MetadataSource,
+                 sampler: MetricSampler,
+                 cluster_adapter: Optional[ClusterAdapter] = None,
+                 capacity_resolver=None, sample_store=None,
+                 mesh=None):
+        self.config = config
+        self.constraint = config.balancing_constraint()
+        self.default_goals = tuple(config.get("default.goals"))
+        self.mesh = mesh
+        self.load_monitor = LoadMonitor(
+            metadata_source, sampler,
+            capacity_resolver=capacity_resolver,
+            sample_store=sample_store,
+            num_windows=config.get("num.partition.metrics.windows"),
+            window_ms=config.get("partition.metrics.window.ms"),
+            min_samples_per_window=config.get(
+                "min.samples.per.partition.metrics.window"),
+            max_allowed_extrapolations=config.get(
+                "max.allowed.extrapolations.per.partition"),
+            sampling_interval_ms=config.get("metric.sampling.interval.ms"))
+        self._metadata_source = metadata_source
+        adapter = cluster_adapter or FakeClusterAdapter({})
+        self.executor = Executor(
+            adapter,
+            ExecutorConfig(
+                num_concurrent_partition_movements_per_broker=config.get(
+                    "num.concurrent.partition.movements.per.broker"),
+                num_concurrent_leader_movements=config.get(
+                    "num.concurrent.leader.movements"),
+                execution_progress_check_interval_ms=config.get(
+                    "execution.progress.check.interval.ms"),
+                default_replication_throttle=config.get(
+                    "default.replication.throttle")))
+        notifier = SelfHealingNotifier(
+            broker_failure_alert_threshold_ms=config.get(
+                "broker.failure.alert.threshold.ms"),
+            self_healing_threshold_ms=config.get(
+                "broker.failure.self.healing.threshold.ms"),
+            enabled={t: bool(config.get("self.healing.enabled"))
+                     for t in AnomalyType})
+        self.anomaly_detector = AnomalyDetectorService(
+            notifier, context=self,
+            has_ongoing_execution=lambda: self.executor.has_ongoing_execution,
+            detectors={
+                "broker_failure": BrokerFailureDetector(
+                    metadata_source,
+                    persist_path=config.get("failed.brokers.file.path") or None
+                ).detect,
+                "goal_violation": GoalViolationDetector(
+                    self.load_monitor,
+                    goal_names=tuple(config.get("anomaly.detection.goals"))
+                ).detect,
+            },
+            interval_ms=config.get("anomaly.detection.interval.ms"))
+        self._proposal_cache: Optional[CachedProposals] = None
+        self._cache_lock = threading.Lock()
+        self._default_requirements = ModelCompletenessRequirements(
+            min_required_num_windows=1,
+            min_monitored_partitions_percentage=config.get(
+                "min.valid.partition.ratio"))
+
+    # ----------------------------------------------------------------- boot
+
+    def startup(self):
+        """KafkaCruiseControl.startUp (KafkaCruiseControl.java:156-165)."""
+        self.load_monitor.startup()
+        self.anomaly_detector.start()
+
+    def shutdown(self):
+        self.anomaly_detector.shutdown()
+        self.load_monitor.shutdown()
+
+    # ------------------------------------------------------------- optimize
+
+    def _anneal_config(self) -> AnnealConfig:
+        return AnnealConfig(
+            num_chains=self.config.get("anneal.num.chains"),
+            steps=self.config.get("anneal.steps"),
+            tries_move=self.config.get("anneal.tries.move"),
+            tries_lead=self.config.get("anneal.tries.lead"),
+            tries_swap=self.config.get("anneal.tries.swap"))
+
+    def _optimize(self, topo: ClusterTopology, assign: Assignment,
+                  goal_names: Optional[Sequence[str]] = None,
+                  options: Optional[G.DeviceOptions] = None,
+                  ) -> OPT.OptimizerResult:
+        return OPT.optimize(
+            topo, assign,
+            goal_names=tuple(goal_names or self.default_goals),
+            constraint=self.constraint,
+            options=options,
+            engine=self.config.get("optimizer.engine"),
+            anneal_config=self._anneal_config(),
+            mesh=self.mesh)
+
+    def _model(self, requirements=None) -> Tuple[ClusterTopology, Assignment]:
+        return self.load_monitor.cluster_model(
+            requirements=requirements or self._default_requirements)
+
+    def proposals(self, goal_names: Optional[Sequence[str]] = None,
+                  ignore_proposal_cache: bool = False,
+                  **option_kw) -> OPT.OptimizerResult:
+        """ProposalsRunnable.getProposals: cached unless stale/bypassed."""
+        use_cache = (not ignore_proposal_cache and not goal_names
+                     and not option_kw)
+        if use_cache:
+            with self._cache_lock:
+                c = self._proposal_cache
+                if c is not None:
+                    gen = self.load_monitor.model_generation()
+                    age = time.time() * 1000 - c.computed_at_ms
+                    if (not c.generation.is_stale(gen)
+                            and age < self.config.get("proposal.expiration.ms")):
+                        return c.result
+        topo, assign = self._model()
+        options = (G.build_options(topo, **option_kw) if option_kw else None)
+        result = self._optimize(topo, assign, goal_names, options)
+        if use_cache:
+            with self._cache_lock:
+                self._proposal_cache = CachedProposals(
+                    result, self.load_monitor.model_generation(),
+                    int(time.time() * 1000))
+        return result
+
+    # ----------------------------------------------- operations (runnables)
+
+    def rebalance(self, goal_names: Optional[Sequence[str]] = None,
+                  dryrun: bool = True, self_healing: bool = False,
+                  excluded_topics: Sequence[str] = (),
+                  destination_broker_ids: Sequence[int] = (),
+                  concurrency: Optional[int] = None,
+                  **_ignored) -> dict:
+        """RebalanceRunnable.rebalance (RebalanceRunnable.java:130-144)."""
+        if self_healing:
+            dryrun = False
+        goals = goal_names or (
+            tuple(self.config.get("self.healing.goals")) or None
+            if self_healing else None)
+        topo, assign = self._model()
+        options = G.build_options(
+            topo, excluded_topics=excluded_topics,
+            requested_destination_broker_ids=destination_broker_ids)
+        result = self._optimize(topo, assign, goals, options)
+        summary = result.to_json()
+        if not dryrun:
+            exec_summary = self.executor.execute_proposals(
+                result.proposals, concurrency=concurrency)
+            summary["execution"] = exec_summary
+        return summary
+
+    def add_brokers(self, broker_ids: Sequence[int], dryrun: bool = True,
+                    **kw) -> dict:
+        """AddBrokersRunnable: move load onto the new brokers."""
+        topo, assign = self._model()
+        ids = set(int(b) for b in broker_ids)
+        new_mask = np.array([int(b) in ids for b in topo.broker_ids])
+        topo = dataclasses.replace(topo, broker_new=new_mask)
+        options = G.build_options(topo,
+                                  requested_destination_broker_ids=broker_ids)
+        result = self._optimize(topo, assign, None, options)
+        summary = result.to_json()
+        if not dryrun:
+            summary["execution"] = self.executor.execute_proposals(
+                result.proposals)
+        return summary
+
+    def remove_brokers(self, broker_ids: Sequence[int], dryrun: bool = True,
+                       self_healing: bool = False, **kw) -> dict:
+        """RemoveBrokersRunnable: drain the given brokers."""
+        if self_healing:
+            dryrun = False
+        topo, assign = self._model()
+        ids = set(int(b) for b in broker_ids)
+        # removed brokers: not a legal destination; their replicas must leave
+        idx = {int(b): i for i, b in enumerate(topo.broker_ids)}
+        offline = topo.replica_offline.copy()
+        dead_rows = [idx[b] for b in ids if b in idx]
+        alive = topo.broker_alive.copy()
+        for r_i in dead_rows:
+            alive[r_i] = False
+            offline |= (np.asarray(assign.broker_of) == r_i)
+        topo = dataclasses.replace(topo, broker_alive=alive,
+                                   replica_offline=offline)
+        options = G.build_options(
+            topo, excluded_brokers_for_replica_move=broker_ids,
+            excluded_brokers_for_leadership=broker_ids)
+        result = self._optimize(topo, assign, None, options)
+        summary = result.to_json()
+        if not dryrun:
+            summary["execution"] = self.executor.execute_proposals(
+                result.proposals, removed_brokers=ids)
+        return summary
+
+    def demote_brokers(self, broker_ids: Sequence[int], dryrun: bool = True,
+                       self_healing: bool = False, **kw) -> dict:
+        """DemoteBrokerRunnable: move leadership off the given brokers."""
+        if self_healing:
+            dryrun = False
+        topo, assign = self._model()
+        ids = set(int(b) for b in broker_ids)
+        idx = {int(b): i for i, b in enumerate(topo.broker_ids)}
+        demoted = topo.broker_demoted.copy()
+        for b in ids:
+            if b in idx:
+                demoted[idx[b]] = True
+        topo = dataclasses.replace(topo, broker_demoted=demoted)
+        options = G.build_options(topo,
+                                  excluded_brokers_for_leadership=broker_ids)
+        result = self._optimize(
+            topo, assign, ("LeaderReplicaDistributionGoal",
+                           "LeaderBytesInDistributionGoal",
+                           "PreferredLeaderElectionGoal"), options)
+        summary = result.to_json()
+        if not dryrun:
+            summary["execution"] = self.executor.execute_proposals(
+                result.proposals, demoted_brokers=ids)
+        return summary
+
+    def fix_offline_replicas(self, dryrun: bool = True,
+                             self_healing: bool = False, **kw) -> dict:
+        """FixOfflineReplicasRunnable: self-heal dead-disk/broker replicas."""
+        if self_healing:
+            dryrun = False
+        topo, assign = self._model()
+        result = self._optimize(topo, assign)
+        summary = result.to_json()
+        if not dryrun:
+            summary["execution"] = self.executor.execute_proposals(
+                result.proposals)
+        return summary
+
+    def update_topic_replication_factor(self, topic_pattern: str,
+                                        replication_factor: int,
+                                        dryrun: bool = True, **kw) -> dict:
+        """UpdateTopicConfigurationRunnable: change matching topics' RF
+        (ClusterModel.createOrDeleteReplicas, ClusterModel.java:906).
+
+        Increase: add replicas on rack-diverse, least-loaded brokers that do
+        not already host the partition. Decrease: drop follower replicas
+        from the tail (never the leader)."""
+        import re
+
+        from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+        from cruise_control_tpu.common import resources as res
+        pat = re.compile(topic_pattern)
+        topo, assign = self._model()
+        bo = np.asarray(assign.broker_of)
+        lo = np.asarray(assign.leader_of)
+        ids = np.asarray(topo.broker_ids)
+        alive_rows = np.flatnonzero(topo.broker_alive)
+        counts = np.bincount(bo, minlength=topo.num_brokers).astype(float)
+        proposals: List[ExecutionProposal] = []
+        for p in range(topo.num_partitions):
+            t = topo.topic_names[topo.topic_of_partition[p]]
+            if not pat.fullmatch(t):
+                continue
+            slots = topo.replicas_of_partition[p]
+            slots = slots[slots >= 0]
+            cur = [int(x) for x in bo[slots]]
+            leader_row = int(bo[lo[p]])
+            old_list = [leader_row] + [b for b in cur if b != leader_row]
+            new_list = list(old_list)
+            if replication_factor > len(cur):
+                have_racks = {int(topo.rack_of_broker[b]) for b in new_list}
+                for _ in range(replication_factor - len(cur)):
+                    cands = [b for b in alive_rows if b not in new_list]
+                    if not cands:
+                        break
+                    fresh = [b for b in cands
+                             if int(topo.rack_of_broker[b]) not in have_racks]
+                    pool = fresh or cands
+                    pick = min(pool, key=lambda b: counts[b])
+                    new_list.append(int(pick))
+                    counts[pick] += 1
+                    have_racks.add(int(topo.rack_of_broker[pick]))
+            elif replication_factor < len(cur):
+                if replication_factor < 1:
+                    raise ValueError("replication_factor must be >= 1")
+                new_list = new_list[:replication_factor]
+            if new_list != old_list:
+                disk = float(topo.replica_base_load[lo[p], res.DISK])
+                proposals.append(ExecutionProposal(
+                    topic=t,
+                    partition=int(topo.partition_index[p]),
+                    old_leader=int(ids[leader_row]),
+                    old_replicas=tuple(int(ids[b]) for b in old_list),
+                    new_replicas=tuple(int(ids[b]) for b in new_list),
+                    data_size=disk))
+        summary = {"proposals": [p.to_json() for p in proposals],
+                   "numPartitionsChanged": len(proposals),
+                   "replicationFactor": replication_factor}
+        if not dryrun and proposals:
+            summary["execution"] = self.executor.execute_proposals(proposals)
+        return summary
+
+    # ------------------------------------------------------------- controls
+
+    def pause_sampling(self, reason: str = "Paused by user"):
+        self.load_monitor.pause(reason)
+        return {"paused": True, "reason": reason}
+
+    def resume_sampling(self, reason: str = "Resumed by user"):
+        self.load_monitor.resume(reason)
+        return {"resumed": True, "reason": reason}
+
+    def stop_execution(self, forced: bool = False):
+        self.executor.stop_execution(forced)
+        return {"stopRequested": True, "forced": forced}
+
+    def set_self_healing(self, anomaly_type: Optional[str], enabled: bool) -> dict:
+        types = ([AnomalyType[anomaly_type]] if anomaly_type
+                 else list(AnomalyType))
+        for t in types:
+            self.anomaly_detector.notifier.set_self_healing_for(t, enabled)
+        return {"selfHealingEnabled": {
+            t.value: v for t, v in
+            self.anomaly_detector.notifier.self_healing_enabled().items()}}
+
+    # ----------------------------------------------------------------- state
+
+    def state(self) -> dict:
+        """CruiseControlState for the STATE endpoint."""
+        return {
+            "MonitorState": self.load_monitor.state_snapshot(),
+            "ExecutorState": self.executor.state_snapshot(),
+            "AnalyzerState": {
+                "isProposalReady": self._proposal_cache is not None,
+                "readyGoals": list(self.default_goals),
+            },
+            "AnomalyDetectorState": self.anomaly_detector.state_snapshot(),
+        }
+
+    def kafka_cluster_state(self) -> dict:
+        md = self._metadata_source.get_metadata()
+        by_broker: Dict[int, Dict[str, int]] = {
+            b.broker_id: {"replicaCount": 0, "leaderCount": 0,
+                          "alive": b.alive} for b in md.brokers}
+        urp, offline = [], []
+        for p in md.partitions:
+            for r in p.replicas:
+                if r in by_broker:
+                    by_broker[r]["replicaCount"] += 1
+            if p.leader in by_broker:
+                by_broker[p.leader]["leaderCount"] += 1
+            if p.isr and set(p.isr) != set(p.replicas):
+                urp.append(f"{p.topic}-{p.partition}")
+            if p.offline_replicas:
+                offline.append(f"{p.topic}-{p.partition}")
+        return {"KafkaBrokerState": by_broker,
+                "KafkaPartitionState": {
+                    "urp": urp, "offline": offline,
+                    "totalPartitions": len(md.partitions)}}

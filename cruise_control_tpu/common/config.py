@@ -1,0 +1,338 @@
+"""Kafka-style typed configuration framework.
+
+Rebuild of the core config machinery
+(``cruise-control-core/.../common/config/ConfigDef.java`` — typed defines
+with defaults, validators, importance, docs — and ``AbstractConfig.java``)
+plus the service's config surface (``config/KafkaCruiseControlConfig.java``,
+the keys that drive behavior in this framework). Reads Java-style
+``.properties`` files or plain dicts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Callable, Dict, List, Optional
+
+
+class ConfigType(enum.Enum):
+    BOOLEAN = "boolean"
+    STRING = "string"
+    INT = "int"
+    LONG = "long"
+    DOUBLE = "double"
+    LIST = "list"
+    CLASS = "class"
+
+
+class Importance(enum.Enum):
+    HIGH = "high"
+    MEDIUM = "medium"
+    LOW = "low"
+
+
+class ConfigException(ValueError):
+    pass
+
+
+_NO_DEFAULT = object()
+
+
+def at_least(n):
+    def check(name, v):
+        if v < n:
+            raise ConfigException(f"{name} must be >= {n}, got {v}")
+    return check
+
+
+def between(lo, hi):
+    def check(name, v):
+        if not (lo <= v <= hi):
+            raise ConfigException(f"{name} must be in [{lo}, {hi}], got {v}")
+    return check
+
+
+@dataclasses.dataclass
+class ConfigKey:
+    name: str
+    type: ConfigType
+    default: Any
+    importance: Importance
+    doc: str
+    validator: Optional[Callable[[str, Any], None]] = None
+
+    @property
+    def has_default(self) -> bool:
+        return self.default is not _NO_DEFAULT
+
+
+class ConfigDef:
+    """Typed schema: define keys, then parse a raw mapping."""
+
+    def __init__(self):
+        self._keys: Dict[str, ConfigKey] = {}
+
+    def define(self, name: str, ctype: ConfigType, default: Any = _NO_DEFAULT,
+               importance: Importance = Importance.MEDIUM, doc: str = "",
+               validator: Optional[Callable] = None) -> "ConfigDef":
+        if name in self._keys:
+            raise ConfigException(f"duplicate config key {name}")
+        self._keys[name] = ConfigKey(name, ctype, default, importance, doc,
+                                     validator)
+        return self
+
+    @property
+    def keys(self) -> Dict[str, ConfigKey]:
+        return dict(self._keys)
+
+    def parse_value(self, key: ConfigKey, raw: Any) -> Any:
+        t = key.type
+        try:
+            if raw is None:
+                return None
+            if t == ConfigType.BOOLEAN:
+                if isinstance(raw, bool):
+                    return raw
+                s = str(raw).strip().lower()
+                if s in ("true", "1", "yes"):
+                    return True
+                if s in ("false", "0", "no"):
+                    return False
+                raise ConfigException(f"{key.name}: not a boolean: {raw!r}")
+            if t in (ConfigType.INT, ConfigType.LONG):
+                return int(str(raw).strip())
+            if t == ConfigType.DOUBLE:
+                return float(str(raw).strip())
+            if t == ConfigType.LIST:
+                if isinstance(raw, (list, tuple)):
+                    return list(raw)
+                s = str(raw).strip()
+                return [x.strip() for x in s.split(",") if x.strip()] if s else []
+            return str(raw)
+        except ConfigException:
+            raise
+        except (TypeError, ValueError) as e:
+            raise ConfigException(f"{key.name}: cannot parse {raw!r} as "
+                                  f"{t.value}: {e}")
+
+    def parse(self, raw: Dict[str, Any], allow_unknown: bool = True
+              ) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for name, key in self._keys.items():
+            if name in raw:
+                v = self.parse_value(key, raw[name])
+            elif key.has_default:
+                v = key.default
+            else:
+                raise ConfigException(f"missing required config {name}")
+            if key.validator is not None and v is not None:
+                key.validator(name, v)
+            out[name] = v
+        if not allow_unknown:
+            unknown = set(raw) - set(self._keys)
+            if unknown:
+                raise ConfigException(f"unknown configs: {sorted(unknown)}")
+        return out
+
+
+def load_properties(path: str) -> Dict[str, str]:
+    """Minimal Java .properties reader (the boot-file format)."""
+    out: Dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#") or line.startswith("!"):
+                continue
+            for sep in ("=", ":"):
+                if sep in line:
+                    k, _, v = line.partition(sep)
+                    out[k.strip()] = v.strip()
+                    break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Service config (KafkaCruiseControlConfig.java keys that drive behavior)
+# ---------------------------------------------------------------------------
+
+def _service_config_def() -> ConfigDef:
+    from cruise_control_tpu.analyzer import goals as G
+    d = ConfigDef()
+    T, I = ConfigType, Importance
+    # goals (KafkaCruiseControlConfig.java:1521-1570)
+    d.define("goals", T.LIST, list(G.DEFAULT_GOALS) + list(G.EXTRA_GOALS),
+             I.HIGH, "Supported goals in priority order.")
+    d.define("default.goals", T.LIST, list(G.DEFAULT_GOALS), I.HIGH,
+             "Goals used when a request names none; also precompute goals.")
+    d.define("hard.goals", T.LIST, sorted(G.HARD_GOALS), I.HIGH, "Hard goals.")
+    d.define("self.healing.goals", T.LIST, [], I.HIGH,
+             "Goals for self-healing; empty = default.goals.")
+    d.define("anomaly.detection.goals", T.LIST,
+             list(G.ANOMALY_DETECTION_GOALS), I.MEDIUM,
+             "Goals the goal-violation detector checks.")
+    d.define("intra.broker.goals", T.LIST,
+             ["IntraBrokerDiskCapacityGoal", "IntraBrokerDiskUsageDistributionGoal"],
+             I.HIGH, "Intra-broker (JBOD) goals.")
+    # balancing constraint (BalancingConstraint.java defaults)
+    for res_name in ("cpu", "disk", "network.inbound", "network.outbound"):
+        d.define(f"{res_name}.balance.threshold", T.DOUBLE, 1.10, I.HIGH,
+                 f"Balance band multiplier for {res_name}.", at_least(1.0))
+        d.define(f"{res_name}.capacity.threshold", T.DOUBLE, 0.8, I.HIGH,
+                 f"Capacity threshold for {res_name}.", between(0.0, 1.0))
+        d.define(f"{res_name}.low.utilization.threshold", T.DOUBLE, 0.0,
+                 I.LOW, f"Low-utilization threshold for {res_name}.",
+                 between(0.0, 1.0))
+    d.define("max.replicas.per.broker", T.LONG, 10_000, I.MEDIUM,
+             "ReplicaCapacityGoal limit.", at_least(1))
+    d.define("replica.count.balance.threshold", T.DOUBLE, 1.10, I.LOW,
+             "Replica-count balance band.", at_least(1.0))
+    d.define("leader.replica.count.balance.threshold", T.DOUBLE, 1.10, I.LOW,
+             "Leader-replica-count balance band.", at_least(1.0))
+    d.define("topic.replica.count.balance.threshold", T.DOUBLE, 3.00, I.LOW,
+             "Per-topic replica balance band.", at_least(1.0))
+    d.define("goal.violation.distribution.threshold.multiplier", T.DOUBLE,
+             1.0, I.MEDIUM, "Detector relaxation multiplier.", at_least(1.0))
+    d.define("goal.balancedness.priority.weight", T.DOUBLE, 1.1, I.LOW,
+             "Balancedness priority weight.")
+    d.define("goal.balancedness.strictness.weight", T.DOUBLE, 1.5, I.LOW,
+             "Balancedness strictness weight.")
+    # monitor
+    d.define("num.partition.metrics.windows", T.INT, 5, I.HIGH,
+             "Number of load windows.", at_least(1))
+    d.define("partition.metrics.window.ms", T.LONG, 300_000, I.HIGH,
+             "Window length ms.", at_least(1))
+    d.define("min.samples.per.partition.metrics.window", T.INT, 1, I.MEDIUM,
+             "Min samples for a valid window.", at_least(1))
+    d.define("max.allowed.extrapolations.per.partition", T.INT, 5, I.LOW,
+             "Max extrapolated windows per partition.", at_least(0))
+    d.define("metric.sampling.interval.ms", T.LONG, 60_000, I.MEDIUM,
+             "Sampler period.", at_least(1))
+    d.define("min.valid.partition.ratio", T.DOUBLE, 0.95, I.MEDIUM,
+             "Monitored-partition completeness ratio.", between(0.0, 1.0))
+    d.define("broker.capacity.config.resolver.class", T.CLASS,
+             "FileCapacityResolver", I.MEDIUM, "Capacity resolver class.")
+    d.define("capacity.config.file", T.STRING, "config/capacity.json",
+             I.MEDIUM, "Capacity file path.")
+    d.define("sample.store.class", T.CLASS, "FileSampleStore", I.LOW,
+             "Sample store implementation.")
+    d.define("sample.store.dir", T.STRING, "", I.LOW,
+             "FileSampleStore directory ('' = disabled).")
+    d.define("metric.sampler.class", T.CLASS, "SyntheticLoadSampler", I.HIGH,
+             "MetricSampler implementation.")
+    # analyzer / optimizer engine
+    d.define("proposal.expiration.ms", T.LONG, 900_000, I.MEDIUM,
+             "Cached proposal staleness bound.", at_least(0))
+    d.define("num.proposal.precompute.threads", T.INT, 1, I.LOW,
+             "Proposal precompute workers.", at_least(0))
+    d.define("optimizer.engine", T.STRING, "auto", I.HIGH,
+             "auto | greedy | anneal")
+    d.define("anneal.num.chains", T.INT, 32, I.MEDIUM,
+             "Parallel-tempering chains.", at_least(1))
+    d.define("anneal.steps", T.INT, 2048, I.MEDIUM, "Annealer steps.",
+             at_least(1))
+    d.define("anneal.tries.move", T.INT, 32, I.LOW, "Move proposals/step.")
+    d.define("anneal.tries.lead", T.INT, 8, I.LOW, "Leadership proposals/step.")
+    d.define("anneal.tries.swap", T.INT, 16, I.LOW, "Swap proposals/step.")
+    # executor (Executor.java config surface)
+    d.define("num.concurrent.partition.movements.per.broker", T.INT, 5,
+             I.MEDIUM, "Per-broker reassignment concurrency.", at_least(1))
+    d.define("num.concurrent.leader.movements", T.INT, 1000, I.MEDIUM,
+             "Leadership movement batch size.", at_least(1))
+    d.define("execution.progress.check.interval.ms", T.LONG, 10_000, I.LOW,
+             "Executor poll period.", at_least(1))
+    d.define("default.replication.throttle", T.LONG, None, I.MEDIUM,
+             "Default replication throttle bytes/sec (None = off).")
+    d.define("max.num.cluster.movements", T.INT, 1250, I.MEDIUM,
+             "Cap on simultaneous movements.", at_least(1))
+    # anomaly detector
+    d.define("anomaly.detection.interval.ms", T.LONG, 300_000, I.MEDIUM,
+             "Detector sweep period.", at_least(1))
+    d.define("anomaly.notifier.class", T.CLASS, "SelfHealingNotifier",
+             I.LOW, "AnomalyNotifier implementation.")
+    d.define("self.healing.enabled", T.BOOLEAN, False, I.HIGH,
+             "Global self-healing master switch.")
+    d.define("broker.failure.alert.threshold.ms", T.LONG, 900_000, I.MEDIUM,
+             "Broker-failure alert delay.")
+    d.define("broker.failure.self.healing.threshold.ms", T.LONG, 1_800_000,
+             I.MEDIUM, "Broker-failure fix delay.")
+    d.define("failed.brokers.file.path", T.STRING, "failed_brokers.json",
+             I.LOW, "Persisted failed-broker record.")
+    # webserver (KafkaCruiseControlMain/WebServerConfig)
+    d.define("webserver.http.port", T.INT, 9090, I.HIGH, "REST port.")
+    d.define("webserver.http.address", T.STRING, "127.0.0.1", I.HIGH,
+             "REST bind address.")
+    d.define("webserver.api.urlprefix", T.STRING, "/kafkacruisecontrol",
+             I.LOW, "API prefix.")
+    d.define("webserver.session.maxExpiryPeriodMs", T.LONG, 60_000, I.LOW,
+             "Session expiry.")
+    d.define("max.active.user.tasks", T.INT, 25, I.LOW,
+             "Active user task cap.")
+    d.define("completed.user.task.retention.time.ms", T.LONG, 86_400_000,
+             I.LOW, "Completed task retention.")
+    d.define("two.step.verification.enabled", T.BOOLEAN, False, I.MEDIUM,
+             "Purgatory 2-step review for POSTs.")
+    d.define("bootstrap.servers", T.STRING, "", I.HIGH,
+             "Kafka bootstrap servers (Kafka-backed deployments).")
+    d.define("zookeeper.connect", T.STRING, "", I.MEDIUM,
+             "ZooKeeper connect string (legacy deployments).")
+    return d
+
+
+class CruiseControlConfig:
+    """AbstractConfig equivalent over the service schema."""
+
+    _DEF: Optional[ConfigDef] = None
+
+    @classmethod
+    def definition(cls) -> ConfigDef:
+        if cls._DEF is None:
+            cls._DEF = _service_config_def()
+        return cls._DEF
+
+    def __init__(self, raw: Optional[Dict[str, Any]] = None,
+                 properties_file: Optional[str] = None):
+        merged: Dict[str, Any] = {}
+        if properties_file:
+            merged.update(load_properties(properties_file))
+        if raw:
+            merged.update(raw)
+        self._values = self.definition().parse(merged)
+        self.originals = merged
+
+    def get(self, name: str) -> Any:
+        try:
+            return self._values[name]
+        except KeyError:
+            raise ConfigException(f"unknown config {name}")
+
+    def __getitem__(self, name: str) -> Any:
+        return self.get(name)
+
+    def balancing_constraint(self):
+        from cruise_control_tpu.common.resources import BalancingConstraint
+        g = self.get
+        return BalancingConstraint(
+            resource_balance_percentage=(
+                g("cpu.balance.threshold"),
+                g("network.inbound.balance.threshold"),
+                g("network.outbound.balance.threshold"),
+                g("disk.balance.threshold")),
+            capacity_threshold=(
+                g("cpu.capacity.threshold"),
+                g("network.inbound.capacity.threshold"),
+                g("network.outbound.capacity.threshold"),
+                g("disk.capacity.threshold")),
+            low_utilization_threshold=(
+                g("cpu.low.utilization.threshold"),
+                g("network.inbound.low.utilization.threshold"),
+                g("network.outbound.low.utilization.threshold"),
+                g("disk.low.utilization.threshold")),
+            replica_balance_percentage=g("replica.count.balance.threshold"),
+            leader_replica_balance_percentage=g(
+                "leader.replica.count.balance.threshold"),
+            topic_replica_balance_percentage=g(
+                "topic.replica.count.balance.threshold"),
+            goal_violation_distribution_threshold_multiplier=g(
+                "goal.violation.distribution.threshold.multiplier"),
+            max_replicas_per_broker=int(g("max.replicas.per.broker")),
+        )

@@ -1,0 +1,254 @@
+"""Async operation machinery: futures, user tasks, sessions, purgatory.
+
+Rebuilds the servlet-side async stack:
+- ``OperationFuture`` + typed progress steps
+  (``async/OperationFuture.java``, ``async/progress/*.java``)
+- ``UserTaskManager`` (``servlet/UserTaskManager.java:62-216``): UUID-keyed
+  active/completed task maps with per-endpoint retention, session binding
+- ``SessionManager`` (``servlet/SessionManager.java``)
+- ``Purgatory`` 2-step verification for POSTs
+  (``servlet/purgatory/Purgatory.java:42-166``): submit → PENDING_REVIEW →
+  approve/discard → submitted once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+import time
+import uuid
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+_now_ms = lambda: int(time.time() * 1000)
+
+
+class OperationProgress:
+    """Typed progress steps (async/progress/OperationProgress.java)."""
+
+    def __init__(self):
+        self._steps: List[Tuple[str, float]] = []
+        self._lock = threading.Lock()
+
+    def add_step(self, description: str):
+        with self._lock:
+            self._steps.append((description, time.time()))
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return [{"step": s, "time": t} for s, t in self._steps]
+
+
+class OperationFuture:
+    """A future with progress + the uuid of its user task."""
+
+    def __init__(self, operation: str):
+        self.operation = operation
+        self.progress = OperationProgress()
+        self._future: Future = Future()
+
+    def set_execution(self, fn: Callable[["OperationFuture"], Any],
+                      pool: ThreadPoolExecutor):
+        def run():
+            try:
+                self._future.set_result(fn(self))
+            except BaseException as e:
+                self._future.set_exception(e)
+        pool.submit(run)
+
+    def done(self) -> bool:
+        return self._future.done()
+
+    def result(self, timeout: Optional[float] = None):
+        return self._future.result(timeout)
+
+    def exception(self):
+        return self._future.exception() if self._future.done() else None
+
+    def describe(self) -> dict:
+        out = {"operation": self.operation, "done": self.done(),
+               "progress": self.progress.snapshot()}
+        if self.done() and self._future.exception() is not None:
+            out["error"] = str(self._future.exception())
+        return out
+
+
+class TaskState(enum.Enum):
+    ACTIVE = "Active"
+    COMPLETED = "Completed"
+    COMPLETED_WITH_ERROR = "CompletedWithError"
+
+
+@dataclasses.dataclass
+class UserTaskInfo:
+    task_id: str
+    endpoint: str
+    request_url: str
+    client_id: str
+    start_ms: int
+    future: OperationFuture
+
+    @property
+    def state(self) -> TaskState:
+        if not self.future.done():
+            return TaskState.ACTIVE
+        return (TaskState.COMPLETED_WITH_ERROR
+                if self.future.exception() is not None else TaskState.COMPLETED)
+
+    def to_json(self) -> dict:
+        return {"UserTaskId": self.task_id, "Status": self.state.value,
+                "RequestURL": self.request_url, "ClientIdentity": self.client_id,
+                "StartMs": self.start_ms, "endpoint": self.endpoint}
+
+
+class UserTaskManager:
+    """UUID-keyed active/completed tasks with retention."""
+
+    def __init__(self, max_active_tasks: int = 25,
+                 completed_retention_ms: int = 86_400_000,
+                 num_threads: int = 4, now_fn=_now_ms):
+        self._active: Dict[str, UserTaskInfo] = {}
+        self._completed: Dict[str, UserTaskInfo] = {}
+        self._max_active = max_active_tasks
+        self._retention_ms = completed_retention_ms
+        self._lock = threading.RLock()
+        self._pool = ThreadPoolExecutor(max_workers=num_threads,
+                                        thread_name_prefix="user-task")
+        self._now = now_fn
+
+    def create_task(self, endpoint: str, request_url: str, client_id: str,
+                    fn: Callable[[OperationFuture], Any]) -> UserTaskInfo:
+        with self._lock:
+            self._expire()
+            if len(self._active) >= self._max_active:
+                raise RuntimeError(
+                    f"There are already {len(self._active)} active user "
+                    f"tasks, which has reached the limit {self._max_active}")
+            tid = str(uuid.uuid4())
+            fut = OperationFuture(endpoint)
+            info = UserTaskInfo(tid, endpoint, request_url, client_id,
+                                self._now(), fut)
+            self._active[tid] = info
+        fut.set_execution(fn, self._pool)
+        return info
+
+    def get(self, task_id: str) -> Optional[UserTaskInfo]:
+        with self._lock:
+            self._expire()
+            return self._active.get(task_id) or self._completed.get(task_id)
+
+    def all_tasks(self) -> List[UserTaskInfo]:
+        with self._lock:
+            self._expire()
+            return list(self._active.values()) + list(self._completed.values())
+
+    def _expire(self):
+        now = self._now()
+        for tid, info in list(self._active.items()):
+            if info.future.done():
+                del self._active[tid]
+                self._completed[tid] = info
+        for tid, info in list(self._completed.items()):
+            if now - info.start_ms > self._retention_ms:
+                del self._completed[tid]
+
+    def close(self):
+        self._pool.shutdown(wait=False)
+
+
+class SessionManager:
+    """HTTP session key → in-flight task binding with expiry."""
+
+    def __init__(self, max_expiry_ms: int = 60_000, now_fn=_now_ms):
+        self._by_session: Dict[str, Tuple[str, int]] = {}
+        self._expiry = max_expiry_ms
+        self._now = now_fn
+        self._lock = threading.Lock()
+
+    def bind(self, session_key: str, task_id: str):
+        with self._lock:
+            self._by_session[session_key] = (task_id, self._now())
+
+    def task_for(self, session_key: str) -> Optional[str]:
+        with self._lock:
+            self._sweep()
+            entry = self._by_session.get(session_key)
+            return entry[0] if entry else None
+
+    def _sweep(self):
+        now = self._now()
+        for k, (tid, t0) in list(self._by_session.items()):
+            if now - t0 > self._expiry:
+                del self._by_session[k]
+
+
+class ReviewStatus(enum.Enum):
+    PENDING_REVIEW = "PENDING_REVIEW"
+    APPROVED = "APPROVED"
+    SUBMITTED = "SUBMITTED"
+    DISCARDED = "DISCARDED"
+
+
+@dataclasses.dataclass
+class ReviewRequest:
+    review_id: int
+    endpoint: str
+    request_url: str
+    submitter: str
+    status: ReviewStatus = ReviewStatus.PENDING_REVIEW
+    reason: str = ""
+    submitted_task_id: Optional[str] = None
+
+    def to_json(self) -> dict:
+        return {"Id": self.review_id, "EndPoint": self.endpoint,
+                "RequestURL": self.request_url, "Submitter": self.submitter,
+                "Status": self.status.value, "Reason": self.reason,
+                "SubmittedTaskId": self.submitted_task_id}
+
+
+class Purgatory:
+    """Two-step verification (servlet/purgatory/Purgatory.java:42-166)."""
+
+    def __init__(self):
+        self._requests: Dict[int, ReviewRequest] = {}
+        self._next_id = 0
+        self._lock = threading.Lock()
+
+    def submit(self, endpoint: str, request_url: str, submitter: str
+               ) -> ReviewRequest:
+        with self._lock:
+            r = ReviewRequest(self._next_id, endpoint, request_url, submitter)
+            self._requests[self._next_id] = r
+            self._next_id += 1
+            return r
+
+    def review(self, review_id: int, approve: bool, reason: str = ""
+               ) -> ReviewRequest:
+        with self._lock:
+            r = self._requests.get(review_id)
+            if r is None:
+                raise KeyError(f"no review request {review_id}")
+            if r.status != ReviewStatus.PENDING_REVIEW:
+                raise ValueError(f"request {review_id} is {r.status.value}, "
+                                 "not PENDING_REVIEW")
+            r.status = (ReviewStatus.APPROVED if approve
+                        else ReviewStatus.DISCARDED)
+            r.reason = reason
+            return r
+
+    def take_approved(self, review_id: int) -> ReviewRequest:
+        """Mark an APPROVED request SUBMITTED (each approval is usable once)."""
+        with self._lock:
+            r = self._requests.get(review_id)
+            if r is None:
+                raise KeyError(f"no review request {review_id}")
+            if r.status != ReviewStatus.APPROVED:
+                raise ValueError(f"request {review_id} is {r.status.value}, "
+                                 "not APPROVED")
+            r.status = ReviewStatus.SUBMITTED
+            return r
+
+    def board(self) -> List[dict]:
+        with self._lock:
+            return [r.to_json() for r in self._requests.values()]

@@ -1,0 +1,408 @@
+"""REST API: the 21-endpoint servlet over the service facade.
+
+Rebuild of ``servlet/KafkaCruiseControlServlet.java:95-135`` +
+``servlet/CruiseControlEndPoint.java:16-36`` on the stdlib threading HTTP
+server: GET/POST dispatch to endpoint handlers, query-parameter parsing
+(``servlet/parameters/ParameterUtils.java`` semantics for the parameters
+this framework consumes), JSON responses, async endpoints through
+UserTaskManager (poll with the returned User-Task-ID), optional 2-step
+verification through the Purgatory.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from cruise_control_tpu.app import CruiseControlApp
+from cruise_control_tpu.server.async_ops import (
+    Purgatory,
+    SessionManager,
+    UserTaskManager,
+)
+
+GET_ENDPOINTS = [
+    "BOOTSTRAP", "TRAIN", "LOAD", "PARTITION_LOAD", "PROPOSALS", "STATE",
+    "KAFKA_CLUSTER_STATE", "USER_TASKS", "REVIEW_BOARD",
+]
+POST_ENDPOINTS = [
+    "ADD_BROKER", "REMOVE_BROKER", "FIX_OFFLINE_REPLICAS", "REBALANCE",
+    "STOP_PROPOSAL_EXECUTION", "PAUSE_SAMPLING", "RESUME_SAMPLING",
+    "DEMOTE_BROKER", "ADMIN", "REVIEW", "TOPIC_CONFIGURATION",
+]
+ALL_ENDPOINTS = GET_ENDPOINTS + POST_ENDPOINTS
+
+#: POST endpoints subject to 2-step verification when enabled
+REVIEWABLE = {"ADD_BROKER", "REMOVE_BROKER", "FIX_OFFLINE_REPLICAS",
+              "REBALANCE", "DEMOTE_BROKER", "TOPIC_CONFIGURATION"}
+
+
+def _parse_bool(params: dict, name: str, default: bool) -> bool:
+    v = params.get(name)
+    if v is None:
+        return default
+    return str(v).strip().lower() == "true"
+
+
+def _parse_csv_ints(params: dict, name: str) -> List[int]:
+    v = params.get(name)
+    if not v:
+        return []
+    return [int(x) for x in str(v).split(",") if x.strip()]
+
+
+def _parse_csv(params: dict, name: str) -> List[str]:
+    v = params.get(name)
+    if not v:
+        return []
+    return [x.strip() for x in str(v).split(",") if x.strip()]
+
+
+class RestApi:
+    """Endpoint handlers; transport-independent (the HTTP layer and tests
+    call ``dispatch`` directly)."""
+
+    def __init__(self, app: CruiseControlApp):
+        self.app = app
+        cfg = app.config
+        self.user_tasks = UserTaskManager(
+            max_active_tasks=cfg.get("max.active.user.tasks"),
+            completed_retention_ms=cfg.get(
+                "completed.user.task.retention.time.ms"))
+        self.sessions = SessionManager(
+            max_expiry_ms=cfg.get("webserver.session.maxExpiryPeriodMs"))
+        self.purgatory = Purgatory() if cfg.get(
+            "two.step.verification.enabled") else None
+        self.prefix = cfg.get("webserver.api.urlprefix").rstrip("/")
+
+    # ------------------------------------------------------------- dispatch
+
+    def dispatch(self, method: str, endpoint: str, params: Dict[str, str],
+                 client_id: str = "local", request_url: str = ""
+                 ) -> Tuple[int, dict]:
+        endpoint = endpoint.upper()
+        if endpoint not in ALL_ENDPOINTS:
+            return 404, {"errorMessage": f"Unknown endpoint {endpoint}",
+                         "validEndpoints": ALL_ENDPOINTS}
+        if method == "GET" and endpoint not in GET_ENDPOINTS:
+            return 405, {"errorMessage": f"{endpoint} requires POST"}
+        if method == "POST" and endpoint not in POST_ENDPOINTS:
+            return 405, {"errorMessage": f"{endpoint} requires GET"}
+
+        # two-step verification (Purgatory.java:116-166)
+        if (method == "POST" and self.purgatory is not None
+                and endpoint in REVIEWABLE):
+            review_id = params.get("review_id")
+            if review_id is None:
+                r = self.purgatory.submit(endpoint, request_url, client_id)
+                return 202, {"reviewResult": r.to_json(),
+                             "message": "Submitted for review; approve via "
+                                        "REVIEW then resubmit with review_id."}
+            try:
+                self.purgatory.take_approved(int(review_id))
+            except (KeyError, ValueError) as e:
+                return 400, {"errorMessage": str(e)}
+
+        try:
+            handler = getattr(self, f"_{endpoint.lower()}")
+            return handler(params, client_id, request_url)
+        except Exception as e:     # surface as the reference's error JSON
+            return 500, {"errorMessage": f"{type(e).__name__}: {e}"}
+
+    # -------------------------------------------------- async plumbing
+
+    def _async_op(self, endpoint: str, params: dict, client_id: str,
+                  request_url: str, fn: Callable[[], dict]) -> Tuple[int, dict]:
+        """Run an operation on the task pool; block up to
+        ``get_response_timeout`` then return in-progress + User-Task-ID
+        (AbstractAsyncRequest.handle semantics)."""
+        existing = params.get("user_task_id")
+        if existing:
+            info = self.user_tasks.get(existing)
+            if info is None:
+                return 404, {"errorMessage": f"unknown user task {existing}"}
+        else:
+            info = self.user_tasks.create_task(
+                endpoint, request_url, client_id, lambda fut: fn())
+        timeout = float(params.get("get_response_timeout_ms", 1_000)) / 1000.0
+        try:
+            result = info.future.result(timeout=timeout)
+            return 200, {"userTaskId": info.task_id, **result}
+        except TimeoutError:
+            return 202, {"userTaskId": info.task_id,
+                         "progress": info.future.describe()}
+        except Exception as e:
+            return 500, {"userTaskId": info.task_id,
+                         "errorMessage": f"{type(e).__name__}: {e}"}
+
+    # ------------------------------------------------------------ GET
+
+    def _state(self, params, client_id, request_url):
+        state = self.app.state()
+        substates = _parse_csv(params, "substates")
+        if substates:
+            want = {s.lower() for s in substates}
+            state = {k: v for k, v in state.items()
+                     if k.lower().replace("state", "") in want
+                     or k.lower() in want}
+        return 200, state
+
+    def _kafka_cluster_state(self, params, client_id, request_url):
+        return 200, self.app.kafka_cluster_state()
+
+    def _proposals(self, params, client_id, request_url):
+        goals = _parse_csv(params, "goals") or None
+        ignore_cache = _parse_bool(params, "ignore_proposal_cache", False)
+        return self._async_op(
+            "PROPOSALS", params, client_id, request_url,
+            lambda: self.app.proposals(
+                goal_names=goals,
+                ignore_proposal_cache=ignore_cache).to_json())
+
+    def _load(self, params, client_id, request_url):
+        topo, assign = self.app._model()
+        from cruise_control_tpu.ops.aggregates import (
+            compute_aggregates, device_topology)
+        import numpy as np
+        dt = device_topology(topo)
+        agg = compute_aggregates(dt, assign, topo.num_topics)
+        hosts = {}
+        brokers = []
+        load = np.asarray(agg.broker_load)
+        cnt = np.asarray(agg.replica_count)
+        leaders = np.asarray(agg.leader_count)
+        pot = np.asarray(agg.potential_nw_out)
+        from cruise_control_tpu.common import resources as res
+        for i, bid in enumerate(topo.broker_ids):
+            brokers.append({
+                "Broker": int(bid),
+                "Host": topo.host_names[topo.host_of_broker[i]]
+                if topo.host_names else str(topo.host_of_broker[i]),
+                "Rack": topo.rack_names[topo.rack_of_broker[i]]
+                if topo.rack_names else str(topo.rack_of_broker[i]),
+                "BrokerState": "ALIVE" if topo.broker_alive[i] else "DEAD",
+                "Replicas": int(cnt[i]),
+                "Leaders": int(leaders[i]),
+                "CpuPct": float(load[i, res.CPU]),
+                "DiskMB": float(load[i, res.DISK]),
+                "NwInRate": float(load[i, res.NW_IN]),
+                "NwOutRate": float(load[i, res.NW_OUT]),
+                "PnwOutRate": float(pot[i]),
+            })
+        return 200, {"brokers": brokers, "hosts": list(hosts.values()),
+                     "version": 1}
+
+    def _partition_load(self, params, client_id, request_url):
+        topo, assign = self.app._model()
+        import numpy as np
+        from cruise_control_tpu.common import resources as res
+        sort_res = {"cpu": res.CPU, "disk": res.DISK,
+                    "network_inbound": res.NW_IN,
+                    "network_outbound": res.NW_OUT}.get(
+            str(params.get("resource", "disk")).lower(), res.DISK)
+        n = int(params.get("entries", 50))
+        lo = np.asarray(assign.leader_of)
+        leader_load = (topo.replica_base_load[lo]
+                       + topo.leader_extra)               # [P,4]
+        order = np.argsort(-leader_load[:, sort_res])[:n]
+        bo = np.asarray(assign.broker_of)
+        records = []
+        for p in order:
+            slots = topo.replicas_of_partition[p]
+            slots = slots[slots >= 0]
+            records.append({
+                "topic": topo.topic_names[topo.topic_of_partition[p]],
+                "partition": int(topo.partition_index[p]),
+                "leader": int(topo.broker_ids[bo[lo[p]]]),
+                "followers": [int(topo.broker_ids[bo[s]]) for s in slots
+                              if s != lo[p]],
+                "cpu": float(leader_load[p, res.CPU]),
+                "disk": float(leader_load[p, res.DISK]),
+                "networkInbound": float(leader_load[p, res.NW_IN]),
+                "networkOutbound": float(leader_load[p, res.NW_OUT]),
+            })
+        return 200, {"records": records, "version": 1}
+
+    def _user_tasks(self, params, client_id, request_url):
+        return 200, {"userTasks": [t.to_json()
+                                   for t in self.user_tasks.all_tasks()],
+                     "version": 1}
+
+    def _review_board(self, params, client_id, request_url):
+        if self.purgatory is None:
+            return 400, {"errorMessage": "two-step verification disabled"}
+        return 200, {"requestInfo": self.purgatory.board(), "version": 1}
+
+    def _bootstrap(self, params, client_id, request_url):
+        start = int(params.get("start", 0))
+        end = int(params.get("end", 0))
+        return self._async_op(
+            "BOOTSTRAP", params, client_id, request_url,
+            lambda: (self.app.load_monitor.bootstrap(start, end)
+                     or {"bootstrap": "done", "startMs": start, "endMs": end}))
+
+    def _train(self, params, client_id, request_url):
+        # the reference trains a linear-regression CPU model; the TPU build's
+        # static estimation model needs no training — acknowledge the range.
+        return 200, {"train": "noop",
+                     "message": "static CPU model in use; training not "
+                                "required (ModelParameters.java parity)"}
+
+    # ------------------------------------------------------------ POST
+
+    def _rebalance(self, params, client_id, request_url):
+        kw = dict(
+            goal_names=_parse_csv(params, "goals") or None,
+            dryrun=_parse_bool(params, "dryrun", True),
+            excluded_topics=_parse_csv(params, "excluded_topics"),
+            destination_broker_ids=_parse_csv_ints(
+                params, "destination_broker_ids"),
+        )
+        if params.get("concurrent_partition_movements_per_broker"):
+            kw["concurrency"] = int(
+                params["concurrent_partition_movements_per_broker"])
+        return self._async_op("REBALANCE", params, client_id, request_url,
+                              lambda: self.app.rebalance(**kw))
+
+    def _add_broker(self, params, client_id, request_url):
+        ids = _parse_csv_ints(params, "brokerid")
+        if not ids:
+            return 400, {"errorMessage": "brokerid parameter required"}
+        dry = _parse_bool(params, "dryrun", True)
+        return self._async_op("ADD_BROKER", params, client_id, request_url,
+                              lambda: self.app.add_brokers(ids, dryrun=dry))
+
+    def _remove_broker(self, params, client_id, request_url):
+        ids = _parse_csv_ints(params, "brokerid")
+        if not ids:
+            return 400, {"errorMessage": "brokerid parameter required"}
+        dry = _parse_bool(params, "dryrun", True)
+        return self._async_op("REMOVE_BROKER", params, client_id, request_url,
+                              lambda: self.app.remove_brokers(ids, dryrun=dry))
+
+    def _demote_broker(self, params, client_id, request_url):
+        ids = _parse_csv_ints(params, "brokerid")
+        if not ids:
+            return 400, {"errorMessage": "brokerid parameter required"}
+        dry = _parse_bool(params, "dryrun", True)
+        return self._async_op("DEMOTE_BROKER", params, client_id, request_url,
+                              lambda: self.app.demote_brokers(ids, dryrun=dry))
+
+    def _fix_offline_replicas(self, params, client_id, request_url):
+        dry = _parse_bool(params, "dryrun", True)
+        return self._async_op(
+            "FIX_OFFLINE_REPLICAS", params, client_id, request_url,
+            lambda: self.app.fix_offline_replicas(dryrun=dry))
+
+    def _stop_proposal_execution(self, params, client_id, request_url):
+        return 200, self.app.stop_execution(
+            forced=_parse_bool(params, "force_stop", False))
+
+    def _pause_sampling(self, params, client_id, request_url):
+        return 200, self.app.pause_sampling(
+            params.get("reason", "Paused by user"))
+
+    def _resume_sampling(self, params, client_id, request_url):
+        return 200, self.app.resume_sampling(
+            params.get("reason", "Resumed by user"))
+
+    def _admin(self, params, client_id, request_url):
+        out = {}
+        if "self_healing_for" in params or "enable_self_healing_for" in params:
+            t = params.get("self_healing_for") or params.get(
+                "enable_self_healing_for")
+            enabled = _parse_bool(params, "enable_self_healing", True)
+            out.update(self.app.set_self_healing(
+                t.upper() if t and t.upper() != "ALL" else None, enabled))
+        if "disable_self_healing_for" in params:
+            t = params["disable_self_healing_for"]
+            out.update(self.app.set_self_healing(
+                t.upper() if t and t.upper() != "ALL" else None, False))
+        if "concurrent_partition_movements_per_broker" in params:
+            n = int(params["concurrent_partition_movements_per_broker"])
+            self.app.executor.config.num_concurrent_partition_movements_per_broker = n
+            out["concurrentPartitionMovementsPerBroker"] = n
+        if not out:
+            return 400, {"errorMessage": "no admin action specified"}
+        return 200, out
+
+    def _review(self, params, client_id, request_url):
+        if self.purgatory is None:
+            return 400, {"errorMessage": "two-step verification disabled"}
+        approve = _parse_csv_ints(params, "approve")
+        discard = _parse_csv_ints(params, "discard")
+        reason = params.get("reason", "")
+        results = []
+        for rid in approve:
+            results.append(self.purgatory.review(rid, True, reason).to_json())
+        for rid in discard:
+            results.append(self.purgatory.review(rid, False, reason).to_json())
+        return 200, {"requestInfo": results, "version": 1}
+
+    def _topic_configuration(self, params, client_id, request_url):
+        topic = params.get("topic")
+        rf = params.get("replication_factor")
+        if not topic or not rf:
+            return 400, {"errorMessage":
+                         "topic and replication_factor parameters required"}
+        dry = _parse_bool(params, "dryrun", True)
+        return self._async_op(
+            "TOPIC_CONFIGURATION", params, client_id, request_url,
+            lambda: self.app.update_topic_replication_factor(
+                topic_pattern=topic, replication_factor=int(rf), dryrun=dry))
+
+
+class _Handler(BaseHTTPRequestHandler):
+    api: RestApi = None     # injected by serve()
+
+    def _do(self, method: str):
+        parsed = urllib.parse.urlparse(self.path)
+        params = {k: v[-1] for k, v in
+                  urllib.parse.parse_qs(parsed.query).items()}
+        if method == "POST":
+            length = int(self.headers.get("Content-Length") or 0)
+            if length:
+                body = self.rfile.read(length).decode()
+                params.update({k: v[-1] for k, v in
+                               urllib.parse.parse_qs(body).items()})
+        path = parsed.path.rstrip("/")
+        prefix = self.api.prefix
+        endpoint = path[len(prefix):].strip("/") if path.startswith(prefix) \
+            else path.strip("/")
+        code, payload = self.api.dispatch(
+            method, endpoint or "STATE", params,
+            client_id=self.client_address[0], request_url=self.path)
+        data = json.dumps(payload, indent=2, default=str).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        self._do("GET")
+
+    def do_POST(self):
+        self._do("POST")
+
+    def log_message(self, fmt, *args):   # NCSA-style access log to stdout
+        print(f"{self.client_address[0]} - {args[0] if args else ''}")
+
+
+def serve(app: CruiseControlApp, port: Optional[int] = None,
+          address: Optional[str] = None) -> ThreadingHTTPServer:
+    """Start the REST server (KafkaCruiseControlMain.java:79-115)."""
+    api = RestApi(app)
+    handler = type("Handler", (_Handler,), {"api": api})
+    server = ThreadingHTTPServer(
+        (address or app.config.get("webserver.http.address"),
+         port if port is not None else app.config.get("webserver.http.port")),
+        handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True,
+                              name="cc-rest")
+    thread.start()
+    server.api = api          # for tests
+    return server

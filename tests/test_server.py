@@ -1,0 +1,337 @@
+"""Service shell tests: config parsing, facade operations, async machinery,
+and the REST endpoints driven end-to-end over a live HTTP server —
+modeled on KafkaCruiseControlServletEndpointTest / UserTaskManagerTest /
+SessionManagerTest / OperationFutureTest.
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.app import CruiseControlApp
+from cruise_control_tpu.common.config import (
+    ConfigException,
+    CruiseControlConfig,
+    load_properties,
+)
+from cruise_control_tpu.executor.executor import FakeClusterAdapter
+from cruise_control_tpu.monitor.load_monitor import StaticMetadataSource
+from cruise_control_tpu.monitor.sampler import (
+    BrokerMetadata,
+    ClusterMetadata,
+    PartitionMetadata,
+    SyntheticLoadSampler,
+)
+from cruise_control_tpu.server.async_ops import (
+    Purgatory,
+    ReviewStatus,
+    SessionManager,
+    UserTaskManager,
+)
+from cruise_control_tpu.server import rest
+
+W = 60_000
+
+
+def _metadata(num_brokers=6, num_parts=30, rf=2, dead=()):
+    brokers = [BrokerMetadata(i, rack=f"r{i % 3}", host=f"h{i}",
+                              alive=i not in dead) for i in range(num_brokers)]
+    parts = []
+    for p in range(num_parts):
+        reps = tuple((p + j) % num_brokers for j in range(rf))
+        leader = next((r for r in reps if r not in dead), reps[0])
+        parts.append(PartitionMetadata("T", p, leader=leader, replicas=reps))
+    return ClusterMetadata(brokers=brokers, partitions=parts, generation=1)
+
+
+def _app(metadata=None, overrides=None):
+    cfg = CruiseControlConfig({
+        "optimizer.engine": "greedy",
+        "partition.metrics.window.ms": W,
+        "num.partition.metrics.windows": 3,
+        "min.valid.partition.ratio": 0.0,
+        "execution.progress.check.interval.ms": 1,
+        "failed.brokers.file.path": "",
+        **(overrides or {})})
+    md = metadata or _metadata()
+    adapter = FakeClusterAdapter(
+        {f"{p.topic}-{p.partition}": tuple(p.replicas)
+         for p in md.partitions},
+        latency_polls=1)
+    app = CruiseControlApp(cfg, StaticMetadataSource(md),
+                           SyntheticLoadSampler(seed=4),
+                           cluster_adapter=adapter)
+    for w in range(4):
+        app.load_monitor.sample_once(now_ms=w * W + 30_000)
+    return app
+
+
+# ---------------------------------------------------------------- config
+
+
+def test_config_defaults_and_parse():
+    cfg = CruiseControlConfig()
+    assert cfg.get("num.partition.metrics.windows") == 5
+    assert "RackAwareGoal" in cfg.get("default.goals")
+    c2 = CruiseControlConfig({"num.partition.metrics.windows": "7",
+                              "self.healing.enabled": "true",
+                              "goals": "RackAwareGoal,ReplicaCapacityGoal"})
+    assert c2.get("num.partition.metrics.windows") == 7
+    assert c2.get("self.healing.enabled") is True
+    assert c2.get("goals") == ["RackAwareGoal", "ReplicaCapacityGoal"]
+
+
+def test_config_validation():
+    with pytest.raises(ConfigException):
+        CruiseControlConfig({"cpu.capacity.threshold": "1.5"})
+    with pytest.raises(ConfigException):
+        CruiseControlConfig({"num.partition.metrics.windows": "zero"})
+
+
+def test_properties_file(tmp_path):
+    p = tmp_path / "cc.properties"
+    p.write_text("# comment\nwebserver.http.port=9999\n"
+                 "default.goals=RackAwareGoal\n")
+    cfg = CruiseControlConfig(properties_file=str(p))
+    assert cfg.get("webserver.http.port") == 9999
+    assert cfg.get("default.goals") == ["RackAwareGoal"]
+
+
+def test_balancing_constraint_from_config():
+    cfg = CruiseControlConfig({"disk.balance.threshold": "1.25",
+                               "max.replicas.per.broker": "500"})
+    c = cfg.balancing_constraint()
+    from cruise_control_tpu.common import resources as res
+    assert c.resource_balance_percentage[res.DISK] == 1.25
+    assert c.max_replicas_per_broker == 500
+
+
+# ---------------------------------------------------------------- async ops
+
+
+def test_user_task_manager_lifecycle():
+    utm = UserTaskManager(max_active_tasks=2)
+    info = utm.create_task("REBALANCE", "/rebalance", "c1",
+                           lambda fut: {"ok": True})
+    assert info.future.result(5) == {"ok": True}
+    assert utm.get(info.task_id) is not None
+    assert utm.get(info.task_id).state.value in ("Completed", "Active")
+    tasks = utm.all_tasks()
+    assert any(t.task_id == info.task_id for t in tasks)
+
+
+def test_user_task_manager_limit():
+    utm = UserTaskManager(max_active_tasks=1)
+    ev = {"hold": True}
+    utm.create_task("A", "/a", "c", lambda fut: time.sleep(0.5))
+    with pytest.raises(RuntimeError):
+        utm.create_task("B", "/b", "c", lambda fut: None)
+
+
+def test_session_manager_expiry():
+    clock = {"t": 0}
+    sm = SessionManager(max_expiry_ms=100, now_fn=lambda: clock["t"])
+    sm.bind("s1", "task1")
+    assert sm.task_for("s1") == "task1"
+    clock["t"] = 200
+    assert sm.task_for("s1") is None
+
+
+def test_purgatory_flow():
+    p = Purgatory()
+    r = p.submit("REBALANCE", "/rebalance?dryrun=false", "alice")
+    assert r.status == ReviewStatus.PENDING_REVIEW
+    with pytest.raises(ValueError):
+        p.take_approved(r.review_id)        # not approved yet
+    p.review(r.review_id, approve=True, reason="lgtm")
+    taken = p.take_approved(r.review_id)
+    assert taken.status == ReviewStatus.SUBMITTED
+    with pytest.raises(ValueError):
+        p.take_approved(r.review_id)        # single use
+    r2 = p.submit("REMOVE_BROKER", "/remove_broker?brokerid=1", "bob")
+    p.review(r2.review_id, approve=False, reason="nope")
+    assert p.board()[1]["Status"] == "DISCARDED"
+
+
+# ---------------------------------------------------------------- facade
+
+
+def test_app_proposals_cache():
+    app = _app()
+    r1 = app.proposals()
+    r2 = app.proposals()
+    assert r1 is r2                         # cache hit (same generation)
+    r3 = app.proposals(ignore_proposal_cache=True)
+    assert r3 is not r1
+
+
+def test_app_rebalance_execute():
+    app = _app()
+    out = app.rebalance(dryrun=False)
+    assert "execution" in out
+    assert out["numReplicaMovements"] >= 0
+
+
+def test_app_remove_brokers_drains():
+    app = _app()
+    out = app.remove_brokers([2], dryrun=True)
+    # every proposal moving replicas must move them OFF broker 2 and
+    # never INTO broker 2
+    for p in out["proposals"]:
+        assert 2 not in p["newReplicas"]
+    assert out["numReplicaMovements"] > 0
+
+
+def test_app_demote_brokers():
+    app = _app()
+    out = app.demote_brokers([1], dryrun=True)
+    for p in out["proposals"]:
+        assert p["newReplicas"][0] != 1     # leadership moved off broker 1
+
+
+def test_app_topic_rf_change():
+    app = _app()
+    out = app.update_topic_replication_factor("T", 3, dryrun=True)
+    assert out["numPartitionsChanged"] > 0
+    for p in out["proposals"]:
+        assert len(p["newReplicas"]) == 3
+        assert len(set(p["newReplicas"])) == 3
+    out2 = app.update_topic_replication_factor("T", 1, dryrun=True)
+    for p in out2["proposals"]:
+        assert len(p["newReplicas"]) == 1
+        assert p["newReplicas"][0] == p["oldReplicas"][0]  # leader kept
+
+
+def test_app_self_healing_context():
+    app = _app(metadata=_metadata(dead=(3,)))
+    out = app.remove_brokers([3], self_healing=True)
+    assert "execution" in out               # self-healing executes
+
+
+# ---------------------------------------------------------------- REST
+
+
+@pytest.fixture(scope="module")
+def server():
+    app = _app()
+    srv = rest.serve(app, port=0)           # ephemeral port
+    yield srv
+    srv.shutdown()
+
+
+def _get(srv, path):
+    port = srv.server_address[1]
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _post(srv, path, data=b""):
+    port = srv.server_address[1]
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}", data=data,
+                                 method="POST")
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_rest_state(server):
+    code, body = _get(server, "/kafkacruisecontrol/state")
+    assert code == 200
+    assert set(body) >= {"MonitorState", "ExecutorState", "AnalyzerState",
+                         "AnomalyDetectorState"}
+    code, body = _get(server, "/kafkacruisecontrol/state?substates=monitor")
+    assert list(body) == ["MonitorState"]
+
+
+def test_rest_kafka_cluster_state(server):
+    code, body = _get(server, "/kafkacruisecontrol/kafka_cluster_state")
+    assert code == 200
+    assert body["KafkaPartitionState"]["totalPartitions"] == 30
+
+
+def test_rest_load_and_partition_load(server):
+    code, body = _get(server, "/kafkacruisecontrol/load")
+    assert code == 200 and len(body["brokers"]) == 6
+    code, body = _get(server,
+                      "/kafkacruisecontrol/partition_load?entries=5")
+    assert code == 200 and len(body["records"]) == 5
+
+
+def test_rest_proposals_async(server):
+    code, body = _get(server, "/kafkacruisecontrol/proposals"
+                              "?get_response_timeout_ms=60000")
+    assert code == 200
+    assert "proposals" in body and "userTaskId" in body
+
+
+def test_rest_rebalance_dryrun(server):
+    code, body = _post(server, "/kafkacruisecontrol/rebalance"
+                               "?dryrun=true&get_response_timeout_ms=60000")
+    assert code == 200
+    # hard goals must end satisfied; balancedness is reported both ways
+    assert "balancednessAfter" in body and "proposals" in body
+    assert body["violatedGoalsAfter"] == [] or all(
+        g not in ("RackAwareGoal", "ReplicaCapacityGoal")
+        for g in body["violatedGoalsAfter"])
+
+
+def test_rest_user_tasks(server):
+    _get(server, "/kafkacruisecontrol/proposals?get_response_timeout_ms=60000")
+    code, body = _get(server, "/kafkacruisecontrol/user_tasks")
+    assert code == 200 and len(body["userTasks"]) >= 1
+
+
+def test_rest_pause_resume(server):
+    server.api.app.load_monitor._state = __import__(
+        "cruise_control_tpu.monitor.load_monitor",
+        fromlist=["MonitorState"]).MonitorState.RUNNING
+    code, body = _post(server, "/kafkacruisecontrol/pause_sampling?reason=test")
+    assert code == 200 and body["paused"]
+    code, body = _post(server, "/kafkacruisecontrol/resume_sampling")
+    assert code == 200 and body["resumed"]
+
+
+def test_rest_admin_self_healing(server):
+    code, body = _post(server, "/kafkacruisecontrol/admin"
+                               "?self_healing_for=ALL&enable_self_healing=true")
+    assert code == 200
+    assert all(body["selfHealingEnabled"].values())
+
+
+def test_rest_unknown_endpoint(server):
+    code, body = _get(server, "/kafkacruisecontrol/nonsense")
+    assert code == 404
+    assert "validEndpoints" in body
+
+
+def test_rest_wrong_method(server):
+    code, body = _get(server, "/kafkacruisecontrol/rebalance")
+    assert code == 405
+
+
+def test_rest_two_step_verification():
+    app = _app(overrides={"two.step.verification.enabled": True})
+    api = rest.RestApi(app)
+    code, body = api.dispatch("POST", "REBALANCE", {"dryrun": "true"},
+                              request_url="/rebalance?dryrun=true")
+    assert code == 202 and "reviewResult" in body
+    rid = body["reviewResult"]["Id"]
+    code, body = api.dispatch("POST", "REVIEW", {"approve": str(rid)})
+    assert code == 200
+    code, body = api.dispatch(
+        "POST", "REBALANCE",
+        {"dryrun": "true", "review_id": str(rid),
+         "get_response_timeout_ms": "60000"})
+    assert code == 200 and "proposals" in body
+    # approval is single-use
+    code, body = api.dispatch("POST", "REBALANCE",
+                              {"dryrun": "true", "review_id": str(rid)})
+    assert code == 400
