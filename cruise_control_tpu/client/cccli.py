@@ -1,0 +1,204 @@
+"""``cccli`` — console client for the REST API.
+
+Rebuild of the reference's Python client
+(``cruise-control-client/cruisecontrolclient/client/cccli.py:135-225``):
+the argparse tree is generated from endpoint + parameter metadata
+(mirroring ``client/Endpoint.py:158-454`` and the ``CCParameter`` classes),
+requests go through a small Responder layer, async operations poll with the
+returned User-Task-ID.
+
+Usage::
+
+    cccli -a host:9090 rebalance --dryrun true
+    cccli -a host:9090 remove_broker --brokers 3,4 --dryrun false
+    cccli -a host:9090 state
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Parameter:
+    """Typed query parameter (client/CCParameter/*.py)."""
+
+    name: str                     # query-parameter name
+    flag: str                     # CLI flag
+    type: str = "string"          # string | bool | int | csv | csv-int
+    help: str = ""
+
+    def validate(self, value: str) -> str:
+        if self.type == "bool":
+            if value.lower() not in ("true", "false"):
+                raise ValueError(f"--{self.flag} must be true|false")
+            return value.lower()
+        if self.type == "int":
+            int(value)
+            return value
+        if self.type == "csv-int":
+            [int(x) for x in value.split(",") if x]
+            return value
+        return value
+
+
+_COMMON = [
+    Parameter("reason", "reason", "string", "Reason for the request"),
+    Parameter("get_response_timeout_ms", "timeout-ms", "int",
+              "How long to wait before returning in-progress"),
+]
+_DRYRUN = Parameter("dryrun", "dryrun", "bool",
+                    "true = propose only (default), false = execute")
+_BROKERS = Parameter("brokerid", "brokers", "csv-int",
+                     "Comma-separated broker ids")
+_GOALS = Parameter("goals", "goals", "csv", "Goal list in priority order")
+
+
+@dataclasses.dataclass(frozen=True)
+class Endpoint:
+    """REST endpoint metadata (client/Endpoint.py)."""
+
+    name: str                     # CLI subcommand and URL path
+    method: str                   # GET | POST
+    help: str
+    parameters: Tuple[Parameter, ...] = ()
+    is_async: bool = False
+
+
+ENDPOINTS: List[Endpoint] = [
+    Endpoint("state", "GET", "Cruise Control substates", (
+        Parameter("substates", "substates", "csv",
+                  "monitor,analyzer,executor,anomaly_detector"),)),
+    Endpoint("kafka_cluster_state", "GET", "Kafka cluster state"),
+    Endpoint("load", "GET", "Per-broker load"),
+    Endpoint("partition_load", "GET", "Top partition loads", (
+        Parameter("resource", "resource", "string", "cpu|disk|network_inbound|network_outbound"),
+        Parameter("entries", "entries", "int", "Number of records"),)),
+    Endpoint("proposals", "GET", "Optimization proposals", (
+        _GOALS,
+        Parameter("ignore_proposal_cache", "ignore-proposal-cache", "bool"),),
+             is_async=True),
+    Endpoint("user_tasks", "GET", "Active/completed user tasks"),
+    Endpoint("review_board", "GET", "Two-step review board"),
+    Endpoint("bootstrap", "GET", "Replay a historical sample range", (
+        Parameter("start", "start", "int", "Range start ms"),
+        Parameter("end", "end", "int", "Range end ms"),), is_async=True),
+    Endpoint("train", "GET", "Train the CPU estimation model", (
+        Parameter("start", "start", "int"), Parameter("end", "end", "int"),)),
+    Endpoint("rebalance", "POST", "Rebalance the cluster", (
+        _DRYRUN, _GOALS,
+        Parameter("excluded_topics", "excluded-topics", "csv"),
+        Parameter("destination_broker_ids", "destination-brokers", "csv-int"),
+        Parameter("concurrent_partition_movements_per_broker",
+                  "concurrency", "int"),), is_async=True),
+    Endpoint("add_broker", "POST", "Move load onto new brokers",
+             (_BROKERS, _DRYRUN), is_async=True),
+    Endpoint("remove_broker", "POST", "Drain brokers",
+             (_BROKERS, _DRYRUN), is_async=True),
+    Endpoint("demote_broker", "POST", "Move leadership off brokers",
+             (_BROKERS, _DRYRUN), is_async=True),
+    Endpoint("fix_offline_replicas", "POST", "Self-heal offline replicas",
+             (_DRYRUN,), is_async=True),
+    Endpoint("stop_proposal_execution", "POST", "Stop the ongoing execution", (
+        Parameter("force_stop", "force", "bool"),)),
+    Endpoint("pause_sampling", "POST", "Pause metric sampling"),
+    Endpoint("resume_sampling", "POST", "Resume metric sampling"),
+    Endpoint("admin", "POST", "Runtime admin toggles", (
+        Parameter("self_healing_for", "enable-self-healing-for", "string",
+                  "Anomaly type or ALL"),
+        Parameter("disable_self_healing_for", "disable-self-healing-for",
+                  "string"),
+        Parameter("enable_self_healing", "enable-self-healing", "bool"),
+        Parameter("concurrent_partition_movements_per_broker",
+                  "concurrency", "int"),)),
+    Endpoint("review", "POST", "Approve/discard review requests", (
+        Parameter("approve", "approve", "csv-int"),
+        Parameter("discard", "discard", "csv-int"),)),
+    Endpoint("topic_configuration", "POST", "Change topic replication factor", (
+        Parameter("topic", "topic", "string", "Topic regex"),
+        Parameter("replication_factor", "replication-factor", "int"),
+        _DRYRUN,), is_async=True),
+]
+
+
+class Responder:
+    """HTTP layer (client/Responder.py): issue the request, poll async
+    operations with the returned User-Task-ID until done."""
+
+    def __init__(self, address: str, prefix: str = "/kafkacruisecontrol",
+                 poll_interval_s: float = 1.0, max_polls: int = 600):
+        if "://" not in address:
+            address = f"http://{address}"
+        self.base = address.rstrip("/") + prefix
+        self.poll_interval_s = poll_interval_s
+        self.max_polls = max_polls
+
+    def _request(self, method: str, path: str, params: Dict[str, str]
+                 ) -> Tuple[int, dict]:
+        qs = urllib.parse.urlencode(params)
+        url = f"{self.base}/{path}" + (f"?{qs}" if qs else "")
+        req = urllib.request.Request(url, method=method,
+                                     data=b"" if method == "POST" else None)
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read())
+            except Exception:
+                return e.code, {"errorMessage": str(e)}
+
+    def run(self, endpoint: Endpoint, params: Dict[str, str]) -> Tuple[int, dict]:
+        code, body = self._request(endpoint.method, endpoint.name, params)
+        polls = 0
+        while (endpoint.is_async and code == 202 and "userTaskId" in body
+               and polls < self.max_polls):
+            time.sleep(self.poll_interval_s)
+            polls += 1
+            code, body = self._request(
+                endpoint.method, endpoint.name,
+                {**params, "user_task_id": body["userTaskId"]})
+        return code, body
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="cccli", description="Cruise Control TPU client")
+    parser.add_argument("-a", "--address", required=True,
+                        help="host:port of the Cruise Control server")
+    parser.add_argument("--prefix", default="/kafkacruisecontrol")
+    parser.add_argument("--poll-interval", type=float, default=1.0)
+    sub = parser.add_subparsers(dest="endpoint", required=True)
+    for ep in ENDPOINTS:
+        p = sub.add_parser(ep.name, help=ep.help)
+        for param in tuple(ep.parameters) + tuple(_COMMON):
+            p.add_argument(f"--{param.flag}", dest=f"param_{param.name}",
+                           help=param.help)
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    ep = next(e for e in ENDPOINTS if e.name == args.endpoint)
+    by_name = {p.name: p for p in tuple(ep.parameters) + tuple(_COMMON)}
+    params: Dict[str, str] = {}
+    for key, value in vars(args).items():
+        if key.startswith("param_") and value is not None:
+            name = key[len("param_"):]
+            params[name] = by_name[name].validate(value)
+    responder = Responder(args.address, args.prefix, args.poll_interval)
+    code, body = responder.run(ep, params)
+    print(json.dumps(body, indent=2, default=str))
+    return 0 if code < 400 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

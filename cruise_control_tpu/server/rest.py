@@ -388,8 +388,10 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         self._do("POST")
 
-    def log_message(self, fmt, *args):   # NCSA-style access log to stdout
-        print(f"{self.client_address[0]} - {args[0] if args else ''}")
+    def log_message(self, fmt, *args):   # NCSA-style access log to stderr
+        import sys
+        print(f"{self.client_address[0]} - {args[0] if args else ''}",
+              file=sys.stderr)
 
 
 def serve(app: CruiseControlApp, port: Optional[int] = None,

@@ -1,0 +1,66 @@
+"""Client CLI tests against a live server (client <-> REST round trips)."""
+
+import json
+
+import pytest
+
+from cruise_control_tpu.client import cccli
+from cruise_control_tpu.server import rest
+from tests.test_server import _app
+
+
+@pytest.fixture(scope="module")
+def server():
+    app = _app()
+    srv = rest.serve(app, port=0)
+    yield srv
+    srv.shutdown()
+
+
+def _run(server, argv, capsys):
+    port = server.server_address[1]
+    rc = cccli.main(["-a", f"127.0.0.1:{port}", "--poll-interval", "0.05"]
+                    + argv)
+    out = capsys.readouterr().out
+    return rc, json.loads(out)
+
+
+def test_cli_state(server, capsys):
+    rc, body = _run(server, ["state"], capsys)
+    assert rc == 0 and "MonitorState" in body
+
+
+def test_cli_load(server, capsys):
+    rc, body = _run(server, ["load"], capsys)
+    assert rc == 0 and len(body["brokers"]) == 6
+
+
+def test_cli_rebalance_dryrun_polls(server, capsys):
+    rc, body = _run(server, ["rebalance", "--dryrun", "true",
+                             "--timeout-ms", "60000"], capsys)
+    assert rc == 0 and "proposals" in body
+
+
+def test_cli_admin(server, capsys):
+    rc, body = _run(server, ["admin", "--enable-self-healing-for", "ALL",
+                             "--enable-self-healing", "true"], capsys)
+    assert rc == 0 and all(body["selfHealingEnabled"].values())
+
+
+def test_cli_validation():
+    with pytest.raises(ValueError):
+        cccli._DRYRUN.validate("maybe")
+    assert cccli._BROKERS.validate("1,2,3") == "1,2,3"
+    with pytest.raises(ValueError):
+        cccli._BROKERS.validate("1,x")
+
+
+def test_cli_parser_covers_all_endpoints():
+    parser = cccli.build_parser()
+    names = {e.name for e in cccli.ENDPOINTS}
+    assert {"rebalance", "proposals", "state", "remove_broker",
+            "topic_configuration", "review"} <= names
+    # every endpoint subcommand parses
+    for e in cccli.ENDPOINTS:
+        args = parser.parse_args(["-a", "x:1", e.name])
+        assert args.endpoint == e.name
