@@ -408,7 +408,9 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
     movable_idx = jnp.asarray(movable_np if movable_np.size else np.array([0]), jnp.int32)
     dest_idx = jnp.asarray(dest_np if dest_np.size else np.array([0]), jnp.int32)
 
-    agg = compute_aggregates(dt, assign, num_topics)
+    # when the topic term is off, skip building the (potentially huge) dense
+    # [B, T] histogram — pass a 1-topic axis instead
+    agg = compute_aggregates(dt, assign, num_topics if use_topic else 1)
     base = ChainState(
         broker_of=jnp.asarray(assign.broker_of, jnp.int32),
         leader_of=jnp.asarray(assign.leader_of, jnp.int32),
@@ -614,17 +616,36 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
 
     chains, temps = run(chains, temps0)
 
-    # exact rescore of every chain, pick the best
-    def exact(bof, lof):
-        a = Assignment(broker_of=bof, leader_of=lof)
-        return OBJ.evaluate_objective(
-            dt, a, th, weights, tuple(goal_names), num_topics,
-            initial_broker_of).value
+    # Rescore every chain with exactly-recomputed load aggregates (immune to
+    # incremental float drift) plus the *maintained* topic counts — integer
+    # scatter-adds, hence already exact. Rebuilding the dense [B, T]
+    # histogram per chain here would cost more than the whole anneal.
+    def rescore(st: ChainState):
+        eff = (dt.replica_base_load
+               + jnp.where((st.leader_of[dt.partition_of_replica]
+                            == jnp.arange(R))[:, None],
+                           dt.leader_extra[dt.partition_of_replica], 0.0))
+        broker_load = jax.ops.segment_sum(eff, st.broker_of, num_segments=B)
+        host_load = jax.ops.segment_sum(broker_load, dt.host_of_broker,
+                                        num_segments=dt.num_hosts)
+        ones = jnp.ones((R,), jnp.float32)
+        leader_broker = st.broker_of[st.leader_of]
+        pl = (dt.leader_extra[:, res.NW_OUT]
+              + dt.replica_base_load[st.leader_of, res.NW_OUT])
+        st2 = st._replace(
+            broker_load=broker_load,
+            host_load=host_load,
+            replica_count=jax.ops.segment_sum(ones, st.broker_of, num_segments=B),
+            leader_count=jax.ops.segment_sum(jnp.ones((P,), jnp.float32),
+                                             leader_broker, num_segments=B),
+            potential_nw_out=jax.ops.segment_sum(
+                pl[dt.partition_of_replica], st.broker_of, num_segments=B),
+            leader_bytes_in=jax.ops.segment_sum(
+                dt.leader_bytes_in, leader_broker, num_segments=B),
+        )
+        return _chain_energy(dt, th, weights, st2, initial_broker_of, use_topic)
 
-    # sequential per chain: the exact eval builds a dense [B,T] histogram,
-    # which must not be materialized C times at once.
-    energies = jax.jit(lambda b, l: jax.lax.map(
-        lambda bl: exact(bl[0], bl[1]), (b, l)))(chains.broker_of, chains.leader_of)
+    energies = jax.jit(jax.vmap(rescore))(chains)
     best = int(jnp.argmin(energies))
     return AnnealResult(
         assignment=Assignment(broker_of=chains.broker_of[best],

@@ -1,0 +1,281 @@
+"""Intra-broker (JBOD) goals: per-disk capacity and usage distribution.
+
+Rebuild of ``goals/IntraBrokerDiskCapacityGoal.java:36-41`` (HARD: disk
+utilization ≤ capacity·threshold) and
+``goals/IntraBrokerDiskUsageDistributionGoal.java:41-46`` (SOFT: per-disk
+utilization within a band around the broker's mean), plus the
+INTRA_BROKER_REPLICA_MOVEMENT action (``ActionType``): moving a replica
+between logdirs of one broker.
+
+Penalty evaluation is vectorized over the global disk axis; the rebalance
+itself is a per-broker greedy pass (hot-disk → cold-disk, largest movable
+replica first) because disk counts per broker are tiny and the action space
+is local to each broker — the cross-broker engines stay untouched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.common import resources as res
+from cruise_control_tpu.models.cluster import Assignment, ClusterTopology
+
+INTRA_BROKER_GOALS = ("IntraBrokerDiskCapacityGoal",
+                      "IntraBrokerDiskUsageDistributionGoal")
+
+
+@dataclasses.dataclass(frozen=True)
+class LogdirMove:
+    """One INTRA_BROKER_REPLICA_MOVEMENT."""
+
+    topic: str
+    partition: int
+    broker_id: int
+    from_logdir: str
+    to_logdir: str
+    data_size: float
+
+    def to_json(self) -> dict:
+        return {"topicPartition": {"topic": self.topic,
+                                   "partition": self.partition},
+                "broker": self.broker_id, "fromLogdir": self.from_logdir,
+                "toLogdir": self.to_logdir}
+
+
+def disk_penalties(topo: ClusterTopology, assign: Assignment,
+                   disk_of_replica: Optional[np.ndarray] = None,
+                   capacity_threshold: float = 0.8,
+                   balance_band: float = 0.10) -> Dict[str, Tuple[float, float]]:
+    """(violations, cost) per intra-broker goal on the current disk layout."""
+    assert topo.has_disks, "model has no JBOD disk axis"
+    dof = (disk_of_replica if disk_of_replica is not None
+           else topo.disk_of_replica)
+    D = topo.num_disks
+    disk_load = np.zeros(D)
+    p = topo.partition_of_replica
+    is_leader = np.zeros(topo.num_replicas, bool)
+    is_leader[np.asarray(assign.leader_of)] = True
+    load = topo.replica_base_load[:, res.DISK] + np.where(
+        is_leader, topo.leader_extra[p, res.DISK], 0.0)
+    ok = dof >= 0
+    np.add.at(disk_load, dof[ok], load[ok])
+
+    alive = topo.disk_alive
+    cap = np.maximum(topo.disk_capacity, 1e-9)
+    limit = cap * capacity_threshold
+    over = np.maximum(disk_load - limit, 0.0) * alive
+    cap_viol = float((over > 0).sum())
+    cap_cost = float((over / limit).sum())
+    # dead disks must be empty
+    dead_occ = float(((disk_load > 0) & ~alive).sum())
+    cap_viol += dead_occ
+    cap_cost += dead_occ
+
+    # distribution: per broker, disks within [mean·(1−band), mean·(1+band)]
+    pct = disk_load / cap
+    dist_viol = dist_cost = 0.0
+    for b in range(topo.num_brokers):
+        rows = np.flatnonzero((topo.broker_of_disk == b) & alive)
+        if rows.size < 2:
+            continue
+        mean = pct[rows].mean()
+        hi, lo = mean * (1 + balance_band), mean * (1 - balance_band)
+        out = np.maximum(pct[rows] - hi, 0) + np.maximum(lo - pct[rows], 0)
+        dist_viol += float((out > 1e-9).sum())
+        dist_cost += float(out.sum())
+    return {"IntraBrokerDiskCapacityGoal": (cap_viol, cap_cost),
+            "IntraBrokerDiskUsageDistributionGoal": (dist_viol, dist_cost)}
+
+
+def rebalance_disks(topo: ClusterTopology, assign: Assignment,
+                    capacity_threshold: float = 0.8,
+                    balance_band: float = 0.10,
+                    max_moves_per_broker: int = 1000
+                    ) -> Tuple[List[LogdirMove], np.ndarray]:
+    """Greedy per-broker disk rebalance; returns (moves, new disk vector).
+
+    Order of concerns mirrors the reference goal priority: dead-disk
+    evacuation and capacity violations first, then usage spread.
+    """
+    assert topo.has_disks
+    dof = topo.disk_of_replica.copy()
+    p = topo.partition_of_replica
+    is_leader = np.zeros(topo.num_replicas, bool)
+    is_leader[np.asarray(assign.leader_of)] = True
+    load = topo.replica_base_load[:, res.DISK] + np.where(
+        is_leader, topo.leader_extra[p, res.DISK], 0.0)
+    cap = np.maximum(topo.disk_capacity, 1e-9)
+    alive = topo.disk_alive
+    bo = np.asarray(assign.broker_of)
+    moves: List[LogdirMove] = []
+
+    for b in range(topo.num_brokers):
+        disks = np.flatnonzero(topo.broker_of_disk == b)
+        live = disks[alive[disks]]
+        if disks.size == 0 or live.size == 0:
+            continue
+        replicas = np.flatnonzero((bo == b) & (dof >= 0))
+        if replicas.size == 0:
+            continue
+        disk_load = np.zeros(topo.num_disks)
+        np.add.at(disk_load, dof[replicas], load[replicas])
+
+        def best_dest(exclude):
+            cands = [d for d in live if d != exclude]
+            return min(cands, key=lambda d: disk_load[d] / cap[d]) if cands else None
+
+        n_moves = 0
+        # 1) evacuate dead disks + fix capacity overflows
+        for d in disks:
+            over_dead = not alive[d] and disk_load[d] > 0
+            while n_moves < max_moves_per_broker and (
+                    over_dead or (alive[d]
+                                  and disk_load[d] > cap[d] * capacity_threshold)):
+                on_d = replicas[dof[replicas] == d]
+                if on_d.size == 0:
+                    break
+                r = on_d[np.argmax(load[on_d])]
+                dest = best_dest(d)
+                if dest is None:
+                    break
+                moves.append(LogdirMove(
+                    topic=topo.topic_names[topo.topic_of_partition[p[r]]],
+                    partition=int(topo.partition_index[p[r]]),
+                    broker_id=int(topo.broker_ids[b]),
+                    from_logdir=topo.disk_names[d],
+                    to_logdir=topo.disk_names[dest],
+                    data_size=float(load[r])))
+                disk_load[d] -= load[r]
+                disk_load[dest] += load[r]
+                dof[r] = dest
+                n_moves += 1
+                over_dead = not alive[d] and disk_load[d] > 0
+
+        # 2) usage distribution: move replicas hot → cold while out of band
+        for _ in range(max_moves_per_broker - n_moves):
+            pct = disk_load[live] / cap[live]
+            mean = pct.mean()
+            hi = mean * (1 + balance_band)
+            hot_i = int(np.argmax(pct))
+            if pct[hot_i] <= hi or live.size < 2:
+                break
+            d_hot = live[hot_i]
+            d_cold = live[int(np.argmin(pct))]
+            on_hot = replicas[dof[replicas] == d_hot]
+            if on_hot.size == 0:
+                break
+            # biggest replica that fits without flipping the imbalance
+            gap = (disk_load[d_hot] - disk_load[d_cold]) / 2
+            fitting = on_hot[load[on_hot] <= max(gap, 0)]
+            if fitting.size == 0:
+                break
+            r = fitting[np.argmax(load[fitting])]
+            moves.append(LogdirMove(
+                topic=topo.topic_names[topo.topic_of_partition[p[r]]],
+                partition=int(topo.partition_index[p[r]]),
+                broker_id=int(topo.broker_ids[b]),
+                from_logdir=topo.disk_names[d_hot],
+                to_logdir=topo.disk_names[d_cold],
+                data_size=float(load[r])))
+            disk_load[d_hot] -= load[r]
+            disk_load[d_cold] += load[r]
+            dof[r] = d_cold
+    return moves, dof
+
+
+# ---------------------------------------------------------------------------
+# Kafka-assigner mode (analyzer/kafkaassigner/*.java)
+# ---------------------------------------------------------------------------
+
+
+def kafka_assigner_even_rack_aware(topo: ClusterTopology, assign: Assignment
+                                   ) -> Assignment:
+    """KafkaAssignerEvenRackAwareGoal (KafkaAssignerEvenRackAwareGoal.java):
+    deterministic greedy round-robin: replicas of each partition spread over
+    racks, brokers picked by lowest replica count within the rack; leaders
+    balanced by lowest leader count."""
+    import jax.numpy as jnp
+    B, K = topo.num_brokers, topo.num_racks
+    alive_rows = np.flatnonzero(topo.broker_alive)
+    if alive_rows.size == 0:
+        return assign
+    by_rack: Dict[int, List[int]] = {}
+    for b in alive_rows:
+        by_rack.setdefault(int(topo.rack_of_broker[b]), []).append(int(b))
+    racks = sorted(by_rack)
+    counts = np.zeros(B, np.int64)
+    leader_counts = np.zeros(B, np.int64)
+    new_broker_of = np.asarray(assign.broker_of).copy()
+    new_leader_of = np.asarray(assign.leader_of).copy()
+
+    rack_cursor = 0
+    for pi in range(topo.num_partitions):
+        slots = topo.replicas_of_partition[pi]
+        slots = slots[slots >= 0]
+        chosen: List[int] = []
+        for j in range(len(slots)):
+            rk = racks[(rack_cursor + j) % len(racks)]
+            pool = [b for b in by_rack[rk] if b not in chosen]
+            if not pool:
+                pool = [b for b in alive_rows if b not in chosen]
+                if not pool:
+                    break
+            pick = min(pool, key=lambda b: counts[b])
+            chosen.append(pick)
+            counts[pick] += 1
+        rack_cursor = (rack_cursor + 1) % len(racks)
+        for slot_r, b in zip(slots, chosen):
+            new_broker_of[slot_r] = b
+        leader_slot = min(range(len(chosen)),
+                          key=lambda j: leader_counts[chosen[j]])
+        leader_counts[chosen[leader_slot]] += 1
+        new_leader_of[pi] = slots[leader_slot]
+    return Assignment(broker_of=jnp.asarray(new_broker_of, jnp.int32),
+                      leader_of=jnp.asarray(new_leader_of, jnp.int32))
+
+
+def kafka_assigner_disk_usage_distribution(topo: ClusterTopology,
+                                           assign: Assignment,
+                                           balance_band: float = 0.10,
+                                           max_swaps: int = 10_000) -> Assignment:
+    """KafkaAssignerDiskUsageDistributionGoal
+    (KafkaAssignerDiskUsageDistributionGoal.java): balance broker DISK usage
+    only, via replica swaps between the hottest and coldest brokers."""
+    import jax.numpy as jnp
+    p = topo.partition_of_replica
+    is_leader = np.zeros(topo.num_replicas, bool)
+    is_leader[np.asarray(assign.leader_of)] = True
+    load = topo.replica_base_load[:, res.DISK] + np.where(
+        is_leader, topo.leader_extra[p, res.DISK], 0.0)
+    bo = np.asarray(assign.broker_of).copy()
+    cap = np.maximum(topo.capacity[:, res.DISK], 1e-9)
+    alive = np.asarray(topo.broker_alive)
+    broker_load = np.zeros(topo.num_brokers)
+    np.add.at(broker_load, bo, load)
+
+    def partition_on(b):
+        return {int(p[r]) for r in np.flatnonzero(bo == b)}
+
+    for _ in range(max_swaps):
+        pct = np.where(alive, broker_load / cap, -1.0)
+        mean = pct[alive].mean()
+        hot = int(np.argmax(pct))
+        cold = int(np.argmin(np.where(alive, pct, np.inf)))
+        if pct[hot] <= mean * (1 + balance_band) or hot == cold:
+            break
+        hot_parts = partition_on(hot)
+        cold_parts = partition_on(cold)
+        gap = (broker_load[hot] - broker_load[cold]) / 2
+        on_hot = [r for r in np.flatnonzero(bo == hot)
+                  if int(p[r]) not in cold_parts and 0 < load[r] <= gap]
+        if not on_hot:
+            break
+        r = max(on_hot, key=lambda x: load[x])
+        bo[r] = cold
+        broker_load[hot] -= load[r]
+        broker_load[cold] += load[r]
+    return Assignment(broker_of=jnp.asarray(bo, jnp.int32),
+                      leader_of=assign.leader_of)

@@ -284,6 +284,45 @@ class CruiseControlApp:
                 result.proposals)
         return summary
 
+    def rebalance_disk(self, dryrun: bool = True, **kw) -> dict:
+        """Intra-broker (JBOD) rebalance: IntraBrokerDiskCapacityGoal +
+        IntraBrokerDiskUsageDistributionGoal via logdir moves."""
+        from cruise_control_tpu.analyzer import intra_broker as IB
+        topo, assign = self._model()
+        if not topo.has_disks:
+            raise ValueError("cluster model has no JBOD disk information")
+        before = IB.disk_penalties(topo, assign)
+        moves, new_dof = IB.rebalance_disks(topo, assign)
+        after = IB.disk_penalties(topo, assign, disk_of_replica=new_dof)
+        summary = {
+            "logdirMoves": [m.to_json() for m in moves],
+            "numIntraBrokerReplicaMovements": len(moves),
+            "intraBrokerDataToMoveMB": sum(m.data_size for m in moves),
+            "goalSummary": [
+                {"goal": g, "violationsBefore": before[g][0],
+                 "violationsAfter": after[g][0]} for g in before],
+        }
+        if not dryrun and moves:
+            summary["execution"] = self.executor.execute_logdir_moves(moves)
+        return summary
+
+    def rebalance_kafka_assigner(self, dryrun: bool = True, **kw) -> dict:
+        """Kafka-assigner mode (analyzer/kafkaassigner): deterministic even
+        rack-aware placement + disk-usage balancing."""
+        from cruise_control_tpu.analyzer import intra_broker as IB
+        from cruise_control_tpu.analyzer import proposals as PR
+        topo, assign = self._model()
+        new = IB.kafka_assigner_even_rack_aware(topo, assign)
+        new = IB.kafka_assigner_disk_usage_distribution(topo, new)
+        props = PR.diff(topo, assign, new)
+        summary = {"proposals": [p.to_json() for p in props],
+                   "numReplicaMovements": sum(len(p.replicas_to_add)
+                                              for p in props),
+                   "mode": "kafka_assigner"}
+        if not dryrun:
+            summary["execution"] = self.executor.execute_proposals(props)
+        return summary
+
     def update_topic_replication_factor(self, topic_pattern: str,
                                         replication_factor: int,
                                         dryrun: bool = True, **kw) -> dict:

@@ -72,6 +72,11 @@ class ClusterAdapter:
     def dead_brokers(self) -> Set[int]:
         return set()
 
+    def alter_replica_logdirs(self, moves) -> None:
+        """Apply intra-broker logdir moves (AdminClient alterReplicaLogDirs,
+        Executor.java:995 seam)."""
+        raise NotImplementedError
+
 
 class FakeClusterAdapter(ClusterAdapter):
     """In-memory cluster: reassignments complete after ``latency_polls``
@@ -125,6 +130,12 @@ class FakeClusterAdapter(ClusterAdapter):
 
     def kill_broker(self, broker_id: int):
         self._dead.add(broker_id)
+
+    def alter_replica_logdirs(self, moves):
+        for m in moves:
+            self.logdir_by_tp_broker = getattr(self, "logdir_by_tp_broker", {})
+            self.logdir_by_tp_broker[
+                (f"{m.topic}-{m.partition}", m.broker_id)] = m.to_logdir
 
     def _tick(self, tp):
         if tp in self._pending:
@@ -261,6 +272,20 @@ class Executor:
             else:
                 self.notifier.on_execution_finished(summary)
         return summary
+
+    def execute_logdir_moves(self, moves) -> dict:
+        """Phase 2 (Executor.java:995): intra-broker logdir moves."""
+        with self._lock:
+            if self.has_ongoing_execution:
+                raise RuntimeError("An execution is already in progress")
+            self._state = ExecutorState.INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
+        t0 = time.time()
+        try:
+            self.adapter.alter_replica_logdirs(moves)
+            return {"intraBrokerMoves": len(moves),
+                    "durationSeconds": round(time.time() - t0, 3)}
+        finally:
+            self._state = ExecutorState.NO_TASK_IN_PROGRESS
 
     # -- phases --
     def _move_replicas(self, planner: ExecutionTaskPlanner,

@@ -101,6 +101,20 @@ class ClusterTopology:
     broker_ids: Optional[np.ndarray] = None       # i32[B] external broker ids
     host_names: tuple = ()
     rack_names: tuple = ()
+    # --- optional JBOD disk axis (model/Disk.java): D global disks ---
+    disk_of_replica: Optional[np.ndarray] = None  # i32[R] (-1 = unknown)
+    broker_of_disk: Optional[np.ndarray] = None   # i32[D]
+    disk_capacity: Optional[np.ndarray] = None    # f32[D]
+    disk_alive: Optional[np.ndarray] = None       # bool[D]
+    disk_names: tuple = ()                        # logdir paths, D entries
+
+    @property
+    def has_disks(self) -> bool:
+        return self.disk_of_replica is not None
+
+    @property
+    def num_disks(self) -> int:
+        return int(self.broker_of_disk.shape[0]) if self.has_disks else 0
 
     # ---- sizes ----
     @property
@@ -242,8 +256,10 @@ class ClusterModelBuilder:
 
     def create_broker(self, rack: str, host: str, broker_id: int, capacity,
                       alive: bool = True, new: bool = False, demoted: bool = False,
-                      bad_disks: bool = False) -> int:
-        """capacity: dict {resource_id: value} or sequence of 4 values."""
+                      bad_disks: bool = False, disks: Optional[dict] = None) -> int:
+        """capacity: dict {resource_id: value} or sequence of 4 values.
+        ``disks``: optional JBOD map {logdir: disk_capacity} (or
+        {logdir: (capacity, alive)}); DISK capacity then sums alive disks."""
         self.create_rack(rack)
         if host not in self._hosts:
             self._hosts[host] = {"rack": rack}
@@ -253,11 +269,21 @@ class ClusterModelBuilder:
                 cap[k] = v
         else:
             cap[:] = np.asarray(capacity, dtype=np.float32)
+        disk_list = None
+        if disks is not None:
+            disk_list = []
+            for logdir, v in disks.items():
+                dcap, dalive = v if isinstance(v, tuple) else (v, True)
+                disk_list.append(dict(logdir=logdir, capacity=float(dcap),
+                                      alive=bool(dalive)))
+            cap[res.DISK] = sum(d["capacity"] for d in disk_list if d["alive"])
+            bad_disks = bad_disks or any(not d["alive"] for d in disk_list)
         if broker_id in self._broker_index:
             raise ValueError(f"duplicate broker id {broker_id}")
         idx = len(self._brokers)
         self._brokers.append(dict(id=broker_id, rack=rack, host=host, capacity=cap,
-                                  alive=alive, new=new, demoted=demoted, bad_disks=bad_disks))
+                                  alive=alive, new=new, demoted=demoted,
+                                  bad_disks=bad_disks, disks=disk_list))
         self._broker_index[broker_id] = idx
         return broker_id
 
@@ -269,9 +295,12 @@ class ClusterModelBuilder:
 
     # -- partitions --
     def create_replica(self, broker_id: int, topic: str, partition: int,
-                       index: int, is_leader: bool, offline: bool = False):
+                       index: int, is_leader: bool, offline: bool = False,
+                       logdir: Optional[str] = None):
         """Mirror of ClusterModel.createReplica: register a replica at a list
-        position; exactly one replica per partition must be the leader."""
+        position; exactly one replica per partition must be the leader.
+        ``logdir`` places the replica on a JBOD disk; a dead disk marks it
+        offline (ClusterModel.markDiskDead semantics)."""
         if topic not in self._topic_index:
             self._topic_index[topic] = len(self._topics)
             self._topics.append(topic)
@@ -280,7 +309,15 @@ class ClusterModelBuilder:
             key, dict(topic=topic, partition=partition, replicas={}, leader_index=None))
         if index in part["replicas"]:
             raise ValueError(f"duplicate replica index {index} for {key}")
-        part["replicas"][index] = dict(broker=broker_id, load=None, offline=offline)
+        if logdir is not None:
+            b = self._brokers[self._broker_index[broker_id]]
+            disk = next((d for d in (b["disks"] or [])
+                         if d["logdir"] == logdir), None)
+            if disk is None:
+                raise ValueError(f"broker {broker_id} has no logdir {logdir}")
+            offline = offline or not disk["alive"]
+        part["replicas"][index] = dict(broker=broker_id, load=None,
+                                       offline=offline, logdir=logdir)
         if is_leader:
             if part["leader_index"] is not None:
                 raise ValueError(f"two leaders for {key}")
@@ -335,11 +372,25 @@ class ClusterModelBuilder:
                     if B else np.zeros((0, res.NUM_RESOURCES), np.float32))
         broker_ids = np.array([b["id"] for b in self._brokers], dtype=np.int32)
 
+        # JBOD disk axis (only if any broker declares disks)
+        has_disks = any(b.get("disks") for b in self._brokers)
+        disk_index: dict = {}
+        broker_of_disk, disk_capacity, disk_alive, disk_names = [], [], [], []
+        if has_disks:
+            for bi, b in enumerate(self._brokers):
+                for d in (b.get("disks") or []):
+                    disk_index[(b["id"], d["logdir"])] = len(disk_names)
+                    broker_of_disk.append(bi)
+                    disk_capacity.append(d["capacity"])
+                    disk_alive.append(d["alive"])
+                    disk_names.append(d["logdir"])
+
         parts = sorted(self._partitions.values(),
                        key=lambda d: (self._topic_index[d["topic"]], d["partition"]))
         P = len(parts)
         max_rf = max((len(p["replicas"]) for p in parts), default=1)
         partition_of_replica, broker_of, replica_offline, base_loads = [], [], [], []
+        disk_of_replica = []
         replicas_of_partition = np.full((P, max_rf), -1, dtype=np.int32)
         leader_position = np.zeros(P, dtype=np.int64)
         rf = np.zeros(P, dtype=np.int32)
@@ -371,6 +422,10 @@ class ClusterModelBuilder:
                 bidx = self._broker_index[rep["broker"]]
                 broker_of.append(bidx)
                 replica_offline.append(rep["offline"] or not self._brokers[bidx]["alive"])
+                if has_disks:
+                    ld = rep.get("logdir")
+                    disk_of_replica.append(
+                        disk_index.get((rep["broker"], ld), -1))
                 r += 1
 
         topo = ClusterTopology(
@@ -396,6 +451,14 @@ class ClusterModelBuilder:
             broker_ids=broker_ids,
             host_names=tuple(host_names),
             rack_names=tuple(self._racks),
+            disk_of_replica=(np.asarray(disk_of_replica, np.int32)
+                             if has_disks else None),
+            broker_of_disk=(np.asarray(broker_of_disk, np.int32)
+                            if has_disks else None),
+            disk_capacity=(np.asarray(disk_capacity, np.float32)
+                           if has_disks else None),
+            disk_alive=(np.asarray(disk_alive, bool) if has_disks else None),
+            disk_names=tuple(disk_names),
         )
         assignment = initial_assignment(topo, np.asarray(broker_of, dtype=np.int32))
         return topo, assignment

@@ -254,6 +254,16 @@ class RestApi:
     # ------------------------------------------------------------ POST
 
     def _rebalance(self, params, client_id, request_url):
+        if _parse_bool(params, "rebalance_disk", False):
+            dry = _parse_bool(params, "dryrun", True)
+            return self._async_op(
+                "REBALANCE", params, client_id, request_url,
+                lambda: self.app.rebalance_disk(dryrun=dry))
+        if _parse_bool(params, "kafka_assigner", False):
+            dry = _parse_bool(params, "dryrun", True)
+            return self._async_op(
+                "REBALANCE", params, client_id, request_url,
+                lambda: self.app.rebalance_kafka_assigner(dryrun=dry))
         kw = dict(
             goal_names=_parse_csv(params, "goals") or None,
             dryrun=_parse_bool(params, "dryrun", True),

@@ -1,0 +1,107 @@
+"""JBOD / intra-broker goal tests (reference: IntraBrokerRebalanceTest,
+KafkaAssignerDiskUsageDistributionGoalTest patterns)."""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import intra_broker as IB
+from cruise_control_tpu.common import resources as res
+from cruise_control_tpu.models import fixtures
+from cruise_control_tpu.models.cluster import ClusterModelBuilder
+
+
+def _jbod_model(dead_disk=False):
+    b = ClusterModelBuilder()
+    cap = {res.CPU: 100.0, res.NW_IN: 1e6, res.NW_OUT: 1e6, res.DISK: 0.0}
+    disks = {"/d1": 1000.0, "/d2": (1000.0, not dead_disk)}
+    b.create_broker("r0", "h0", 0, cap, disks=dict(disks))
+    b.create_broker("r1", "h1", 1, cap, disks={"/d1": 1000.0, "/d2": 1000.0})
+    # all of broker 0's replicas piled on /d1... plus some on /d2
+    for i in range(6):
+        b.create_replica(0, "T", i, 0, True,
+                         logdir="/d2" if dead_disk and i >= 4 else "/d1")
+        b.create_replica(1, "T", i, 1, False, logdir="/d1")
+        load = np.zeros(res.NUM_RESOURCES, np.float32)
+        load[res.DISK] = 50.0 * (i + 1)
+        b.set_replica_load(0, "T", i, load)
+        b.set_replica_load(1, "T", i, load * 0.0 + load)  # follower same disk
+    return b.build()
+
+
+def test_builder_disk_axis():
+    topo, assign = _jbod_model()
+    assert topo.has_disks
+    assert topo.num_disks == 4
+    assert topo.disk_capacity.sum() == 4000.0
+    assert (topo.disk_of_replica >= 0).all()
+    # broker DISK capacity derived from alive disks
+    assert topo.capacity[0, res.DISK] == 2000.0
+
+
+def test_dead_disk_marks_replicas_offline():
+    topo, assign = _jbod_model(dead_disk=True)
+    dead_rows = ~topo.disk_alive[np.maximum(topo.disk_of_replica, 0)]
+    assert topo.replica_offline[dead_rows].all()
+    assert topo.capacity[0, res.DISK] == 1000.0  # only /d1 counts
+
+
+def test_disk_penalties_and_rebalance():
+    topo, assign = _jbod_model()
+    pen = IB.disk_penalties(topo, assign)
+    # each broker's /d1 holds 1050 > 1000*0.8 and /d2 empty: capacity + spread bad
+    assert pen["IntraBrokerDiskCapacityGoal"][0] >= 1
+    assert pen["IntraBrokerDiskUsageDistributionGoal"][0] >= 1
+    moves, new_dof = IB.rebalance_disks(topo, assign)
+    assert moves
+    pen2 = IB.disk_penalties(topo, assign, disk_of_replica=new_dof)
+    assert pen2["IntraBrokerDiskCapacityGoal"][0] == 0
+    assert (pen2["IntraBrokerDiskUsageDistributionGoal"][1]
+            < pen["IntraBrokerDiskUsageDistributionGoal"][1])
+    for mv in moves:
+        j = mv.to_json()
+        assert j["fromLogdir"] != j["toLogdir"]
+
+
+def test_dead_disk_evacuated():
+    topo, assign = _jbod_model(dead_disk=True)
+    moves, new_dof = IB.rebalance_disks(topo, assign)
+    pen = IB.disk_penalties(topo, assign, disk_of_replica=new_dof)
+    # no load may remain on the dead disk
+    dead = np.flatnonzero(~topo.disk_alive)
+    assert not np.isin(new_dof, dead).any()
+
+
+def test_kafka_assigner_even_rack_aware():
+    topo, assign = fixtures.random_cluster(fixtures.ClusterProperties(
+        num_racks=4, num_brokers=8, num_replicas=600, num_topics=10), seed=5)
+    new = IB.kafka_assigner_even_rack_aware(topo, assign)
+    from cruise_control_tpu.ops.aggregates import (
+        device_topology, partition_rack_excess)
+    dt = device_topology(topo)
+    excess = float(np.sum(np.asarray(
+        partition_rack_excess(dt, new.broker_of))))
+    assert excess == 0.0            # perfectly rack aware (rf=3 <= 4 racks)
+    counts = np.bincount(np.asarray(new.broker_of), minlength=8)
+    assert counts.max() - counts.min() <= 1   # even replica counts
+    # partition invariant: replicas of one partition on distinct brokers
+    bo = np.asarray(new.broker_of)
+    for p in range(topo.num_partitions):
+        slots = topo.replicas_of_partition[p]
+        slots = slots[slots >= 0]
+        assert len(set(bo[slots].tolist())) == len(slots)
+
+
+def test_kafka_assigner_disk_distribution():
+    topo, assign = fixtures.unbalanced2()
+    new = IB.kafka_assigner_disk_usage_distribution(topo, assign)
+    bo = np.asarray(new.broker_of)
+    load = np.zeros(topo.num_brokers)
+    p = topo.partition_of_replica
+    is_leader = np.zeros(topo.num_replicas, bool)
+    is_leader[np.asarray(new.leader_of)] = True
+    dload = topo.replica_base_load[:, res.DISK] + np.where(
+        is_leader, topo.leader_extra[p, res.DISK], 0)
+    np.add.at(load, bo, dload)
+    before = np.zeros(topo.num_brokers)
+    np.add.at(before, np.asarray(assign.broker_of), dload)
+    assert load.std() < before.std()
