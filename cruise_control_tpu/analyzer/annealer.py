@@ -386,56 +386,10 @@ def _apply_leads(dt: DeviceTopology, st: ChainState, p_vec, new_leader_vec
     )
 
 
-def optimize_anneal(dt: DeviceTopology, assign: Assignment,
-                    th: G.GoalThresholds, weights: OBJ.ObjectiveWeights,
-                    opts: G.DeviceOptions, num_topics: int,
-                    config: Optional[AnnealConfig] = None, seed: int = 0,
-                    goal_names: Sequence[str] = G.DEFAULT_GOALS,
-                    initial_broker_of: Optional[jax.Array] = None,
-                    mesh: Optional[jax.sharding.Mesh] = None) -> AnnealResult:
-    cfg = config or AnnealConfig()
-    C = cfg.num_chains
+def make_step_fn(dt: DeviceTopology, th, weights, opts, cfg: AnnealConfig,
+                 movable_idx, dest_idx, initial_broker_of, use_topic: bool):
+    """Build the per-chain annealer step (module-level for profiling/tests)."""
     R, P, B = dt.num_replicas, dt.num_partitions, dt.num_brokers
-    use_topic = bool(B * num_topics <= cfg.topic_term_limit)
-    if initial_broker_of is None:
-        initial_broker_of = jnp.asarray(assign.broker_of, jnp.int32)
-
-    # Empty candidate pools degrade to a single always-illegal index (the
-    # legality masks turn those proposals into +inf deltas) so leadership-only
-    # optimization still runs.
-    movable_np = np.flatnonzero(np.asarray(jax.device_get(opts.replica_movable)))
-    dest_np = np.flatnonzero(np.asarray(jax.device_get(opts.move_dest_ok)))
-    movable_idx = jnp.asarray(movable_np if movable_np.size else np.array([0]), jnp.int32)
-    dest_idx = jnp.asarray(dest_np if dest_np.size else np.array([0]), jnp.int32)
-
-    # when the topic term is off, skip building the (potentially huge) dense
-    # [B, T] histogram — pass a 1-topic axis instead
-    agg = compute_aggregates(dt, assign, num_topics if use_topic else 1)
-    base = ChainState(
-        broker_of=jnp.asarray(assign.broker_of, jnp.int32),
-        leader_of=jnp.asarray(assign.leader_of, jnp.int32),
-        broker_load=agg.broker_load,
-        host_load=agg.host_load,
-        replica_count=agg.replica_count.astype(jnp.float32),
-        leader_count=agg.leader_count.astype(jnp.float32),
-        potential_nw_out=agg.potential_nw_out,
-        leader_bytes_in=agg.leader_bytes_in,
-        topic_count=(agg.topic_count.astype(jnp.float32) if use_topic
-                     else jnp.zeros((1, 1), jnp.float32)),
-        energy=jnp.float32(0.0),
-    )
-    e0 = _chain_energy(dt, th, weights, base, initial_broker_of, use_topic)
-    base = base._replace(energy=e0)
-    chains = jax.tree.map(lambda x: jnp.broadcast_to(x, (C,) + x.shape), base)
-
-    # temperature ladder: a cold block at ~0 (pure descent) + geometric ladder
-    n_cold = max(1, int(C * cfg.cold_fraction))
-    ladder = np.concatenate([
-        np.full(n_cold, cfg.t_min, np.float32),
-        np.geomspace(cfg.t_min, cfg.t_max, max(C - n_cold, 1)).astype(np.float32)[:C - n_cold],
-    ])[:C]
-    temps0 = jnp.asarray(ladder)
-
     Km, Kl, Ks = cfg.tries_move, cfg.tries_lead, cfg.tries_swap
     m = dt.max_rf
 
@@ -558,6 +512,63 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
         st = _apply_leads(dt, st, p_c, new_leader)
         st = st._replace(energy=st.energy + jnp.sum(jnp.where(accept, deltas, 0.0)))
         return st
+
+    return step
+
+
+def optimize_anneal(dt: DeviceTopology, assign: Assignment,
+                    th: G.GoalThresholds, weights: OBJ.ObjectiveWeights,
+                    opts: G.DeviceOptions, num_topics: int,
+                    config: Optional[AnnealConfig] = None, seed: int = 0,
+                    goal_names: Sequence[str] = G.DEFAULT_GOALS,
+                    initial_broker_of: Optional[jax.Array] = None,
+                    mesh: Optional[jax.sharding.Mesh] = None) -> AnnealResult:
+    cfg = config or AnnealConfig()
+    C = cfg.num_chains
+    R, P, B = dt.num_replicas, dt.num_partitions, dt.num_brokers
+    use_topic = bool(B * num_topics <= cfg.topic_term_limit)
+    if initial_broker_of is None:
+        initial_broker_of = jnp.asarray(assign.broker_of, jnp.int32)
+
+    # Empty candidate pools degrade to a single always-illegal index (the
+    # legality masks turn those proposals into +inf deltas) so leadership-only
+    # optimization still runs.
+    movable_np = np.flatnonzero(np.asarray(jax.device_get(opts.replica_movable)))
+    dest_np = np.flatnonzero(np.asarray(jax.device_get(opts.move_dest_ok)))
+    movable_idx = jnp.asarray(movable_np if movable_np.size else np.array([0]), jnp.int32)
+    dest_idx = jnp.asarray(dest_np if dest_np.size else np.array([0]), jnp.int32)
+
+    # when the topic term is off, skip building the (potentially huge) dense
+    # [B, T] histogram — pass a 1-topic axis instead
+    agg = compute_aggregates(dt, assign, num_topics if use_topic else 1)
+    base = ChainState(
+        broker_of=jnp.asarray(assign.broker_of, jnp.int32),
+        leader_of=jnp.asarray(assign.leader_of, jnp.int32),
+        broker_load=agg.broker_load,
+        host_load=agg.host_load,
+        replica_count=agg.replica_count.astype(jnp.float32),
+        leader_count=agg.leader_count.astype(jnp.float32),
+        potential_nw_out=agg.potential_nw_out,
+        leader_bytes_in=agg.leader_bytes_in,
+        topic_count=(agg.topic_count.astype(jnp.float32) if use_topic
+                     else jnp.zeros((1, 1), jnp.float32)),
+        energy=jnp.float32(0.0),
+    )
+    e0 = jax.jit(_chain_energy, static_argnames=("use_topic",))(
+        dt, th, weights, base, initial_broker_of, use_topic)
+    base = base._replace(energy=e0)
+    chains = jax.tree.map(lambda x: jnp.broadcast_to(x, (C,) + x.shape), base)
+
+    # temperature ladder: a cold block at ~0 (pure descent) + geometric ladder
+    n_cold = max(1, int(C * cfg.cold_fraction))
+    ladder = np.concatenate([
+        np.full(n_cold, cfg.t_min, np.float32),
+        np.geomspace(cfg.t_min, cfg.t_max, max(C - n_cold, 1)).astype(np.float32)[:C - n_cold],
+    ])[:C]
+    temps0 = jnp.asarray(ladder)
+
+    step = make_step_fn(dt, th, weights, opts, cfg, movable_idx,
+                        dest_idx, initial_broker_of, use_topic)
 
     def chain_round(st: ChainState, temp, key):
         keys = jax.random.split(key, cfg.swap_interval)

@@ -25,6 +25,7 @@ Goal inventory and priority order mirror ``config/cruisecontrol.properties:99``
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple, Optional, Sequence
 
 import jax
@@ -221,6 +222,7 @@ class GoalThresholds(NamedTuple):
     lbi_upper: jax.Array              # f32 scalar
 
 
+@partial(jax.jit, static_argnames=("constraint",))
 def compute_thresholds(dt: DeviceTopology, constraint: BalancingConstraint,
                        initial: BrokerAggregates) -> GoalThresholds:
     """Precompute all goal constants from the initial aggregates.
@@ -473,12 +475,15 @@ def preferred_leader_penalty(dt: DeviceTopology, assign: Assignment):
     return mism, mism
 
 
+@partial(jax.jit, static_argnames=("num_topics", "goal_names"))
 def full_goal_penalties(dt: DeviceTopology, assign: Assignment,
                         th: GoalThresholds, num_topics: int,
                         goal_names: Sequence[str],
                         initial_broker_of: Optional[jax.Array] = None,
                         agg: Optional[BrokerAggregates] = None) -> GoalPenalties:
-    """Evaluate every requested goal on a full state. jit/vmap-safe."""
+    """Evaluate every requested goal on a full state. jit/vmap-safe.
+
+    ``goal_names`` must be a tuple (static jit argument)."""
     if agg is None:
         agg = compute_aggregates(dt, assign, num_topics)
     bt = broker_terms(

@@ -125,7 +125,8 @@ class OptimizerResult:
 
 def _stats_dict(dt, assign, constraint, num_topics) -> dict:
     st = compute_cluster_stats(dt, assign, constraint, num_topics)
-    return {k: np.asarray(v).tolist() for k, v in st._asdict().items()}
+    host = jax.device_get(st._asdict())     # one transfer for all fields
+    return {k: np.asarray(v).tolist() for k, v in host.items()}
 
 
 def _balancedness(goal_names, violations) -> float:
@@ -148,6 +149,8 @@ def optimize(topo: ClusterTopology, assign: Assignment,
     """Full optimization pass. ``engine``: auto | greedy | anneal."""
     from cruise_control_tpu.analyzer import annealer as AN  # cycle-free import
 
+    from cruise_control_tpu.common.metrics import REGISTRY
+    proposal_timer = REGISTRY.timer("proposal-computation-timer")
     t0 = time.time()
     constraint = constraint or BalancingConstraint()
     opts = options if options is not None else G.default_options(topo)
@@ -209,6 +212,7 @@ def optimize(topo: ClusterTopology, assign: Assignment,
                     cost_before=float(cb[i]), cost_after=float(ca[i]))
         for i, g in enumerate(names_ext)]
 
+    proposal_timer.update(time.time() - t0)
     return OptimizerResult(
         proposals=props,
         goal_summaries=summaries,

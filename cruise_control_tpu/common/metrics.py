@@ -1,0 +1,98 @@
+"""Metrics registry: counters, gauges, timers (dropwizard → JMX parity).
+
+The reference exports dropwizard meters/timers via JMX under
+``kafka.cruisecontrol`` (``KafkaCruiseControlMain.java:71-73``; sensor table
+``docs/wiki/User Guide/Sensors.md``). Here the registry is in-process and
+exported through the REST ``/metrics`` route in Prometheus text format —
+the observability fabric this ecosystem actually scrapes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class Timer:
+    """Wall-clock timer with count/total/max (dropwizard Timer parity)."""
+
+    def __init__(self):
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+        self._lock = threading.Lock()
+
+    def update(self, seconds: float):
+        with self._lock:
+            self.count += 1
+            self.total_s += seconds
+            self.max_s = max(self.max_s, seconds)
+
+    def time(self):
+        timer = self
+
+        class _Ctx:
+            def __enter__(self):
+                self.t0 = time.time()
+                return self
+
+            def __exit__(self, *exc):
+                timer.update(time.time() - self.t0)
+
+        return _Ctx()
+
+    @property
+    def mean_s(self) -> float:
+        return self.total_s / self.count if self.count else 0.0
+
+
+class MetricsRegistry:
+    """Named counters / gauges / timers, snapshot-able and scrapable."""
+
+    def __init__(self, prefix: str = "kafka_cruisecontrol"):
+        self.prefix = prefix
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, Callable[[], float]] = {}
+        self._timers: Dict[str, Timer] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, inc: float = 1.0):
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + inc
+
+    def gauge(self, name: str, fn: Callable[[], float]):
+        with self._lock:
+            self._gauges[name] = fn
+
+    def timer(self, name: str) -> Timer:
+        with self._lock:
+            t = self._timers.get(name)
+            if t is None:
+                t = self._timers[name] = Timer()
+            return t
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {f"{k}": v for k, v in self._counters.items()}
+            for k, fn in self._gauges.items():
+                try:
+                    out[k] = float(fn())
+                except Exception:
+                    pass
+            for k, t in self._timers.items():
+                out[f"{k}-count"] = t.count
+                out[f"{k}-mean-s"] = round(t.mean_s, 6)
+                out[f"{k}-max-s"] = round(t.max_s, 6)
+            return out
+
+    def prometheus(self) -> str:
+        lines: List[str] = []
+        for k, v in sorted(self.snapshot().items()):
+            metric = f"{self.prefix}_{k}".replace(".", "_").replace("-", "_")
+            lines.append(f"{metric} {v}")
+        return "\n".join(lines) + "\n"
+
+
+#: process-wide default registry (the reference's singleton MetricRegistry)
+REGISTRY = MetricsRegistry()

@@ -1,0 +1,233 @@
+"""Kafka-facing adapters: metadata, metrics-topic sampling, execution.
+
+The cluster-side seams the rest of the framework is built against:
+
+- :class:`KafkaMetadataSource` → ``MetadataClient`` /
+  ``LoadMonitor``'s metadata refresh (``monitor/MetadataClient.java``)
+- :class:`KafkaMetricsTopicSampler` → ``CruiseControlMetricsReporterSampler``
+  consuming the ``__CruiseControlMetrics`` topic
+  (``sampling/CruiseControlMetricsReporterSampler.java:41-67``) +
+  ``CruiseControlMetricsProcessor`` raw→sample conversion
+- :class:`KafkaClusterAdapter` → the reassignment/PLE/config surface the
+  executor drives (``ExecutorUtils.scala:22-34`` + ``ExecutorAdminUtils``)
+
+They bind to a Kafka client library (``kafka-python`` or ``confluent-kafka``)
+lazily at construction, so environments without one can still import this
+module, run every other subsystem, and unit-test against the fakes. The raw
+record schema matches :mod:`cruise_control_tpu.reporter`, and raw→model
+metric conversion reuses :mod:`cruise_control_tpu.monitor.metricdef`, so a
+live deployment only needs these three classes.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.monitor import metricdef as md
+from cruise_control_tpu.monitor.load_monitor import MetadataSource
+from cruise_control_tpu.monitor.sampler import (
+    BrokerMetadata,
+    BrokerMetricSample,
+    ClusterMetadata,
+    MetricSampler,
+    PartitionMetadata,
+    PartitionMetricSample,
+    estimate_partition_cpu,
+)
+from cruise_control_tpu.reporter import CruiseControlMetric
+
+METRICS_TOPIC = "__CruiseControlMetrics"
+
+
+def _require_kafka():
+    try:
+        import kafka  # noqa: F401  (kafka-python)
+        return kafka
+    except ImportError as e:
+        raise RuntimeError(
+            "Kafka deployments need the `kafka-python` client library; "
+            "this environment does not provide one. All other subsystems "
+            "(model, analyzer, executor-with-adapter, REST) run without it."
+        ) from e
+
+
+class KafkaMetadataSource(MetadataSource):
+    """Cluster composition from the Kafka admin API."""
+
+    def __init__(self, config):
+        self._kafka = _require_kafka()
+        self._admin = self._kafka.KafkaAdminClient(
+            bootstrap_servers=config.get("bootstrap.servers"))
+        self._generation = 0
+
+    def get_metadata(self) -> ClusterMetadata:
+        cluster = self._admin.describe_cluster()
+        brokers = [BrokerMetadata(b["node_id"], rack=b.get("rack") or "",
+                                  host=b["host"])
+                   for b in cluster["brokers"]]
+        topics = self._admin.describe_topics()
+        partitions: List[PartitionMetadata] = []
+        for t in topics:
+            if t["topic"].startswith("__"):
+                continue
+            for p in t["partitions"]:
+                partitions.append(PartitionMetadata(
+                    topic=t["topic"], partition=p["partition"],
+                    leader=p["leader"], replicas=tuple(p["replicas"]),
+                    isr=tuple(p["isr"]),
+                    offline_replicas=tuple(p.get("offline_replicas", ()))))
+        self._generation += 1
+        return ClusterMetadata(brokers=brokers, partitions=partitions,
+                               generation=self._generation)
+
+
+class KafkaMetricsTopicSampler(MetricSampler):
+    """Consume raw reporter records and fold them into samples
+    (CruiseControlMetricsProcessor.process, :102)."""
+
+    def __init__(self, config, topic: str = METRICS_TOPIC):
+        self._kafka = _require_kafka()
+        self._consumer = self._kafka.KafkaConsumer(
+            topic, bootstrap_servers=config.get("bootstrap.servers"),
+            value_deserializer=lambda b: json.loads(b.decode()),
+            consumer_timeout_ms=10_000, auto_offset_reset="earliest",
+            group_id="cruise-control-tpu-sampler")
+
+    def get_samples(self, metadata: ClusterMetadata, start_ms: int,
+                    end_ms: int):
+        raw: List[CruiseControlMetric] = []
+        for msg in self._consumer:
+            m = CruiseControlMetric.from_json(msg.value)
+            if start_ms <= m.time_ms < end_ms:
+                raw.append(m)
+        return process_raw_metrics(raw, metadata, (start_ms + end_ms) // 2)
+
+
+def process_raw_metrics(raw: List[CruiseControlMetric],
+                        metadata: ClusterMetadata, t_ms: int
+                        ) -> Tuple[List[PartitionMetricSample],
+                                   List[BrokerMetricSample]]:
+    """Raw records → partition/broker samples, incl. the CPU attribution of
+    CruiseControlMetricsProcessor (ModelParameters static linear model).
+
+    Shared by the Kafka sampler and any file/HTTP-fed pipeline.
+    """
+    broker_vals: Dict[int, Dict[str, float]] = collections.defaultdict(dict)
+    topic_vals: Dict[Tuple[int, str, str], float] = {}
+    partition_size: Dict[Tuple[str, int], float] = {}
+    for m in raw:
+        scope = md.RAW_METRIC_TYPES.get(m.raw_metric_type)
+        if scope == md.MetricScope.BROKER:
+            broker_vals[m.broker_id][m.raw_metric_type] = m.value
+        elif scope == md.MetricScope.TOPIC:
+            topic_vals[(m.broker_id, m.topic, m.raw_metric_type)] = m.value
+        elif scope == md.MetricScope.PARTITION:
+            partition_size[(m.topic, m.partition)] = m.value
+
+    bsamples: List[BrokerMetricSample] = []
+    broker_ctx: Dict[int, Tuple[float, float, float]] = {}
+    for b, vals in broker_vals.items():
+        cpu = vals.get("BROKER_CPU_UTIL", 0.0)
+        lbi = vals.get("ALL_TOPIC_BYTES_IN", 0.0)
+        lbo = vals.get("ALL_TOPIC_BYTES_OUT", 0.0)
+        rbi = vals.get("ALL_TOPIC_REPLICATION_BYTES_IN", 0.0)
+        rbo = vals.get("ALL_TOPIC_REPLICATION_BYTES_OUT", 0.0)
+        broker_ctx[b] = (cpu, lbi, lbo, rbi)
+        bsamples.append(BrokerMetricSample(
+            broker_id=b, time_ms=t_ms, cpu_util=cpu, leader_bytes_in=lbi,
+            leader_bytes_out=lbo, replication_bytes_in=rbi,
+            replication_bytes_out=rbo,
+            extra={k: v for k, v in vals.items()
+                   if k not in ("BROKER_CPU_UTIL",)}))
+
+    # topic-level rates attributed evenly over the broker's leader
+    # partitions of that topic (the processor's allocation rule), partition
+    # sizes direct.
+    leaders: Dict[Tuple[int, str], List[PartitionMetadata]] = collections.defaultdict(list)
+    for pm in metadata.partitions:
+        leaders[(pm.leader, pm.topic)].append(pm)
+    psamples: List[PartitionMetricSample] = []
+    for pm in metadata.partitions:
+        n_leader = max(len(leaders[(pm.leader, pm.topic)]), 1)
+        bytes_in = topic_vals.get((pm.leader, pm.topic, "TOPIC_BYTES_IN"),
+                                  0.0) / n_leader
+        bytes_out = topic_vals.get((pm.leader, pm.topic, "TOPIC_BYTES_OUT"),
+                                   0.0) / n_leader
+        size = partition_size.get((pm.topic, pm.partition))
+        if size is None and not bytes_in and not bytes_out:
+            continue
+        cpu_b, lbi_b, lbo_b, rbi_b = broker_ctx.get(pm.leader,
+                                                    (0.0, 0.0, 0.0, 0.0))
+        pcpu = float(estimate_partition_cpu(
+            np.asarray(bytes_in), np.asarray(bytes_out),
+            cpu_b, lbi_b, lbo_b, rbi_b))
+        metrics = np.full(md.NUM_MODEL_METRICS, np.nan)
+        metrics[md.ModelMetric.CPU_USAGE] = pcpu
+        metrics[md.ModelMetric.DISK_USAGE] = size if size is not None else np.nan
+        metrics[md.ModelMetric.LEADER_BYTES_IN] = bytes_in
+        metrics[md.ModelMetric.LEADER_BYTES_OUT] = bytes_out
+        psamples.append(PartitionMetricSample(
+            topic=pm.topic, partition=pm.partition, leader_broker=pm.leader,
+            time_ms=t_ms, metrics=metrics))
+    return psamples, bsamples
+
+
+class KafkaClusterAdapter:
+    """Executor seam against the Kafka admin API (ClusterAdapter impl)."""
+
+    def __init__(self, config):
+        self._kafka = _require_kafka()
+        self._admin = self._kafka.KafkaAdminClient(
+            bootstrap_servers=config.get("bootstrap.servers"))
+
+    def execute_replica_reassignments(self, tasks):
+        assignments = {}
+        for t in tasks:
+            assignments[(t.proposal.topic, t.proposal.partition)] = list(
+                t.proposal.new_replicas)
+        self._admin.alter_partition_reassignments(assignments)
+
+    def execute_preferred_leader_elections(self, tasks):
+        parts = [(t.proposal.topic, t.proposal.partition) for t in tasks]
+        self._admin.perform_leader_election("PREFERRED", parts)
+
+    def current_replicas(self, topic_partition: str):
+        topic, _, part = topic_partition.rpartition("-")
+        meta = self._admin.describe_topics([topic])
+        for p in meta[0]["partitions"]:
+            if p["partition"] == int(part):
+                return tuple(p["replicas"])
+        return ()
+
+    def current_leader(self, topic_partition: str) -> int:
+        topic, _, part = topic_partition.rpartition("-")
+        meta = self._admin.describe_topics([topic])
+        for p in meta[0]["partitions"]:
+            if p["partition"] == int(part):
+                return p["leader"]
+        return -1
+
+    def in_progress_reassignments(self) -> Set[str]:
+        out = self._admin.list_partition_reassignments()
+        return {f"{t}-{p}" for (t, p) in out}
+
+    def set_replication_throttles(self, rate, tps):
+        cfgs = {"leader.replication.throttled.rate": str(rate),
+                "follower.replication.throttled.rate": str(rate)}
+        self._admin.alter_configs({"broker": cfgs})
+
+    def clear_replication_throttles(self):
+        self._admin.alter_configs({"broker": {
+            "leader.replication.throttled.rate": "",
+            "follower.replication.throttled.rate": ""}})
+
+    def dead_brokers(self) -> Set[int]:
+        return set()
+
+    def alter_replica_logdirs(self, moves):
+        self._admin.alter_replica_log_dirs(
+            {(m.topic, m.partition, m.broker_id): m.to_logdir for m in moves})

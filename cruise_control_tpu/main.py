@@ -1,0 +1,116 @@
+"""Service entrypoint: properties file → config → app → REST server.
+
+Rebuild of ``KafkaCruiseControlMain.java:38-125``: load the boot properties,
+construct the application (monitor + analyzer + executor + anomaly
+detector), start the REST server, block until shutdown.
+
+Deployment modes:
+
+- ``--demo``: a self-contained synthetic cluster (static metadata + the
+  synthetic load sampler) — the zero-dependency way to drive the full
+  service.
+- Kafka mode: when ``bootstrap.servers`` is configured, the Kafka adapters
+  (metadata source, metrics-topic sampler, admin executor) are loaded from
+  :mod:`cruise_control_tpu.kafka_adapter`; they require a Kafka client
+  library at runtime.
+
+Usage::
+
+    python -m cruise_control_tpu.main --config config/cruisecontrol.properties
+    python -m cruise_control_tpu.main --demo --port 9090
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+from typing import Optional
+
+
+def build_demo_app(config):
+    """Synthetic single-process deployment (the CCEmbedded* analogue)."""
+    from cruise_control_tpu.app import CruiseControlApp
+    from cruise_control_tpu.executor.executor import FakeClusterAdapter
+    from cruise_control_tpu.monitor.load_monitor import StaticMetadataSource
+    from cruise_control_tpu.monitor.sampler import (
+        BrokerMetadata,
+        ClusterMetadata,
+        PartitionMetadata,
+        SyntheticLoadSampler,
+    )
+    num_brokers, num_parts, rf = 12, 120, 3
+    brokers = [BrokerMetadata(i, rack=f"rack{i % 4}", host=f"host{i}")
+               for i in range(num_brokers)]
+    parts = [PartitionMetadata(
+        f"topic{p % 8}", p // 8,
+        leader=(p % num_brokers),
+        replicas=tuple((p + j) % num_brokers for j in range(rf)))
+        for p in range(num_parts)]
+    metadata = ClusterMetadata(brokers=brokers, partitions=parts, generation=1)
+    adapter = FakeClusterAdapter(
+        {f"{p.topic}-{p.partition}": tuple(p.replicas) for p in parts})
+    return CruiseControlApp(config, StaticMetadataSource(metadata),
+                            SyntheticLoadSampler(seed=1),
+                            cluster_adapter=adapter)
+
+
+def build_kafka_app(config):
+    from cruise_control_tpu import kafka_adapter
+    from cruise_control_tpu.app import CruiseControlApp
+    from cruise_control_tpu.monitor.capacity import FileCapacityResolver
+    from cruise_control_tpu.monitor.sample_store import FileSampleStore
+    source = kafka_adapter.KafkaMetadataSource(config)
+    sampler = kafka_adapter.KafkaMetricsTopicSampler(config)
+    adapter = kafka_adapter.KafkaClusterAdapter(config)
+    store_dir = config.get("sample.store.dir")
+    return CruiseControlApp(
+        config, source, sampler, cluster_adapter=adapter,
+        capacity_resolver=FileCapacityResolver(
+            config.get("capacity.config.file")),
+        sample_store=FileSampleStore(store_dir) if store_dir else None)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="cruise-control-tpu")
+    parser.add_argument("--config", help="properties file path")
+    parser.add_argument("--demo", action="store_true",
+                        help="run against a synthetic in-process cluster")
+    parser.add_argument("--port", type=int, help="REST port override")
+    parser.add_argument("--no-sampling-loop", action="store_true",
+                        help="do not start the periodic sampler thread")
+    args = parser.parse_args(argv)
+
+    from cruise_control_tpu.common.config import CruiseControlConfig
+    from cruise_control_tpu.server import rest
+    config = CruiseControlConfig(properties_file=args.config)
+    if args.demo or not config.get("bootstrap.servers"):
+        app = build_demo_app(config)
+        # prime a few windows so the model is immediately buildable
+        w = config.get("partition.metrics.window.ms")
+        for i in range(config.get("num.partition.metrics.windows") + 1):
+            app.load_monitor.sample_once(now_ms=i * w + w // 2)
+    else:
+        app = build_kafka_app(config)
+
+    if not args.no_sampling_loop:
+        app.startup()
+    server = rest.serve(app, port=args.port)
+    host, port = server.server_address[:2]
+    print(f"cruise-control-tpu listening on http://{host}:{port}"
+          f"{config.get('webserver.api.urlprefix')}/state", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    try:
+        stop.wait()
+    finally:
+        server.shutdown()
+        app.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -250,8 +250,10 @@ class LoadMonitor:
         """Build (ClusterTopology, Assignment) — LoadMonitor.clusterModel
         (LoadMonitor.java:469-541). Raises NotEnoughValidWindowsError when
         completeness requirements fail."""
+        from cruise_control_tpu.common.metrics import REGISTRY
         now_ms = now_ms or int(time.time() * 1000)
-        with self._model_semaphore:
+        with self._model_semaphore, \
+                REGISTRY.timer("cluster-model-creation-timer").time():
             metadata = self._metadata_source.get_metadata()
             result = self.partition_aggregator.aggregate(now_ms)
             if result.completeness.num_valid_windows < requirements.min_required_num_windows:

@@ -103,6 +103,10 @@ def replica_effective_load(dt: DeviceTopology, assign: Assignment) -> jax.Array:
     return dt.replica_base_load + jnp.where(is_leader[:, None], dt.leader_extra[p], 0.0)
 
 
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("num_topics",))
 def compute_aggregates(dt: DeviceTopology, assign: Assignment, num_topics: int) -> BrokerAggregates:
     B = dt.num_brokers
     p = dt.partition_of_replica

@@ -65,6 +65,10 @@ class ClusterStats(NamedTuple):
     num_partitions_with_offline_replicas: jax.Array
 
 
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("constraint", "num_topics"))
 def compute_cluster_stats(dt: DeviceTopology, assign: Assignment,
                           constraint: BalancingConstraint, num_topics: int,
                           agg: BrokerAggregates | None = None) -> ClusterStats:

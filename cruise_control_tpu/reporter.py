@@ -1,0 +1,152 @@
+"""Metrics reporter: the in-broker agent shipping raw metrics.
+
+Rebuild of the ``cruise-control-metrics-reporter`` module
+(``CruiseControlMetricsReporter.java:41-172``): a reporter co-located with
+each broker samples the broker's metrics every reporting interval and ships
+serialized ``CruiseControlMetric`` records (63 raw types,
+``metric/RawMetricType.java``) to a transport. The reference's transport is
+the ``__CruiseControlMetrics`` Kafka topic; here the transport is pluggable
+(Kafka producer adapter, JSONL file, or HTTP POST to the service), with the
+same record schema either way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Callable, Dict, Iterable, List, Optional
+
+from cruise_control_tpu.monitor.metricdef import MetricScope, RAW_METRIC_TYPES
+
+
+@dataclasses.dataclass(frozen=True)
+class CruiseControlMetric:
+    """One raw metric record (metric/CruiseControlMetric.java serde schema)."""
+
+    raw_metric_type: str
+    time_ms: int
+    broker_id: int
+    value: float
+    topic: Optional[str] = None
+    partition: Optional[int] = None
+
+    def __post_init__(self):
+        scope = RAW_METRIC_TYPES.get(self.raw_metric_type)
+        if scope is None:
+            raise ValueError(f"unknown raw metric {self.raw_metric_type}")
+        if scope == MetricScope.TOPIC and self.topic is None:
+            raise ValueError(f"{self.raw_metric_type} requires a topic")
+        if scope == MetricScope.PARTITION and (self.topic is None
+                                               or self.partition is None):
+            raise ValueError(f"{self.raw_metric_type} requires topic+partition")
+
+    def to_json(self) -> dict:
+        out = {"type": self.raw_metric_type, "time": self.time_ms,
+               "brokerId": self.broker_id, "value": self.value}
+        if self.topic is not None:
+            out["topic"] = self.topic
+        if self.partition is not None:
+            out["partition"] = self.partition
+        return out
+
+    @classmethod
+    def from_json(cls, d: dict) -> "CruiseControlMetric":
+        return cls(d["type"], d["time"], d["brokerId"], d["value"],
+                   d.get("topic"), d.get("partition"))
+
+
+class MetricsTransport:
+    """Where records go (the metrics-topic producer seam)."""
+
+    def send(self, records: Iterable[CruiseControlMetric]) -> None:
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class FileMetricsTransport(MetricsTransport):
+    def __init__(self, path: str):
+        self._path = path
+        self._lock = threading.Lock()
+
+    def send(self, records):
+        with self._lock, open(self._path, "a") as f:
+            for r in records:
+                f.write(json.dumps(r.to_json()) + "\n")
+
+
+class InMemoryMetricsTransport(MetricsTransport):
+    def __init__(self):
+        self.records: List[CruiseControlMetric] = []
+
+    def send(self, records):
+        self.records.extend(records)
+
+
+class BrokerMetricsSource:
+    """Reads the co-located broker's current metric values:
+    {raw_metric_type: value} for broker metrics and
+    {(type, topic[, partition]): value} for topic/partition metrics
+    (YammerMetricProcessor seam)."""
+
+    def broker_metrics(self) -> Dict[str, float]:
+        raise NotImplementedError
+
+    def topic_metrics(self) -> Dict[tuple, float]:
+        return {}
+
+    def partition_metrics(self) -> Dict[tuple, float]:
+        return {}
+
+
+class MetricsReporter:
+    """The reporting loop (CruiseControlMetricsReporter.run, :172)."""
+
+    def __init__(self, broker_id: int, source: BrokerMetricsSource,
+                 transport: MetricsTransport,
+                 reporting_interval_ms: int = 60_000,
+                 now_fn=lambda: int(time.time() * 1000)):
+        self.broker_id = broker_id
+        self.source = source
+        self.transport = transport
+        self.interval_ms = reporting_interval_ms
+        self._now = now_fn
+        self._shutdown = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def report_once(self) -> int:
+        now = self._now()
+        records: List[CruiseControlMetric] = []
+        for mtype, value in self.source.broker_metrics().items():
+            records.append(CruiseControlMetric(mtype, now, self.broker_id,
+                                               float(value)))
+        for (mtype, topic), value in self.source.topic_metrics().items():
+            records.append(CruiseControlMetric(mtype, now, self.broker_id,
+                                               float(value), topic=topic))
+        for (mtype, topic, part), value in self.source.partition_metrics().items():
+            records.append(CruiseControlMetric(mtype, now, self.broker_id,
+                                               float(value), topic=topic,
+                                               partition=part))
+        self.transport.send(records)
+        return len(records)
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"cc-metrics-reporter-{self.broker_id}")
+        self._thread.start()
+
+    def _run(self):
+        while not self._shutdown.wait(self.interval_ms / 1000.0):
+            try:
+                self.report_once()
+            except Exception:
+                pass
+
+    def close(self):
+        self._shutdown.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self.transport.close()

@@ -26,7 +26,7 @@ from cruise_control_tpu.server.async_ops import (
 
 GET_ENDPOINTS = [
     "BOOTSTRAP", "TRAIN", "LOAD", "PARTITION_LOAD", "PROPOSALS", "STATE",
-    "KAFKA_CLUSTER_STATE", "USER_TASKS", "REVIEW_BOARD",
+    "KAFKA_CLUSTER_STATE", "USER_TASKS", "REVIEW_BOARD", "METRICS",
 ]
 POST_ENDPOINTS = [
     "ADD_BROKER", "REMOVE_BROKER", "FIX_OFFLINE_REPLICAS", "REBALANCE",
@@ -152,6 +152,10 @@ class RestApi:
 
     def _kafka_cluster_state(self, params, client_id, request_url):
         return 200, self.app.kafka_cluster_state()
+
+    def _metrics(self, params, client_id, request_url):
+        from cruise_control_tpu.common.metrics import REGISTRY
+        return 200, REGISTRY.snapshot()
 
     def _proposals(self, params, client_id, request_url):
         goals = _parse_csv(params, "goals") or None

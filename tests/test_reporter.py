@@ -1,0 +1,94 @@
+"""Metrics-reporter + raw-metric processing tests (reference:
+CruiseControlMetricsReporterTest / CruiseControlMetricsProcessorTest)."""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.kafka_adapter import process_raw_metrics
+from cruise_control_tpu.monitor import metricdef as md
+from cruise_control_tpu.monitor.sampler import (
+    BrokerMetadata,
+    ClusterMetadata,
+    PartitionMetadata,
+)
+from cruise_control_tpu.reporter import (
+    BrokerMetricsSource,
+    CruiseControlMetric,
+    InMemoryMetricsTransport,
+    MetricsReporter,
+)
+
+
+class FakeSource(BrokerMetricsSource):
+    def broker_metrics(self):
+        return {"BROKER_CPU_UTIL": 42.0, "ALL_TOPIC_BYTES_IN": 1000.0,
+                "ALL_TOPIC_BYTES_OUT": 2000.0,
+                "ALL_TOPIC_REPLICATION_BYTES_IN": 500.0,
+                "BROKER_LOG_FLUSH_TIME_MS_999TH": 12.5}
+
+    def topic_metrics(self):
+        return {("TOPIC_BYTES_IN", "T"): 800.0,
+                ("TOPIC_BYTES_OUT", "T"): 1600.0}
+
+    def partition_metrics(self):
+        return {("PARTITION_SIZE", "T", 0): 10_000.0,
+                ("PARTITION_SIZE", "T", 1): 20_000.0}
+
+
+def test_metric_record_validation():
+    CruiseControlMetric("BROKER_CPU_UTIL", 1, 0, 50.0)
+    with pytest.raises(ValueError):
+        CruiseControlMetric("NOT_A_METRIC", 1, 0, 1.0)
+    with pytest.raises(ValueError):
+        CruiseControlMetric("TOPIC_BYTES_IN", 1, 0, 1.0)      # needs topic
+    with pytest.raises(ValueError):
+        CruiseControlMetric("PARTITION_SIZE", 1, 0, 1.0, topic="T")
+    m = CruiseControlMetric("PARTITION_SIZE", 1, 0, 5.0, topic="T", partition=2)
+    assert CruiseControlMetric.from_json(m.to_json()) == m
+
+
+def test_reporter_ships_all_scopes():
+    transport = InMemoryMetricsTransport()
+    rep = MetricsReporter(7, FakeSource(), transport, now_fn=lambda: 1234)
+    n = rep.report_once()
+    assert n == len(transport.records) == 9
+    assert all(r.broker_id == 7 and r.time_ms == 1234
+               for r in transport.records)
+
+
+def test_process_raw_metrics_to_samples():
+    metadata = ClusterMetadata(
+        brokers=[BrokerMetadata(0, "r0", "h0"), BrokerMetadata(1, "r0", "h1")],
+        partitions=[
+            PartitionMetadata("T", 0, leader=0, replicas=(0, 1)),
+            PartitionMetadata("T", 1, leader=0, replicas=(0, 1)),
+        ])
+    transport = InMemoryMetricsTransport()
+    MetricsReporter(0, FakeSource(), transport, now_fn=lambda: 50).report_once()
+    ps, bs = process_raw_metrics(transport.records, metadata, t_ms=50)
+    assert len(bs) == 1 and bs[0].cpu_util == 42.0
+    assert len(ps) == 2
+    by_part = {p.partition: p for p in ps}
+    # topic rate split across the broker's two leader partitions of T
+    assert by_part[0].metrics[md.ModelMetric.LEADER_BYTES_IN] == pytest.approx(400.0)
+    # partition sizes direct
+    assert by_part[0].metrics[md.ModelMetric.DISK_USAGE] == 10_000.0
+    assert by_part[1].metrics[md.ModelMetric.DISK_USAGE] == 20_000.0
+    # CPU attributed proportionally, positive
+    assert by_part[0].metrics[md.ModelMetric.CPU_USAGE] > 0
+
+
+def test_main_demo_boots():
+    from cruise_control_tpu.common.config import CruiseControlConfig
+    from cruise_control_tpu.main import build_demo_app
+    cfg = CruiseControlConfig({"optimizer.engine": "greedy",
+                               "min.valid.partition.ratio": 0.0,
+                               "failed.brokers.file.path": ""})
+    app = build_demo_app(cfg)
+    w = cfg.get("partition.metrics.window.ms")
+    for i in range(6):
+        app.load_monitor.sample_once(now_ms=i * w + w // 2)
+    state = app.state()
+    assert state["MonitorState"]["numMonitoredPartitions"] == 120
+    r = app.proposals()
+    assert r.balancedness_after >= 0
