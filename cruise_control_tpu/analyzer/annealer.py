@@ -525,6 +525,9 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
                     mesh: Optional[jax.sharding.Mesh] = None) -> AnnealResult:
     cfg = config or AnnealConfig()
     C = cfg.num_chains
+    if mesh is not None:   # chain axis must tile the mesh evenly
+        n_dev = int(np.prod(mesh.devices.shape))
+        C = -(-C // n_dev) * n_dev
     R, P, B = dt.num_replicas, dt.num_partitions, dt.num_brokers
     use_topic = bool(B * num_topics <= cfg.topic_term_limit)
     if initial_broker_of is None:
@@ -609,15 +612,11 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
 
     if mesh is not None:
         # chains are embarrassingly parallel: shard the chain axis across the
-        # mesh; XLA inserts the (cheap) collectives for the PT temperature
-        # swap and the final argmin.
-        from jax.sharding import NamedSharding, PartitionSpec
-        axis = mesh.axis_names[0]
-        chains = jax.tree.map(
-            lambda x: jax.device_put(x, NamedSharding(
-                mesh, PartitionSpec(axis, *([None] * (x.ndim - 1))))),
-            chains)
-        temps0 = jax.device_put(temps0, NamedSharding(mesh, PartitionSpec(axis)))
+        # mesh (parallel/sharding.py); XLA inserts the (cheap) collectives
+        # for the PT temperature swap and the final argmin.
+        from cruise_control_tpu.parallel.sharding import shard_chains
+        chains = shard_chains(chains, mesh)
+        temps0 = shard_chains(temps0, mesh)
 
     @jax.jit
     def run(chains, temps):

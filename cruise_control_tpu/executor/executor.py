@@ -62,11 +62,23 @@ class ClusterAdapter:
     def in_progress_reassignments(self) -> Set[str]:
         raise NotImplementedError
 
-    def set_replication_throttles(self, rate_bytes_per_sec: int,
-                                  topic_partitions: Sequence[str]) -> None:
+    # -- replication throttling (ReplicationThrottleHelper.java:29-79 seam):
+    # per-broker leader/follower rates + per-topic throttled replica lists.
+    def set_broker_throttle_rate(self, broker_ids: Sequence[int],
+                                 rate_bytes_per_sec: int) -> None:
+        """Set leader.replication.throttled.rate and
+        follower.replication.throttled.rate on each broker."""
+
+    def clear_broker_throttle_rate(self, broker_ids: Sequence[int]) -> None:
         pass
 
-    def clear_replication_throttles(self) -> None:
+    def set_topic_throttled_replicas(self, topic: str,
+                                     leader_entries: Sequence[str],
+                                     follower_entries: Sequence[str]) -> None:
+        """Set {leader,follower}.replication.throttled.replicas on the topic;
+        entries are "partition:brokerId" strings."""
+
+    def clear_topic_throttled_replicas(self, topic: str) -> None:
         pass
 
     def dead_brokers(self) -> Set[int]:
@@ -91,8 +103,8 @@ class FakeClusterAdapter(ClusterAdapter):
         self.latency = latency_polls
         self._pending: Dict[str, Tuple[int, Tuple[int, ...]]] = {}
         self._pending_ple: Dict[str, Tuple[int, int]] = {}
-        self.throttle: Optional[int] = None
-        self.throttled_tps: List[str] = []
+        self.broker_throttle_rates: Dict[int, int] = {}
+        self.topic_throttled_replicas: Dict[str, Dict[str, Tuple[str, ...]]] = {}
         self._dead: Set[int] = set()
 
     # -- adapter API --
@@ -117,13 +129,22 @@ class FakeClusterAdapter(ClusterAdapter):
     def in_progress_reassignments(self):
         return set(self._pending)
 
-    def set_replication_throttles(self, rate, tps):
-        self.throttle = rate
-        self.throttled_tps = list(tps)
+    def set_broker_throttle_rate(self, broker_ids, rate):
+        for b in broker_ids:
+            self.broker_throttle_rates[int(b)] = rate
 
-    def clear_replication_throttles(self):
-        self.throttle = None
-        self.throttled_tps = []
+    def clear_broker_throttle_rate(self, broker_ids):
+        for b in broker_ids:
+            self.broker_throttle_rates.pop(int(b), None)
+
+    def set_topic_throttled_replicas(self, topic, leader_entries,
+                                     follower_entries):
+        self.topic_throttled_replicas[topic] = {
+            "leader": tuple(leader_entries),
+            "follower": tuple(follower_entries)}
+
+    def clear_topic_throttled_replicas(self, topic):
+        self.topic_throttled_replicas.pop(topic, None)
 
     def dead_brokers(self):
         return set(self._dead)
@@ -154,6 +175,52 @@ class FakeClusterAdapter(ClusterAdapter):
                 del self._pending_ple[tp]
             else:
                 self._pending_ple[tp] = (n - 1, leader)
+
+
+class ReplicationThrottleHelper:
+    """Sets/clears leader+follower throttled rates and per-topic throttled
+    replica lists around an execution (ReplicationThrottleHelper.java:29-79):
+
+    - every broker participating in a move gets the throttled *rate*;
+    - each moved partition's topic gets ``leader.replication.throttled.replicas``
+      entries "partition:broker" for the OLD replicas (they lead/serve the
+      transfer) and ``follower.replication.throttled.replicas`` entries for
+      the ADDED replicas (they fetch), and both are removed afterwards.
+    """
+
+    def __init__(self, adapter: ClusterAdapter, rate_bytes_per_sec: int):
+        self.adapter = adapter
+        self.rate = rate_bytes_per_sec
+        self._brokers: Set[int] = set()
+        self._topics: Set[str] = set()
+
+    def set_throttles(self, proposals: Sequence[ExecutionProposal]) -> None:
+        leader_entries: Dict[str, List[str]] = {}
+        follower_entries: Dict[str, List[str]] = {}
+        for p in proposals:
+            if not p.replicas_to_add:
+                continue
+            leader_entries.setdefault(p.topic, []).extend(
+                f"{p.partition}:{b}" for b in p.old_replicas)
+            follower_entries.setdefault(p.topic, []).extend(
+                f"{p.partition}:{b}" for b in p.replicas_to_add)
+            self._brokers |= set(p.old_replicas) | set(p.new_replicas)
+        if self._brokers:
+            self.adapter.set_broker_throttle_rate(sorted(self._brokers),
+                                                  self.rate)
+        for topic in leader_entries:
+            self._topics.add(topic)
+            self.adapter.set_topic_throttled_replicas(
+                topic, sorted(leader_entries[topic]),
+                sorted(follower_entries.get(topic, [])))
+
+    def clear_throttles(self) -> None:
+        if self._brokers:
+            self.adapter.clear_broker_throttle_rate(sorted(self._brokers))
+        for topic in sorted(self._topics):
+            self.adapter.clear_topic_throttled_replicas(topic)
+        self._brokers.clear()
+        self._topics.clear()
 
 
 class ExecutorNotifier:
@@ -189,6 +256,8 @@ class Executor:
         self._strategy = strategy
         self._state = ExecutorState.NO_TASK_IN_PROGRESS
         self._stop_requested = threading.Event()
+        self._force_stop = threading.Event()
+        self._timed_out = False
         self._lock = threading.Lock()
         self.tracker = ExecutionTaskTracker()
         self._planner: Optional[ExecutionTaskPlanner] = None
@@ -215,8 +284,11 @@ class Executor:
         }
 
     def stop_execution(self, forced: bool = False):
-        """Graceful stop: in-flight tasks drain; pending are cancelled
-        (Executor.java stopExecution)."""
+        """Stop the ongoing execution (Executor.java:94-99 stopExecution):
+        graceful — in-flight tasks drain/abort, pending are cancelled;
+        forced — in-flight tasks are dropped (marked DEAD) without waiting."""
+        if forced:
+            self._force_stop.set()
         self._stop_requested.set()
         if self.has_ongoing_execution:
             self._state = ExecutorState.STOPPING_EXECUTION
@@ -226,14 +298,22 @@ class Executor:
                           removed_brokers: Iterable[int] = (),
                           demoted_brokers: Iterable[int] = (),
                           replication_throttle: Optional[int] = None,
-                          concurrency: Optional[int] = None) -> dict:
+                          concurrency: Optional[int] = None,
+                          logdir_moves: Sequence = ()) -> dict:
         """Synchronous execution of a proposal set; returns the summary.
-        (The async layer runs this in an operation thread.)"""
+        (The async layer runs this in an operation thread.)
+
+        One execution runs all three phases (Executor.java:734): inter-broker
+        replica moves, intra-broker logdir moves (``logdir_moves``), then
+        leadership moves.
+        """
         with self._lock:
             if self.has_ongoing_execution:
                 raise RuntimeError("An execution is already in progress")
             self._state = ExecutorState.STARTING_EXECUTION
         self._stop_requested.clear()
+        self._force_stop.clear()
+        self._timed_out = False
         t0 = time.time()
         planner = ExecutionTaskPlanner(self._strategy)
         planner.add_proposals(proposals)
@@ -247,21 +327,32 @@ class Executor:
         throttle = (replication_throttle
                     if replication_throttle is not None
                     else self.config.default_replication_throttle)
-        moved_tps = [t.proposal.topic_partition for t in planner.replica_tasks]
-        if throttle is not None and moved_tps:
-            self.adapter.set_replication_throttles(throttle, moved_tps)
-
+        helper = (ReplicationThrottleHelper(self.adapter, throttle)
+                  if throttle is not None else None)
+        intra_moves_applied = 0
         try:
+            # inside the try: a partial throttle-set failure must still clear
+            # what was applied and release the executor state
+            if helper is not None:
+                helper.set_throttles([t.proposal for t in planner.replica_tasks])
             self._state = ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
             self._move_replicas(planner, concurrency)
+            if logdir_moves and not self._stop_requested.is_set():
+                self._state = \
+                    ExecutorState.INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
+                self.adapter.alter_replica_logdirs(logdir_moves)
+                intra_moves_applied = len(logdir_moves)
             self._state = ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS
             self._move_leadership(planner)
         finally:
-            if throttle is not None and moved_tps:
-                self.adapter.clear_replication_throttles()
+            if helper is not None:
+                helper.clear_throttles()
             summary = {
                 "stopped": self._stop_requested.is_set(),
+                "forcedStop": self._force_stop.is_set(),
+                "timedOut": self._timed_out,
                 "taskCounts": self.tracker.snapshot(),
+                "intraBrokerMoves": intra_moves_applied,
                 "durationSeconds": round(time.time() - t0, 3),
             }
             self._execution_history.append(summary)
@@ -307,7 +398,8 @@ class Executor:
             self._wait_for(batch, self._replica_task_done)
 
     def _move_leadership(self, planner: ExecutionTaskPlanner):
-        """Phase 3 (Executor.java:1050)."""
+        """Phase 3 (Executor.java:1050); leadership movements time out on
+        their own (shorter) round budget."""
         while not self._stop_requested.is_set():
             batch = planner.next_leadership_batch(
                 self.config.num_concurrent_leader_movements)
@@ -318,7 +410,8 @@ class Executor:
                 t.transition(TaskState.IN_PROGRESS, now)
                 self.tracker.mark(t, TaskState.PENDING)
             self.adapter.execute_preferred_leader_elections(batch)
-            self._wait_for(batch, self._leader_task_done)
+            self._wait_for(batch, self._leader_task_done,
+                           max_rounds=self.config.leadership_movement_timeout_rounds)
 
     def _replica_task_done(self, task: ExecutionTask) -> Optional[TaskState]:
         tp = task.proposal.topic_partition
@@ -339,18 +432,31 @@ class Executor:
         return None
 
     def _wait_for(self, batch: List[ExecutionTask],
-                  done_fn: Callable[[ExecutionTask], Optional[TaskState]]):
-        """Progress polling (Executor.java waitForExecutionTaskToFinish)."""
+                  done_fn: Callable[[ExecutionTask], Optional[TaskState]],
+                  max_rounds: Optional[int] = None):
+        """Progress polling (Executor.java waitForExecutionTaskToFinish).
+
+        Graceful stop aborts what can be aborted and drains the rest; forced
+        stop (Executor.java:94-99) drops in-flight tasks immediately (DEAD).
+        Exhausting the round budget also marks the stragglers DEAD — leaving
+        them IN_PROGRESS would corrupt per-broker concurrency accounting for
+        the next batch — and surfaces ``timedOut`` in the summary.
+        """
         rounds = 0
+        budget = (max_rounds if max_rounds is not None
+                  else self.config.max_execution_progress_check_rounds)
         open_tasks = list(batch)
-        while open_tasks and rounds < self.config.max_execution_progress_check_rounds:
+        while open_tasks and rounds < budget:
             rounds += 1
             now = int(time.time() * 1000)
             still = []
-            force_stop = self._stop_requested.is_set()
+            stopping = self._stop_requested.is_set()
+            forced = self._force_stop.is_set()
             for t in open_tasks:
                 outcome = done_fn(t)
-                if outcome is None and force_stop:
+                if outcome is None and forced:
+                    outcome = TaskState.DEAD
+                elif outcome is None and stopping:
                     # graceful stop: abort what can be aborted
                     if t.proposal.can_be_aborted(
                             self.adapter.current_replicas(
@@ -369,3 +475,10 @@ class Executor:
             open_tasks = still
             if open_tasks:
                 time.sleep(self.config.execution_progress_check_interval_ms / 1000.0)
+        if open_tasks:   # round budget exhausted
+            self._timed_out = True
+            now = int(time.time() * 1000)
+            for t in open_tasks:
+                prev = t.state
+                t.transition(TaskState.DEAD, now)
+                self.tracker.mark(t, prev)

@@ -163,7 +163,12 @@ class ExecutionTaskPlanner:
             if p.has_replica_action:
                 self.replica_tasks.append(ExecutionTask(
                     next(self._id_gen), p, TaskType.INTER_BROKER_REPLICA_ACTION))
-            elif p.has_leader_action:
+            # A leadership task is created for EVERY proposal with a leader
+            # action, including those that also move replicas: reassignment
+            # alone does not transfer leadership while the old leader remains
+            # in the replica set (ExecutionTaskPlanner.java:250-258,
+            # maybeAddLeaderChangeTasks).
+            if p.has_leader_action:
                 self.leadership_tasks.append(ExecutionTask(
                     next(self._id_gen), p, TaskType.LEADER_ACTION))
         self.replica_tasks.sort(

@@ -215,15 +215,80 @@ class KafkaClusterAdapter:
         out = self._admin.list_partition_reassignments()
         return {f"{t}-{p}" for (t, p) in out}
 
-    def set_replication_throttles(self, rate, tps):
-        cfgs = {"leader.replication.throttled.rate": str(rate),
-                "follower.replication.throttled.rate": str(rate)}
-        self._admin.alter_configs({"broker": cfgs})
+    # Dynamic-config sources in DescribeConfigs responses (Kafka protocol
+    # ConfigSource): 1 = DYNAMIC_TOPIC_CONFIG, 4 = DYNAMIC_BROKER_CONFIG.
+    _DYNAMIC_SOURCES = (1, 4)
 
-    def clear_replication_throttles(self):
-        self._admin.alter_configs({"broker": {
-            "leader.replication.throttled.rate": "",
-            "follower.replication.throttled.rate": ""}})
+    def _current_dynamic_configs(self, resource) -> Dict[str, str]:
+        """Read a resource's current *dynamic* config overrides."""
+        out: Dict[str, str] = {}
+        try:
+            responses = self._admin.describe_configs(
+                config_resources=[resource])
+            for resp in responses:
+                for res_entry in resp.resources:
+                    # (error_code, error_message, type, name, config_entries)
+                    for entry in res_entry[4]:
+                        name, value = entry[0], entry[1]
+                        source = entry[3] if len(entry) > 3 else None
+                        if source in self._DYNAMIC_SOURCES and value is not None:
+                            out[name] = value
+        except Exception:
+            # best effort: an unreadable config means we merge with nothing
+            pass
+        return out
+
+    def _alter_configs_batch(self, updates) -> None:
+        """Apply config updates (list of ("broker"|"topic", name, {k: v}));
+        one AlterConfigs RPC for all resources.
+
+        kafka-python only exposes the legacy AlterConfigs, which REPLACES a
+        resource's whole dynamic config — so merge with the current dynamic
+        overrides to avoid wiping unrelated settings
+        (ReplicationThrottleHelper.java does the same via the ZK config
+        path). An empty-string value deletes the key.
+        """
+        from kafka.admin import ConfigResource, ConfigResourceType
+        resources = []
+        for resource_type, name, configs in updates:
+            rtype = (ConfigResourceType.BROKER if resource_type == "broker"
+                     else ConfigResourceType.TOPIC)
+            merged = self._current_dynamic_configs(
+                ConfigResource(rtype, name))
+            for k, v in configs.items():
+                if v == "":
+                    merged.pop(k, None)
+                else:
+                    merged[k] = v
+            resources.append(ConfigResource(rtype, name, configs=merged))
+        if resources:
+            self._admin.alter_configs(resources)
+
+    def set_broker_throttle_rate(self, broker_ids, rate):
+        self._alter_configs_batch([
+            ("broker", str(int(b)), {
+                "leader.replication.throttled.rate": str(rate),
+                "follower.replication.throttled.rate": str(rate)})
+            for b in broker_ids])
+
+    def clear_broker_throttle_rate(self, broker_ids):
+        self._alter_configs_batch([
+            ("broker", str(int(b)), {
+                "leader.replication.throttled.rate": "",
+                "follower.replication.throttled.rate": ""})
+            for b in broker_ids])
+
+    def set_topic_throttled_replicas(self, topic, leader_entries,
+                                     follower_entries):
+        self._alter_configs_batch([("topic", topic, {
+            "leader.replication.throttled.replicas": ",".join(leader_entries),
+            "follower.replication.throttled.replicas":
+                ",".join(follower_entries)})])
+
+    def clear_topic_throttled_replicas(self, topic):
+        self._alter_configs_batch([("topic", topic, {
+            "leader.replication.throttled.replicas": "",
+            "follower.replication.throttled.replicas": ""})])
 
     def dead_brokers(self) -> Set[int]:
         return set()

@@ -152,7 +152,9 @@ class MetricSampleAggregator:
             shift = widx - newest
             self._roll(shift)
             self._oldest_window += shift
-        return (widx - self._oldest_window) % W1 if False else widx % W1
+        # slots are window-index mod W1; valid because widx is always within
+        # [oldest_window, oldest_window + num_windows] here
+        return widx % W1
 
     def _roll(self, shift: int):
         """Zero the slots that cycle out (they become future windows)."""
@@ -195,14 +197,26 @@ class MetricSampleAggregator:
     # -- aggregate ----------------------------------------------------------
 
     def _stable_slots(self, now_ms: int) -> np.ndarray:
-        """Slots of the N completed windows, oldest first."""
-        W1 = self.num_windows + 1
+        """Window indexes of the N completed windows before ``now``, oldest
+        first. Read-only — the buffer rolls forward only in add_sample."""
         cur = int(now_ms) // self.window_ms
         if self._oldest_window is None:
             return np.zeros(0, np.int64)
         first = max(self._oldest_window, cur - self.num_windows)
         widxs = np.arange(first, cur)
         return widxs
+
+    def _real_windows(self, widxs: np.ndarray) -> np.ndarray:
+        """bool mask: which queried windows actually live in the buffer.
+
+        A queried index outside [oldest, oldest + num_windows] would alias
+        (mod W+1) onto a slot holding a DIFFERENT window's samples — after a
+        sampling gap the expired slots still contain old data. Masking keeps
+        the read path non-destructive while never attributing stale samples
+        to newer windows.
+        """
+        return ((widxs >= self._oldest_window)
+                & (widxs <= self._oldest_window + self.num_windows))
 
     def aggregate(self, now_ms: int,
                   requirements: ModelCompletenessRequirements = ModelCompletenessRequirements(),
@@ -223,10 +237,13 @@ class MetricSampleAggregator:
                     generation=self.generation)
 
             slots = (widxs % W1).astype(np.int64)
-            cnt = self._count[:E][:, slots]                     # [E, Wv]
-            ssum = self._sum[:E][:, slots]
-            smax = self._max[:E][:, slots]
-            slatest = self._latest[:E][:, slots]
+            real = self._real_windows(widxs)                    # [Wv]
+            cnt = np.where(real, self._count[:E][:, slots], 0)  # [E, Wv]
+            ssum = np.where(real[None, :, None], self._sum[:E][:, slots], 0.0)
+            smax = np.where(real[None, :, None], self._max[:E][:, slots],
+                            -np.inf)
+            slatest = np.where(real[None, :, None],
+                               self._latest[:E][:, slots], 0.0)
 
             safe_cnt = np.maximum(cnt, 1)[:, :, None]
             vals = np.zeros((E, Wv, self.M))
@@ -260,8 +277,15 @@ class MetricSampleAggregator:
             n_extrap = ((extra == 1) | (extra == 2)).sum(axis=1)
             entity_valid = (~invalid.any(axis=1)) & (n_extrap <= self.max_extrapolations)
 
-            ratio_per_window = (some | adj)[entity_valid].mean(axis=0).astype(np.float32) \
-                if entity_valid.any() else np.zeros(Wv, np.float32)
+            # per-window valid-entity ratio over ALL entities, and valid
+            # windows = windows meeting the requirement's ratio — the
+            # MetricSampleCompleteness accounting (a monitor with data in 1
+            # of 5 windows has 1 valid window, not 5).
+            ratio_per_window = (some | adj).mean(axis=0).astype(np.float32)
+            num_valid_windows = int(
+                (ratio_per_window
+                 >= max(requirements.min_monitored_partitions_percentage,
+                        1e-12)).sum())
             valid_ratio = float(entity_valid.mean())
             groups = {self._group_of.get(e) for i, e in enumerate(self._entities)
                       if entity_valid[i]}
@@ -276,7 +300,7 @@ class MetricSampleAggregator:
                     valid_entity_ratio_per_window=ratio_per_window,
                     valid_entity_ratio=valid_ratio,
                     valid_entity_groups=len(groups),
-                    num_valid_windows=Wv,
+                    num_valid_windows=num_valid_windows,
                     num_valid_entities=int(entity_valid.sum()),
                 ),
                 generation=self.generation,

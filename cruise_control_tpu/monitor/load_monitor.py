@@ -95,7 +95,8 @@ class LoadMonitor:
                  num_windows: int = 5, window_ms: int = 60_000,
                  min_samples_per_window: int = 1,
                  max_allowed_extrapolations: int = 5,
-                 sampling_interval_ms: int = 60_000):
+                 sampling_interval_ms: int = 60_000,
+                 now_fn: Optional[Callable[[], int]] = None):
         self._metadata_source = metadata_source
         self._sampler = sampler
         self._capacity_resolver = capacity_resolver or StaticCapacityResolver(
@@ -121,6 +122,9 @@ class LoadMonitor:
         self._thread: Optional[threading.Thread] = None
         self._model_semaphore = threading.Semaphore(2)
         self._bootstrap_progress: Optional[float] = None
+        # injectable clock: windowed aggregation is time-driven, so tests
+        # feeding synthetic timestamps must also control "now"
+        self._now = now_fn or (lambda: int(time.time() * 1000))
 
     # ------------------------------------------------------------------ state
 
@@ -130,7 +134,7 @@ class LoadMonitor:
 
     def state_snapshot(self, now_ms: Optional[int] = None) -> dict:
         """LoadMonitorState for the STATE endpoint (LoadMonitor.java:223)."""
-        now_ms = now_ms or int(time.time() * 1000)
+        now_ms = now_ms or self._now()
         result = self.partition_aggregator.aggregate(now_ms)
         c = result.completeness
         return {
@@ -205,7 +209,7 @@ class LoadMonitor:
 
     def sample_once(self, now_ms: Optional[int] = None) -> int:
         """One sampling pass (SamplingTask body); returns samples ingested."""
-        now_ms = now_ms or int(time.time() * 1000)
+        now_ms = now_ms or self._now()
         prev = self._state
         self._state = MonitorState.SAMPLING
         try:
@@ -251,11 +255,13 @@ class LoadMonitor:
         (LoadMonitor.java:469-541). Raises NotEnoughValidWindowsError when
         completeness requirements fail."""
         from cruise_control_tpu.common.metrics import REGISTRY
-        now_ms = now_ms or int(time.time() * 1000)
+        now_ms = now_ms or self._now()
         with self._model_semaphore, \
                 REGISTRY.timer("cluster-model-creation-timer").time():
             metadata = self._metadata_source.get_metadata()
-            result = self.partition_aggregator.aggregate(now_ms)
+            # pass the requirements down: num_valid_windows counts windows
+            # meeting the per-window valid-entity ratio of THESE requirements
+            result = self.partition_aggregator.aggregate(now_ms, requirements)
             if result.completeness.num_valid_windows < requirements.min_required_num_windows:
                 raise NotEnoughValidWindowsError(
                     f"{result.completeness.num_valid_windows} valid windows, "

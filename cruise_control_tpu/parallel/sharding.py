@@ -1,0 +1,255 @@
+"""Multi-device sharding: chain-axis data parallelism + replica-axis model
+parallelism over a ``jax.sharding.Mesh``.
+
+The two scale axes of the optimizer (SURVEY §5.7-5.8, §7 step 3):
+
+- **Chain axis** — annealer chains are embarrassingly parallel. The chain
+  pytree is placed with a ``NamedSharding`` over the mesh axis and every
+  step of the jitted parallel-tempering scan runs fully partitioned; XLA
+  inserts the (tiny) collectives only for the temperature-exchange argsort
+  and the final argmin. See :func:`shard_chains`.
+
+- **Replica axis** — the exact full-model evaluations (initial scoring,
+  final rescore, goal summaries) are O(R) segment-reductions over all 500K
+  replicas. :func:`sharded_aggregates` shards the replica AND partition
+  axes with ``jax.shard_map``: each device computes partial per-broker
+  segment sums over its replica shard, then one ``psum`` over the ICI mesh
+  axis combines them — the standard data-parallel reduction layout, with
+  the [B,4] aggregate (small) replicated and the [R,4] load tensor (large)
+  never materialized on any single device.
+
+The reference has no counterpart (its "distributed backend" is Kafka/ZK,
+SURVEY §5.8); this layer is the TPU-native capability the rebuild adds.
+Collectives ride the mesh the caller provides: ICI within a pod slice, DCN
+across hosts — the caller shapes the mesh, XLA routes the traffic.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cruise_control_tpu.common import resources as res
+from cruise_control_tpu.ops.aggregates import DeviceTopology
+
+
+def make_cpu_mesh(n_devices: int, axis: str = "chains") -> Mesh:
+    """An n-device mesh on the CPU platform, never touching the default
+    (possibly TPU) backend — safe for tests and the driver dry-run."""
+    devices = jax.devices("cpu")
+    if len(devices) < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} CPU devices, have {len(devices)}; set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n_devices} "
+            "before the first JAX use")
+    return Mesh(np.asarray(devices[:n_devices]), (axis,))
+
+
+# ---------------------------------------------------------------------------
+# Chain-axis data parallelism
+# ---------------------------------------------------------------------------
+
+
+def shard_chains(tree, mesh: Mesh, axis: Optional[str] = None):
+    """Place a chain-carrying pytree with its leading axis sharded over the
+    mesh; scalar leaves are replicated. The chain count must divide evenly
+    (the annealer rounds its chain count up to the mesh size)."""
+    axis = axis or mesh.axis_names[0]
+
+    def put(x):
+        x = jnp.asarray(x)
+        if x.ndim == 0:
+            return jax.device_put(x, NamedSharding(mesh, P()))
+        return jax.device_put(x, NamedSharding(
+            mesh, P(axis, *([None] * (x.ndim - 1)))))
+
+    return jax.tree.map(put, tree)
+
+
+def replicate(tree, mesh: Mesh):
+    """Replicate a pytree (topology constants, thresholds) over the mesh."""
+    return jax.tree.map(
+        lambda x: jax.device_put(jnp.asarray(x), NamedSharding(mesh, P())),
+        tree)
+
+
+# ---------------------------------------------------------------------------
+# Replica-axis sharded exact aggregates
+# ---------------------------------------------------------------------------
+
+
+class ShardedAggregates(NamedTuple):
+    """Exact per-chain broker aggregates from a replica-sharded reduction."""
+
+    broker_load: jax.Array       # f32[C, B, 4]
+    host_load: jax.Array         # f32[C, H, 4]
+    replica_count: jax.Array     # f32[C, B]
+    leader_count: jax.Array      # f32[C, B]
+    potential_nw_out: jax.Array  # f32[C, B]
+    leader_bytes_in: jax.Array   # f32[C, B]
+    unhealed: jax.Array          # f32[C] offline replicas still in place
+
+
+def _pad_axis(x: jax.Array, size: int, axis: int, fill=0):
+    pad = size - x.shape[axis]
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=fill)
+
+
+def sharded_aggregates(mesh: Mesh, dt: DeviceTopology,
+                       broker_of: jax.Array, leader_of: jax.Array,
+                       initial_broker_of: jax.Array) -> ShardedAggregates:
+    """Per-chain exact aggregates with the replica/partition axes sharded.
+
+    ``broker_of`` is i32[C, R], ``leader_of`` i32[C, P]. Each device owns
+    R/n replicas and P/n partitions, computes partial per-broker segment
+    sums, and one psum over the mesh axis yields the exact aggregates —
+    the replica-axis layout for the 500K regime. The O(C·P) leader gathers
+    (which need global indexing) run outside the shard_map; the O(C·R)
+    heavy reductions run inside it.
+    """
+    ax = mesh.axis_names[0]
+    n = mesh.devices.size
+    C = broker_of.shape[0]
+    R, Pn, B = dt.num_replicas, dt.num_partitions, dt.num_brokers
+    H = dt.num_hosts
+    R_pad = -(-R // n) * n
+    P_pad = -(-Pn // n) * n
+
+    # --- global (small) gathers outside the shard_map ---
+    # partition leader's potential NW_OUT and the leader's broker, per chain
+    pl = (dt.leader_extra[:, res.NW_OUT][None, :]
+          + jnp.take_along_axis(
+              jnp.broadcast_to(dt.replica_base_load[:, res.NW_OUT], (C, R)),
+              leader_of, axis=1))                              # f32[C, P]
+    leader_broker = jnp.take_along_axis(broker_of, leader_of, axis=1)  # [C,P]
+
+    # --- padded, shard-ready operands ---
+    bo = _pad_axis(broker_of, R_pad, 1)                       # i32[C, R_pad]
+    valid_r = _pad_axis(jnp.ones((R,), jnp.float32), R_pad, 0)
+    por = _pad_axis(dt.partition_of_replica, R_pad, 0)
+    rbl = _pad_axis(dt.replica_base_load, R_pad, 0)
+    roff = _pad_axis(dt.replica_offline, R_pad, 0)
+    ridx = jnp.arange(R_pad, dtype=jnp.int32)
+    init_bo = _pad_axis(initial_broker_of, R_pad, 0)
+    lo_rep = leader_of                                        # replicated [C, P]
+    le_rep = dt.leader_extra                                  # replicated [P, 4]
+    pl_rep = pl                                               # replicated [C, P]
+    alive_rep = dt.broker_alive
+    lb = _pad_axis(leader_broker, P_pad, 1)                   # i32[C, P_pad]
+    valid_p = _pad_axis(jnp.ones((Pn,), jnp.float32), P_pad, 0)
+    lbi_p = _pad_axis(dt.leader_bytes_in, P_pad, 0)
+
+    def local(bo, valid_r, por, rbl, roff, ridx, init_bo,
+              lo_rep, le_rep, pl_rep, alive_rep, lb, valid_p, lbi_p):
+        # --- replica-sharded part: each device owns a slice of R ---
+        is_leader = (jnp.take_along_axis(
+            jnp.broadcast_to(lo_rep, (C,) + lo_rep.shape[1:]), por[None, :]
+            .repeat(C, 0), axis=1) == ridx[None, :])          # [C, r_loc]
+        eff = (rbl[None, :, :]
+               + jnp.where(is_leader[:, :, None], le_rep[por][None, :, :], 0.0)
+               ) * valid_r[None, :, None]                     # [C, r_loc, 4]
+
+        def seg_b(vals, seg):
+            """[C, r_loc(,k)] → [C, B(,k)] via combined (chain, broker)
+            segment ids — one flat segment_sum, no vmap."""
+            Cl = seg.shape[0]
+            vals = jnp.broadcast_to(vals, seg.shape + vals.shape[2:])
+            comb = seg + jnp.arange(Cl, dtype=seg.dtype)[:, None] * B
+            flat = jax.ops.segment_sum(
+                vals.reshape((-1,) + vals.shape[2:]), comb.reshape(-1),
+                num_segments=Cl * B)
+            return flat.reshape((Cl, B) + vals.shape[2:])
+
+        broker_load = seg_b(eff, bo)
+        replica_count = seg_b(valid_r[None, :], bo)
+        pot = seg_b(jnp.take_along_axis(pl_rep, por[None, :].repeat(C, 0),
+                                        axis=1) * valid_r[None, :], bo)
+        unhealed = jnp.sum(
+            (roff[None, :] & (bo == init_bo[None, :]) & alive_rep[bo]
+             ).astype(jnp.float32) * valid_r[None, :], axis=1)   # [C]
+
+        # --- partition-sharded part: each device owns a slice of P ---
+        leader_count = seg_b(valid_p[None, :], lb)
+        leader_bytes_in = seg_b(lbi_p[None, :] * valid_p[None, :], lb)
+        # potential NW_OUT delta is carried by replicas (above); leadership's
+        # own contribution is already inside pl.
+
+        out = (broker_load, replica_count, pot, unhealed,
+               leader_count, leader_bytes_in)
+        return jax.tree.map(lambda x: jax.lax.psum(x, ax), out)
+
+    specs_in = (
+        P(None, ax),          # bo
+        P(ax),                # valid_r
+        P(ax),                # por
+        P(ax, None),          # rbl
+        P(ax),                # roff
+        P(ax),                # ridx
+        P(ax),                # init_bo
+        P(None, None),        # lo_rep (replicated)
+        P(None, None),        # le_rep
+        P(None, None),        # pl_rep
+        P(None),              # alive_rep
+        P(None, ax),          # lb
+        P(ax),                # valid_p
+        P(ax),                # lbi_p
+    )
+    out = jax.shard_map(
+        local, mesh=mesh, in_specs=specs_in,
+        out_specs=(P(None, None, None), P(None, None), P(None, None), P(None),
+                   P(None, None), P(None, None)))(
+        bo, valid_r, por, rbl, roff, ridx, init_bo, lo_rep, le_rep, pl_rep,
+        alive_rep, lb, valid_p, lbi_p)
+    broker_load, replica_count, pot, unhealed, leader_count, leader_bi = out
+    host_load = jax.vmap(
+        lambda bl: jax.ops.segment_sum(bl, dt.host_of_broker, num_segments=H)
+    )(broker_load)
+    return ShardedAggregates(
+        broker_load=broker_load, host_load=host_load,
+        replica_count=replica_count, leader_count=leader_count,
+        potential_nw_out=pot, leader_bytes_in=leader_bi, unhealed=unhealed)
+
+
+def sharded_chain_energies(mesh: Mesh, dt: DeviceTopology, th, weights,
+                           broker_of: jax.Array, leader_of: jax.Array,
+                           initial_broker_of: jax.Array,
+                           use_topic: bool = False,
+                           topic_count: Optional[jax.Array] = None
+                           ) -> jax.Array:
+    """f32[C] — exact decomposed objective per chain, replica-sharded.
+
+    Parity target: the annealer's ``rescore`` (annealer.py) / the
+    chain-energy decomposition of :mod:`objective`. Topic term: pass the
+    maintained per-chain ``topic_count`` histogram when active (the exact
+    counts are integer-maintained, so they need no recomputation here).
+    """
+    from cruise_control_tpu.analyzer import goals as G
+    from cruise_control_tpu.analyzer import objective as OBJ
+    from cruise_control_tpu.ops.aggregates import partition_rack_excess
+
+    agg = sharded_aggregates(mesh, dt, broker_of, leader_of,
+                             initial_broker_of)
+    f = jax.vmap(
+        lambda bl, rc, lc, pot, lbi: OBJ.broker_cost(th, weights, bl, rc,
+                                                     lc, pot, lbi)
+    )(agg.broker_load, agg.replica_count, agg.leader_count,
+      agg.potential_nw_out, agg.leader_bytes_in)              # [C, B]
+    h = jax.vmap(lambda hl: OBJ.host_cost(th, weights, hl))(agg.host_load)
+    e = jnp.sum(f, axis=1) + jnp.sum(h, axis=1)
+    rack = jax.vmap(lambda bo: jnp.sum(partition_rack_excess(dt, bo)))(
+        broker_of)
+    e = e + weights.rack * rack
+    if use_topic and topic_count is not None:
+        alive_f = th.alive.astype(jnp.float32)[None, :, None]
+        out = (G.band_cost(topic_count, th.topic_upper[None, None, :],
+                           th.topic_lower[None, None, :]) * alive_f)
+        e = e + weights.topic * jnp.sum(out, axis=(1, 2))
+    return e + weights.healing * agg.unhealed

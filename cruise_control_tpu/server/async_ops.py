@@ -199,6 +199,10 @@ class ReviewRequest:
     status: ReviewStatus = ReviewStatus.PENDING_REVIEW
     reason: str = ""
     submitted_task_id: Optional[str] = None
+    #: the parameters as reviewed — the resubmission executes THESE, so an
+    #: approval cannot be redeemed for a different request
+    #: (Purgatory.java submit() executes the stored request's parameters)
+    params: Dict[str, str] = dataclasses.field(default_factory=dict)
 
     def to_json(self) -> dict:
         return {"Id": self.review_id, "EndPoint": self.endpoint,
@@ -215,10 +219,11 @@ class Purgatory:
         self._next_id = 0
         self._lock = threading.Lock()
 
-    def submit(self, endpoint: str, request_url: str, submitter: str
-               ) -> ReviewRequest:
+    def submit(self, endpoint: str, request_url: str, submitter: str,
+               params: Optional[Dict[str, str]] = None) -> ReviewRequest:
         with self._lock:
-            r = ReviewRequest(self._next_id, endpoint, request_url, submitter)
+            r = ReviewRequest(self._next_id, endpoint, request_url, submitter,
+                              params=dict(params or {}))
             self._requests[self._next_id] = r
             self._next_id += 1
             return r
@@ -237,17 +242,37 @@ class Purgatory:
             r.reason = reason
             return r
 
-    def take_approved(self, review_id: int) -> ReviewRequest:
-        """Mark an APPROVED request SUBMITTED (each approval is usable once)."""
+    def take_approved(self, review_id: int,
+                      endpoint: Optional[str] = None) -> ReviewRequest:
+        """Mark an APPROVED request SUBMITTED (each approval is usable once).
+
+        When ``endpoint`` is given, the approval is only redeemable at the
+        endpoint it was reviewed for (Purgatory.submit endpoint check); a
+        mismatch raises without consuming the approval.
+        """
         with self._lock:
             r = self._requests.get(review_id)
             if r is None:
                 raise KeyError(f"no review request {review_id}")
+            if endpoint is not None and r.endpoint != endpoint:
+                raise ValueError(
+                    f"review {review_id} was approved for {r.endpoint}, "
+                    f"not {endpoint}")
             if r.status != ReviewStatus.APPROVED:
                 raise ValueError(f"request {review_id} is {r.status.value}, "
                                  "not APPROVED")
             r.status = ReviewStatus.SUBMITTED
             return r
+
+    def reopen(self, review_id: int) -> None:
+        """Roll a SUBMITTED request back to APPROVED — used when the
+        submitted handler fails before doing any work, so a transient error
+        does not burn the approval (take/reopen keeps single-use atomic
+        under concurrent resubmits)."""
+        with self._lock:
+            r = self._requests.get(review_id)
+            if r is not None and r.status == ReviewStatus.SUBMITTED:
+                r.status = ReviewStatus.APPROVED
 
     def board(self) -> List[dict]:
         with self._lock:

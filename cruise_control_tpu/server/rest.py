@@ -93,24 +93,43 @@ class RestApi:
             return 405, {"errorMessage": f"{endpoint} requires GET"}
 
         # two-step verification (Purgatory.java:116-166)
+        consumed_review: Optional[int] = None
         if (method == "POST" and self.purgatory is not None
                 and endpoint in REVIEWABLE):
             review_id = params.get("review_id")
             if review_id is None:
-                r = self.purgatory.submit(endpoint, request_url, client_id)
+                r = self.purgatory.submit(endpoint, request_url, client_id,
+                                          params=params)
                 return 202, {"reviewResult": r.to_json(),
                              "message": "Submitted for review; approve via "
                                         "REVIEW then resubmit with review_id."}
             try:
-                self.purgatory.take_approved(int(review_id))
+                r = self.purgatory.take_approved(int(review_id),
+                                                 endpoint=endpoint)
             except (KeyError, ValueError) as e:
                 return 400, {"errorMessage": str(e)}
+            consumed_review = int(review_id)
+            # execute the request exactly as reviewed: an approval cannot be
+            # redeemed with different parameters (e.g. flipping dryrun=false).
+            # Client plumbing (poll timeout / task id) is not part of the
+            # reviewed action and carries over from the resubmission.
+            reviewed = dict(r.params)
+            for k in ("get_response_timeout_ms", "user_task_id"):
+                if k in params:
+                    reviewed[k] = params[k]
+            params = reviewed
+            request_url = r.request_url
 
         try:
             handler = getattr(self, f"_{endpoint.lower()}")
-            return handler(params, client_id, request_url)
+            code, payload = handler(params, client_id, request_url)
         except Exception as e:     # surface as the reference's error JSON
-            return 500, {"errorMessage": f"{type(e).__name__}: {e}"}
+            code, payload = 500, {"errorMessage": f"{type(e).__name__}: {e}"}
+        if consumed_review is not None and code >= 500:
+            # the reviewed action never ran: re-open the approval so a
+            # transient failure doesn't force a full re-review cycle
+            self.purgatory.reopen(consumed_review)
+        return code, payload
 
     # -------------------------------------------------- async plumbing
 

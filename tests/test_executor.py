@@ -52,7 +52,8 @@ def test_execute_replica_and_leadership_moves():
     assert adapter.leaders["t-1"] == 0
     counts = summary["taskCounts"]
     assert counts["INTER_BROKER_REPLICA_ACTION"]["COMPLETED"] == 1
-    assert counts["LEADER_ACTION"]["COMPLETED"] == 1
+    # t-0 changes leader (0→2) as part of the move AND t-1 is leadership-only
+    assert counts["LEADER_ACTION"]["COMPLETED"] == 2
     assert not summary["stopped"]
     assert ex.state == ExecutorState.NO_TASK_IN_PROGRESS
 
@@ -131,21 +132,96 @@ def test_stop_execution_aborts_pending():
 
 
 def test_replication_throttle_set_and_cleared():
+    """ReplicationThrottleHelper.java:29-79 semantics: participating brokers
+    get the rate, moved topics get leader (old replicas) / follower (added
+    replicas) throttled-replica lists; all cleared after the execution."""
     props = [_proposal("t", 0, [0, 1], [2, 1])]
     adapter = _adapter_for(props)
-    seen = {}
+    seen = {"rates": [], "topics": {}}
 
     class SpyAdapter(FakeClusterAdapter):
-        def set_replication_throttles(self, rate, tps):
-            seen["rate"] = rate
-            seen["tps"] = list(tps)
-            super().set_replication_throttles(rate, tps)
+        def set_broker_throttle_rate(self, broker_ids, rate):
+            seen["rates"].append((tuple(broker_ids), rate))
+            super().set_broker_throttle_rate(broker_ids, rate)
+
+        def set_topic_throttled_replicas(self, topic, leaders, followers):
+            seen["topics"][topic] = (tuple(leaders), tuple(followers))
+            super().set_topic_throttled_replicas(topic, leaders, followers)
 
     adapter = SpyAdapter({p.topic_partition: p.old_replicas for p in props})
     ex = Executor(adapter, ExecutorConfig(execution_progress_check_interval_ms=1))
     ex.execute_proposals(props, replication_throttle=12345)
-    assert seen == {"rate": 12345, "tps": ["t-0"]}
-    assert adapter.throttle is None          # cleared after execution
+    assert seen["rates"] == [((0, 1, 2), 12345)]
+    # leader entries = old replicas {0,1}; follower entries = added {2}
+    assert seen["topics"]["t"] == (("0:0", "0:1"), ("0:2",))
+    assert adapter.broker_throttle_rates == {}       # cleared after execution
+    assert adapter.topic_throttled_replicas == {}
+
+
+def test_replica_move_with_leader_action_gets_leader_task():
+    """A proposal that both moves replicas AND changes leadership must get a
+    LEADER_ACTION task (ExecutionTaskPlanner.java:250-258): reassignment
+    alone does not transfer leadership while the old leader stays in the
+    replica set."""
+    props = [_proposal("t", 0, [0, 1], [1, 2])]   # 0->2 move, leader 0->1
+    adapter = _adapter_for(props)
+    ex = Executor(adapter, ExecutorConfig(execution_progress_check_interval_ms=1))
+    summary = ex.execute_proposals(props)
+    counts = summary["taskCounts"]
+    assert counts["INTER_BROKER_REPLICA_ACTION"]["COMPLETED"] == 1
+    assert counts["LEADER_ACTION"]["COMPLETED"] == 1
+    assert adapter.replicas["t-0"] == (1, 2)
+    assert adapter.leaders["t-0"] == 1
+
+
+def test_forced_stop_drops_in_flight_tasks():
+    props = [_proposal("t", i, [0, 1], [2, 1]) for i in range(4)]
+    adapter = _adapter_for(props, latency=10_000)   # effectively never finish
+    ex = Executor(adapter, ExecutorConfig(
+        execution_progress_check_interval_ms=5,
+        num_concurrent_partition_movements_per_broker=1))
+    done = {}
+
+    def run():
+        done["summary"] = ex.execute_proposals(props)
+
+    th = threading.Thread(target=run)
+    th.start()
+    time.sleep(0.05)
+    ex.stop_execution(forced=True)
+    th.join(timeout=30)
+    assert done["summary"]["stopped"] and done["summary"]["forcedStop"]
+    counts = done["summary"]["taskCounts"]["INTER_BROKER_REPLICA_ACTION"]
+    assert counts.get("DEAD", 0) >= 1               # in-flight dropped, not drained
+    assert counts.get("PENDING", 0) >= 1
+
+
+def test_round_exhaustion_marks_tasks_dead_and_times_out():
+    props = [_proposal("t", 0, [0, 1], [2, 1])]
+    adapter = _adapter_for(props, latency=10_000)
+    ex = Executor(adapter, ExecutorConfig(
+        execution_progress_check_interval_ms=1,
+        max_execution_progress_check_rounds=3,
+        leadership_movement_timeout_rounds=3))
+    summary = ex.execute_proposals(props)
+    assert summary["timedOut"]
+    counts = summary["taskCounts"]["INTER_BROKER_REPLICA_ACTION"]
+    assert counts.get("DEAD", 0) == 1
+    assert counts.get("IN_PROGRESS", 0) == 0        # nothing left dangling
+
+
+def test_intra_broker_phase_runs_inside_execution():
+    class Move:
+        def __init__(self):
+            self.topic, self.partition, self.broker_id = "t", 0, 0
+            self.to_logdir = "/d2"
+
+    props = [_proposal("t", 0, [0, 1], [2, 1])]
+    adapter = _adapter_for(props)
+    ex = Executor(adapter, ExecutorConfig(execution_progress_check_interval_ms=1))
+    summary = ex.execute_proposals(props, logdir_moves=[Move()])
+    assert summary["intraBrokerMoves"] == 1
+    assert adapter.logdir_by_tp_broker[("t-0", 0)] == "/d2"
 
 
 def test_notifier_called():

@@ -105,7 +105,26 @@ def test_aggregator_window_rolling_drops_oldest():
     agg.add_sample(e, 5 * W + 5_000, _sample("t", 0, 0, nw_in=5.0)[2], group="t")
     assert agg.generation > gen0
     r = agg.aggregate(now_ms=6 * W)
-    assert r.completeness.num_valid_windows == 2
+    # honest per-window accounting (MetricSampleCompleteness): of the two
+    # completed windows [4, 5], only window 5 has data → 1 valid window
+    assert r.completeness.num_valid_windows == 1
+    assert list(r.completeness.valid_entity_ratio_per_window) == [0.0, 1.0]
+
+
+def test_aggregator_gap_does_not_alias_stale_windows():
+    """After a sampling gap longer than the buffer, expired slots must not
+    leak old samples into new window indexes (stale-slot aliasing)."""
+    agg = MetricSampleAggregator(num_windows=3, window_ms=W,
+                                 min_samples_per_window=1)
+    e = ("t", 0)
+    for w in range(4):
+        agg.add_sample(e, w * W + 5_000, _sample("t", 0, 0, nw_in=9.0)[2],
+                       group="t")
+    # no samples since; aggregate far in the future: every completed window
+    # in [cur-3, cur) is empty, so nothing may be attributed
+    r = agg.aggregate(now_ms=50 * W)
+    assert r.completeness.num_valid_windows == 0
+    assert r.completeness.num_valid_entities == 0
 
 
 def test_capacity_file_resolver_formats(tmp_path):

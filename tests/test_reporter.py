@@ -86,6 +86,7 @@ def test_main_demo_boots():
                                "failed.brokers.file.path": ""})
     app = build_demo_app(cfg)
     w = cfg.get("partition.metrics.window.ms")
+    app.load_monitor._now = lambda: 6 * w   # clock pinned to the sample times
     for i in range(6):
         app.load_monitor.sample_once(now_ms=i * w + w // 2)
     state = app.state()

@@ -64,6 +64,9 @@ def _app(metadata=None, overrides=None):
     app = CruiseControlApp(cfg, StaticMetadataSource(md),
                            SyntheticLoadSampler(seed=4),
                            cluster_adapter=adapter)
+    # samples carry synthetic timestamps → pin the monitor clock to match
+    # (window aggregation is time-driven; real "now" would expire them)
+    app.load_monitor._now = lambda: 4 * W
     for w in range(4):
         app.load_monitor.sample_once(now_ms=w * W + 30_000)
     return app
@@ -326,6 +329,10 @@ def test_rest_two_step_verification():
     rid = body["reviewResult"]["Id"]
     code, body = api.dispatch("POST", "REVIEW", {"approve": str(rid)})
     assert code == 200
+    # an approval is bound to the endpoint it was reviewed for
+    code, body = api.dispatch("POST", "REMOVE_BROKER",
+                              {"brokerid": "1", "review_id": str(rid)})
+    assert code == 400 and "REBALANCE" in body["errorMessage"]
     code, body = api.dispatch(
         "POST", "REBALANCE",
         {"dryrun": "true", "review_id": str(rid),
