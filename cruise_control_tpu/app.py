@@ -28,7 +28,10 @@ from cruise_control_tpu.detector.anomalies import AnomalyType, SelfHealingNotifi
 from cruise_control_tpu.detector.detectors import (
     AnomalyDetectorService,
     BrokerFailureDetector,
+    DiskFailureDetector,
     GoalViolationDetector,
+    MetricAnomalyDetector,
+    SlowBrokerFinder,
 )
 from cruise_control_tpu.executor.executor import (
     ClusterAdapter,
@@ -93,6 +96,10 @@ class CruiseControlApp:
                 "broker.failure.self.healing.threshold.ms"),
             enabled={t: bool(config.get("self.healing.enabled"))
                      for t in AnomalyType})
+        # the full finder suite the reference schedules
+        # (AnomalyDetector.java:167-180): broker failure, goal violation,
+        # disk failure (adapter logdir state), metric anomaly and slow-broker
+        # (windowed broker metric history from the monitor).
         self.anomaly_detector = AnomalyDetectorService(
             notifier, context=self,
             has_ongoing_execution=lambda: self.executor.has_ongoing_execution,
@@ -105,8 +112,23 @@ class CruiseControlApp:
                     self.load_monitor,
                     goal_names=tuple(config.get("anomaly.detection.goals"))
                 ).detect,
+                "disk_failure": DiskFailureDetector(
+                    adapter.describe_logdirs).detect,
+                "metric_anomaly": MetricAnomalyDetector(
+                    self.load_monitor.broker_metric_history,
+                    metrics=("cpu",),
+                    upper_percentile=config.get(
+                        "metric.anomaly.percentile.upper.threshold"),
+                    lower_percentile=config.get(
+                        "metric.anomaly.percentile.lower.threshold")).detect,
+                "slow_broker": SlowBrokerFinder(
+                    self.load_monitor.broker_metric_history,
+                    score_threshold=config.get("slow.broker.demotion.score"),
+                    removal_threshold=config.get(
+                        "slow.broker.decommission.score")).detect,
             },
-            interval_ms=config.get("anomaly.detection.interval.ms"))
+            interval_ms=config.get("anomaly.detection.interval.ms"),
+            recheck_delay_ms=config.get("anomaly.detection.recheck.delay.ms"))
         self._proposal_cache: Optional[CachedProposals] = None
         self._cache_lock = threading.Lock()
         self._default_requirements = ModelCompletenessRequirements(

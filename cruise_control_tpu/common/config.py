@@ -257,6 +257,19 @@ def _service_config_def() -> ConfigDef:
              I.MEDIUM, "Broker-failure fix delay.")
     d.define("failed.brokers.file.path", T.STRING, "failed_brokers.json",
              I.LOW, "Persisted failed-broker record.")
+    d.define("anomaly.detection.recheck.delay.ms", T.LONG, None, I.LOW,
+             "Delay before re-checking an anomaly deferred by an ongoing "
+             "execution (None = anomaly.detection.interval.ms).")
+    d.define("metric.anomaly.percentile.upper.threshold", T.DOUBLE, 95.0,
+             I.LOW, "Percentile above which a broker metric is anomalous "
+             "(PercentileMetricAnomalyFinder).")
+    d.define("metric.anomaly.percentile.lower.threshold", T.DOUBLE, 2.0,
+             I.LOW, "Percentile below which a broker metric is anomalous.")
+    d.define("slow.broker.demotion.score", T.INT, 3, I.LOW,
+             "Consecutive slow detections before demotion "
+             "(SlowBrokerFinder escalation).")
+    d.define("slow.broker.decommission.score", T.INT, 6, I.LOW,
+             "Consecutive slow detections before removal.")
     # webserver (KafkaCruiseControlMain/WebServerConfig)
     d.define("webserver.http.port", T.INT, 9090, I.HIGH, "REST port.")
     d.define("webserver.http.address", T.STRING, "127.0.0.1", I.HIGH,

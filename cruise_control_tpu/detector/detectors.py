@@ -272,6 +272,10 @@ class _Queued:
     priority: int
     seq: int
     anomaly: Anomaly = dataclasses.field(compare=False)
+    #: earliest handle time; anomalies deferred by an ongoing execution or a
+    #: CHECK verdict re-enter the queue with a future ready_at
+    #: (AnomalyDetector.java:391-404 re-check with delay)
+    ready_at_ms: int = dataclasses.field(compare=False, default=0)
 
 
 class AnomalyDetectorService:
@@ -284,12 +288,16 @@ class AnomalyDetectorService:
                  context: Optional[SelfHealingContext] = None,
                  has_ongoing_execution: Callable[[], bool] = lambda: False,
                  detectors: Optional[Dict[str, Callable[[], object]]] = None,
-                 interval_ms: int = 300_000, now_fn=_now_ms):
+                 interval_ms: int = 300_000,
+                 recheck_delay_ms: Optional[int] = None, now_fn=_now_ms):
         self.notifier = notifier
         self.context = context
         self._has_exec = has_ongoing_execution
         self.detectors = detectors or {}
         self.interval_ms = interval_ms
+        #: how long a deferred anomaly waits before its re-check
+        self.recheck_delay_ms = (recheck_delay_ms if recheck_delay_ms is not None
+                                 else interval_ms)
         self._queue: List[_Queued] = []
         self._seq = 0
         self._lock = threading.RLock()
@@ -301,8 +309,24 @@ class AnomalyDetectorService:
                         "fixes_failed": 0, "ignored": 0, "checks": 0}
 
     # -- queue --
+    @staticmethod
+    def _same_target(a: Anomaly, b: Anomaly) -> bool:
+        if isinstance(a, MetricAnomaly) and isinstance(b, MetricAnomaly):
+            return a.broker_id == b.broker_id and a.metric == b.metric
+        return True
+
     def enqueue(self, anomaly: Anomaly):
         with self._lock:
+            # A fresh detection supersedes a queued/deferred anomaly of the
+            # same kind — detector payloads carry the full current state
+            # (e.g. failed_brokers_by_time), so the newest wins and the queue
+            # can't accumulate one entry per sweep for a persistent condition.
+            before = len(self._queue)
+            self._queue = [q for q in self._queue
+                           if not (type(q.anomaly) is type(anomaly)
+                                   and self._same_target(q.anomaly, anomaly))]
+            if len(self._queue) != before:
+                heapq.heapify(self._queue)
             heapq.heappush(self._queue, _Queued(
                 anomaly.anomaly_type.priority, self._seq, anomaly))
             self._seq += 1
@@ -324,18 +348,31 @@ class AnomalyDetectorService:
         return n
 
     def handle_pending(self) -> int:
-        """Drain the queue through the notifier (AnomalyHandlerTask)."""
+        """Drain the ready queue through the notifier (AnomalyHandlerTask).
+
+        Anomalies arriving while an execution is in progress are NOT dropped:
+        they re-enter the queue with a delayed ``ready_at_ms`` and are
+        re-checked once the delay elapses (AnomalyDetector.java:391-404).
+        CHECK verdicts requeue the anomaly with the notifier's delay.
+        """
         handled = 0
+        now = self._now()
+        deferred: List[_Queued] = []
         while True:
             with self._lock:
                 if not self._queue:
                     break
                 item = heapq.heappop(self._queue)
             a = item.anomaly
+            if item.ready_at_ms > now:
+                deferred.append(item)     # not due yet — hold for re-push
+                continue
             if self._has_exec():
                 self.metrics["checks"] += 1
                 self.history.append({"anomaly": a.summary(),
                                      "action": "DELAYED_ONGOING_EXECUTION"})
+                deferred.append(dataclasses.replace(
+                    item, ready_at_ms=now + self.recheck_delay_ms))
                 continue
             result = self.notifier.on_anomaly(a)
             record = {"anomaly": a.summary(), "action": result.action.value}
@@ -351,8 +388,14 @@ class AnomalyDetectorService:
                 self.metrics["ignored"] += 1
             else:
                 self.metrics["checks"] += 1
+                if result.delay_ms > 0:   # CHECK with delay → re-check later
+                    deferred.append(dataclasses.replace(
+                        item, ready_at_ms=now + result.delay_ms))
             self.history.append(record)
             handled += 1
+        with self._lock:
+            for item in deferred:
+                heapq.heappush(self._queue, item)
         return handled
 
     # -- service loop --

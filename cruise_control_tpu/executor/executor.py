@@ -84,6 +84,11 @@ class ClusterAdapter:
     def dead_brokers(self) -> Set[int]:
         return set()
 
+    def describe_logdirs(self) -> Dict[int, Dict[str, bool]]:
+        """Logdir liveness per broker (AdminClient describeLogDirs — the
+        DiskFailureDetector.java:35-85 seam): {broker_id: {logdir: alive}}."""
+        return {}
+
     def alter_replica_logdirs(self, moves) -> None:
         """Apply intra-broker logdir moves (AdminClient alterReplicaLogDirs,
         Executor.java:995 seam)."""
@@ -106,6 +111,7 @@ class FakeClusterAdapter(ClusterAdapter):
         self.broker_throttle_rates: Dict[int, int] = {}
         self.topic_throttled_replicas: Dict[str, Dict[str, Tuple[str, ...]]] = {}
         self._dead: Set[int] = set()
+        self.logdir_state: Dict[int, Dict[str, bool]] = {}
 
     # -- adapter API --
     def execute_replica_reassignments(self, tasks):
@@ -151,6 +157,12 @@ class FakeClusterAdapter(ClusterAdapter):
 
     def kill_broker(self, broker_id: int):
         self._dead.add(broker_id)
+
+    def describe_logdirs(self):
+        return {b: dict(dirs) for b, dirs in self.logdir_state.items()}
+
+    def fail_disk(self, broker_id: int, logdir: str):
+        self.logdir_state.setdefault(int(broker_id), {})[logdir] = False
 
     def alter_replica_logdirs(self, moves):
         for m in moves:

@@ -216,31 +216,48 @@ class KafkaClusterAdapter:
         return {f"{t}-{p}" for (t, p) in out}
 
     # Dynamic-config sources in DescribeConfigs responses (Kafka protocol
-    # ConfigSource): 1 = DYNAMIC_TOPIC_CONFIG, 4 = DYNAMIC_BROKER_CONFIG.
-    _DYNAMIC_SOURCES = (1, 4)
+    # ConfigSource): 1 = TOPIC_CONFIG (a topic's dynamic override),
+    # 2 = DYNAMIC_BROKER_CONFIG. 3/4/5 are default/static sources that must
+    # NOT be re-written as dynamic overrides.
+    _DYNAMIC_SOURCES = (1, 2)
 
-    def _current_dynamic_configs(self, resource) -> Dict[str, str]:
-        """Read a resource's current *dynamic* config overrides."""
-        out: Dict[str, str] = {}
-        try:
-            responses = self._admin.describe_configs(
-                config_resources=[resource])
-            for resp in responses:
-                for res_entry in resp.resources:
-                    # (error_code, error_message, type, name, config_entries)
-                    for entry in res_entry[4]:
-                        name, value = entry[0], entry[1]
-                        source = entry[3] if len(entry) > 3 else None
-                        if source in self._DYNAMIC_SOURCES and value is not None:
-                            out[name] = value
-        except Exception:
-            # best effort: an unreadable config means we merge with nothing
-            pass
+    @classmethod
+    def _entry_is_dynamic(cls, entry) -> bool:
+        """True when a DescribeConfigs entry is a dynamic override.
+
+        v1+ responses carry config_source (int); v0 responses carry
+        is_default (bool) in the same tuple slot — a bool would otherwise
+        compare equal to source code 1.
+        """
+        source = entry[3] if len(entry) > 3 else None
+        if isinstance(source, bool):       # v0: non-default ⇒ an override
+            return not source
+        return source in cls._DYNAMIC_SOURCES
+
+    def _current_dynamic_configs(self, resources) -> Dict[Tuple[int, str], Dict[str, str]]:
+        """Current *dynamic* overrides for many resources in one
+        DescribeConfigs RPC, keyed by (resource_type, name).
+
+        Errors propagate: with replace-semantics AlterConfigs, merging with
+        an empty read would silently wipe unrelated dynamic settings, so an
+        unreadable config must abort the update instead.
+        """
+        out: Dict[Tuple[int, str], Dict[str, str]] = {}
+        responses = self._admin.describe_configs(config_resources=list(resources))
+        for resp in responses:
+            for res_entry in resp.resources:
+                # (error_code, error_message, type, name, config_entries)
+                rkey = (int(res_entry[2]), str(res_entry[3]))
+                cfgs = out.setdefault(rkey, {})
+                for entry in res_entry[4]:
+                    name, value = entry[0], entry[1]
+                    if self._entry_is_dynamic(entry) and value is not None:
+                        cfgs[name] = value
         return out
 
     def _alter_configs_batch(self, updates) -> None:
         """Apply config updates (list of ("broker"|"topic", name, {k: v}));
-        one AlterConfigs RPC for all resources.
+        one DescribeConfigs + one AlterConfigs RPC for all resources.
 
         kafka-python only exposes the legacy AlterConfigs, which REPLACES a
         resource's whole dynamic config — so merge with the current dynamic
@@ -249,20 +266,25 @@ class KafkaClusterAdapter:
         path). An empty-string value deletes the key.
         """
         from kafka.admin import ConfigResource, ConfigResourceType
-        resources = []
+        if not updates:
+            return
+        wanted = []
         for resource_type, name, configs in updates:
             rtype = (ConfigResourceType.BROKER if resource_type == "broker"
                      else ConfigResourceType.TOPIC)
-            merged = self._current_dynamic_configs(
-                ConfigResource(rtype, name))
+            wanted.append((rtype, str(name), configs))
+        current = self._current_dynamic_configs(
+            [ConfigResource(rtype, name) for rtype, name, _ in wanted])
+        resources = []
+        for rtype, name, configs in wanted:
+            merged = dict(current.get((int(rtype.value), name), {}))
             for k, v in configs.items():
                 if v == "":
                     merged.pop(k, None)
                 else:
                     merged[k] = v
             resources.append(ConfigResource(rtype, name, configs=merged))
-        if resources:
-            self._admin.alter_configs(resources)
+        self._admin.alter_configs(resources)
 
     def set_broker_throttle_rate(self, broker_ids, rate):
         self._alter_configs_batch([
@@ -292,6 +314,21 @@ class KafkaClusterAdapter:
 
     def dead_brokers(self) -> Set[int]:
         return set()
+
+    def describe_logdirs(self) -> Dict[int, Dict[str, bool]]:
+        """Logdir liveness via AdminClient describeLogDirs
+        (DiskFailureDetector.java:35-85). kafka-python returns
+        {broker: {logdir: {"error_code": int, ...}}}; error 0 = alive."""
+        out: Dict[int, Dict[str, bool]] = {}
+        try:
+            described = self._admin.describe_log_dirs()
+        except Exception:
+            return out
+        for broker, dirs in (described or {}).items():
+            out[int(broker)] = {
+                str(d): int(info.get("error_code", 0)) == 0
+                for d, info in dirs.items()}
+        return out
 
     def alter_replica_logdirs(self, moves):
         self._admin.alter_replica_log_dirs(

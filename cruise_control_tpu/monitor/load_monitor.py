@@ -106,13 +106,14 @@ class LoadMonitor:
             num_windows=num_windows, window_ms=window_ms,
             min_samples_per_window=min_samples_per_window,
             max_allowed_extrapolations=max_allowed_extrapolations)
-        # broker aggregator reuses the same engine; metrics: cpu/lbi/lbo/rbi/rbo
+        # broker aggregator reuses the same engine; metrics:
+        # cpu/lbi/lbo/rbi/rbo/log-flush-time (the last feeds SlowBrokerFinder)
         self.broker_aggregator = MetricSampleAggregator(
             num_windows=num_windows, window_ms=window_ms,
             min_samples_per_window=min_samples_per_window,
             max_allowed_extrapolations=max_allowed_extrapolations,
-            num_metrics=5,
-            strategies=[md.Strategy.AVG] * 5)
+            num_metrics=6,
+            strategies=[md.Strategy.AVG] * 6)
         self.window_ms = window_ms
         self.sampling_interval_ms = sampling_interval_ms
         self._state = MonitorState.NOT_STARTED
@@ -204,8 +205,31 @@ class LoadMonitor:
 
     def _ingest_broker_sample(self, s):
         vec = np.array([s.cpu_util, s.leader_bytes_in, s.leader_bytes_out,
-                        s.replication_bytes_in, s.replication_bytes_out])
+                        s.replication_bytes_in, s.replication_bytes_out,
+                        s.extra.get("log_flush_time_ms", np.nan)])
         self.broker_aggregator.add_sample(s.broker_id, s.time_ms, vec)
+
+    def broker_metric_history(self, now_ms: Optional[int] = None
+                              ) -> Dict[int, Dict[str, np.ndarray]]:
+        """Windowed per-broker metric series for the metric-anomaly and
+        slow-broker finders (the reference reads the same history out of
+        ``KafkaPartitionMetricSampleAggregator``'s broker twin:
+        ``MetricAnomalyDetector.java:29-72``, ``SlowBrokerFinder.java:38-77``).
+
+        Returns ``{broker_id: {"cpu", "bytes_in", "flush_time": f64[W]}}``
+        with windows oldest-first; the newest window is each series' tail.
+        """
+        now_ms = now_ms or self._now()
+        result = self.broker_aggregator.aggregate(now_ms)
+        out: Dict[int, Dict[str, np.ndarray]] = {}
+        for i, broker in enumerate(result.entities):
+            v = result.values[i]                  # [W, 6]
+            out[int(broker)] = {
+                "cpu": v[:, 0],
+                "bytes_in": v[:, 1] + v[:, 3],    # leader + replication in
+                "flush_time": v[:, 5],
+            }
+        return out
 
     def sample_once(self, now_ms: Optional[int] = None) -> int:
         """One sampling pass (SamplingTask body); returns samples ingested."""

@@ -227,3 +227,131 @@ def test_detector_service_delays_during_execution():
     svc.handle_pending()
     assert ctx.calls == []
     assert svc.history[-1]["action"] == "DELAYED_ONGOING_EXECUTION"
+
+
+def test_anomaly_requeued_and_rechecked_after_execution():
+    """Anomalies deferred by an ongoing execution are re-queued with a delay
+    and handled once it finishes (AnomalyDetector.java:391-404), not dropped."""
+    clock = FakeTime(1_000_000)
+    notifier = SelfHealingNotifier(enabled={t: True for t in AnomalyType},
+                                   now_fn=clock)
+    ctx = _Ctx()
+    executing = {"on": True}
+    svc = AnomalyDetectorService(
+        notifier, context=ctx, has_ongoing_execution=lambda: executing["on"],
+        detectors={}, recheck_delay_ms=10_000, now_fn=clock)
+    svc.enqueue(GoalViolations(AnomalyType.GOAL_VIOLATION, 0,
+                               fixable_violated_goals=["RackAwareGoal"]))
+    svc.handle_pending()
+    assert ctx.calls == []
+    assert svc.history[-1]["action"] == "DELAYED_ONGOING_EXECUTION"
+    # execution still running at the re-check: deferred again
+    clock.t += 10_001
+    svc.handle_pending()
+    assert ctx.calls == []
+    # execution done but delay not yet elapsed: stays queued, no action
+    executing["on"] = False
+    svc.handle_pending()
+    assert ctx.calls == []
+    clock.t += 10_001
+    assert svc.handle_pending() == 1
+    assert ctx.calls == ["rebalance"]
+
+
+def test_enqueue_dedupes_persistent_condition():
+    clock = FakeTime(1_000_000)
+    notifier = SelfHealingNotifier(now_fn=clock)
+    svc = AnomalyDetectorService(notifier, detectors={}, now_fn=clock)
+    for i in range(5):   # the same condition re-detected every sweep
+        svc.enqueue(GoalViolations(AnomalyType.GOAL_VIOLATION, i,
+                                   fixable_violated_goals=["RackAwareGoal"]))
+    assert len(svc._queue) == 1
+    assert svc._queue[0].anomaly.detection_time_ms == 4
+
+
+def _service_app(overrides=None):
+    """Full app with self-healing on; returns (app, adapter)."""
+    from cruise_control_tpu.app import CruiseControlApp
+    from cruise_control_tpu.common.config import CruiseControlConfig
+    from cruise_control_tpu.executor.executor import FakeClusterAdapter
+    md = _metadata()
+    cfg = CruiseControlConfig({
+        "optimizer.engine": "greedy",
+        "partition.metrics.window.ms": W,
+        "num.partition.metrics.windows": 3,
+        "min.valid.partition.ratio": 0.0,
+        "execution.progress.check.interval.ms": 1,
+        "failed.brokers.file.path": "",
+        "self.healing.enabled": True,
+        **(overrides or {})})
+    adapter = FakeClusterAdapter(
+        {f"{p.topic}-{p.partition}": tuple(p.replicas)
+         for p in md.partitions}, latency_polls=1)
+    app = CruiseControlApp(cfg, StaticMetadataSource(md),
+                           SyntheticLoadSampler(seed=7),
+                           cluster_adapter=adapter)
+    app.load_monitor._now = lambda: 4 * W
+    for w in range(4):
+        app.load_monitor.sample_once(now_ms=w * W + 30_000)
+    return app, adapter
+
+
+def test_disk_failure_detected_and_fixed_end_to_end():
+    """Kill a disk in the fake cluster → DiskFailureDetector (wired through
+    the adapter's describe_logdirs) → notifier FIX → fix_offline_replicas
+    executes through the executor."""
+    app, adapter = _service_app()
+    adapter.fail_disk(0, "/data1")
+    n = app.anomaly_detector.sweep()
+    assert n >= 1
+    kinds = {q.anomaly.anomaly_type for q in app.anomaly_detector._queue}
+    assert AnomalyType.DISK_FAILURE in kinds
+    app.anomaly_detector.handle_pending()
+    fixed = [h for h in app.anomaly_detector.history
+             if h["anomaly"]["type"] == "DISK_FAILURE"]
+    assert fixed and fixed[-1]["action"] == "FIX"
+    assert app.anomaly_detector.metrics["fixes_triggered"] >= 1
+
+
+def test_slow_broker_detected_through_monitor_history():
+    """Slow a broker in the monitor's broker-sample stream → SlowBrokerFinder
+    (wired on load_monitor.broker_metric_history) detects and escalates."""
+    from cruise_control_tpu.monitor.sampler import BrokerMetricSample
+    app, adapter = _service_app({"num.partition.metrics.windows": 8,
+                                 "slow.broker.demotion.score": 3})
+    finder = app.anomaly_detector.detectors["slow_broker"]
+    # broker 3's log-flush time escalates while peers stay flat; the finder
+    # needs >= 3 completed windows of own history and 3 consecutive slow
+    # detections before it reports (score_threshold)
+    t0 = 4 * W
+    windows = []
+    for w in range(8):
+        now = t0 + w * W
+        app.load_monitor._now = lambda now=now: now + W
+        for b in range(4):
+            flush = 10.0 if (b != 3 or w < 3) else 10.0 * 4.0 ** (w - 2)
+            app.load_monitor._ingest_broker_sample(BrokerMetricSample(
+                broker_id=b, time_ms=now + 1000, cpu_util=20.0,
+                leader_bytes_in=1000.0,
+                extra={"log_flush_time_ms": flush}))
+        windows.append(finder())
+    found = [a for a in windows if a is not None]
+    assert found, "slow broker never detected"
+    assert 3 in found[-1].slow_brokers_by_time
+
+
+def test_metric_anomaly_detected_through_monitor_history():
+    from cruise_control_tpu.monitor.sampler import BrokerMetricSample
+    app, adapter = _service_app({"num.partition.metrics.windows": 8})
+    finder = app.anomaly_detector.detectors["metric_anomaly"]
+    t0 = 4 * W
+    for w in range(8):
+        now = t0 + w * W
+        app.load_monitor._now = lambda now=now: now + W
+        for b in range(4):
+            spike = b == 1 and w == 7
+            app.load_monitor._ingest_broker_sample(BrokerMetricSample(
+                broker_id=b, time_ms=now + 1000,
+                cpu_util=95.0 if spike else 20.0, leader_bytes_in=1000.0))
+    found = finder()
+    assert any(a.broker_id == 1 and a.metric == "cpu" for a in found)
