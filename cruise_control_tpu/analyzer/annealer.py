@@ -75,7 +75,11 @@ class ChainState(NamedTuple):
     potential_nw_out: jax.Array  # f32[B]
     leader_bytes_in: jax.Array   # f32[B]
     topic_count: jax.Array       # f32[B,T] or f32[1,1] when disabled
-    energy: jax.Array            # f32 — incremental objective estimate
+    #: f32[2] — incremental (violation, cost) channel totals. Kept as two
+    #: channels because the combined scalar exceeds f32 precision (a single
+    #: hard violation at 2^40·2^20 absorbs every cost digit); deltas combine
+    #: fine, totals must not.
+    energy: jax.Array
 
 
 class AnnealResult(NamedTuple):
@@ -90,22 +94,26 @@ _band_cost = G.band_cost
 def _chain_energy(dt: DeviceTopology, th: G.GoalThresholds,
                   w: OBJ.ObjectiveWeights, st: ChainState,
                   initial_broker_of: jax.Array, use_topic: bool) -> jax.Array:
-    """Decomposed objective from the running aggregates (init/rescore)."""
+    """Decomposed two-channel objective from the running aggregates
+    (init/rescore); returns f32[2] = (violation, cost) channel totals."""
     f = OBJ.broker_cost(th, w, st.broker_load, st.replica_count,
-                        st.leader_count, st.potential_nw_out, st.leader_bytes_in)
-    h = OBJ.host_cost(th, w, st.host_load)
-    e = jnp.sum(f) + jnp.sum(h)
+                        st.leader_count, st.potential_nw_out,
+                        st.leader_bytes_in)                     # [B, 2]
+    h = OBJ.host_cost(th, w, st.host_load)                      # [H, 2]
+    e2 = jnp.sum(f, axis=0) + jnp.sum(h, axis=0)                # [2]
     from cruise_control_tpu.ops.aggregates import partition_rack_excess
-    e = e + w.rack * jnp.sum(partition_rack_excess(dt, st.broker_of))
+    rack_n = jnp.sum(partition_rack_excess(dt, st.broker_of))
+    e2 = e2 + jnp.stack([w.rack_viol, w.rack]) * rack_n
     if use_topic:
         alive_f = th.alive.astype(jnp.float32)[:, None]
         out = (_band_cost(st.topic_count, th.topic_upper[None, :],
                           th.topic_lower[None, :]) * alive_f)
-        e = e + w.topic * jnp.sum(out)
+        e2 = e2 + jnp.stack([w.topic_viol * jnp.sum((out > 0).astype(jnp.float32)),
+                             w.topic * jnp.sum(out)])
     unhealed = jnp.sum((dt.replica_offline
                         & (st.broker_of == initial_broker_of)
                         & dt.broker_alive[st.broker_of]).astype(jnp.float32))
-    return e + w.healing * unhealed
+    return e2 + jnp.stack([w.healing_viol, w.healing]) * unhealed
 
 
 def _move_delta(dt: DeviceTopology, th: G.GoalThresholds, w: OBJ.ObjectiveWeights,
@@ -127,7 +135,7 @@ def _move_delta(dt: DeviceTopology, th: G.GoalThresholds, w: OBJ.ObjectiveWeight
     th_ab = OBJ.gather_thresholds(th, ab)
     f0 = OBJ.broker_cost(th_ab, w, st.broker_load[ab], st.replica_count[ab],
                          st.leader_count[ab], st.potential_nw_out[ab],
-                         st.leader_bytes_in[ab])
+                         st.leader_bytes_in[ab])                # [2, 2ch]
     sgn = jnp.array([-1.0, 1.0])
     f1 = OBJ.broker_cost(
         th_ab, w,
@@ -137,14 +145,14 @@ def _move_delta(dt: DeviceTopology, th: G.GoalThresholds, w: OBJ.ObjectiveWeight
         st.potential_nw_out[ab] + sgn * pl,
         st.leader_bytes_in[ab] + sgn * lbi,
     )
-    delta = jnp.sum(f1 - f0)
+    d2 = jnp.sum(f1 - f0, axis=0)                               # [2]
 
     ha, hb = dt.host_of_broker[a], dt.host_of_broker[b]
     hab = jnp.stack([ha, hb])
     th_h = OBJ.gather_host_thresholds(th, hab)
     h0 = OBJ.host_cost(th_h, w, st.host_load[hab])
     h1 = OBJ.host_cost(th_h, w, st.host_load[hab] + sgn[:, None] * eff[None, :])
-    delta = delta + jnp.where(ha != hb, jnp.sum(h1 - h0), 0.0)
+    d2 = d2 + jnp.where(ha != hb, jnp.sum(h1 - h0, axis=0), 0.0)
 
     # rack: Δexcess = occ(dest rack) − occ(src rack) over the *other* replicas
     reps = dt.replicas_of_partition[p]                      # [m]
@@ -152,25 +160,30 @@ def _move_delta(dt: DeviceTopology, th: G.GoalThresholds, w: OBJ.ObjectiveWeight
     sib_rack = dt.rack_of_broker[st.broker_of[jnp.clip(reps, 0)]]
     occ_a = jnp.any(valid_sib & (sib_rack == dt.rack_of_broker[a]))
     occ_b = jnp.any(valid_sib & (sib_rack == dt.rack_of_broker[b]))
-    delta = delta + w.rack * (occ_b.astype(jnp.float32) - occ_a.astype(jnp.float32))
+    d_rack = occ_b.astype(jnp.float32) - occ_a.astype(jnp.float32)
+    d2 = d2 + jnp.stack([w.rack_viol, w.rack]) * d_rack
 
     if use_topic:
         t = dt.topic_of_partition[p]
         n_a, n_b = st.topic_count[a, t], st.topic_count[b, t]
         u, l = th.topic_upper[t], th.topic_lower[t]
-        delta = delta + w.topic * (
-            _band_cost(n_a - 1.0, u, l) - _band_cost(n_a, u, l)
-            + _band_cost(n_b + 1.0, u, l) - _band_cost(n_b, u, l))
+        dc_t = (_band_cost(n_a - 1.0, u, l) - _band_cost(n_a, u, l)
+                + _band_cost(n_b + 1.0, u, l) - _band_cost(n_b, u, l))
+        vi = lambda n, uu, ll: (_band_cost(n, uu, ll) > 0).astype(jnp.float32)
+        dv_t = (vi(n_a - 1.0, u, l) - vi(n_a, u, l)
+                + vi(n_b + 1.0, u, l) - vi(n_b, u, l))
+        d2 = d2 + jnp.stack([w.topic_viol * dv_t, w.topic * dc_t])
 
     on_init = a == initial_broker_of[r]
     heals = dt.replica_offline[r] & on_init & dt.broker_alive[a]
     back = dt.replica_offline[r] & (b == initial_broker_of[r])
-    delta = delta + w.healing * (back.astype(jnp.float32) - heals.astype(jnp.float32))
+    d_heal = back.astype(jnp.float32) - heals.astype(jnp.float32)
+    d2 = d2 + jnp.stack([w.healing_viol, w.healing]) * d_heal
 
     # legality: no duplicate replica of p on b; eligible dest; movable replica
     sib_on_b = jnp.any(valid_sib & (st.broker_of[jnp.clip(reps, 0)] == b))
     ok = (opts.replica_movable[r] & opts.move_dest_ok[b] & (b != a) & ~sib_on_b)
-    return jnp.where(ok, delta, _INF)
+    return jnp.where(ok, d2, _INF)
 
 
 def _lead_delta(dt: DeviceTopology, th: G.GoalThresholds, w: OBJ.ObjectiveWeights,
@@ -193,7 +206,7 @@ def _lead_delta(dt: DeviceTopology, th: G.GoalThresholds, w: OBJ.ObjectiveWeight
     sgn = ((mem_b == b).astype(jnp.float32) - (mem_b == a).astype(jnp.float32))
     f0 = OBJ.broker_cost(th_m, w, st.broker_load[mem_b], st.replica_count[mem_b],
                          st.leader_count[mem_b], st.potential_nw_out[mem_b],
-                         st.leader_bytes_in[mem_b])
+                         st.leader_bytes_in[mem_b])             # [m, 2]
     f1 = OBJ.broker_cost(
         th_m, w,
         st.broker_load[mem_b] + sgn[:, None] * extra[None, :],
@@ -202,7 +215,7 @@ def _lead_delta(dt: DeviceTopology, th: G.GoalThresholds, w: OBJ.ObjectiveWeight
         st.potential_nw_out[mem_b] + d_pl,
         st.leader_bytes_in[mem_b] + sgn * lbi,
     )
-    delta = jnp.sum(jnp.where(valid, f1 - f0, 0.0))
+    d2 = jnp.sum(jnp.where(valid[:, None], f1 - f0, 0.0), axis=0)   # [2]
 
     ha, hb = dt.host_of_broker[a], dt.host_of_broker[b]
     hab = jnp.stack([ha, hb])
@@ -210,17 +223,17 @@ def _lead_delta(dt: DeviceTopology, th: G.GoalThresholds, w: OBJ.ObjectiveWeight
     sgn_h = jnp.array([-1.0, 1.0])
     h0 = OBJ.host_cost(th_h, w, st.host_load[hab])
     h1 = OBJ.host_cost(th_h, w, st.host_load[hab] + sgn_h[:, None] * extra[None, :])
-    delta = delta + jnp.where(ha != hb, jnp.sum(h1 - h0), 0.0)
+    d2 = d2 + jnp.where(ha != hb, jnp.sum(h1 - h0, axis=0), 0.0)
 
     first = reps[0]
-    d_ple = w.preferred_leader * ((cur == first).astype(jnp.float32)
-                                  - (cand == first).astype(jnp.float32))
-    delta = delta + d_ple
+    d_ple = ((cur == first).astype(jnp.float32)
+             - (cand == first).astype(jnp.float32))
+    d2 = d2 + jnp.stack([w.preferred_leader_viol, w.preferred_leader]) * d_ple
 
     ok = (valid[slot] & (cand != cur)
           & opts.leader_dest_ok[b] & opts.leadership_movable[jnp.clip(cand, 0)]
           & ~dt.replica_offline[jnp.clip(cand, 0)] & dt.broker_alive[b])
-    return jnp.where(ok, delta, _INF)
+    return jnp.where(ok, d2, _INF)
 
 
 def _swap_delta(dt: DeviceTopology, th: G.GoalThresholds, w: OBJ.ObjectiveWeights,
@@ -255,7 +268,7 @@ def _swap_delta(dt: DeviceTopology, th: G.GoalThresholds, w: OBJ.ObjectiveWeight
     th_ab = OBJ.gather_thresholds(th, ab)
     f0 = OBJ.broker_cost(th_ab, w, st.broker_load[ab], st.replica_count[ab],
                          st.leader_count[ab], st.potential_nw_out[ab],
-                         st.leader_bytes_in[ab])
+                         st.leader_bytes_in[ab])                # [2, 2ch]
     f1 = OBJ.broker_cost(
         th_ab, w,
         st.broker_load[ab] + sgn[:, None] * de[None, :],
@@ -264,14 +277,14 @@ def _swap_delta(dt: DeviceTopology, th: G.GoalThresholds, w: OBJ.ObjectiveWeight
         st.potential_nw_out[ab] + sgn * dpl,
         st.leader_bytes_in[ab] + sgn * dlbi,
     )
-    delta = jnp.sum(f1 - f0)
+    d2 = jnp.sum(f1 - f0, axis=0)                               # [2]
 
     ha, hb = dt.host_of_broker[a], dt.host_of_broker[b]
     hab = jnp.stack([ha, hb])
     th_h = OBJ.gather_host_thresholds(th, hab)
     h0 = OBJ.host_cost(th_h, w, st.host_load[hab])
     h1 = OBJ.host_cost(th_h, w, st.host_load[hab] + sgn[:, None] * de[None, :])
-    delta = delta + jnp.where(ha != hb, jnp.sum(h1 - h0), 0.0)
+    d2 = d2 + jnp.where(ha != hb, jnp.sum(h1 - h0, axis=0), 0.0)
 
     # rack deltas, one per partition
     def rack_delta(rr, pp, src_b, dst_b):
@@ -282,7 +295,8 @@ def _swap_delta(dt: DeviceTopology, th: G.GoalThresholds, w: OBJ.ObjectiveWeight
         occ_d = jnp.any(valid_sib & (sib_rack == dt.rack_of_broker[dst_b]))
         return occ_d.astype(jnp.float32) - occ_s.astype(jnp.float32)
 
-    delta = delta + w.rack * (rack_delta(r1, p1, a, b) + rack_delta(r2, p2, b, a))
+    d_rack = rack_delta(r1, p1, a, b) + rack_delta(r2, p2, b, a)
+    d2 = d2 + jnp.stack([w.rack_viol, w.rack]) * d_rack
 
     if use_topic:
         t1 = dt.topic_of_partition[p1]
@@ -291,13 +305,17 @@ def _swap_delta(dt: DeviceTopology, th: G.GoalThresholds, w: OBJ.ObjectiveWeight
         def topic_delta(t, frm, to):
             n_f, n_t = st.topic_count[frm, t], st.topic_count[to, t]
             u, l = th.topic_upper[t], th.topic_lower[t]
-            return (_band_cost(n_f - 1.0, u, l) - _band_cost(n_f, u, l)
-                    + _band_cost(n_t + 1.0, u, l) - _band_cost(n_t, u, l))
+            vi = lambda n: (_band_cost(n, u, l) > 0).astype(jnp.float32)
+            dc = (_band_cost(n_f - 1.0, u, l) - _band_cost(n_f, u, l)
+                  + _band_cost(n_t + 1.0, u, l) - _band_cost(n_t, u, l))
+            dv = (vi(n_f - 1.0) - vi(n_f) + vi(n_t + 1.0) - vi(n_t))
+            return jnp.stack([dv, dc])
 
         same_topic = t1 == t2
-        delta = delta + jnp.where(
+        d2 = d2 + jnp.where(
             same_topic, 0.0,
-            w.topic * (topic_delta(t1, a, b) + topic_delta(t2, b, a)))
+            jnp.stack([w.topic_viol, w.topic])
+            * (topic_delta(t1, a, b) + topic_delta(t2, b, a)))
 
     def heal_delta(rr, src_b, dst_b):
         on_init = src_b == initial_broker_of[rr]
@@ -305,7 +323,8 @@ def _swap_delta(dt: DeviceTopology, th: G.GoalThresholds, w: OBJ.ObjectiveWeight
         back = dt.replica_offline[rr] & (dst_b == initial_broker_of[rr])
         return back.astype(jnp.float32) - heals.astype(jnp.float32)
 
-    delta = delta + w.healing * (heal_delta(r1, a, b) + heal_delta(r2, b, a))
+    d2 = d2 + (jnp.stack([w.healing_viol, w.healing])
+               * (heal_delta(r1, a, b) + heal_delta(r2, b, a)))
 
     def sib_on(rr, pp, broker):
         reps = dt.replicas_of_partition[pp]
@@ -316,7 +335,7 @@ def _swap_delta(dt: DeviceTopology, th: G.GoalThresholds, w: OBJ.ObjectiveWeight
           & opts.move_dest_ok[a] & opts.move_dest_ok[b]
           & (a != b) & (p1 != p2)
           & ~sib_on(r1, p1, b) & ~sib_on(r2, p2, a))
-    return jnp.where(ok, delta, _INF)
+    return jnp.where(ok, d2, _INF)
 
 
 def _apply_moves(dt: DeviceTopology, st: ChainState, r_vec, b_vec,
@@ -441,7 +460,8 @@ def make_step_fn(dt: DeviceTopology, th, weights, opts, cfg: AnnealConfig,
         # exactly additive deltas. Conservative rule: in delta-sorted order a
         # proposal survives only if it conflicts with NO earlier candidate.
         K = Km + Kl + Ks
-        deltas = jnp.concatenate([d_move, d_lead, d_swap])
+        deltas2 = jnp.concatenate([d_move, d_lead, d_swap])       # [K, 2]
+        deltas = OBJ.combine(deltas2)   # ordering/acceptance scalar
         mm = max(m, 2)
 
         def padset(x, width=mm):   # pad id-set rows to a common width with -1
@@ -510,7 +530,8 @@ def make_step_fn(dt: DeviceTopology, th, weights, opts, cfg: AnnealConfig,
 
         st = _apply_moves(dt, st, all_r, all_b, use_topic)
         st = _apply_leads(dt, st, p_c, new_leader)
-        st = st._replace(energy=st.energy + jnp.sum(jnp.where(accept, deltas, 0.0)))
+        st = st._replace(energy=st.energy + jnp.sum(
+            jnp.where(accept[:, None], deltas2, 0.0), axis=0))
         return st
 
     return step
@@ -555,10 +576,9 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
         leader_bytes_in=agg.leader_bytes_in,
         topic_count=(agg.topic_count.astype(jnp.float32) if use_topic
                      else jnp.zeros((1, 1), jnp.float32)),
-        energy=jnp.float32(0.0),
+        energy=jnp.zeros((2,), jnp.float32),
     )
-    e0 = jax.jit(_chain_energy, static_argnames=("use_topic",))(
-        dt, th, weights, base, initial_broker_of, use_topic)
+    e0 = _chain_energy_jit(dt, th, weights, base, initial_broker_of, use_topic)
     base = base._replace(energy=e0)
     chains = jax.tree.map(lambda x: jnp.broadcast_to(x, (C,) + x.shape), base)
 
@@ -569,43 +589,6 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
         np.geomspace(cfg.t_min, cfg.t_max, max(C - n_cold, 1)).astype(np.float32)[:C - n_cold],
     ])[:C]
     temps0 = jnp.asarray(ladder)
-
-    step = make_step_fn(dt, th, weights, opts, cfg, movable_idx,
-                        dest_idx, initial_broker_of, use_topic)
-
-    def chain_round(st: ChainState, temp, key):
-        keys = jax.random.split(key, cfg.swap_interval)
-
-        def body(s, k):
-            return step(s, temp, k), None
-
-        st, _ = jax.lax.scan(body, st, keys)
-        return st
-
-    def pt_round(carry, inp):
-        chains, temps = carry
-        rnd, key = inp
-        kc = jax.random.split(jax.random.fold_in(key, 1), C)
-        chains = jax.vmap(chain_round, in_axes=(0, 0, 0))(chains, temps, kc)
-        # temperature swap between ladder-adjacent chains (even/odd alternation)
-        order = jnp.argsort(temps)
-        e_sorted = chains.energy[order]
-        t_sorted = temps[order]
-        off = rnd % 2
-        i = jnp.arange(C)
-        partner = jnp.where((i - off) % 2 == 0, i + 1, i - 1)
-        partner = jnp.clip(partner, 0, C - 1)
-        d_swap = ((e_sorted - e_sorted[partner])
-                  * (1.0 / jnp.maximum(t_sorted, 1e-9)
-                     - 1.0 / jnp.maximum(t_sorted[partner], 1e-9)))
-        u = jax.random.uniform(jax.random.fold_in(key, 2), (C,))
-        u_pair = u[jnp.minimum(i, partner)]  # both sides draw the same uniform
-        do = (partner != i) & ((d_swap > 0)
-                               | (u_pair < jnp.exp(jnp.minimum(d_swap, 0.0))))
-        do = do & do[partner]
-        new_t_sorted = jnp.where(do, t_sorted[partner], t_sorted)
-        temps = temps.at[order].set(new_t_sorted)
-        return (chains, temps), None
 
     n_rounds = max(1, cfg.steps // cfg.swap_interval)
     keys = jax.random.split(jax.random.PRNGKey(seed), n_rounds)
@@ -618,18 +601,96 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
         chains = shard_chains(chains, mesh)
         temps0 = shard_chains(temps0, mesh)
 
-    @jax.jit
-    def run(chains, temps):
-        (chains, temps), _ = jax.lax.scan(
-            pt_round, (chains, temps), (jnp.arange(n_rounds), keys))
-        return chains, temps
+    chains, temps = _run_pt(chains, temps0, keys, dt, th, weights, opts,
+                            movable_idx, dest_idx, initial_broker_of,
+                            cfg, use_topic, n_rounds)
+    energies = _rescore_chains(chains, dt, th, weights, initial_broker_of,
+                               use_topic)                        # f32[C, 2]
+    # lexicographic best chain, combined in f64 on host — the f32 combined
+    # scalar would absorb the cost channel under any hard violation
+    e2 = np.asarray(jax.device_get(energies), np.float64)
+    comb = e2[:, 0] * OBJ.VIOL_SCALE + e2[:, 1]
+    best = int(np.argmin(comb))
+    return AnnealResult(
+        assignment=Assignment(broker_of=chains.broker_of[best],
+                              leader_of=chains.leader_of[best]),
+        energy=jnp.float32(comb[best]),
+        chain_energies=energies,
+    )
 
-    chains, temps = run(chains, temps0)
 
-    # Rescore every chain with exactly-recomputed load aggregates (immune to
-    # incremental float drift) plus the *maintained* topic counts — integer
-    # scatter-adds, hence already exact. Rebuilding the dense [B, T]
-    # histogram per chain here would cost more than the whole anneal.
+from functools import partial as _partial
+
+_chain_energy_jit = jax.jit(_chain_energy, static_argnames=("use_topic",))
+
+
+@_partial(jax.jit, static_argnames=("cfg", "use_topic", "n_rounds"))
+def _run_pt(chains, temps, keys, dt, th, weights, opts, movable_idx,
+            dest_idx, initial_broker_of, cfg: AnnealConfig, use_topic: bool,
+            n_rounds: int):
+    """The whole parallel-tempering run as ONE module-level jit.
+
+    Module-level matters: a jit wrapper created inside ``optimize_anneal``
+    would be a fresh function object per call, so every service/bench
+    invocation would re-trace and re-lower the full scan (tens of seconds at
+    LinkedIn scale — this was the dominant cost of the whole proposal path,
+    ~50× the actual device time of the annealing steps). Keyed here by the
+    (hashable, frozen) AnnealConfig + shapes, repeat calls are pure cache
+    hits and pay device time only.
+    """
+    C = temps.shape[0]
+    step = make_step_fn(dt, th, weights, opts, cfg, movable_idx, dest_idx,
+                        initial_broker_of, use_topic)
+
+    def chain_round(st: ChainState, temp, key):
+        ks = jax.random.split(key, cfg.swap_interval)
+
+        def body(s, k):
+            return step(s, temp, k), None
+
+        st, _ = jax.lax.scan(body, st, ks)
+        return st
+
+    def pt_round(carry, inp):
+        chains, temps = carry
+        rnd, key = inp
+        kc = jax.random.split(jax.random.fold_in(key, 1), C)
+        chains = jax.vmap(chain_round, in_axes=(0, 0, 0))(chains, temps, kc)
+        # temperature swap between ladder-adjacent chains (even/odd
+        # alternation); energies combine AFTER differencing the channels
+        order = jnp.argsort(temps)
+        e_sorted = chains.energy[order]                          # [C, 2]
+        t_sorted = temps[order]
+        off = rnd % 2
+        i = jnp.arange(C)
+        partner = jnp.where((i - off) % 2 == 0, i + 1, i - 1)
+        partner = jnp.clip(partner, 0, C - 1)
+        d_swap = (OBJ.combine(e_sorted - e_sorted[partner])
+                  * (1.0 / jnp.maximum(t_sorted, 1e-9)
+                     - 1.0 / jnp.maximum(t_sorted[partner], 1e-9)))
+        u = jax.random.uniform(jax.random.fold_in(key, 2), (C,))
+        u_pair = u[jnp.minimum(i, partner)]  # both sides draw the same uniform
+        do = (partner != i) & ((d_swap > 0)
+                               | (u_pair < jnp.exp(jnp.minimum(d_swap, 0.0))))
+        do = do & do[partner]
+        new_t_sorted = jnp.where(do, t_sorted[partner], t_sorted)
+        temps = temps.at[order].set(new_t_sorted)
+        return (chains, temps), None
+
+    (chains, temps), _ = jax.lax.scan(
+        pt_round, (chains, temps), (jnp.arange(n_rounds), keys))
+    return chains, temps
+
+
+@_partial(jax.jit, static_argnames=("use_topic",))
+def _rescore_chains(chains, dt, th, weights, initial_broker_of,
+                    use_topic: bool):
+    """Exact per-chain rescore: recomputed load aggregates (immune to
+    incremental float drift) plus the *maintained* topic counts — integer
+    scatter-adds, hence already exact. Rebuilding the dense [B, T]
+    histogram per chain here would cost more than the whole anneal."""
+    R, P, B = dt.num_replicas, dt.num_partitions, dt.num_brokers
+
     def rescore(st: ChainState):
         eff = (dt.replica_base_load
                + jnp.where((st.leader_of[dt.partition_of_replica]
@@ -655,11 +716,4 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
         )
         return _chain_energy(dt, th, weights, st2, initial_broker_of, use_topic)
 
-    energies = jax.jit(jax.vmap(rescore))(chains)
-    best = int(jnp.argmin(energies))
-    return AnnealResult(
-        assignment=Assignment(broker_of=chains.broker_of[best],
-                              leader_of=chains.leader_of[best]),
-        energy=energies[best],
-        chain_energies=energies,
-    )
+    return jax.vmap(rescore)(chains)

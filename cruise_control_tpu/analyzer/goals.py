@@ -540,11 +540,12 @@ SELF_HEALING_TERM = "_SelfHealingPlacement"
 
 def goal_weights(goal_names: Sequence[str], hard_weight: float = 1e7,
                  soft_base: float = 2.0) -> np.ndarray:
-    """Lexicographic-approximating weights: hard goals get ``hard_weight``;
-    soft goals geometric by priority (earlier = heavier), mirroring the
-    sequential veto order of GoalOptimizer (GoalOptimizer.java:429) and the
-    priority weights of the balancedness score (KafkaCruiseControlUtils.java:530).
-    The appended self-healing term is hard."""
+    """Cost-channel weights: hard goals get ``hard_weight``; soft goals
+    geometric by priority (earlier = heavier), mirroring the priority
+    weights of the balancedness score (KafkaCruiseControlUtils.java:530).
+    The appended self-healing term is hard. Priority *enforcement* lives in
+    the violation channel (:func:`goal_viol_weights`); this channel shapes
+    descent inside a violation level set."""
     soft_rank = 0
     n_soft = sum(1 for g in goal_names if not is_hard(g))
     w = []
@@ -558,7 +559,32 @@ def goal_weights(goal_names: Sequence[str], hard_weight: float = 1e7,
     return np.asarray(w, dtype=np.float32)
 
 
-def scalar_objective(pen: GoalPenalties, weights: jax.Array) -> jax.Array:
-    """Single scalar the annealer minimizes: weighted cost, with violations
-    of hard terms already dominating through their weights."""
-    return jnp.sum(pen.cost * weights)
+#: violation-channel weight for hard goals / internal hard terms: a power of
+#: two above the whole soft ladder (soft top = 2^(4·(n_soft−1)) = 2^32 at 9
+#: soft goals)
+HARD_VIOL_WEIGHT = 2.0 ** 40
+
+#: ladder base 2^4 = 16: one action changes a goal's violation count by at
+#: most ~4 (two brokers, two partitions/topics touched), so a single count
+#: on tier i outweighs every possible gain on all lower tiers combined
+_VIOL_BASE_BITS = 4
+
+
+def goal_viol_weights(goal_names: Sequence[str]) -> np.ndarray:
+    """Violation-channel lexicographic ladder (AbstractGoal.java:211
+    semantics: a higher-priority goal may never be sacrificed). Powers of
+    two, so count × weight products are exact in f32 and an unaffected
+    tier's delta is exactly zero."""
+    soft_rank = 0
+    n_soft = sum(1 for g in goal_names if not is_hard(g))
+    w = []
+    for g in goal_names:
+        if is_hard(g):
+            w.append(HARD_VIOL_WEIGHT)
+        else:
+            w.append(2.0 ** (_VIOL_BASE_BITS * (n_soft - 1 - soft_rank)))
+            soft_rank += 1
+    w.append(HARD_VIOL_WEIGHT)  # _SelfHealingPlacement
+    return np.asarray(w, dtype=np.float32)
+
+

@@ -95,12 +95,12 @@ def _replica_move_deltas(dt: DeviceTopology, th: G.GoalThresholds,
     lbi_r = jnp.where(is_leader, dt.leader_bytes_in[p], 0.0)         # [R]
     lead_f = is_leader.astype(jnp.float32)
 
-    # ---- current per-broker / per-host costs
+    # ---- current per-broker / per-host costs (two channels each)
     f0 = OBJ.broker_cost(th, w, st.broker_load, st.replica_count,
-                         st.leader_count, st.potential_nw_out, st.leader_bytes_in)  # [B]
-    h0 = OBJ.host_cost(th, w, st.host_load)                                         # [H]
+                         st.leader_count, st.potential_nw_out, st.leader_bytes_in)  # [B, 2]
+    h0 = OBJ.host_cost(th, w, st.host_load)                                         # [H, 2]
 
-    # ---- source side: broker a without replica r  → [R]
+    # ---- source side: broker a without replica r  → [R, 2]
     th_a = OBJ.gather_thresholds(th, a)
     f_minus = OBJ.broker_cost(
         th_a, w,
@@ -110,9 +110,9 @@ def _replica_move_deltas(dt: DeviceTopology, th: G.GoalThresholds,
         st.potential_nw_out[a] - pl_r,
         st.leader_bytes_in[a] - lbi_r,
     )
-    d_src = f_minus - f0[a]                                          # [R]
+    d_src = f_minus - f0[a]                                          # [R, 2]
 
-    # ---- destination side: broker b with replica r → [R, B]
+    # ---- destination side: broker b with replica r → [R, B, 2]
     f_plus = OBJ.broker_cost(
         th, w,
         st.broker_load[None, :, :] + eff[:, None, :],
@@ -121,21 +121,23 @@ def _replica_move_deltas(dt: DeviceTopology, th: G.GoalThresholds,
         st.potential_nw_out[None, :] + pl_r[:, None],
         st.leader_bytes_in[None, :] + lbi_r[:, None],
     )
-    d_dst = f_plus - f0[None, :]                                     # [R, B]
+    d_dst = f_plus - f0[None, :]                                     # [R, B, 2]
 
     # ---- host terms (zero when the move stays on one host)
     ha = dt.host_of_broker[a]                                        # [R]
     hb = dt.host_of_broker                                           # [B]
     h_minus = OBJ.host_cost(OBJ.gather_host_thresholds(th, ha), w,
-                            st.host_load[ha] - eff)                  # [R]
+                            st.host_load[ha] - eff)                  # [R, 2]
     h_plus = OBJ.host_cost(OBJ.gather_host_thresholds(th, hb), w,
-                           st.host_load[None, :, :][:, hb] + eff[:, None, :])  # [R,B]
-    cross_host = (ha[:, None] != hb[None, :]).astype(jnp.float32)
-    d_host = ((h_minus - h0[ha])[:, None] + (h_plus - h0[hb][None, :])) * cross_host
+                           st.host_load[None, :, :][:, hb] + eff[:, None, :])  # [R,B,2]
+    cross_host = (ha[:, None] != hb[None, :]).astype(jnp.float32)[..., None]
+    d_host = ((h_minus - h0[ha])[:, None, :]
+              + (h_plus - h0[hb][None, :, :])) * cross_host
 
     # ---- rack-awareness delta: occ[r, k] = some *other* replica of r's
-    # partition lives in rack k (under the current assignment).
-    K = int(np.max(np.asarray(jax.device_get(dt.rack_of_broker))) + 1) if dt.rack_of_broker.size else 1
+    # partition lives in rack k (under the current assignment). Rack ids are
+    # < B (each broker sits in exactly one rack), which keeps this jittable.
+    K = B
     reps = dt.replicas_of_partition[p]                               # [R, m]
     valid_sib = (reps >= 0) & (reps != jnp.arange(R)[:, None])
     sib_broker = st.broker_of[jnp.clip(reps, 0)]                     # [R, m]
@@ -144,26 +146,38 @@ def _replica_move_deltas(dt: DeviceTopology, th: G.GoalThresholds,
         jnp.arange(R)[:, None], sib_rack].max(valid_sib)             # [R, K]
     occ_a = occ[jnp.arange(R), dt.rack_of_broker[a]]                 # [R]
     occ_b = occ[:, dt.rack_of_broker]                                # [R, B]
-    d_rack = w.rack * (occ_b.astype(jnp.float32) - occ_a.astype(jnp.float32)[:, None])
+    d_rack_n = (occ_b.astype(jnp.float32)
+                - occ_a.astype(jnp.float32)[:, None])                # [R, B]
+    w_rack2 = jnp.stack([w.rack_viol, w.rack])
+    d_rack = d_rack_n[..., None] * w_rack2                           # [R, B, 2]
 
-    # ---- topic distribution delta
+    # ---- topic distribution delta (cost + violation-count channels)
     t = dt.topic_of_partition[p]                                     # [R]
     n_a = st.topic_count[a, t]                                       # [R]
     n_b = st.topic_count[:, t].T                                     # [R, B]
     u_t, l_t = th.topic_upper[t], th.topic_lower[t]                  # [R]
-    d_topic = w.topic * (
+    dc_topic = (
         (_band_cost(n_a - 1.0, u_t, l_t) - _band_cost(n_a, u_t, l_t))[:, None]
         + _band_cost(n_b + 1.0, u_t[:, None], l_t[:, None])
         - _band_cost(n_b, u_t[:, None], l_t[:, None]))
+    vi = lambda n, uu, ll: (_band_cost(n, uu, ll) > 0).astype(jnp.float32)
+    dv_topic = (
+        (vi(n_a - 1.0, u_t, l_t) - vi(n_a, u_t, l_t))[:, None]
+        + vi(n_b + 1.0, u_t[:, None], l_t[:, None])
+        - vi(n_b, u_t[:, None], l_t[:, None]))
+    d_topic = jnp.stack([w.topic_viol * dv_topic, w.topic * dc_topic],
+                        axis=-1)                                     # [R, B, 2]
 
     # ---- self-healing: offline replicas must leave their original broker
     on_init = st.broker_of == initial_broker_of
     heal_gain = (dt.replica_offline & on_init & dt.broker_alive[a]).astype(jnp.float32)
     heal_back = (dt.replica_offline & ~on_init)
     back_to_init = heal_back[:, None] & (initial_broker_of[:, None] == jnp.arange(B)[None, :])
-    d_heal = w.healing * (back_to_init.astype(jnp.float32) - heal_gain[:, None])
+    d_heal_n = back_to_init.astype(jnp.float32) - heal_gain[:, None]
+    d_heal = d_heal_n[..., None] * jnp.stack([w.healing_viol, w.healing])
 
-    delta = (d_src[:, None] + d_dst + d_host + d_rack + d_topic + d_heal)
+    delta = OBJ.combine(d_src[:, None, :] + d_dst + d_host + d_rack
+                        + d_topic + d_heal)                          # [R, B]
 
     # ---- legality (GoalUtils.legitMove): destination alive+allowed, not the
     # source, and not already hosting a replica of the partition.
@@ -194,8 +208,9 @@ def _leadership_deltas(dt: DeviceTopology, th: G.GoalThresholds,
     d_pl = base_nwout[jnp.clip(reps, 0)] - base_nwout[cur_leader][:, None]  # [P, m]
 
     f0 = OBJ.broker_cost(th, w, st.broker_load, st.replica_count,
-                         st.leader_count, st.potential_nw_out, st.leader_bytes_in)
-    h0 = OBJ.host_cost(th, w, st.host_load)
+                         st.leader_count, st.potential_nw_out,
+                         st.leader_bytes_in)                         # [B, 2]
+    h0 = OBJ.host_cost(th, w, st.host_load)                          # [H, 2]
 
     # Evaluate every member broker under candidate s: loads move extra from a
     # to b_s; potential shifts by d_pl on every member broker (each member
@@ -223,29 +238,33 @@ def _leadership_deltas(dt: DeviceTopology, th: G.GoalThresholds,
     )
     f_new = OBJ.broker_cost(th_mem, w, load_new,
                             st.replica_count[mem_b][:, None, :],
-                            lc_new, pot_new, lbi_new)                # [P, mc, mm]
+                            lc_new, pot_new, lbi_new)                # [P, mc, mm, 2]
     # mask duplicate-broker double counting: each member counted once; padded
     # slots contribute 0.
-    mem_valid = valid[:, None, :]
-    d_brokers = jnp.sum(jnp.where(mem_valid, f_new - f0[mem_b][:, None, :], 0.0), axis=-1)
+    mem_valid = valid[:, None, :, None]
+    d_brokers = jnp.sum(jnp.where(mem_valid,
+                                  f_new - f0[mem_b][:, None, :, :], 0.0),
+                        axis=-2)                                     # [P, mc, 2]
 
     # host terms: extra moves host(a) → host(b_s)
     ha = dt.host_of_broker[a]                                        # [P]
     hb = dt.host_of_broker[jnp.clip(b_s, 0)]                         # [P, m]
     h_minus = OBJ.host_cost(OBJ.gather_host_thresholds(th, ha), w,
-                            st.host_load[ha] - extra)                # [P]
+                            st.host_load[ha] - extra)                # [P, 2]
     h_plus = OBJ.host_cost(OBJ.gather_host_thresholds(th, hb), w,
-                           st.host_load[hb] + extra[:, None, :])     # [P, m]
-    cross = (ha[:, None] != hb).astype(jnp.float32)
-    d_host = ((h_minus - h0[ha])[:, None] + (h_plus - h0[hb])) * cross
+                           st.host_load[hb] + extra[:, None, :])     # [P, m, 2]
+    cross = (ha[:, None] != hb).astype(jnp.float32)[..., None]
+    d_host = ((h_minus - h0[ha])[:, None, :] + (h_plus - h0[hb])) * cross
 
     # preferred-leader term: moving to slot 0 earns, off slot 0 pays
     first = reps[:, 0]
     cur_is_first = (cur_leader == first).astype(jnp.float32)
     cand_is_first = (reps == first[:, None]).astype(jnp.float32)
-    d_ple = w.preferred_leader * (cur_is_first[:, None] - cand_is_first)
+    d_ple_n = cur_is_first[:, None] - cand_is_first                  # [P, m]
+    d_ple = d_ple_n[..., None] * jnp.stack([w.preferred_leader_viol,
+                                            w.preferred_leader])
 
-    delta = d_brokers + d_host + d_ple
+    delta = OBJ.combine(d_brokers + d_host + d_ple)                  # [P, m]
 
     cand_replica = jnp.clip(reps, 0)
     ok = (valid
@@ -318,22 +337,20 @@ class GreedyResult(NamedTuple):
     rounds: int
 
 
-def optimize_greedy(dt: DeviceTopology, assign: Assignment,
-                    th: G.GoalThresholds, weights: OBJ.ObjectiveWeights,
-                    opts: G.DeviceOptions, num_topics: int,
-                    max_actions: Optional[int] = None,
-                    min_improvement: float = 1e-6) -> GreedyResult:
-    """Greedy descent until no candidate action improves the objective.
+from functools import partial
 
-    Mirrors the convergence contract of the reference's optimize loop
-    (``AbstractGoal.optimize`` runs until ``_finished``/no action applies):
-    deterministic given the model, terminates, and never accepts an action
-    that worsens the weighted objective.
-    """
-    if max_actions is None:
-        max_actions = 4 * dt.num_replicas + 2 * dt.num_partitions
-    initial_broker_of = jnp.asarray(assign.broker_of, jnp.int32)
+
+@partial(jax.jit, static_argnames=("num_topics", "max_actions",
+                                   "min_improvement"))
+def _greedy_loop(dt: DeviceTopology, broker_of, leader_of,
+                 th: G.GoalThresholds, weights: OBJ.ObjectiveWeights,
+                 opts: G.DeviceOptions, num_topics: int, max_actions: int,
+                 min_improvement: float, initial_broker_of):
+    """The jitted descent loop; module-level so repeated optimize calls on
+    same-shaped models hit the jit cache instead of retracing the
+    while_loop (fresh closures defeat lax's own cache)."""
     B, m = dt.num_brokers, dt.max_rf
+    assign = Assignment(broker_of=broker_of, leader_of=leader_of)
 
     def cond(carry):
         st, rounds = carry
@@ -369,10 +386,73 @@ def optimize_greedy(dt: DeviceTopology, assign: Assignment,
         return st2, rounds + 1
 
     st0 = _init_state(dt, assign, num_topics)
-    st, rounds = jax.lax.while_loop(cond, body, (st0, jnp.int32(0)))
+    return jax.lax.while_loop(cond, body, (st0, jnp.int32(0)))
+
+
+def optimize_greedy(dt: DeviceTopology, assign: Assignment,
+                    th: G.GoalThresholds, weights: OBJ.ObjectiveWeights,
+                    opts: G.DeviceOptions, num_topics: int,
+                    max_actions: Optional[int] = None,
+                    min_improvement: float = 1e-6,
+                    initial_broker_of=None) -> GreedyResult:
+    """Greedy descent until no candidate action improves the objective.
+
+    Mirrors the convergence contract of the reference's optimize loop
+    (``AbstractGoal.optimize`` runs until ``_finished``/no action applies):
+    deterministic given the model, terminates, and never accepts an action
+    that worsens the weighted objective. ``initial_broker_of``: the true
+    original placement for self-healing accounting (defaults to ``assign``;
+    staged/sequential callers must pass the pre-optimization original).
+    """
+    if max_actions is None:
+        max_actions = 4 * dt.num_replicas + 2 * dt.num_partitions
+    if initial_broker_of is None:
+        initial_broker_of = jnp.asarray(assign.broker_of, jnp.int32)
+    st, rounds = _greedy_loop(dt, jnp.asarray(assign.broker_of, jnp.int32),
+                              jnp.asarray(assign.leader_of, jnp.int32),
+                              th, weights, opts, num_topics, int(max_actions),
+                              float(min_improvement), initial_broker_of)
     return GreedyResult(
         assignment=Assignment(broker_of=st.broker_of, leader_of=st.leader_of),
         moves=int(st.moves),
         leadership_moves=int(st.leadership_moves),
         rounds=int(rounds),
     )
+
+
+def optimize_greedy_staged(dt: DeviceTopology, assign: Assignment,
+                           th: G.GoalThresholds, goal_names: Sequence[str],
+                           opts: G.DeviceOptions, num_topics: int,
+                           max_actions: Optional[int] = None) -> GreedyResult:
+    """Sequential-priority descent: the reference's per-goal phase structure
+    (GoalOptimizer.java:429 — optimize goal 1, then goal 2 subject to goal 1,
+    ...). Stage k descends on the weight set with goals > k zeroed, starting
+    from stage k−1's assignment; the violation-ladder channel guarantees no
+    stage trades a higher-priority goal's violations for lower-priority
+    gains. All stages share one compiled loop (weights are traced values).
+    """
+    goal_names = tuple(goal_names)
+    init_bo = jnp.asarray(assign.broker_of, jnp.int32)
+    # stage ends: the leading hard block as one stage, then one stage per
+    # soft goal, always finishing with the full list
+    hard_prefix = 0
+    for g in goal_names:
+        if not G.is_hard(g):
+            break
+        hard_prefix += 1
+    ends = sorted({hard_prefix, len(goal_names),
+                   *(i + 1 for i, g in enumerate(goal_names)
+                     if not G.is_hard(g))} - {0})
+    cur = assign
+    total_moves = total_leads = total_rounds = 0
+    for k in ends:
+        w_k = OBJ.build_weights(goal_names, active_prefix=k)
+        res = optimize_greedy(dt, cur, th, w_k, opts, num_topics,
+                              max_actions=max_actions,
+                              initial_broker_of=init_bo)
+        cur = res.assignment
+        total_moves += res.moves
+        total_leads += res.leadership_moves
+        total_rounds += res.rounds
+    return GreedyResult(assignment=cur, moves=total_moves,
+                        leadership_moves=total_leads, rounds=total_rounds)

@@ -1,15 +1,30 @@
-"""Weighted scalar objective over the goal penalty terms.
+"""Lexicographic two-channel objective over the goal penalty terms.
 
 Bridges :mod:`cruise_control_tpu.analyzer.goals` (per-goal penalties) and the
-two search engines (greedy descent, annealer). The objective is
+search engines (greedy descent, annealer). The reference's sequential
+goal-priority semantics (``GoalOptimizer.java:429`` +
+``AbstractGoal.actionAcceptance``: an action may never sacrifice a
+higher-priority goal for lower ones) are carried by TWO channels:
 
-    O(state) = Σ_goals w_g · cost_g(state)
+    O(state) = VIOL_SCALE · Σ_g v_g · violations_g(state)  +  Σ_g w_g · cost_g
 
-with hierarchical weights approximating the reference's sequential
-goal-priority semantics (``GoalOptimizer.java:429``: earlier goals veto later
-actions; hard goals always win). It decomposes as
+- **Violation channel** (primary): per-goal violation *counts* weighted by a
+  power-of-two priority ladder (``goals.goal_viol_weights``). Counts are
+  small integers and ladder weights are powers of two, so products and the
+  all-important "unaffected goal ⇒ exactly zero delta" property are exact in
+  f32 — a move is never accepted on float noise from a higher tier.
+- **Cost channel** (tiebreak): the continuous out-of-spec distance with the
+  soft geometric weights, providing descent direction inside a violation
+  level set.
 
-    O = Σ_b f_broker(b) + Σ_h f_host(h) + w_rack·excess + topic term + healing
+The two channels are kept separate through every delta computation and
+**differenced separately** (``f1 - f0`` per channel), then combined with
+:func:`combine` only at the end — this is what makes the lexicographic
+ordering numerically sound.
+
+Everything decomposes as
+
+    O = Σ_b f_broker(b) + Σ_h f_host(h) + rack + topic + healing
 
 which is what both engines exploit: greedy evaluates f on batched hypothetical
 loads; the annealer maintains running aggregates and evaluates f only on
@@ -34,31 +49,74 @@ from cruise_control_tpu.ops.aggregates import (
 )
 
 
-class ObjectiveWeights(NamedTuple):
-    """Per-term weights in the decomposed layout."""
+#: separates the violation channel from the cost channel in the combined
+#: scalar; any single violation-count change dominates any cost change
+VIOL_SCALE = 2.0 ** 20
 
-    broker_terms: jax.Array   # f32[NUM_BROKER_TERMS] (0 where goal not selected)
+
+class ObjectiveWeights(NamedTuple):
+    """Per-term weights in the decomposed layout, both channels."""
+
+    broker_terms: jax.Array   # f32[NUM_BROKER_TERMS] cost weights
     host_terms: jax.Array     # f32[3] (CpuCapacity, NwInCapacity, NwOutCapacity)
     rack: jax.Array           # f32 scalar
     topic: jax.Array          # f32 scalar
     healing: jax.Array        # f32 scalar (offline replicas must relocate)
     preferred_leader: jax.Array  # f32 scalar
-    per_goal: jax.Array       # f32[G+1] — goal_weights vector for full evals
+    per_goal: jax.Array       # f32[G+1] — cost weights for full evals
+    # --- violation-channel (lexicographic priority ladder) mirrors ---
+    broker_terms_viol: jax.Array  # f32[NUM_BROKER_TERMS]
+    host_terms_viol: jax.Array    # f32[3]
+    rack_viol: jax.Array
+    topic_viol: jax.Array
+    healing_viol: jax.Array
+    preferred_leader_viol: jax.Array
+    per_goal_viol: jax.Array      # f32[G+1]
+
+
+def combine(two: jax.Array) -> jax.Array:
+    """Collapse a [..., 2] (viol, cost) pair into the lexicographic scalar.
+    Call this only on *differenced* channels (or totals used for ranking)."""
+    return two[..., 0] * VIOL_SCALE + two[..., 1]
 
 
 def build_weights(goal_names: Sequence[str],
-                  hard_weight: float = 1e7,
-                  soft_base: float = 2.0) -> ObjectiveWeights:
-    """Map a priority-ordered goal list to decomposed term weights."""
-    w = G.goal_weights(goal_names, hard_weight, soft_base)  # [G+1]
+                  hard_weight: float = 2.0 ** 13,
+                  soft_base: float = 2.0,
+                  active_prefix: Optional[int] = None) -> ObjectiveWeights:
+    """Map a priority-ordered goal list to decomposed two-channel weights.
+
+    ``hard_weight`` (cost channel) stays well below ``VIOL_SCALE``: the
+    maximum per-action cost delta must never outweigh a single violation
+    count on the primary channel, or cost could buy soft-goal regressions.
+
+    ``active_prefix``: zero both channels for goals at index >= the prefix —
+    the staged sequential descent (GoalOptimizer.java:429 phase structure)
+    reuses one compiled loop across stages because only weight *values*
+    change, never shapes. Internal hard terms and self-healing stay active
+    in every stage.
+    """
+    w = G.goal_weights(goal_names, hard_weight, soft_base)       # [G+1]
+    wv = G.goal_viol_weights(goal_names)                         # [G+1]
+    if active_prefix is not None:
+        mask = np.arange(len(w), dtype=np.float32) < active_prefix
+        mask[-1] = True                       # appended self-healing term
+        w = w * mask
+        wv = wv * mask
     by_goal = {g: float(w[i]) for i, g in enumerate(goal_names)}
+    by_goal_v = {g: float(wv[i]) for i, g in enumerate(goal_names)}
     bt = np.zeros(G.NUM_BROKER_TERMS, np.float32)
+    btv = np.zeros(G.NUM_BROKER_TERMS, np.float32)
     for g, i in ((g, G.BROKER_TERM_GOALS.index(g)) for g in goal_names
                  if g in G.BROKER_TERM_GOALS):
         bt[i] = by_goal[g]
-    bt[G.BROKER_TERM_GOALS.index("_DeadBrokerPlacement")] = hard_weight
-    bt[G.BROKER_TERM_GOALS.index("_DemotedLeadership")] = hard_weight
+        btv[i] = by_goal_v[g]
+    for internal in ("_DeadBrokerPlacement", "_DemotedLeadership"):
+        bt[G.BROKER_TERM_GOALS.index(internal)] = hard_weight
+        btv[G.BROKER_TERM_GOALS.index(internal)] = G.HARD_VIOL_WEIGHT
     ht = np.array([by_goal.get(g, 0.0) for g in G.HOST_TERM_GOALS], np.float32)
+    htv = np.array([by_goal_v.get(g, 0.0) for g in G.HOST_TERM_GOALS],
+                   np.float32)
     return ObjectiveWeights(
         broker_terms=jnp.asarray(bt),
         host_terms=jnp.asarray(ht),
@@ -67,6 +125,14 @@ def build_weights(goal_names: Sequence[str],
         healing=jnp.float32(hard_weight),
         preferred_leader=jnp.float32(by_goal.get("PreferredLeaderElectionGoal", 0.0)),
         per_goal=jnp.asarray(w),
+        broker_terms_viol=jnp.asarray(btv),
+        host_terms_viol=jnp.asarray(htv),
+        rack_viol=jnp.float32(by_goal_v.get("RackAwareGoal", 0.0)),
+        topic_viol=jnp.float32(by_goal_v.get("TopicReplicaDistributionGoal", 0.0)),
+        healing_viol=jnp.float32(G.HARD_VIOL_WEIGHT),
+        preferred_leader_viol=jnp.float32(
+            by_goal_v.get("PreferredLeaderElectionGoal", 0.0)),
+        per_goal_viol=jnp.asarray(wv),
     )
 
 
@@ -74,7 +140,8 @@ def broker_cost(th: G.GoalThresholds, weights: ObjectiveWeights,
                 broker_load: jax.Array, replica_count: jax.Array,
                 leader_count: jax.Array, potential_nw_out: jax.Array,
                 leader_bytes_in: jax.Array) -> jax.Array:
-    """Weighted per-broker cost; broadcasts over any leading batch dims.
+    """Two-channel per-broker objective, shape [..., 2] = (viol, cost);
+    broadcasts over any leading batch dims.
 
     All per-broker inputs must be *gathered for the same broker index* so the
     alive/capacity threshold rows line up: callers evaluating hypothetical
@@ -82,7 +149,9 @@ def broker_cost(th: G.GoalThresholds, weights: ObjectiveWeights,
     """
     bt = G.broker_terms(th, broker_load, replica_count, leader_count,
                         potential_nw_out, leader_bytes_in)
-    return jnp.sum(bt.cost * weights.broker_terms, axis=-1)
+    return jnp.stack([
+        jnp.sum(bt.violations * weights.broker_terms_viol, axis=-1),
+        jnp.sum(bt.cost * weights.broker_terms, axis=-1)], axis=-1)
 
 
 def gather_thresholds(th: G.GoalThresholds, idx: jax.Array) -> G.GoalThresholds:
@@ -98,10 +167,11 @@ def gather_thresholds(th: G.GoalThresholds, idx: jax.Array) -> G.GoalThresholds:
 
 def host_cost(th: G.GoalThresholds, weights: ObjectiveWeights,
               host_load: jax.Array) -> jax.Array:
-    """Weighted per-host cost; broadcasts over leading batch dims (rows of
-    ``host_load`` must correspond to rows of ``th.cap_limit_host``)."""
-    _, cost = G.host_terms(th, host_load)
-    return jnp.sum(cost * weights.host_terms, axis=-1)
+    """Two-channel per-host objective [..., 2]; broadcasts over leading batch
+    dims (rows of ``host_load`` must correspond to ``th.cap_limit_host``)."""
+    viol, cost = G.host_terms(th, host_load)
+    return jnp.stack([jnp.sum(viol * weights.host_terms_viol, axis=-1),
+                      jnp.sum(cost * weights.host_terms, axis=-1)], axis=-1)
 
 
 def gather_host_thresholds(th: G.GoalThresholds, hidx: jax.Array) -> G.GoalThresholds:
@@ -111,8 +181,19 @@ def gather_host_thresholds(th: G.GoalThresholds, hidx: jax.Array) -> G.GoalThres
 class ObjectiveState(NamedTuple):
     """Everything needed to score a full state in one pass."""
 
-    value: jax.Array          # f32 scalar — the weighted objective
+    #: f32[2] — (weighted violation total, weighted cost total). Kept as two
+    #: channels: the combined f32 scalar would absorb every cost digit under
+    #: any violation (see module docstring). Rank states with
+    #: :func:`combine_f64` on host.
+    value: jax.Array
     penalties: G.GoalPenalties
+
+
+def combine_f64(value: "np.ndarray | jax.Array") -> float:
+    """Host-side lexicographic scalar from a (viol, cost) value pair —
+    float64 keeps both channels' digits."""
+    v = np.asarray(jax.device_get(value), np.float64)
+    return float(v[..., 0] * VIOL_SCALE + v[..., 1])
 
 
 def evaluate_objective(dt: DeviceTopology, assign: Assignment,
@@ -124,4 +205,6 @@ def evaluate_objective(dt: DeviceTopology, assign: Assignment,
     for periodic drift correction of the annealer's running aggregates)."""
     pen = G.full_goal_penalties(dt, assign, th, num_topics, goal_names,
                                 initial_broker_of=initial_broker_of, agg=agg)
-    return ObjectiveState(value=jnp.sum(pen.cost * weights.per_goal), penalties=pen)
+    value = jnp.stack([jnp.sum(pen.violations * weights.per_goal_viol),
+                       jnp.sum(pen.cost * weights.per_goal)])
+    return ObjectiveState(value=value, penalties=pen)

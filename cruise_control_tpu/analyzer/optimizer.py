@@ -171,7 +171,10 @@ def optimize(topo: ClusterTopology, assign: Assignment,
                   else "anneal")
 
     if engine == "greedy":
-        gres = GR.optimize_greedy(dt, assign, th, weights, opts, num_topics)
+        # sequential-priority stages (GoalOptimizer.java:429): lexicographic
+        # parity with the reference's per-goal phase loop
+        gres = GR.optimize_greedy_staged(dt, assign, th, goal_names, opts,
+                                         num_topics)
         final = gres.assignment
     elif engine == "anneal":
         ares = AN.optimize_anneal(dt, assign, th, weights, opts, num_topics,
@@ -187,7 +190,10 @@ def optimize(topo: ClusterTopology, assign: Assignment,
         hard_mask = np.array([G.is_hard(g) for g in goal_names] + [True])
         if (np.asarray(interim.penalties.violations)[hard_mask].sum() > 0
                 and topo.num_replicas * topo.num_brokers <= GREEDY_LIMIT):
-            gres = GR.optimize_greedy(dt, final, th, weights, opts, num_topics)
+            # pass the TRUE original placement: healing accounting must not
+            # re-penalize offline replicas the annealer already relocated
+            gres = GR.optimize_greedy(dt, final, th, weights, opts, num_topics,
+                                      initial_broker_of=init_broker)
             final = gres.assignment
     else:
         raise ValueError(f"unknown engine {engine!r}")

@@ -217,7 +217,10 @@ class SlowBrokerFinder:
         bi = np.asarray(series.get("bytes_in", ()), dtype=np.float64)
         if ft.size == 0 or bi.size == 0:
             return None
-        return float(ft[-1] / max(bi[-1], 1.0))
+        s = float(ft[-1] / max(bi[-1], 1.0))
+        # a broker without flush-time samples must not enter the peer pool:
+        # one NaN would poison the peer median and mute detection entirely
+        return None if np.isnan(s) else s
 
     def detect(self) -> Optional[SlowBrokers]:
         hist = self._history_fn()
@@ -237,6 +240,7 @@ class SlowBrokerFinder:
             bi = np.asarray(hist[broker].get("bytes_in", ()), dtype=np.float64)
             n = min(ft.size, bi.size)
             own_hist = ft[:n - 1] / np.maximum(bi[:n - 1], 1.0) if n > 1 else np.array([])
+            own_hist = own_hist[~np.isnan(own_hist)]
             own_slow = (own_hist.size >= 3
                         and s > self._self_margin * float(np.mean(own_hist)))
             peer_slow = s > self._peer_margin * peer_median
@@ -320,15 +324,24 @@ class AnomalyDetectorService:
             # A fresh detection supersedes a queued/deferred anomaly of the
             # same kind — detector payloads carry the full current state
             # (e.g. failed_brokers_by_time), so the newest wins and the queue
-            # can't accumulate one entry per sweep for a persistent condition.
-            before = len(self._queue)
-            self._queue = [q for q in self._queue
-                           if not (type(q.anomaly) is type(anomaly)
-                                   and self._same_target(q.anomaly, anomaly))]
-            if len(self._queue) != before:
+            # can't accumulate one entry per sweep for a persistent
+            # condition. The superseding entry INHERITS the displaced entry's
+            # re-check time, so a CHECK/execution deferral delay is honored
+            # even though the condition is re-detected every sweep.
+            displaced_ready = 0
+            kept = []
+            for q in self._queue:
+                if (type(q.anomaly) is type(anomaly)
+                        and self._same_target(q.anomaly, anomaly)):
+                    displaced_ready = max(displaced_ready, q.ready_at_ms)
+                else:
+                    kept.append(q)
+            if len(kept) != len(self._queue):
+                self._queue = kept
                 heapq.heapify(self._queue)
             heapq.heappush(self._queue, _Queued(
-                anomaly.anomaly_type.priority, self._seq, anomaly))
+                anomaly.anomaly_type.priority, self._seq, anomaly,
+                ready_at_ms=displaced_ready))
             self._seq += 1
             self.metrics["anomalies_detected"] += 1
 

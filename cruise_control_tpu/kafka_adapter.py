@@ -247,6 +247,12 @@ class KafkaClusterAdapter:
         for resp in responses:
             for res_entry in resp.resources:
                 # (error_code, error_message, type, name, config_entries)
+                if int(res_entry[0]) != 0:
+                    # a failed resource read would merge as "no overrides"
+                    # and wipe that resource's dynamic config — abort instead
+                    raise RuntimeError(
+                        f"DescribeConfigs failed for {res_entry[3]!r}: "
+                        f"error {res_entry[0]} {res_entry[1]!r}")
                 rkey = (int(res_entry[2]), str(res_entry[3]))
                 cfgs = out.setdefault(rkey, {})
                 for entry in res_entry[4]:
@@ -317,17 +323,30 @@ class KafkaClusterAdapter:
 
     def describe_logdirs(self) -> Dict[int, Dict[str, bool]]:
         """Logdir liveness via AdminClient describeLogDirs
-        (DiskFailureDetector.java:35-85). kafka-python returns
-        {broker: {logdir: {"error_code": int, ...}}}; error 0 = alive."""
-        out: Dict[int, Dict[str, bool]] = {}
+        (DiskFailureDetector.java:35-85): {broker: {logdir: alive}}.
+
+        Handles both shapes kafka-python may hand back: a broker-keyed dict
+        (newer/forked clients and test doubles) or a bare
+        DescribeLogDirsResponse from a single node, whose ``log_dirs``
+        entries are ``(error_code, log_dir, topics)`` tuples with no broker
+        attribution — those are reported under broker −1 so a dead dir still
+        raises a DiskFailures anomaly. Unknown shapes yield no data (the
+        detector simply sees no dirs) rather than crashing the sweep."""
         try:
             described = self._admin.describe_log_dirs()
         except Exception:
+            return {}
+        out: Dict[int, Dict[str, bool]] = {}
+        if hasattr(described, "items"):
+            for broker, dirs in described.items():
+                out[int(broker)] = {
+                    str(d): int(info.get("error_code", 0)) == 0
+                    for d, info in dirs.items()}
             return out
-        for broker, dirs in (described or {}).items():
-            out[int(broker)] = {
-                str(d): int(info.get("error_code", 0)) == 0
-                for d, info in dirs.items()}
+        log_dirs = getattr(described, "log_dirs", None)
+        if log_dirs is not None:
+            out[-1] = {str(entry[1]): int(entry[0]) == 0
+                       for entry in log_dirs}
         return out
 
     def alter_replica_logdirs(self, moves):

@@ -372,10 +372,14 @@ def random_cluster(props: ClusterProperties = None, seed: int = 3140,
     cum = np.cumsum(rf)
     n_parts = int(np.searchsorted(cum, props.num_replicas)) + 1
     rf = rf[:n_parts]
-    # topic popularity: partitions distributed over topics (some topics big)
+    # topic popularity: partitions distributed over topics (some topics big).
+    # Every topic gets at least one partition so the built model's topic
+    # count equals n_topics for every seed — keeps shapes (and therefore jit
+    # caches) stable across seeds of the same ClusterProperties.
     n_topics = min(props.num_topics, n_parts)
     popularity = rng.exponential(1.0, size=n_topics)
     topic_of_part = rng.choice(n_topics, size=n_parts, p=popularity / popularity.sum())
+    topic_of_part[:n_topics] = rng.permutation(n_topics)
 
     means = np.zeros(res.NUM_RESOURCES)
     means[CPU], means[DISK] = props.mean_cpu, props.mean_disk

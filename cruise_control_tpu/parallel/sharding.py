@@ -224,7 +224,7 @@ def sharded_chain_energies(mesh: Mesh, dt: DeviceTopology, th, weights,
                            use_topic: bool = False,
                            topic_count: Optional[jax.Array] = None
                            ) -> jax.Array:
-    """f32[C] — exact decomposed objective per chain, replica-sharded.
+    """f32[C, 2] — exact (violation, cost) channels per chain, replica-sharded.
 
     Parity target: the annealer's ``rescore`` (annealer.py) / the
     chain-energy decomposition of :mod:`objective`. Topic term: pass the
@@ -241,15 +241,19 @@ def sharded_chain_energies(mesh: Mesh, dt: DeviceTopology, th, weights,
         lambda bl, rc, lc, pot, lbi: OBJ.broker_cost(th, weights, bl, rc,
                                                      lc, pot, lbi)
     )(agg.broker_load, agg.replica_count, agg.leader_count,
-      agg.potential_nw_out, agg.leader_bytes_in)              # [C, B]
+      agg.potential_nw_out, agg.leader_bytes_in)              # [C, B, 2]
     h = jax.vmap(lambda hl: OBJ.host_cost(th, weights, hl))(agg.host_load)
-    e = jnp.sum(f, axis=1) + jnp.sum(h, axis=1)
+    e2 = jnp.sum(f, axis=1) + jnp.sum(h, axis=1)              # [C, 2]
     rack = jax.vmap(lambda bo: jnp.sum(partition_rack_excess(dt, bo)))(
         broker_of)
-    e = e + weights.rack * rack
+    e2 = e2 + rack[:, None] * jnp.stack([weights.rack_viol, weights.rack])
     if use_topic and topic_count is not None:
         alive_f = th.alive.astype(jnp.float32)[None, :, None]
         out = (G.band_cost(topic_count, th.topic_upper[None, None, :],
                            th.topic_lower[None, None, :]) * alive_f)
-        e = e + weights.topic * jnp.sum(out, axis=(1, 2))
-    return e + weights.healing * agg.unhealed
+        e2 = e2 + jnp.stack(
+            [weights.topic_viol * jnp.sum((out > 0).astype(jnp.float32),
+                                          axis=(1, 2)),
+             weights.topic * jnp.sum(out, axis=(1, 2))], axis=-1)
+    return e2 + agg.unhealed[:, None] * jnp.stack([weights.healing_viol,
+                                                   weights.healing])

@@ -134,3 +134,51 @@ def test_annealer_heals_dead_brokers():
                                                    swap_interval=64))
     assert _hard_violations_after(r)[G.SELF_HEALING_TERM] == 0
     _check_invariants(topo, assign, r)
+
+
+# ---------------------------------------------------------------------------
+# Lexicographic goal-priority property (OptimizationVerifier.java:53,112,339):
+# optimizing the full goal list must not leave a higher-priority goal worse
+# than optimizing its prefix alone achieves — the array-weighted objective
+# must preserve the reference's sequential-priority semantics.
+# ---------------------------------------------------------------------------
+
+_LEX_PROPS = None
+
+
+def _lex_fixture(seed):
+    global _LEX_PROPS
+    if _LEX_PROPS is None:
+        _LEX_PROPS = fixtures.ClusterProperties(
+            num_racks=3, num_brokers=8, num_replicas=240, num_topics=20,
+            min_replication=3, max_replication=3)
+    return fixtures.random_cluster(_LEX_PROPS, seed=1000 + seed)
+
+
+def _viol_after(result):
+    return {s.name: s.violations_after for s in result.goal_summaries}
+
+
+#: prefix lengths checked: end of the hard block, then each early soft goal,
+#: the usage-distribution block, and the full list
+_PREFIX_POINTS = (6, 7, 8, 10, 13, 15)
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_lexicographic_goal_priority(seed):
+    goals = list(G.DEFAULT_GOALS)
+    topo, assign = _lex_fixture(seed)
+    full = OPT.optimize(topo, assign, engine="greedy")
+    vf = _viol_after(full)
+    # hard goals always satisfied on these feasible fixtures
+    for s in full.goal_summaries:
+        if s.hard:
+            assert s.violations_after == 0, (s.name, s.violations_after)
+    for k in _PREFIX_POINTS[:-1]:
+        prefix = tuple(goals[:k])
+        pre = OPT.optimize(topo, assign, goal_names=prefix, engine="greedy")
+        vp = _viol_after(pre)
+        g = goals[k - 1]   # the lowest-priority goal of this prefix
+        assert vf[g] <= vp[g] + 1e-6, (
+            f"goal {g}: full-list optimization leaves {vf[g]} violations "
+            f"but prefix-only achieves {vp[g]}")

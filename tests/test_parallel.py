@@ -111,9 +111,10 @@ def test_sharded_energies_match_full_objective(small_model):
         potential_nw_out=agg0.potential_nw_out,
         leader_bytes_in=agg0.leader_bytes_in,
         topic_count=jnp.zeros((1, 1), jnp.float32),
-        energy=jnp.float32(0.0))
+        energy=jnp.zeros((2,), jnp.float32))
     e_ref = AN._chain_energy(dt, th, weights, st, init, use_topic=False)
-    np.testing.assert_allclose(float(e_sh[0]), float(e_ref), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(e_sh[0]), np.asarray(e_ref),
+                               rtol=1e-5)
 
 
 def test_shard_chains_places_leading_axis(small_model):
