@@ -61,6 +61,12 @@ class AnnealConfig:
     t_max: float = 64.0
     #: include the dense [B,T] topic-count aggregate (memory B·T per chain)
     topic_term_limit: int = 2_000_000
+    #: explicit topic-term mode override: "dense" | "sparse" | "off".
+    #: None = dense when B·T fits, otherwise off (the optimizer's targeted
+    #: repair pass handles the topic goal at scale — in-step sparse CSR
+    #: counts are exact but cost ~2.5x wall-clock while random candidate
+    #: sampling rarely lands on the few violating cells)
+    topic_mode: Optional[str] = None
     #: greedy-at-T≈0 fraction of chains (pure descent)
     cold_fraction: float = 0.25
 
@@ -93,9 +99,15 @@ _band_cost = G.band_cost
 
 def _chain_energy(dt: DeviceTopology, th: G.GoalThresholds,
                   w: OBJ.ObjectiveWeights, st: ChainState,
-                  initial_broker_of: jax.Array, use_topic: bool) -> jax.Array:
+                  initial_broker_of: jax.Array, topic_mode: str,
+                  num_topics: int = 1) -> jax.Array:
     """Decomposed two-channel objective from the running aggregates
-    (init/rescore); returns f32[2] = (violation, cost) channel totals."""
+    (init/rescore); returns f32[2] = (violation, cost) channel totals.
+
+    ``topic_mode``: "dense" scores the maintained [B, T] histogram;
+    "sparse" recomputes the exact topic penalty from ``broker_of`` without
+    the histogram (LinkedIn scale); "off" skips the term (goal unselected).
+    """
     f = OBJ.broker_cost(th, w, st.broker_load, st.replica_count,
                         st.leader_count, st.potential_nw_out,
                         st.leader_bytes_in)                     # [B, 2]
@@ -104,12 +116,15 @@ def _chain_energy(dt: DeviceTopology, th: G.GoalThresholds,
     from cruise_control_tpu.ops.aggregates import partition_rack_excess
     rack_n = jnp.sum(partition_rack_excess(dt, st.broker_of))
     e2 = e2 + jnp.stack([w.rack_viol, w.rack]) * rack_n
-    if use_topic:
+    if topic_mode == "dense":
         alive_f = th.alive.astype(jnp.float32)[:, None]
         out = (_band_cost(st.topic_count, th.topic_upper[None, :],
                           th.topic_lower[None, :]) * alive_f)
         e2 = e2 + jnp.stack([w.topic_viol * jnp.sum((out > 0).astype(jnp.float32)),
                              w.topic * jnp.sum(out)])
+    elif topic_mode == "sparse":
+        tv, tc = G.sparse_topic_penalty(dt, st.broker_of, th, num_topics)
+        e2 = e2 + jnp.stack([w.topic_viol * tv, w.topic * tc])
     unhealed = jnp.sum((dt.replica_offline
                         & (st.broker_of == initial_broker_of)
                         & dt.broker_alive[st.broker_of]).astype(jnp.float32))
@@ -118,9 +133,10 @@ def _chain_energy(dt: DeviceTopology, th: G.GoalThresholds,
 
 def _move_delta(dt: DeviceTopology, th: G.GoalThresholds, w: OBJ.ObjectiveWeights,
                 opts: G.DeviceOptions, st: ChainState,
-                initial_broker_of: jax.Array, use_topic: bool,
-                r: jax.Array, b: jax.Array) -> jax.Array:
-    """Objective delta of moving replica r to broker b. O(max_rf)."""
+                initial_broker_of: jax.Array, topic_mode: str,
+                topic_reps: jax.Array, r: jax.Array, b: jax.Array) -> jax.Array:
+    """Two-channel objective delta of moving replica r to broker b.
+    O(max_rf) (+ O(topic size) for the sparse topic count)."""
     p = dt.partition_of_replica[r]
     a = st.broker_of[r]
     is_leader = st.leader_of[p] == r
@@ -163,9 +179,16 @@ def _move_delta(dt: DeviceTopology, th: G.GoalThresholds, w: OBJ.ObjectiveWeight
     d_rack = occ_b.astype(jnp.float32) - occ_a.astype(jnp.float32)
     d2 = d2 + jnp.stack([w.rack_viol, w.rack]) * d_rack
 
-    if use_topic:
+    if topic_mode != "off":
         t = dt.topic_of_partition[p]
-        n_a, n_b = st.topic_count[a, t], st.topic_count[b, t]
+        if topic_mode == "dense":
+            n_a, n_b = st.topic_count[a, t], st.topic_count[b, t]
+        else:   # sparse: count topic-t replicas on a / b via the topic CSR
+            ids = topic_reps[t]                                  # [M]
+            vm = ids >= 0
+            bro = st.broker_of[jnp.clip(ids, 0)]
+            n_a = jnp.sum(((bro == a) & vm).astype(jnp.float32))
+            n_b = jnp.sum(((bro == b) & vm).astype(jnp.float32))
         u, l = th.topic_upper[t], th.topic_lower[t]
         dc_t = (_band_cost(n_a - 1.0, u, l) - _band_cost(n_a, u, l)
                 + _band_cost(n_b + 1.0, u, l) - _band_cost(n_b, u, l))
@@ -238,10 +261,10 @@ def _lead_delta(dt: DeviceTopology, th: G.GoalThresholds, w: OBJ.ObjectiveWeight
 
 def _swap_delta(dt: DeviceTopology, th: G.GoalThresholds, w: OBJ.ObjectiveWeights,
                 opts: G.DeviceOptions, st: ChainState,
-                initial_broker_of: jax.Array, use_topic: bool,
-                r1: jax.Array, r2: jax.Array) -> jax.Array:
-    """Objective delta of exchanging replicas r1 ↔ r2 between their brokers
-    (ActionType.INTER_BROKER_REPLICA_SWAP). O(max_rf)."""
+                initial_broker_of: jax.Array, topic_mode: str,
+                topic_reps: jax.Array, r1: jax.Array, r2: jax.Array) -> jax.Array:
+    """Two-channel objective delta of exchanging replicas r1 ↔ r2 between
+    their brokers (ActionType.INTER_BROKER_REPLICA_SWAP). O(max_rf)."""
     p1 = dt.partition_of_replica[r1]
     p2 = dt.partition_of_replica[r2]
     a = st.broker_of[r1]
@@ -298,12 +321,20 @@ def _swap_delta(dt: DeviceTopology, th: G.GoalThresholds, w: OBJ.ObjectiveWeight
     d_rack = rack_delta(r1, p1, a, b) + rack_delta(r2, p2, b, a)
     d2 = d2 + jnp.stack([w.rack_viol, w.rack]) * d_rack
 
-    if use_topic:
+    if topic_mode != "off":
         t1 = dt.topic_of_partition[p1]
         t2 = dt.topic_of_partition[p2]
 
+        def count(t, broker):
+            if topic_mode == "dense":
+                return st.topic_count[broker, t]
+            ids = topic_reps[t]
+            vm = ids >= 0
+            bro = st.broker_of[jnp.clip(ids, 0)]
+            return jnp.sum(((bro == broker) & vm).astype(jnp.float32))
+
         def topic_delta(t, frm, to):
-            n_f, n_t = st.topic_count[frm, t], st.topic_count[to, t]
+            n_f, n_t = count(t, frm), count(t, to)
             u, l = th.topic_upper[t], th.topic_lower[t]
             vi = lambda n: (_band_cost(n, u, l) > 0).astype(jnp.float32)
             dc = (_band_cost(n_f - 1.0, u, l) - _band_cost(n_f, u, l)
@@ -406,11 +437,15 @@ def _apply_leads(dt: DeviceTopology, st: ChainState, p_vec, new_leader_vec
 
 
 def make_step_fn(dt: DeviceTopology, th, weights, opts, cfg: AnnealConfig,
-                 movable_idx, dest_idx, initial_broker_of, use_topic: bool):
+                 movable_idx, dest_idx, initial_broker_of, topic_mode: str,
+                 topic_reps=None):
     """Build the per-chain annealer step (module-level for profiling/tests)."""
     R, P, B = dt.num_replicas, dt.num_partitions, dt.num_brokers
     Km, Kl, Ks = cfg.tries_move, cfg.tries_lead, cfg.tries_swap
     m = dt.max_rf
+    if topic_reps is None:
+        topic_reps = jnp.full((1, 1), -1, jnp.int32)
+    use_topic = topic_mode == "dense"   # maintained-histogram updates
 
     def _pressure(st, brokers):
         """Max resource-utilization fraction — power-of-two-choices key."""
@@ -432,7 +467,8 @@ def make_step_fn(dt: DeviceTopology, th, weights, opts, cfg: AnnealConfig,
         b_c = jnp.where(cold, b1, b2)
         d_move = jax.vmap(
             lambda r, b: _move_delta(dt, th, weights, opts, st,
-                                     initial_broker_of, use_topic, r, b)
+                                     initial_broker_of, topic_mode,
+                                     topic_reps, r, b)
         )(r_c, b_c)
         # --- candidate leadership moves
         p_c = jax.random.randint(ks[4], (Kl,), 0, P)
@@ -452,7 +488,8 @@ def make_step_fn(dt: DeviceTopology, th, weights, opts, cfg: AnnealConfig,
         s_r2 = jnp.where(cold_w, w3, w4)
         d_swap = jax.vmap(
             lambda x, y: _swap_delta(dt, th, weights, opts, st,
-                                     initial_broker_of, use_topic, x, y)
+                                     initial_broker_of, topic_mode,
+                                     topic_reps, x, y)
         )(s_r1, s_r2)
 
         # --- conflict-free selection: proposals touching disjoint brokers /
@@ -484,7 +521,7 @@ def make_step_fn(dt: DeviceTopology, th, weights, opts, cfg: AnnealConfig,
             jnp.stack([p_of_r[r_c], neg1], axis=1),
             jnp.stack([p_c, negl], axis=1),
             jnp.stack([p_of_r[s_r1], p_of_r[s_r2]], axis=1)])          # [K,2]
-        if use_topic:
+        if topic_mode != "off":
             t_of_p = dt.topic_of_partition
             topic = jnp.concatenate([
                 jnp.stack([t_of_p[p_of_r[r_c]], neg1], axis=1),
@@ -550,9 +587,38 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
         n_dev = int(np.prod(mesh.devices.shape))
         C = -(-C // n_dev) * n_dev
     R, P, B = dt.num_replicas, dt.num_partitions, dt.num_brokers
-    use_topic = bool(B * num_topics <= cfg.topic_term_limit)
+    # topic term: dense maintained histogram when it fits; beyond the dense
+    # limit the default hands TopicReplicaDistributionGoal to the optimizer's
+    # targeted repair pass (analyzer/repair.py); cfg.topic_mode = "sparse"
+    # forces exact in-step CSR counts at any scale instead.
+    topic_on = "TopicReplicaDistributionGoal" in tuple(goal_names)
+    if not topic_on:
+        topic_mode = "off"
+    elif cfg.topic_mode is not None:
+        topic_mode = cfg.topic_mode
+    elif B * num_topics <= cfg.topic_term_limit:
+        topic_mode = "dense"
+    else:
+        topic_mode = "off"
+    use_topic = topic_mode == "dense"
     if initial_broker_of is None:
         initial_broker_of = jnp.asarray(assign.broker_of, jnp.int32)
+
+    topic_reps = jnp.full((1, 1), -1, jnp.int32)
+    if topic_mode == "sparse":
+        # topic CSR: [T, M] replica ids per topic, -1 padded (assignment-
+        # invariant, built once on host)
+        t_of_r = np.asarray(jax.device_get(
+            dt.topic_of_partition[dt.partition_of_replica]))
+        counts = np.bincount(t_of_r, minlength=num_topics)
+        M = max(int(counts.max()), 1)
+        order = np.argsort(t_of_r, kind="stable")
+        starts = np.zeros(num_topics + 1, np.int64)
+        starts[1:] = np.cumsum(counts)
+        cols = np.arange(R) - starts[t_of_r[order]]
+        csr = np.full((num_topics, M), -1, np.int32)
+        csr[t_of_r[order], cols] = order
+        topic_reps = jnp.asarray(csr)
 
     # Empty candidate pools degrade to a single always-illegal index (the
     # legality masks turn those proposals into +inf deltas) so leadership-only
@@ -578,7 +644,8 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
                      else jnp.zeros((1, 1), jnp.float32)),
         energy=jnp.zeros((2,), jnp.float32),
     )
-    e0 = _chain_energy_jit(dt, th, weights, base, initial_broker_of, use_topic)
+    e0 = _chain_energy_jit(dt, th, weights, base, initial_broker_of,
+                           topic_mode, num_topics)
     base = base._replace(energy=e0)
     chains = jax.tree.map(lambda x: jnp.broadcast_to(x, (C,) + x.shape), base)
 
@@ -603,9 +670,9 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
 
     chains, temps = _run_pt(chains, temps0, keys, dt, th, weights, opts,
                             movable_idx, dest_idx, initial_broker_of,
-                            cfg, use_topic, n_rounds)
+                            topic_reps, cfg, topic_mode, n_rounds)
     energies = _rescore_chains(chains, dt, th, weights, initial_broker_of,
-                               use_topic)                        # f32[C, 2]
+                               topic_mode, num_topics)           # f32[C, 2]
     # lexicographic best chain, combined in f64 on host — the f32 combined
     # scalar would absorb the cost channel under any hard violation
     e2 = np.asarray(jax.device_get(energies), np.float64)
@@ -621,13 +688,14 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
 
 from functools import partial as _partial
 
-_chain_energy_jit = jax.jit(_chain_energy, static_argnames=("use_topic",))
+_chain_energy_jit = jax.jit(_chain_energy,
+                            static_argnames=("topic_mode", "num_topics"))
 
 
-@_partial(jax.jit, static_argnames=("cfg", "use_topic", "n_rounds"))
+@_partial(jax.jit, static_argnames=("cfg", "topic_mode", "n_rounds"))
 def _run_pt(chains, temps, keys, dt, th, weights, opts, movable_idx,
-            dest_idx, initial_broker_of, cfg: AnnealConfig, use_topic: bool,
-            n_rounds: int):
+            dest_idx, initial_broker_of, topic_reps, cfg: AnnealConfig,
+            topic_mode: str, n_rounds: int):
     """The whole parallel-tempering run as ONE module-level jit.
 
     Module-level matters: a jit wrapper created inside ``optimize_anneal``
@@ -640,7 +708,7 @@ def _run_pt(chains, temps, keys, dt, th, weights, opts, movable_idx,
     """
     C = temps.shape[0]
     step = make_step_fn(dt, th, weights, opts, cfg, movable_idx, dest_idx,
-                        initial_broker_of, use_topic)
+                        initial_broker_of, topic_mode, topic_reps)
 
     def chain_round(st: ChainState, temp, key):
         ks = jax.random.split(key, cfg.swap_interval)
@@ -682,9 +750,9 @@ def _run_pt(chains, temps, keys, dt, th, weights, opts, movable_idx,
     return chains, temps
 
 
-@_partial(jax.jit, static_argnames=("use_topic",))
+@_partial(jax.jit, static_argnames=("topic_mode", "num_topics"))
 def _rescore_chains(chains, dt, th, weights, initial_broker_of,
-                    use_topic: bool):
+                    topic_mode: str, num_topics: int = 1):
     """Exact per-chain rescore: recomputed load aggregates (immune to
     incremental float drift) plus the *maintained* topic counts — integer
     scatter-adds, hence already exact. Rebuilding the dense [B, T]
@@ -714,6 +782,7 @@ def _rescore_chains(chains, dt, th, weights, initial_broker_of,
             leader_bytes_in=jax.ops.segment_sum(
                 dt.leader_bytes_in, leader_broker, num_segments=B),
         )
-        return _chain_energy(dt, th, weights, st2, initial_broker_of, use_topic)
+        return _chain_energy(dt, th, weights, st2, initial_broker_of,
+                             topic_mode, num_topics)
 
     return jax.vmap(rescore)(chains)

@@ -224,10 +224,15 @@ class GoalThresholds(NamedTuple):
 
 @partial(jax.jit, static_argnames=("constraint",))
 def compute_thresholds(dt: DeviceTopology, constraint: BalancingConstraint,
-                       initial: BrokerAggregates) -> GoalThresholds:
+                       initial: BrokerAggregates,
+                       topic_total: Optional[jax.Array] = None
+                       ) -> GoalThresholds:
     """Precompute all goal constants from the initial aggregates.
 
     Totals are move-invariant, so these are exact for the whole optimization.
+    ``topic_total`` (f32[T], from :func:`~cruise_control_tpu.ops.aggregates.
+    topic_totals`) lets large-cluster callers supply per-topic totals without
+    a dense [B, T] histogram in ``initial``.
     """
     alive = dt.broker_alive
     alive_f = alive.astype(jnp.float32)
@@ -249,7 +254,8 @@ def compute_thresholds(dt: DeviceTopology, constraint: BalancingConstraint,
     rp = jnp.float32(constraint.replica_balance_percentage)
     lp = jnp.float32(constraint.leader_replica_balance_percentage)
     tp = jnp.float32(constraint.topic_replica_balance_percentage)
-    topic_total = jnp.sum(initial.topic_count, axis=0).astype(jnp.float32)  # [T]
+    if topic_total is None:
+        topic_total = jnp.sum(initial.topic_count, axis=0).astype(jnp.float32)
     topic_avg = topic_total / n_alive
 
     host_cap = dt.host_capacity
@@ -448,14 +454,56 @@ class GoalPenalties(NamedTuple):
 def topic_distribution_penalty(topic_count: jax.Array, th: GoalThresholds):
     """TopicReplicaDistributionGoal (goals/TopicReplicaDistributionGoal.java:45-55):
     per-(topic, broker) replica counts within the per-topic band.
-    ``topic_count`` is the [B, T] histogram from BrokerAggregates (the
-    annealer uses a CSR-windowed delta instead)."""
+    ``topic_count`` is the [B, T] histogram from BrokerAggregates; large
+    clusters use :func:`sparse_topic_penalty` instead."""
     counts = topic_count.astype(jnp.float32)
     alive_f = th.alive.astype(jnp.float32)[:, None]
     out = (jnp.maximum(counts - th.topic_upper[None, :], 0.0)
            + jnp.maximum(th.topic_lower[None, :] - counts, 0.0)) * alive_f
     violations = jnp.sum((out > 0).astype(jnp.float32))
     cost = jnp.sum(out / jnp.maximum(th.topic_upper[None, :], 1.0))
+    return violations, cost
+
+
+def sparse_topic_penalty(dt: DeviceTopology, broker_of: jax.Array,
+                         th: GoalThresholds, num_topics: int):
+    """Exact TopicReplicaDistributionGoal totals WITHOUT the [B, T]
+    histogram — at LinkedIn scale (B·T ≈ 78M cells) the dense histogram is
+    hundreds of MB per evaluation, yet only ≤ R cells are non-empty.
+
+    Sort-based: per-replica (broker, topic) keys → run lengths are the
+    non-empty cell counts; empty (alive broker, topic) cells contribute the
+    lower-band penalty analytically per topic. Matches
+    :func:`topic_distribution_penalty` exactly (same band + normalization).
+    """
+    R = dt.num_replicas
+    T = num_topics
+    BT = dt.num_brokers * T
+    t_of_r = dt.topic_of_partition[dt.partition_of_replica]          # [R]
+    alive_r = th.alive[broker_of]
+    # replicas on dead brokers park in a sentinel bin (the reference's
+    # alive-broker factor)
+    key = jnp.where(alive_r, broker_of * T + t_of_r, BT)
+    sk = jnp.sort(key)
+    first = jnp.concatenate([jnp.ones((1,), bool), sk[1:] != sk[:-1]])
+    cell_id = jnp.cumsum(first.astype(jnp.int32)) - 1                # [R]
+    counts = jax.ops.segment_sum(jnp.ones((R,), jnp.float32), cell_id,
+                                 num_segments=R)
+    cell_key = jax.ops.segment_max(sk, cell_id, num_segments=R)
+    n_cells = cell_id[-1] + 1
+    valid = ((jnp.arange(R) < n_cells) & (cell_key >= 0) & (cell_key < BT))
+    t_cell = jnp.where(valid, cell_key % T, 0)
+    u, l = th.topic_upper[t_cell], th.topic_lower[t_cell]
+    out = band_cost(counts, u, l) * valid.astype(jnp.float32)
+    violations = jnp.sum((out > 0).astype(jnp.float32))
+    cost = jnp.sum(out)
+    # empty cells: alive brokers hosting zero replicas of topic t
+    nnz_t = jax.ops.segment_sum(valid.astype(jnp.float32), t_cell,
+                                num_segments=T)
+    empty_t = jnp.maximum(th.n_alive - nnz_t, 0.0)
+    empty_band = band_cost(jnp.zeros((T,)), th.topic_upper, th.topic_lower)
+    violations = violations + jnp.sum(empty_t * (empty_band > 0))
+    cost = cost + jnp.sum(empty_t * empty_band)
     return violations, cost
 
 
@@ -475,17 +523,22 @@ def preferred_leader_penalty(dt: DeviceTopology, assign: Assignment):
     return mism, mism
 
 
-@partial(jax.jit, static_argnames=("num_topics", "goal_names"))
+@partial(jax.jit, static_argnames=("num_topics", "goal_names",
+                                   "sparse_topic"))
 def full_goal_penalties(dt: DeviceTopology, assign: Assignment,
                         th: GoalThresholds, num_topics: int,
                         goal_names: Sequence[str],
                         initial_broker_of: Optional[jax.Array] = None,
-                        agg: Optional[BrokerAggregates] = None) -> GoalPenalties:
+                        agg: Optional[BrokerAggregates] = None,
+                        sparse_topic: bool = False) -> GoalPenalties:
     """Evaluate every requested goal on a full state. jit/vmap-safe.
 
-    ``goal_names`` must be a tuple (static jit argument)."""
+    ``goal_names`` must be a tuple (static jit argument). ``sparse_topic``
+    scores TopicReplicaDistributionGoal with :func:`sparse_topic_penalty`
+    (callers then pass ``agg`` built with a 1-topic axis)."""
     if agg is None:
-        agg = compute_aggregates(dt, assign, num_topics)
+        agg = compute_aggregates(dt, assign,
+                                 1 if sparse_topic else num_topics)
     bt = broker_terms(
         th,
         agg.broker_load,
@@ -506,7 +559,11 @@ def full_goal_penalties(dt: DeviceTopology, assign: Assignment,
         if g == "RackAwareGoal":
             v, c = rack_aware_penalty(dt, assign.broker_of)
         elif g == "TopicReplicaDistributionGoal":
-            v, c = topic_distribution_penalty(agg.topic_count, th)
+            if sparse_topic:
+                v, c = sparse_topic_penalty(dt, assign.broker_of, th,
+                                            num_topics)
+            else:
+                v, c = topic_distribution_penalty(agg.topic_count, th)
         elif g == "PreferredLeaderElectionGoal":
             v, c = preferred_leader_penalty(dt, assign)
         elif g in _BT:

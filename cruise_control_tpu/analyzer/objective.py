@@ -200,11 +200,13 @@ def evaluate_objective(dt: DeviceTopology, assign: Assignment,
                        th: G.GoalThresholds, weights: ObjectiveWeights,
                        goal_names: Sequence[str], num_topics: int,
                        initial_broker_of: Optional[jax.Array] = None,
-                       agg: Optional[BrokerAggregates] = None) -> ObjectiveState:
+                       agg: Optional[BrokerAggregates] = None,
+                       sparse_topic: bool = False) -> ObjectiveState:
     """Exact full-state objective (used for scoring/ranking final states and
     for periodic drift correction of the annealer's running aggregates)."""
     pen = G.full_goal_penalties(dt, assign, th, num_topics, goal_names,
-                                initial_broker_of=initial_broker_of, agg=agg)
+                                initial_broker_of=initial_broker_of, agg=agg,
+                                sparse_topic=sparse_topic)
     value = jnp.stack([jnp.sum(pen.violations * weights.per_goal_viol),
                        jnp.sum(pen.cost * weights.per_goal)])
     return ObjectiveState(value=value, penalties=pen)

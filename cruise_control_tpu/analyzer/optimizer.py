@@ -36,6 +36,10 @@ from cruise_control_tpu.ops.stats import compute_cluster_stats
 #: R·B above which greedy's move matrix is considered too large
 GREEDY_LIMIT = 40_000_000
 
+#: B·T above which the dense [B, T] topic histogram is replaced by the
+#: sort-based sparse topic penalty (matches AnnealConfig.topic_term_limit)
+TOPIC_DENSE_LIMIT = 2_000_000
+
 #: balancedness defaults (KafkaCruiseControlConfig goal.balancedness.*)
 PRIORITY_WEIGHT = 1.1
 STRICTNESS_WEIGHT = 1.5
@@ -103,8 +107,8 @@ class OptimizerResult:
     def violated_goals_after(self) -> List[str]:
         return [s.name for s in self.goal_summaries if s.violated_after]
 
-    def to_json(self) -> dict:
-        return {
+    def to_json(self, verbose: bool = False) -> dict:
+        out = {
             "proposals": [p.to_json() for p in self.proposals],
             "goalSummary": [
                 {"goal": s.name, "status": ("VIOLATED" if s.violated_after
@@ -121,10 +125,24 @@ class OptimizerResult:
             "engine": self.engine,
             "wallTimeSeconds": self.wall_time_s,
         }
+        if verbose:
+            # servlet/response/stats BrokerStats "Statistics" payloads:
+            # the full ClusterModelStats before and after optimization
+            out["clusterModelStatsBeforeOptimization"] = self.stats_before
+            out["clusterModelStatsAfterOptimization"] = self.stats_after
+            out["goalSummaryDetail"] = [
+                {"goal": s.name, "hard": s.hard,
+                 "violationsBefore": s.violations_before,
+                 "violationsAfter": s.violations_after,
+                 "costBefore": s.cost_before, "costAfter": s.cost_after}
+                for s in self.goal_summaries]
+        return out
 
 
-def _stats_dict(dt, assign, constraint, num_topics) -> dict:
-    st = compute_cluster_stats(dt, assign, constraint, num_topics)
+def _stats_dict(dt, assign, constraint, num_topics,
+                sparse_topic: bool = False) -> dict:
+    st = compute_cluster_stats(dt, assign, constraint, num_topics,
+                               sparse_topic=sparse_topic)
     host = jax.device_get(st._asdict())     # one transfer for all fields
     return {k: np.asarray(v).tolist() for k, v in host.items()}
 
@@ -150,6 +168,7 @@ def optimize(topo: ClusterTopology, assign: Assignment,
     from cruise_control_tpu.analyzer import annealer as AN  # cycle-free import
 
     from cruise_control_tpu.common.metrics import REGISTRY
+    from cruise_control_tpu.server.async_ops import report_progress
     proposal_timer = REGISTRY.timer("proposal-computation-timer")
     t0 = time.time()
     constraint = constraint or BalancingConstraint()
@@ -157,18 +176,25 @@ def optimize(topo: ClusterTopology, assign: Assignment,
     goal_names = tuple(goal_names)
     dt = device_topology(topo)
     num_topics = topo.num_topics
-    agg0 = compute_aggregates(dt, assign, num_topics)
-    th = G.compute_thresholds(dt, constraint, agg0)
+    sparse_topic = topo.num_brokers * num_topics > TOPIC_DENSE_LIMIT
+    agg0 = compute_aggregates(dt, assign, 1 if sparse_topic else num_topics)
+    from cruise_control_tpu.ops.aggregates import topic_totals
+    th = G.compute_thresholds(
+        dt, constraint, agg0,
+        topic_total=topic_totals(dt, num_topics) if sparse_topic else None)
     weights = OBJ.build_weights(goal_names)
     init_broker = jnp.asarray(assign.broker_of, jnp.int32)
 
     before = OBJ.evaluate_objective(dt, assign, th, weights, goal_names,
-                                    num_topics, init_broker, agg0)
-    stats_before = _stats_dict(dt, assign, constraint, num_topics)
+                                    num_topics, init_broker, agg0,
+                                    sparse_topic=sparse_topic)
+    stats_before = _stats_dict(dt, assign, constraint, num_topics,
+                               sparse_topic=sparse_topic)
 
     if engine == "auto":
         engine = ("greedy" if topo.num_replicas * topo.num_brokers <= GREEDY_LIMIT
                   else "anneal")
+    report_progress(f"Optimizing goals with the {engine} engine")
 
     if engine == "greedy":
         # sequential-priority stages (GoalOptimizer.java:429): lexicographic
@@ -183,10 +209,18 @@ def optimize(topo: ClusterTopology, assign: Assignment,
                                   initial_broker_of=init_broker,
                                   mesh=mesh)
         final = ares.assignment
-        # hard-goal polish: if stochastic search left hard violations and the
-        # model fits the greedy engine, finish with deterministic descent.
+        # targeted repair (analyzer/repair.py): walk exactly the violating
+        # cells/brokers the stochastic search left behind — the reference's
+        # per-goal violation walks, at any scale
+        report_progress("Repairing residual goal violations")
+        from cruise_control_tpu.analyzer import repair as REP
+        final, _, _ = REP.repair(dt, final, th, weights, opts, num_topics,
+                                 initial_broker_of=init_broker, seed=seed)
+        # hard-goal polish: if violations remain and the model fits the
+        # greedy engine, finish with deterministic descent.
         interim = OBJ.evaluate_objective(dt, final, th, weights, goal_names,
-                                         num_topics, init_broker)
+                                         num_topics, init_broker,
+                                         sparse_topic=sparse_topic)
         hard_mask = np.array([G.is_hard(g) for g in goal_names] + [True])
         if (np.asarray(interim.penalties.violations)[hard_mask].sum() > 0
                 and topo.num_replicas * topo.num_brokers <= GREEDY_LIMIT):
@@ -199,8 +233,11 @@ def optimize(topo: ClusterTopology, assign: Assignment,
         raise ValueError(f"unknown engine {engine!r}")
 
     after = OBJ.evaluate_objective(dt, final, th, weights, goal_names,
-                                   num_topics, init_broker)
-    stats_after = _stats_dict(dt, final, constraint, num_topics)
+                                   num_topics, init_broker,
+                                   sparse_topic=sparse_topic)
+    stats_after = _stats_dict(dt, final, constraint, num_topics,
+                              sparse_topic=sparse_topic)
+    report_progress("Decoding execution proposals")
     props = PR.diff(topo, assign, final)
     # movement counts derived from the proposal diff so both engines report
     # the same thing the executor will do.

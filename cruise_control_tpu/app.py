@@ -75,7 +75,8 @@ class CruiseControlApp:
                 "min.samples.per.partition.metrics.window"),
             max_allowed_extrapolations=config.get(
                 "max.allowed.extrapolations.per.partition"),
-            sampling_interval_ms=config.get("metric.sampling.interval.ms"))
+            sampling_interval_ms=config.get("metric.sampling.interval.ms"),
+            use_lr_model=config.get("use.linear.regression.model"))
         self._metadata_source = metadata_source
         adapter = cluster_adapter or FakeClusterAdapter({})
         self.executor = Executor(
@@ -170,16 +171,66 @@ class CruiseControlApp:
             anneal_config=self._anneal_config(),
             mesh=self.mesh)
 
-    def _model(self, requirements=None) -> Tuple[ClusterTopology, Assignment]:
-        return self.load_monitor.cluster_model(
-            requirements=requirements or self._default_requirements)
+    def _model(self, requirements=None, data_from: Optional[str] = None
+               ) -> Tuple[ClusterTopology, Assignment]:
+        """``data_from`` (ParameterUtils.DataFrom,
+        GoalBasedOptimizationParameters.java:37-46): VALID_WINDOWS demands
+        fully-monitored windows (partition ratio 1.0, ≥1 window);
+        VALID_PARTITIONS uses every valid partition over all available
+        windows (ratio 0.0)."""
+        if requirements is None:
+            if data_from and data_from.upper() == "VALID_WINDOWS":
+                requirements = ModelCompletenessRequirements(
+                    min_required_num_windows=1,
+                    min_monitored_partitions_percentage=1.0,
+                    include_all_topics=True)
+            elif data_from and data_from.upper() == "VALID_PARTITIONS":
+                requirements = ModelCompletenessRequirements(
+                    min_required_num_windows=1,
+                    min_monitored_partitions_percentage=0.0,
+                    include_all_topics=True)
+            else:
+                requirements = self._default_requirements
+        return self.load_monitor.cluster_model(requirements=requirements)
+
+    def _ready_goals(self) -> Tuple[str, ...]:
+        """GoalOptimizer readyGoals approximation: with fewer valid windows
+        than the monitor keeps, only the hard (anomaly-detection) goals are
+        considered ready; with full coverage all default goals are."""
+        snap = self.load_monitor.state_snapshot()
+        if snap["numValidWindows"] < self.load_monitor.partition_aggregator.num_windows:
+            return tuple(g for g in self.default_goals if G.is_hard(g))
+        return tuple(self.default_goals)
+
+    def _exclusions(self, exclude_recently_removed: bool,
+                    exclude_recently_demoted: bool) -> Dict[str, Sequence[int]]:
+        """Excluded-broker sets from the executor's recent history
+        (exclude_recently_removed/demoted_brokers parameters). Keys appear
+        only when the set is non-empty so standing flags from client tooling
+        don't needlessly bypass the proposal cache."""
+        out: Dict[str, Sequence[int]] = {}
+        if exclude_recently_removed and self.executor.recently_removed_brokers:
+            out["excluded_brokers_for_replica_move"] = sorted(
+                self.executor.recently_removed_brokers)
+        if exclude_recently_demoted and self.executor.recently_demoted_brokers:
+            out["excluded_brokers_for_leadership"] = sorted(
+                self.executor.recently_demoted_brokers)
+        return out
 
     def proposals(self, goal_names: Optional[Sequence[str]] = None,
                   ignore_proposal_cache: bool = False,
+                  data_from: Optional[str] = None,
+                  use_ready_default_goals: bool = False,
+                  exclude_recently_removed_brokers: bool = False,
+                  exclude_recently_demoted_brokers: bool = False,
                   **option_kw) -> OPT.OptimizerResult:
         """ProposalsRunnable.getProposals: cached unless stale/bypassed."""
+        if goal_names is None and use_ready_default_goals:
+            goal_names = self._ready_goals()
+        option_kw.update(self._exclusions(exclude_recently_removed_brokers,
+                                          exclude_recently_demoted_brokers))
         use_cache = (not ignore_proposal_cache and not goal_names
-                     and not option_kw)
+                     and not option_kw and not data_from)
         if use_cache:
             with self._cache_lock:
                 c = self._proposal_cache
@@ -189,7 +240,7 @@ class CruiseControlApp:
                     if (not c.generation.is_stale(gen)
                             and age < self.config.get("proposal.expiration.ms")):
                         return c.result
-        topo, assign = self._model()
+        topo, assign = self._model(data_from=data_from)
         options = (G.build_options(topo, **option_kw) if option_kw else None)
         result = self._optimize(topo, assign, goal_names, options)
         if use_cache:
@@ -206,6 +257,11 @@ class CruiseControlApp:
                   excluded_topics: Sequence[str] = (),
                   destination_broker_ids: Sequence[int] = (),
                   concurrency: Optional[int] = None,
+                  data_from: Optional[str] = None,
+                  use_ready_default_goals: bool = False,
+                  exclude_recently_removed_brokers: bool = False,
+                  exclude_recently_demoted_brokers: bool = False,
+                  verbose: bool = False,
                   **_ignored) -> dict:
         """RebalanceRunnable.rebalance (RebalanceRunnable.java:130-144)."""
         if self_healing:
@@ -213,12 +269,16 @@ class CruiseControlApp:
         goals = goal_names or (
             tuple(self.config.get("self.healing.goals")) or None
             if self_healing else None)
-        topo, assign = self._model()
+        if goals is None and use_ready_default_goals:
+            goals = self._ready_goals()
+        topo, assign = self._model(data_from=data_from)
         options = G.build_options(
             topo, excluded_topics=excluded_topics,
-            requested_destination_broker_ids=destination_broker_ids)
+            requested_destination_broker_ids=destination_broker_ids,
+            **self._exclusions(exclude_recently_removed_brokers,
+                               exclude_recently_demoted_brokers))
         result = self._optimize(topo, assign, goals, options)
-        summary = result.to_json()
+        summary = result.to_json(verbose=verbose)
         if not dryrun:
             exec_summary = self.executor.execute_proposals(
                 result.proposals, concurrency=concurrency)
@@ -226,27 +286,30 @@ class CruiseControlApp:
         return summary
 
     def add_brokers(self, broker_ids: Sequence[int], dryrun: bool = True,
+                    data_from: Optional[str] = None, verbose: bool = False,
                     **kw) -> dict:
         """AddBrokersRunnable: move load onto the new brokers."""
-        topo, assign = self._model()
+        topo, assign = self._model(data_from=data_from)
         ids = set(int(b) for b in broker_ids)
         new_mask = np.array([int(b) in ids for b in topo.broker_ids])
         topo = dataclasses.replace(topo, broker_new=new_mask)
         options = G.build_options(topo,
                                   requested_destination_broker_ids=broker_ids)
         result = self._optimize(topo, assign, None, options)
-        summary = result.to_json()
+        summary = result.to_json(verbose=verbose)
         if not dryrun:
             summary["execution"] = self.executor.execute_proposals(
                 result.proposals)
         return summary
 
     def remove_brokers(self, broker_ids: Sequence[int], dryrun: bool = True,
-                       self_healing: bool = False, **kw) -> dict:
+                       self_healing: bool = False,
+                       data_from: Optional[str] = None, verbose: bool = False,
+                       **kw) -> dict:
         """RemoveBrokersRunnable: drain the given brokers."""
         if self_healing:
             dryrun = False
-        topo, assign = self._model()
+        topo, assign = self._model(data_from=data_from)
         ids = set(int(b) for b in broker_ids)
         # removed brokers: not a legal destination; their replicas must leave
         idx = {int(b): i for i, b in enumerate(topo.broker_ids)}
@@ -262,18 +325,20 @@ class CruiseControlApp:
             topo, excluded_brokers_for_replica_move=broker_ids,
             excluded_brokers_for_leadership=broker_ids)
         result = self._optimize(topo, assign, None, options)
-        summary = result.to_json()
+        summary = result.to_json(verbose=verbose)
         if not dryrun:
             summary["execution"] = self.executor.execute_proposals(
                 result.proposals, removed_brokers=ids)
         return summary
 
     def demote_brokers(self, broker_ids: Sequence[int], dryrun: bool = True,
-                       self_healing: bool = False, **kw) -> dict:
+                       self_healing: bool = False,
+                       data_from: Optional[str] = None, verbose: bool = False,
+                       **kw) -> dict:
         """DemoteBrokerRunnable: move leadership off the given brokers."""
         if self_healing:
             dryrun = False
-        topo, assign = self._model()
+        topo, assign = self._model(data_from=data_from)
         ids = set(int(b) for b in broker_ids)
         idx = {int(b): i for i, b in enumerate(topo.broker_ids)}
         demoted = topo.broker_demoted.copy()
@@ -287,20 +352,22 @@ class CruiseControlApp:
             topo, assign, ("LeaderReplicaDistributionGoal",
                            "LeaderBytesInDistributionGoal",
                            "PreferredLeaderElectionGoal"), options)
-        summary = result.to_json()
+        summary = result.to_json(verbose=verbose)
         if not dryrun:
             summary["execution"] = self.executor.execute_proposals(
                 result.proposals, demoted_brokers=ids)
         return summary
 
     def fix_offline_replicas(self, dryrun: bool = True,
-                             self_healing: bool = False, **kw) -> dict:
+                             self_healing: bool = False,
+                             data_from: Optional[str] = None,
+                             verbose: bool = False, **kw) -> dict:
         """FixOfflineReplicasRunnable: self-heal dead-disk/broker replicas."""
         if self_healing:
             dryrun = False
-        topo, assign = self._model()
+        topo, assign = self._model(data_from=data_from)
         result = self._optimize(topo, assign)
-        summary = result.to_json()
+        summary = result.to_json(verbose=verbose)
         if not dryrun:
             summary["execution"] = self.executor.execute_proposals(
                 result.proposals)

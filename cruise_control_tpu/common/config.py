@@ -257,6 +257,9 @@ def _service_config_def() -> ConfigDef:
              I.MEDIUM, "Broker-failure fix delay.")
     d.define("failed.brokers.file.path", T.STRING, "failed_brokers.json",
              I.LOW, "Persisted failed-broker record.")
+    d.define("use.linear.regression.model", T.BOOLEAN, False, I.MEDIUM,
+             "Use the trained linear-regression CPU model for partition CPU "
+             "estimation after TRAIN completes.")
     d.define("anomaly.detection.recheck.delay.ms", T.LONG, None, I.LOW,
              "Delay before re-checking an anomaly deferred by an ongoing "
              "execution (None = anomaly.detection.interval.ms).")

@@ -345,16 +345,25 @@ class Executor:
         try:
             # inside the try: a partial throttle-set failure must still clear
             # what was applied and release the executor state
+            from cruise_control_tpu.server.async_ops import report_progress
             if helper is not None:
                 helper.set_throttles([t.proposal for t in planner.replica_tasks])
             self._state = ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
+            report_progress(
+                f"Executing {len(planner.replica_tasks)} inter-broker "
+                f"replica movements")
             self._move_replicas(planner, concurrency)
             if logdir_moves and not self._stop_requested.is_set():
                 self._state = \
                     ExecutorState.INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
+                report_progress(f"Executing {len(logdir_moves)} intra-broker "
+                                f"logdir movements")
                 self.adapter.alter_replica_logdirs(logdir_moves)
                 intra_moves_applied = len(logdir_moves)
             self._state = ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS
+            report_progress(
+                f"Executing {len(planner.leadership_tasks)} leadership "
+                f"movements")
             self._move_leadership(planner)
         finally:
             if helper is not None:

@@ -91,11 +91,15 @@ class KafkaMetricsTopicSampler(MetricSampler):
 
     def __init__(self, config, topic: str = METRICS_TOPIC):
         self._kafka = _require_kafka()
+        self._cpu_model = None
         self._consumer = self._kafka.KafkaConsumer(
             topic, bootstrap_servers=config.get("bootstrap.servers"),
             value_deserializer=lambda b: json.loads(b.decode()),
             consumer_timeout_ms=10_000, auto_offset_reset="earliest",
             group_id="cruise-control-tpu-sampler")
+
+    def set_cpu_model(self, cpu_model):
+        self._cpu_model = cpu_model
 
     def get_samples(self, metadata: ClusterMetadata, start_ms: int,
                     end_ms: int):
@@ -104,15 +108,21 @@ class KafkaMetricsTopicSampler(MetricSampler):
             m = CruiseControlMetric.from_json(msg.value)
             if start_ms <= m.time_ms < end_ms:
                 raw.append(m)
-        return process_raw_metrics(raw, metadata, (start_ms + end_ms) // 2)
+        return process_raw_metrics(raw, metadata, (start_ms + end_ms) // 2,
+                                   cpu_model=self._cpu_model)
 
 
 def process_raw_metrics(raw: List[CruiseControlMetric],
-                        metadata: ClusterMetadata, t_ms: int
+                        metadata: ClusterMetadata, t_ms: int,
+                        cpu_model=None
                         ) -> Tuple[List[PartitionMetricSample],
                                    List[BrokerMetricSample]]:
     """Raw records → partition/broker samples, incl. the CPU attribution of
-    CruiseControlMetricsProcessor (ModelParameters static linear model).
+    CruiseControlMetricsProcessor. ``cpu_model``: a *trained*
+    LinearRegressionCpuModel estimates partition leader CPU directly from
+    the partition's byte rates
+    (estimateLeaderCpuUtilUsingLinearRegressionModel); otherwise the static
+    proportional attribution applies (ModelParameters static weights).
 
     Shared by the Kafka sampler and any file/HTTP-fed pipeline.
     """
@@ -162,9 +172,12 @@ def process_raw_metrics(raw: List[CruiseControlMetric],
             continue
         cpu_b, lbi_b, lbo_b, rbi_b = broker_ctx.get(pm.leader,
                                                     (0.0, 0.0, 0.0, 0.0))
-        pcpu = float(estimate_partition_cpu(
-            np.asarray(bytes_in), np.asarray(bytes_out),
-            cpu_b, lbi_b, lbo_b, rbi_b))
+        if cpu_model is not None and getattr(cpu_model, "trained", False):
+            pcpu = float(cpu_model.cpu_util(bytes_in, bytes_out))
+        else:
+            pcpu = float(estimate_partition_cpu(
+                np.asarray(bytes_in), np.asarray(bytes_out),
+                cpu_b, lbi_b, lbo_b, rbi_b))
         metrics = np.full(md.NUM_MODEL_METRICS, np.nan)
         metrics[md.ModelMetric.CPU_USAGE] = pcpu
         metrics[md.ModelMetric.DISK_USAGE] = size if size is not None else np.nan

@@ -95,6 +95,11 @@ class ClusterTopology:
     replica_base_load: np.ndarray     # f32[R, 4] follower-role load
     leader_extra: np.ndarray          # f32[P, 4] extra load carried by the leader
     leader_bytes_in: np.ndarray       # f32[P] model metric LEADER_BYTES_IN
+    # --- optional per-window loads (model/Load.java:84-118): the collapsed
+    # vectors above are the AVG over valid windows; these carry the full
+    # [W]-windowed series so MAX/latest-window semantics stay reproducible.
+    replica_base_load_windows: Optional[np.ndarray] = None  # f32[R, W, 4]
+    leader_extra_windows: Optional[np.ndarray] = None       # f32[P, W, 4]
     # --- names for decoding back to proposals ---
     topic_names: tuple = ()
     partition_index: Optional[np.ndarray] = None  # i32[P] kafka partition number
@@ -162,6 +167,34 @@ class ClusterTopology:
         extra = self.leader_extra[self.partition_of_replica]
         return self.replica_base_load + np.where(is_leader[:, None], extra, 0.0)
 
+    @property
+    def num_windows(self) -> int:
+        return (self.replica_base_load_windows.shape[1]
+                if self.replica_base_load_windows is not None else 0)
+
+    def broker_load_windows(self, broker_of: np.ndarray,
+                            is_leader: np.ndarray) -> np.ndarray:
+        """f32[W, B, 4] per-window per-broker load (Load.java:84-118 — the
+        windowed series behind expectedUtilizationFor)."""
+        if self.replica_base_load_windows is None:
+            raise ValueError("model built without windowed loads")
+        extra = self.leader_extra_windows[self.partition_of_replica]  # [R,W,4]
+        eff = (self.replica_base_load_windows
+               + np.where(is_leader[:, None, None], extra, 0.0))
+        out = np.zeros((eff.shape[1], self.num_brokers, res.NUM_RESOURCES),
+                       np.float32)
+        for w in range(eff.shape[1]):
+            np.add.at(out[w], np.asarray(broker_of), eff[:, w, :])
+        return out
+
+    def expected_broker_utilization(self, broker_of: np.ndarray,
+                                    is_leader: np.ndarray,
+                                    use_max: bool = False) -> np.ndarray:
+        """f32[B, 4] — AVG (default) or MAX over windows of per-broker load
+        (Load.expectedUtilizationFor with the max-load requirement set)."""
+        wl = self.broker_load_windows(broker_of, is_leader)
+        return wl.max(axis=0) if use_max else wl.mean(axis=0)
+
 
 @_pytree_dataclass
 @dataclasses.dataclass(frozen=True)
@@ -225,6 +258,62 @@ def leadership_extra_from_leader_load(leader_load: np.ndarray) -> np.ndarray:
 def derive_follower_load(leader_load: np.ndarray) -> np.ndarray:
     """Follower load from leader load (MonitorUtils.java:66-76)."""
     return np.asarray(leader_load, dtype=np.float32) - leadership_extra_from_leader_load(leader_load)
+
+
+@dataclasses.dataclass
+class LinearRegressionCpuModel:
+    """Trained CPU model (model/LinearRegressionModelParameters.java:81):
+    broker CPU utilization as a linear function of the leader bytes-in,
+    leader bytes-out, and follower (replication) bytes-in rates, fitted by
+    least squares from accumulated broker metric samples. Untrained
+    instances fall back to the static ModelParameters weights."""
+
+    #: CPU-per-byte coefficients — zero until trained (the static 0.7/0.15
+    #: ModelParameters weights are attribution FRACTIONS in different units
+    #: and must never masquerade as regression coefficients)
+    coef_leader_bytes_in: float = 0.0
+    coef_leader_bytes_out: float = 0.0
+    coef_follower_bytes_in: float = 0.0
+    trained: bool = False
+    num_samples: int = 0
+
+    @classmethod
+    def fit(cls, leader_bytes_in, leader_bytes_out, follower_bytes_in,
+            cpu_util) -> "LinearRegressionCpuModel":
+        """Least-squares fit; returns an untrained fallback when the sample
+        set is too small or degenerate (singular design matrix)."""
+        x = np.stack([np.asarray(leader_bytes_in, np.float64),
+                      np.asarray(leader_bytes_out, np.float64),
+                      np.asarray(follower_bytes_in, np.float64)], axis=1)
+        y = np.asarray(cpu_util, np.float64)
+        n = y.shape[0]
+        if n < 3 or np.linalg.matrix_rank(x) < 3:
+            return cls()
+        coef, *_ = np.linalg.lstsq(x, y, rcond=None)
+        coef = np.maximum(coef, 0.0)   # negative CPU-per-byte is noise
+        return cls(coef_leader_bytes_in=float(coef[0]),
+                   coef_leader_bytes_out=float(coef[1]),
+                   coef_follower_bytes_in=float(coef[2]),
+                   trained=True, num_samples=int(n))
+
+    def cpu_util(self, leader_bytes_in, leader_bytes_out,
+                 follower_bytes_in=0.0):
+        """Predicted CPU utilization for the given rates
+        (ModelParameters.getCpuUtil equivalent); trained models only."""
+        if not self.trained:
+            raise ValueError("CPU model is untrained; run TRAIN first")
+        return (self.coef_leader_bytes_in * np.asarray(leader_bytes_in)
+                + self.coef_leader_bytes_out * np.asarray(leader_bytes_out)
+                + self.coef_follower_bytes_in * np.asarray(follower_bytes_in))
+
+    def to_json(self) -> dict:
+        out = {"trained": self.trained, "numSamples": self.num_samples}
+        if self.trained:
+            out["coefficients"] = {
+                "leaderBytesInRate": self.coef_leader_bytes_in,
+                "leaderBytesOutRate": self.coef_leader_bytes_out,
+                "followerBytesInRate": self.coef_follower_bytes_in}
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -324,8 +413,10 @@ class ClusterModelBuilder:
             part["leader_index"] = index
 
     def set_replica_load(self, broker_id: int, topic: str, partition: int, load,
-                         leader_bytes_in: float = None):
-        """Mirror of ClusterModel.setReplicaLoad; load = 4-vector or dict."""
+                         leader_bytes_in: float = None, load_windows=None):
+        """Mirror of ClusterModel.setReplicaLoad; load = 4-vector or dict.
+        ``load_windows``: optional [W, 4] per-window loads (Load.java keeps
+        the windowed series; the flat vector is its AVG collapse)."""
         part = self._partitions[(topic, partition)]
         vec = np.zeros(res.NUM_RESOURCES, dtype=np.float32)
         if isinstance(load, dict):
@@ -336,6 +427,8 @@ class ClusterModelBuilder:
         for rep in part["replicas"].values():
             if rep["broker"] == broker_id:
                 rep["load"] = vec
+                if load_windows is not None:
+                    rep["load_windows"] = np.asarray(load_windows, np.float32)
                 if leader_bytes_in is not None:
                     part["leader_bytes_in"] = np.float32(leader_bytes_in)
                 return
@@ -398,6 +491,20 @@ class ClusterModelBuilder:
         partition_index = np.zeros(P, dtype=np.int32)
         leader_extra = np.zeros((P, res.NUM_RESOURCES), dtype=np.float32)
         leader_bytes_in = np.zeros(P, dtype=np.float32)
+        # windowed loads: present iff any replica carries them; W from the
+        # first windowed replica, others tile their collapsed vector
+        n_windows = 0
+        for p in parts:
+            for rep in p["replicas"].values():
+                if rep.get("load_windows") is not None:
+                    n_windows = rep["load_windows"].shape[0]
+                    break
+            if n_windows:
+                break
+        base_load_windows: list = []
+        leader_extra_windows = (np.zeros((P, n_windows, res.NUM_RESOURCES),
+                                         np.float32) if n_windows else None)
+
         r = 0
         for pi, p in enumerate(parts):
             topic_of_partition[pi] = self._topic_index[p["topic"]]
@@ -410,13 +517,23 @@ class ClusterModelBuilder:
             for slot, idx in enumerate(indices):
                 rep = p["replicas"][idx]
                 load = rep["load"] if rep["load"] is not None else np.zeros(res.NUM_RESOURCES, np.float32)
+                lw = rep.get("load_windows")
+                if n_windows:
+                    if lw is None or lw.shape[0] != n_windows:
+                        lw = np.tile(load, (n_windows, 1))
                 if idx == p["leader_index"]:
                     leader_position[pi] = slot
                     extra = leadership_extra_from_leader_load(load)
                     leader_extra[pi] = extra
                     base_loads.append(load - extra)
+                    if n_windows:
+                        extra_w = leadership_extra_from_leader_load(lw)
+                        leader_extra_windows[pi] = extra_w
+                        base_load_windows.append(lw - extra_w)
                 else:
                     base_loads.append(load)
+                    if n_windows:
+                        base_load_windows.append(lw)
                 replicas_of_partition[pi, slot] = r
                 partition_of_replica.append(pi)
                 bidx = self._broker_index[rep["broker"]]
@@ -459,6 +576,10 @@ class ClusterModelBuilder:
                            if has_disks else None),
             disk_alive=(np.asarray(disk_alive, bool) if has_disks else None),
             disk_names=tuple(disk_names),
+            replica_base_load_windows=(
+                np.stack(base_load_windows).astype(np.float32)
+                if n_windows and base_load_windows else None),
+            leader_extra_windows=leader_extra_windows,
         )
         assignment = initial_assignment(topo, np.asarray(broker_of, dtype=np.int32))
         return topo, assignment

@@ -96,6 +96,7 @@ class LoadMonitor:
                  min_samples_per_window: int = 1,
                  max_allowed_extrapolations: int = 5,
                  sampling_interval_ms: int = 60_000,
+                 use_lr_model: bool = False,
                  now_fn: Optional[Callable[[], int]] = None):
         self._metadata_source = metadata_source
         self._sampler = sampler
@@ -123,6 +124,10 @@ class LoadMonitor:
         self._thread: Optional[threading.Thread] = None
         self._model_semaphore = threading.Semaphore(2)
         self._bootstrap_progress: Optional[float] = None
+        # trained CPU model (TRAIN endpoint / LinearRegressionModelParameters)
+        from cruise_control_tpu.models.cluster import LinearRegressionCpuModel
+        self.cpu_model = LinearRegressionCpuModel()
+        self._use_lr_model = use_lr_model
         # injectable clock: windowed aggregation is time-driven, so tests
         # feeding synthetic timestamps must also control "now"
         self._now = now_fn or (lambda: int(time.time() * 1000))
@@ -141,7 +146,7 @@ class LoadMonitor:
         return {
             "state": self._state.value,
             "reasonOfPauseOrResume": self._pause_reason,
-            "trained": False,
+            "trained": self.cpu_model.trained,
             "numValidWindows": c.num_valid_windows,
             "monitoredWindows": result.window_times.tolist(),
             "numMonitoredPartitions": c.num_valid_entities,
@@ -249,6 +254,45 @@ class LoadMonitor:
         finally:
             self._state = prev
 
+    def train(self, start_ms: int, end_ms: int) -> dict:
+        """TrainingTask (LoadMonitorTaskRunner.java:138-188): sample the
+        historical range, fit the linear-regression CPU model from the
+        broker samples (LinearRegressionModelParameters.java:81), and — when
+        ``use.linear.regression.model`` — install it in the sampler so
+        subsequent partition CPU estimation uses the trained coefficients.
+        """
+        from cruise_control_tpu.models.cluster import LinearRegressionCpuModel
+        prev = self._state
+        self._state = MonitorState.TRAINING
+        lbi: list = []
+        lbo: list = []
+        fbi: list = []
+        cpu: list = []
+        try:
+            t = start_ms
+            while t < end_ms:
+                step_end = min(t + self.sampling_interval_ms, end_ms)
+                metadata = self._metadata_source.get_metadata()
+                ps, bs = self._sampler.get_samples(metadata, t, step_end)
+                for s in bs:
+                    lbi.append(s.leader_bytes_in)
+                    lbo.append(s.leader_bytes_out)
+                    fbi.append(s.replication_bytes_in)
+                    cpu.append(s.cpu_util)
+                # training also feeds the regular windows (the reference's
+                # sampling fetchers run in TRAINING mode too)
+                for s in ps:
+                    self._ingest_partition_sample(s)
+                for s in bs:
+                    self._ingest_broker_sample(s)
+                t = step_end
+            self.cpu_model = LinearRegressionCpuModel.fit(lbi, lbo, fbi, cpu)
+            if self.cpu_model.trained and self._use_lr_model:
+                self._sampler.set_cpu_model(self.cpu_model)
+        finally:
+            self._state = prev
+        return self.cpu_model.to_json()
+
     def bootstrap(self, start_ms: int, end_ms: int):
         """BootstrapTask: replay a historical range window by window."""
         self._state = MonitorState.BOOTSTRAPPING
@@ -279,7 +323,9 @@ class LoadMonitor:
         (LoadMonitor.java:469-541). Raises NotEnoughValidWindowsError when
         completeness requirements fail."""
         from cruise_control_tpu.common.metrics import REGISTRY
+        from cruise_control_tpu.server.async_ops import report_progress
         now_ms = now_ms or self._now()
+        report_progress("Retrieving cluster model")
         with self._model_semaphore, \
                 REGISTRY.timer("cluster-model-creation-timer").time():
             metadata = self._metadata_source.get_metadata()
@@ -304,6 +350,7 @@ class LoadMonitor:
         # takes the newest window.
         vals = result.values                       # [E, W, M]
         load_by_entity: Dict[Tuple[str, int], np.ndarray] = {}
+        windows_by_entity: Dict[Tuple[str, int], np.ndarray] = {}
         if len(result.entities):
             avg = vals.mean(axis=1)                # [E, M]
             latest = vals[:, -1, :]
@@ -311,8 +358,21 @@ class LoadMonitor:
             for mm in md.ModelMetric:
                 if md.METRIC_STRATEGY[mm] == md.Strategy.LATEST:
                     collapsed[:, mm] = latest[:, mm]
+            # per-window resource loads (Load.java:84-118 keeps the windowed
+            # series; MAX/latest-window semantics need it in the model)
+            win_res = np.zeros((vals.shape[0], vals.shape[1],
+                                res.NUM_RESOURCES), np.float32)
+            win_res[:, :, res.CPU] = np.nan_to_num(
+                vals[:, :, md.ModelMetric.CPU_USAGE])
+            win_res[:, :, res.DISK] = np.nan_to_num(
+                vals[:, :, md.ModelMetric.DISK_USAGE])
+            win_res[:, :, res.NW_IN] = np.nan_to_num(
+                vals[:, :, md.ModelMetric.LEADER_BYTES_IN])
+            win_res[:, :, res.NW_OUT] = np.nan_to_num(
+                vals[:, :, md.ModelMetric.LEADER_BYTES_OUT])
             for i, e in enumerate(result.entities):
                 load_by_entity[e] = collapsed[i]
+                windows_by_entity[e] = win_res[i]
 
         b = ClusterModelBuilder()
         alive_brokers = set()
@@ -347,6 +407,8 @@ class LoadMonitor:
             offline = set(pm.offline_replicas) | {
                 r for r in pm.replicas if r not in alive_brokers}
             follower_load = derive_follower_load(leader_load)
+            lw = windows_by_entity.get(ent)               # [W, 4] leader-role
+            fw = derive_follower_load(lw) if lw is not None else None
             for idx, broker in enumerate(pm.replicas):
                 is_leader = broker == pm.leader
                 b.create_replica(broker, pm.topic, pm.partition, idx,
@@ -355,5 +417,6 @@ class LoadMonitor:
                     broker, pm.topic, pm.partition,
                     leader_load if is_leader else follower_load,
                     leader_bytes_in=(float(leader_load[res.NW_IN])
-                                     if is_leader else None))
+                                     if is_leader else None),
+                    load_windows=lw if is_leader else fw)
         return b.build()

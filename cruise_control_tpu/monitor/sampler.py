@@ -150,6 +150,11 @@ class MetricSampler:
                     ) -> Tuple[List[PartitionMetricSample], List[BrokerMetricSample]]:
         raise NotImplementedError
 
+    def set_cpu_model(self, cpu_model) -> None:
+        """Install a trained CPU model (LinearRegressionCpuModel) for
+        partition CPU estimation; samplers that estimate CPU from raw broker
+        metrics override this (use.linear.regression.model semantics)."""
+
     def close(self):
         pass
 

@@ -147,6 +147,16 @@ def compute_aggregates(dt: DeviceTopology, assign: Assignment, num_topics: int) 
     )
 
 
+@partial(jax.jit, static_argnames=("num_topics",))
+def topic_totals(dt: DeviceTopology, num_topics: int) -> jax.Array:
+    """f32[T] — total replicas per topic. Assignment-invariant (a replica's
+    topic never changes), so goal thresholds can use this without ever
+    materializing the [B, T] histogram."""
+    t_of_r = dt.topic_of_partition[dt.partition_of_replica]
+    return jax.ops.segment_sum(jnp.ones_like(t_of_r, jnp.float32), t_of_r,
+                               num_segments=num_topics)
+
+
 def partition_rack_excess(dt: DeviceTopology, broker_of: jax.Array) -> jax.Array:
     """f32[P] — per partition, number of replicas beyond one in any rack.
 

@@ -68,12 +68,18 @@ class ClusterStats(NamedTuple):
 from functools import partial
 
 
-@partial(jax.jit, static_argnames=("constraint", "num_topics"))
+@partial(jax.jit, static_argnames=("constraint", "num_topics",
+                                   "sparse_topic"))
 def compute_cluster_stats(dt: DeviceTopology, assign: Assignment,
                           constraint: BalancingConstraint, num_topics: int,
-                          agg: BrokerAggregates | None = None) -> ClusterStats:
+                          agg: BrokerAggregates | None = None,
+                          sparse_topic: bool = False) -> ClusterStats:
+    """``sparse_topic``: compute the topic-replica stats from sorted
+    (broker, topic) cell runs instead of the dense [B, T] histogram — at
+    LinkedIn scale the histogram is hundreds of MB per call."""
     if agg is None:
-        agg = compute_aggregates(dt, assign, num_topics)
+        agg = compute_aggregates(dt, assign,
+                                 1 if sparse_topic else num_topics)
     alive = dt.broker_alive
     n_alive = jnp.maximum(jnp.sum(alive.astype(jnp.float32)), 1.0)
 
@@ -119,14 +125,55 @@ def compute_cluster_stats(dt: DeviceTopology, assign: Assignment,
 
     # topic replica stats: per-topic avg & stdev over alive brokers, then
     # averaged over topics; max/min over all (topic, broker) pairs.
-    tc = agg.topic_count.astype(jnp.float32)             # [B, T]
-    per_topic_total = jnp.sum(tc, axis=0)                # [T]
-    per_topic_avg = per_topic_total / n_alive
-    t_var = jnp.sum(jnp.where(alive[:, None], (tc - per_topic_avg[None, :]) ** 2, 0.0), axis=0) / n_alive
-    topic_avg = jnp.mean(per_topic_avg)
-    topic_std = jnp.mean(jnp.sqrt(t_var))
-    topic_max = jnp.max(tc)
-    topic_min = jnp.min(tc)
+    if sparse_topic:
+        T = num_topics
+        R = dt.num_replicas
+        t_of_r = dt.topic_of_partition[dt.partition_of_replica]
+        per_topic_total = jax.ops.segment_sum(
+            jnp.ones((R,), jnp.float32), t_of_r, num_segments=T)
+        per_topic_avg = per_topic_total / n_alive
+        # non-empty (broker, topic) cell counts via sorted key runs. ALL
+        # brokers' cells are counted (the dense path's max/min run over every
+        # broker row, dead included); the variance term below masks to alive
+        # cells just as the dense path does.
+        alive_r = alive[assign.broker_of]
+        BT = dt.num_brokers * T
+        key = assign.broker_of * T + t_of_r
+        sk = jnp.sort(key)
+        first = jnp.concatenate([jnp.ones((1,), bool), sk[1:] != sk[:-1]])
+        cell_id = jnp.cumsum(first.astype(jnp.int32)) - 1
+        counts = jax.ops.segment_sum(jnp.ones((R,), jnp.float32), cell_id,
+                                     num_segments=R)
+        cell_key = jax.ops.segment_max(sk, cell_id, num_segments=R)
+        n_cells = cell_id[-1] + 1
+        valid_c = ((jnp.arange(R) < n_cells)
+                   & (cell_key >= 0) & (cell_key < BT))
+        t_cell = jnp.where(valid_c, cell_key % T, 0)
+        alive_c = valid_c & alive[jnp.where(valid_c, cell_key // T, 0)]
+        avg_c = per_topic_avg[t_cell]
+        sq = jnp.where(alive_c, (counts - avg_c) ** 2, 0.0)
+        sq_t = jax.ops.segment_sum(sq, t_cell, num_segments=T)
+        nnz_alive_t = jax.ops.segment_sum(alive_c.astype(jnp.float32),
+                                          t_cell, num_segments=T)
+        # empty alive cells contribute avg_t^2 each
+        t_var = (sq_t + jnp.maximum(n_alive - nnz_alive_t, 0.0)
+                 * per_topic_avg ** 2) / n_alive
+        topic_avg = jnp.mean(per_topic_avg)
+        topic_std = jnp.mean(jnp.sqrt(t_var))
+        topic_max = jnp.max(jnp.where(valid_c, counts, 0.0))
+        # min over the full (broker, topic) matrix: 0 unless every cell of
+        # every broker (dead included, dense-path parity) is non-empty
+        topic_min = jnp.where(n_cells >= BT,
+                              jnp.min(jnp.where(valid_c, counts, _BIG)), 0.0)
+    else:
+        tc = agg.topic_count.astype(jnp.float32)             # [B, T]
+        per_topic_total = jnp.sum(tc, axis=0)                # [T]
+        per_topic_avg = per_topic_total / n_alive
+        t_var = jnp.sum(jnp.where(alive[:, None], (tc - per_topic_avg[None, :]) ** 2, 0.0), axis=0) / n_alive
+        topic_avg = jnp.mean(per_topic_avg)
+        topic_std = jnp.mean(jnp.sqrt(t_var))
+        topic_max = jnp.max(tc)
+        topic_min = jnp.min(tc)
 
     # partitions with offline replicas
     p_off = jax.ops.segment_max(

@@ -40,6 +40,22 @@ class OperationProgress:
             return [{"step": s, "time": t} for s, t in self._steps]
 
 
+import contextvars
+
+#: the progress sink of the operation running on THIS thread — subsystems
+#: report steps without threading a handle through every signature
+#: (OperationProgress.java is likewise ambient via the runnable)
+_current_progress: "contextvars.ContextVar[Optional[OperationProgress]]" = \
+    contextvars.ContextVar("operation_progress", default=None)
+
+
+def report_progress(description: str) -> None:
+    """Record a step on the in-flight operation, if any (no-op outside)."""
+    p = _current_progress.get()
+    if p is not None:
+        p.add_step(description)
+
+
 class OperationFuture:
     """A future with progress + the uuid of its user task."""
 
@@ -51,10 +67,13 @@ class OperationFuture:
     def set_execution(self, fn: Callable[["OperationFuture"], Any],
                       pool: ThreadPoolExecutor):
         def run():
+            token = _current_progress.set(self.progress)
             try:
                 self._future.set_result(fn(self))
             except BaseException as e:
                 self._future.set_exception(e)
+            finally:
+                _current_progress.reset(token)
         pool.submit(run)
 
     def done(self) -> bool:

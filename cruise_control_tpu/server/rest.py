@@ -61,6 +61,21 @@ def _parse_csv(params: dict, name: str) -> List[str]:
     return [x.strip() for x in str(v).split(",") if x.strip()]
 
 
+def _goal_based_params(params: Dict[str, str]) -> dict:
+    """Shared GoalBasedOptimizationParameters surface
+    (servlet/parameters/GoalBasedOptimizationParameters.java): data_from,
+    use_ready_default_goals, exclude_recently_removed/demoted_brokers."""
+    return dict(
+        data_from=params.get("data_from"),
+        use_ready_default_goals=_parse_bool(
+            params, "use_ready_default_goals", False),
+        exclude_recently_removed_brokers=_parse_bool(
+            params, "exclude_recently_removed_brokers", False),
+        exclude_recently_demoted_brokers=_parse_bool(
+            params, "exclude_recently_demoted_brokers", False),
+    )
+
+
 class RestApi:
     """Endpoint handlers; transport-independent (the HTTP layer and tests
     call ``dispatch`` directly)."""
@@ -179,11 +194,14 @@ class RestApi:
     def _proposals(self, params, client_id, request_url):
         goals = _parse_csv(params, "goals") or None
         ignore_cache = _parse_bool(params, "ignore_proposal_cache", False)
+        verbose = _parse_bool(params, "verbose", False)
+        kw = _goal_based_params(params)
         return self._async_op(
             "PROPOSALS", params, client_id, request_url,
             lambda: self.app.proposals(
                 goal_names=goals,
-                ignore_proposal_cache=ignore_cache).to_json())
+                ignore_proposal_cache=ignore_cache,
+                **kw).to_json(verbose=verbose))
 
     def _load(self, params, client_id, request_url):
         topo, assign = self.app._model()
@@ -268,11 +286,26 @@ class RestApi:
                      or {"bootstrap": "done", "startMs": start, "endMs": end}))
 
     def _train(self, params, client_id, request_url):
-        # the reference trains a linear-regression CPU model; the TPU build's
-        # static estimation model needs no training — acknowledge the range.
-        return 200, {"train": "noop",
-                     "message": "static CPU model in use; training not "
-                                "required (ModelParameters.java parity)"}
+        """Fit the linear-regression CPU model over a historical range
+        (TrainRunnable → LoadMonitor.train; LinearRegressionModelParameters).
+        The range is mandatory and bounded (the reference's TrainParameters
+        rejects a missing start/end with 400)."""
+        if "start" not in params or "end" not in params:
+            return 400, {"errorMessage": "start and end parameters required"}
+        try:
+            start, end = int(params["start"]), int(params["end"])
+        except ValueError:
+            return 400, {"errorMessage": "start/end must be epoch ms"}
+        if not (0 <= start < end):
+            return 400, {"errorMessage": "need 0 <= start < end"}
+        max_span = 10_000 * self.app.load_monitor.sampling_interval_ms
+        if end - start > max_span:
+            return 400, {"errorMessage":
+                         f"training range too large (max {max_span} ms)"}
+        return self._async_op(
+            "TRAIN", params, client_id, request_url,
+            lambda: {"train": self.app.load_monitor.train(start, end),
+                     "startMs": start, "endMs": end})
 
     # ------------------------------------------------------------ POST
 
@@ -293,6 +326,8 @@ class RestApi:
             excluded_topics=_parse_csv(params, "excluded_topics"),
             destination_broker_ids=_parse_csv_ints(
                 params, "destination_broker_ids"),
+            verbose=_parse_bool(params, "verbose", False),
+            **_goal_based_params(params),
         )
         if params.get("concurrent_partition_movements_per_broker"):
             kw["concurrency"] = int(
@@ -305,30 +340,45 @@ class RestApi:
         if not ids:
             return 400, {"errorMessage": "brokerid parameter required"}
         dry = _parse_bool(params, "dryrun", True)
+        verbose = _parse_bool(params, "verbose", False)
+        df = params.get("data_from")
         return self._async_op("ADD_BROKER", params, client_id, request_url,
-                              lambda: self.app.add_brokers(ids, dryrun=dry))
+                              lambda: self.app.add_brokers(
+                                  ids, dryrun=dry, verbose=verbose,
+                                  data_from=df))
 
     def _remove_broker(self, params, client_id, request_url):
         ids = _parse_csv_ints(params, "brokerid")
         if not ids:
             return 400, {"errorMessage": "brokerid parameter required"}
         dry = _parse_bool(params, "dryrun", True)
+        verbose = _parse_bool(params, "verbose", False)
+        df = params.get("data_from")
         return self._async_op("REMOVE_BROKER", params, client_id, request_url,
-                              lambda: self.app.remove_brokers(ids, dryrun=dry))
+                              lambda: self.app.remove_brokers(
+                                  ids, dryrun=dry, verbose=verbose,
+                                  data_from=df))
 
     def _demote_broker(self, params, client_id, request_url):
         ids = _parse_csv_ints(params, "brokerid")
         if not ids:
             return 400, {"errorMessage": "brokerid parameter required"}
         dry = _parse_bool(params, "dryrun", True)
+        verbose = _parse_bool(params, "verbose", False)
+        df = params.get("data_from")
         return self._async_op("DEMOTE_BROKER", params, client_id, request_url,
-                              lambda: self.app.demote_brokers(ids, dryrun=dry))
+                              lambda: self.app.demote_brokers(
+                                  ids, dryrun=dry, verbose=verbose,
+                                  data_from=df))
 
     def _fix_offline_replicas(self, params, client_id, request_url):
         dry = _parse_bool(params, "dryrun", True)
+        verbose = _parse_bool(params, "verbose", False)
+        df = params.get("data_from")
         return self._async_op(
             "FIX_OFFLINE_REPLICAS", params, client_id, request_url,
-            lambda: self.app.fix_offline_replicas(dryrun=dry))
+            lambda: self.app.fix_offline_replicas(
+                dryrun=dry, verbose=verbose, data_from=df))
 
     def _stop_proposal_execution(self, params, client_id, request_url):
         return 200, self.app.stop_execution(

@@ -196,3 +196,69 @@ def test_options_masks():
     assert not bool(opts.move_dest_ok[3])
     assert not bool(opts.leader_dest_ok[2])
     assert not bool(opts.move_dest_ok[0])  # dead broker never a destination
+
+
+def test_sparse_topic_penalty_matches_dense():
+    """sparse_topic_penalty (sort-based, histogram-free) must equal
+    topic_distribution_penalty on the dense [B,T] histogram exactly."""
+    from cruise_control_tpu.models import fixtures
+    from cruise_control_tpu.ops.aggregates import compute_aggregates, device_topology
+    from cruise_control_tpu.common.resources import BalancingConstraint
+    for seed in (0, 1, 2):
+        topo, assign = fixtures.random_cluster(fixtures.ClusterProperties(
+            num_racks=3, num_brokers=10, num_replicas=300, num_topics=25,
+            min_replication=2, max_replication=3,
+            num_dead_brokers=1 if seed == 2 else 0), seed=seed)
+        dt = device_topology(topo)
+        agg = compute_aggregates(dt, assign, topo.num_topics)
+        th = G.compute_thresholds(dt, BalancingConstraint(), agg)
+        vd, cd = G.topic_distribution_penalty(agg.topic_count, th)
+        vs, cs = G.sparse_topic_penalty(dt, jnp.asarray(assign.broker_of),
+                                        th, topo.num_topics)
+        assert float(vd) == float(vs), (seed, float(vd), float(vs))
+        np.testing.assert_allclose(float(cd), float(cs), rtol=1e-5)
+
+
+def test_annealer_sparse_topic_mode_improves_topic_goal():
+    """Force the sparse topic path (tiny topic_term_limit) — the annealer
+    must still optimize TopicReplicaDistributionGoal, matching the
+    dense-mode behavior (TopicReplicaDistributionGoal.java at any scale)."""
+    from cruise_control_tpu.analyzer import annealer as AN
+    from cruise_control_tpu.analyzer import optimizer as OPT
+    from cruise_control_tpu.models import fixtures
+    topo, assign = fixtures.random_cluster(fixtures.ClusterProperties(
+        num_racks=3, num_brokers=10, num_replicas=400, num_topics=30,
+        min_replication=2, max_replication=3), seed=11)
+    cfg = AN.AnnealConfig(num_chains=8, steps=768, swap_interval=64,
+                          topic_mode="sparse")   # exact CSR topic deltas
+    r = OPT.optimize(topo, assign, engine="anneal", anneal_config=cfg, seed=3)
+    topic = next(s for s in r.goal_summaries
+                 if s.name == "TopicReplicaDistributionGoal")
+    assert topic.violations_after <= topic.violations_before
+    hard = {s.name: s.violations_after for s in r.goal_summaries if s.hard}
+    assert all(v == 0 for v in hard.values()), hard
+
+
+def test_sparse_cluster_stats_match_dense():
+    """compute_cluster_stats topic stats: sparse (sorted cell runs) equals
+    the dense [B,T] histogram computation."""
+    import jax
+    from cruise_control_tpu.models import fixtures
+    from cruise_control_tpu.ops.aggregates import device_topology
+    from cruise_control_tpu.ops.stats import compute_cluster_stats
+    from cruise_control_tpu.common.resources import BalancingConstraint
+    for seed in (0, 3):
+        topo, assign = fixtures.random_cluster(fixtures.ClusterProperties(
+            num_racks=3, num_brokers=10, num_replicas=300, num_topics=25,
+            min_replication=2, max_replication=3,
+            num_dead_brokers=1 if seed else 0), seed=seed)
+        dt = device_topology(topo)
+        dense = compute_cluster_stats(dt, assign, BalancingConstraint(),
+                                      topo.num_topics)
+        sparse = compute_cluster_stats(dt, assign, BalancingConstraint(),
+                                       topo.num_topics, sparse_topic=True)
+        for f in ("topic_replica_avg", "topic_replica_max",
+                  "topic_replica_min", "topic_replica_std"):
+            np.testing.assert_allclose(
+                float(getattr(sparse, f)), float(getattr(dense, f)),
+                rtol=1e-5, err_msg=f"{f} seed={seed}")

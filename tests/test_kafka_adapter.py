@@ -1,0 +1,175 @@
+"""Contract tests for the Kafka-facing adapter against an injected fake
+``kafka`` module — the only code path that talks to a live cluster
+(ExecutorUtils / ReplicationThrottleHelper / AdminClient seams), exercised
+without one."""
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+
+class _FakeAdmin:
+    """Records calls; returns canned DescribeConfigs/LogDirs responses."""
+
+    def __init__(self, bootstrap_servers=""):
+        self.calls = []
+        self.dynamic = {}      # (rtype:int, name:str) -> {k: v}
+        self.describe_error = None
+        self.logdirs_result = {}
+
+    # --- reassignments / elections ---
+    def alter_partition_reassignments(self, assignments):
+        self.calls.append(("reassign", dict(assignments)))
+
+    def perform_leader_election(self, mode, parts):
+        self.calls.append(("election", mode, list(parts)))
+
+    def list_partition_reassignments(self):
+        return {}
+
+    # --- configs ---
+    def describe_configs(self, config_resources):
+        self.calls.append(("describe", [
+            (int(r.resource_type), str(r.name)) for r in config_resources]))
+        resp = types.SimpleNamespace(resources=[])
+        for r in config_resources:
+            key = (int(r.resource_type), str(r.name))
+            if self.describe_error == key:
+                resp.resources.append((42, "boom", key[0], key[1], []))
+                continue
+            entries = [
+                # (name, value, read_only?, config_source, is_sensitive...)
+                (k, v, False, 2 if key[0] == _RT_BROKER else 1, False)
+                for k, v in self.dynamic.get(key, {}).items()]
+            # plus a static entry that must NOT survive the merge
+            entries.append(("static.setting", "s", False, 4, False))
+            resp.resources.append((0, None, key[0], key[1], entries))
+        return [resp]
+
+    def alter_configs(self, resources):
+        self.calls.append(("alter", [
+            (int(r.resource_type), str(r.name), dict(r.configs))
+            for r in resources]))
+        for r in resources:
+            self.dynamic[(int(r.resource_type), str(r.name))] = dict(r.configs)
+
+    def describe_log_dirs(self):
+        return self.logdirs_result
+
+    def alter_replica_log_dirs(self, mapping):
+        self.calls.append(("logdirs", dict(mapping)))
+
+
+_RT_BROKER = 4
+_RT_TOPIC = 2
+
+
+@pytest.fixture()
+def fake_kafka(monkeypatch):
+    """Install a minimal fake `kafka` + `kafka.admin` module pair."""
+    import enum
+
+    class ConfigResourceType(enum.IntEnum):
+        BROKER = _RT_BROKER
+        TOPIC = _RT_TOPIC
+
+    class ConfigResource:
+        def __init__(self, resource_type, name, configs=None):
+            self.resource_type = ConfigResourceType(int(resource_type))
+            self.name = str(name)
+            self.configs = configs or {}
+
+    kafka_mod = types.ModuleType("kafka")
+    admin_mod = types.ModuleType("kafka.admin")
+    admin_mod.ConfigResource = ConfigResource
+    admin_mod.ConfigResourceType = ConfigResourceType
+    kafka_mod.admin = admin_mod
+    kafka_mod.KafkaAdminClient = _FakeAdmin
+    kafka_mod.KafkaConsumer = lambda *a, **k: iter(())
+    monkeypatch.setitem(sys.modules, "kafka", kafka_mod)
+    monkeypatch.setitem(sys.modules, "kafka.admin", admin_mod)
+    return kafka_mod
+
+
+def _adapter(fake_kafka):
+    from cruise_control_tpu.common.config import CruiseControlConfig
+    from cruise_control_tpu.kafka_adapter import KafkaClusterAdapter
+    cfg = CruiseControlConfig({"bootstrap.servers": "fake:9092"})
+    return KafkaClusterAdapter(cfg)
+
+
+def test_throttle_merge_preserves_dynamic_configs(fake_kafka):
+    """Setting throttles merges with the resource's CURRENT dynamic config
+    (legacy AlterConfigs replaces the whole set) and never re-pins static
+    entries (ReplicationThrottleHelper.java:29-79 semantics)."""
+    ad = _adapter(fake_kafka)
+    admin = ad._admin
+    admin.dynamic[(_RT_BROKER, "1")] = {"log.cleaner.threads": "4"}
+    ad.set_broker_throttle_rate([1], 1000)
+    alt = [c for c in admin.calls if c[0] == "alter"][-1]
+    (_, name, cfgs), = [r for r in alt[1] if r[1] == "1"]
+    assert cfgs["log.cleaner.threads"] == "4"          # preserved
+    assert cfgs["leader.replication.throttled.rate"] == "1000"
+    assert "static.setting" not in cfgs                # never re-pinned
+    ad.clear_broker_throttle_rate([1])
+    alt = [c for c in admin.calls if c[0] == "alter"][-1]
+    (_, _, cfgs2), = [r for r in alt[1] if r[1] == "1"]
+    assert "leader.replication.throttled.rate" not in cfgs2
+    assert cfgs2["log.cleaner.threads"] == "4"
+
+
+def test_describe_error_aborts_merge(fake_kafka):
+    """A failed DescribeConfigs resource read must abort the update instead
+    of silently wiping that resource's dynamic config."""
+    ad = _adapter(fake_kafka)
+    ad._admin.dynamic[(_RT_BROKER, "2")] = {"x": "1"}
+    ad._admin.describe_error = (_RT_BROKER, "2")
+    with pytest.raises(RuntimeError, match="DescribeConfigs failed"):
+        ad.set_broker_throttle_rate([2], 500)
+    assert ad._admin.dynamic[(_RT_BROKER, "2")] == {"x": "1"}   # untouched
+
+
+def test_topic_throttled_replica_lists(fake_kafka):
+    ad = _adapter(fake_kafka)
+    ad.set_topic_throttled_replicas("T", ["0:1", "1:2"], ["0:3"])
+    alt = [c for c in ad._admin.calls if c[0] == "alter"][-1]
+    (_, name, cfgs), = alt[1]
+    assert name == "T"
+    assert cfgs["leader.replication.throttled.replicas"] == "0:1,1:2"
+    assert cfgs["follower.replication.throttled.replicas"] == "0:3"
+    ad.clear_topic_throttled_replicas("T")
+    alt = [c for c in ad._admin.calls if c[0] == "alter"][-1]
+    (_, _, cfgs2), = alt[1]
+    assert "leader.replication.throttled.replicas" not in cfgs2
+
+
+def test_describe_logdirs_shapes(fake_kafka):
+    ad = _adapter(fake_kafka)
+    # dict shape
+    ad._admin.logdirs_result = {0: {"/d1": {"error_code": 0},
+                                    "/d2": {"error_code": 7}}}
+    assert ad.describe_logdirs() == {0: {"/d1": True, "/d2": False}}
+    # single-node response-object shape (no broker attribution -> broker -1)
+    ad._admin.logdirs_result = types.SimpleNamespace(
+        log_dirs=[(0, "/data/a", []), (5, "/data/b", [])])
+    assert ad.describe_logdirs() == {-1: {"/data/a": True, "/data/b": False}}
+
+
+def test_reassignments_and_elections(fake_kafka):
+    from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+    from cruise_control_tpu.executor.tasks import ExecutionTask, TaskType
+    ad = _adapter(fake_kafka)
+    p = ExecutionProposal(topic="T", partition=3, old_leader=0,
+                          old_replicas=(0, 1), new_replicas=(2, 1),
+                          data_size=10.0)
+    t = ExecutionTask(execution_id=1, proposal=p,
+                      task_type=TaskType.INTER_BROKER_REPLICA_ACTION)
+    ad.execute_replica_reassignments([t])
+    assert ad._admin.calls[-1] == ("reassign", {("T", 3): [2, 1]})
+    t2 = ExecutionTask(execution_id=2, proposal=p,
+                       task_type=TaskType.LEADER_ACTION)
+    ad.execute_preferred_leader_elections([t2])
+    kind, mode, parts = ad._admin.calls[-1]
+    assert kind == "election" and parts == [("T", 3)]

@@ -258,3 +258,125 @@ def test_monitor_to_optimizer_end_to_end():
     assert r.balancedness_after >= r.balancedness_before
     hard = [s for s in r.goal_summaries if s.hard]
     assert all(s.violations_after == 0 for s in hard)
+
+
+def test_train_linear_regression_cpu_model():
+    """TRAIN fits LinearRegressionModelParameters-style coefficients from
+    broker samples and partition CPU estimation switches to them."""
+    from cruise_control_tpu.kafka_adapter import process_raw_metrics
+    from cruise_control_tpu.models.cluster import LinearRegressionCpuModel
+    from cruise_control_tpu.monitor.load_monitor import LoadMonitor, StaticMetadataSource
+    from cruise_control_tpu.monitor.sampler import (BrokerMetricSample,
+                                                    MetricSampler,
+                                                    PartitionMetricSample)
+
+    true_w = (2.0e-8, 1.0e-8, 5.0e-9)
+
+    class CpuSampler(MetricSampler):
+        """Broker samples whose CPU is an exact linear function of rates."""
+        def __init__(self):
+            self.cpu_model = None
+            self.rng = np.random.default_rng(5)
+
+        def set_cpu_model(self, m):
+            self.cpu_model = m
+
+        def get_samples(self, metadata, start_ms, end_ms):
+            bs = []
+            for b in range(6):
+                lbi = float(self.rng.uniform(1e6, 5e7))
+                lbo = float(self.rng.uniform(1e6, 5e7))
+                fbi = float(self.rng.uniform(1e5, 1e7))
+                cpu = true_w[0] * lbi + true_w[1] * lbo + true_w[2] * fbi
+                bs.append(BrokerMetricSample(
+                    broker_id=b, time_ms=(start_ms + end_ms) // 2,
+                    cpu_util=cpu, leader_bytes_in=lbi, leader_bytes_out=lbo,
+                    replication_bytes_in=fbi))
+            return [], bs
+
+    md_src = StaticMetadataSource(_metadata())
+    sampler = CpuSampler()
+    lm = LoadMonitor(md_src, sampler, num_windows=3, window_ms=W,
+                     use_lr_model=True)
+    result = lm.train(0, 5 * W)
+    assert result["trained"] is True
+    assert lm.cpu_model.trained and lm.cpu_model.num_samples >= 18
+    np.testing.assert_allclose(
+        [lm.cpu_model.coef_leader_bytes_in, lm.cpu_model.coef_leader_bytes_out,
+         lm.cpu_model.coef_follower_bytes_in], true_w, rtol=1e-4)
+    # trained model installed into the sampler (use.linear.regression.model)
+    assert sampler.cpu_model is lm.cpu_model
+    assert lm.state_snapshot(now_ms=5 * W)["trained"] is True
+
+    # partition CPU estimation switches to the trained coefficients
+    from cruise_control_tpu.monitor.sampler import ClusterMetadata, PartitionMetadata, BrokerMetadata
+    from cruise_control_tpu.reporter import CruiseControlMetric
+    meta = ClusterMetadata(
+        brokers=[BrokerMetadata(0, rack="r0", host="h0")],
+        partitions=[PartitionMetadata("T", 0, leader=0, replicas=(0,))],
+        generation=1)
+    raw = [CruiseControlMetric("TOPIC_BYTES_IN", 1000, 0, 1e6, topic="T"),
+           CruiseControlMetric("TOPIC_BYTES_OUT", 1000, 0, 2e6, topic="T"),
+           CruiseControlMetric("BROKER_CPU_UTIL", 1000, 0, 50.0)]
+    ps_static, _ = process_raw_metrics(raw, meta, 1000)
+    ps_lr, _ = process_raw_metrics(raw, meta, 1000, cpu_model=lm.cpu_model)
+    import numpy as _np
+    cpu_static = ps_static[0].metrics[0]
+    cpu_lr = ps_lr[0].metrics[0]
+    expected = true_w[0] * 1e6 + true_w[1] * 2e6
+    assert abs(cpu_lr - expected) / expected < 1e-3
+    assert cpu_lr != cpu_static
+
+
+def test_windowed_loads_in_model():
+    """The model carries [W]-windowed per-replica loads (Load.java:84-118):
+    the collapsed vector equals the window AVG, and the MAX-window broker
+    load matches a hand-computed value."""
+    from cruise_control_tpu.monitor.load_monitor import LoadMonitor, StaticMetadataSource
+    from cruise_control_tpu.monitor.sampler import (BrokerMetadata,
+                                                    ClusterMetadata,
+                                                    MetricSampler,
+                                                    PartitionMetadata,
+                                                    PartitionMetricSample)
+    from cruise_control_tpu.monitor import metricdef as md2
+    from cruise_control_tpu.common import resources as res2
+
+    meta = ClusterMetadata(
+        brokers=[BrokerMetadata(0, rack="r0", host="h0"),
+                 BrokerMetadata(1, rack="r1", host="h1")],
+        partitions=[PartitionMetadata("T", 0, leader=0, replicas=(0, 1))],
+        generation=1)
+
+    # one partition, 3 windows with NW_IN = 100, 200, 600
+    class WindowSampler(MetricSampler):
+        def get_samples(self, metadata, start_ms, end_ms):
+            w = ((start_ms + end_ms) // 2) // W   # the window the sample lands in
+            nw_in = {0: 100.0, 1: 200.0, 2: 600.0}.get(w, 0.0)
+            m = np.full(md2.NUM_MODEL_METRICS, np.nan)
+            m[md2.ModelMetric.CPU_USAGE] = 10.0
+            m[md2.ModelMetric.DISK_USAGE] = 50.0
+            m[md2.ModelMetric.LEADER_BYTES_IN] = nw_in
+            m[md2.ModelMetric.LEADER_BYTES_OUT] = 40.0
+            return [PartitionMetricSample("T", 0, 0, (start_ms + end_ms) // 2,
+                                          m)], []
+
+    lm = LoadMonitor(StaticMetadataSource(meta), WindowSampler(),
+                     num_windows=3, window_ms=W, now_fn=lambda: 3 * W)
+    for w in range(3):
+        lm.sample_once(now_ms=w * W + 30_000)
+    topo, assign = lm.cluster_model(now_ms=3 * W)
+    assert topo.num_windows == 3
+    # collapsed load equals window average for the AVG-strategy NW_IN
+    lead_r = int(assign.leader_of[0])
+    eff = topo.replica_load(np.asarray(
+        assign.is_leader(topo.partition_of_replica)))
+    assert abs(eff[lead_r, res2.NW_IN] - 300.0) < 1e-3   # avg(100,200,600)
+    # max-window broker load: leader broker's NW_IN peak = 600
+    is_lead = np.asarray(assign.is_leader(topo.partition_of_replica))
+    mx = topo.expected_broker_utilization(np.asarray(assign.broker_of),
+                                          is_lead, use_max=True)
+    lead_broker = int(np.asarray(assign.broker_of)[lead_r])
+    assert abs(mx[lead_broker, res2.NW_IN] - 600.0) < 1e-3
+    avg = topo.expected_broker_utilization(np.asarray(assign.broker_of),
+                                           is_lead, use_max=False)
+    assert abs(avg[lead_broker, res2.NW_IN] - 300.0) < 1e-3

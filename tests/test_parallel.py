@@ -112,7 +112,7 @@ def test_sharded_energies_match_full_objective(small_model):
         leader_bytes_in=agg0.leader_bytes_in,
         topic_count=jnp.zeros((1, 1), jnp.float32),
         energy=jnp.zeros((2,), jnp.float32))
-    e_ref = AN._chain_energy(dt, th, weights, st, init, use_topic=False)
+    e_ref = AN._chain_energy(dt, th, weights, st, init, topic_mode="off")
     np.testing.assert_allclose(np.asarray(e_sh[0]), np.asarray(e_ref),
                                rtol=1e-5)
 

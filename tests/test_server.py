@@ -342,3 +342,75 @@ def test_rest_two_step_verification():
     code, body = api.dispatch("POST", "REBALANCE",
                               {"dryrun": "true", "review_id": str(rid)})
     assert code == 400
+
+
+def test_goal_based_parameter_surface():
+    """data_from / use_ready_default_goals / exclusions / verbose honored
+    end-to-end (GoalBasedOptimizationParameters surface)."""
+    app = _app()
+    api = rest.RestApi(app)
+    # verbose adds the before/after ClusterModelStats payloads
+    code, body = api.dispatch("POST", "REBALANCE",
+                              {"dryrun": "true", "verbose": "true",
+                               "get_response_timeout_ms": "60000"})
+    assert code == 200, body
+    assert "clusterModelStatsBeforeOptimization" in body
+    assert "goalSummaryDetail" in body
+    code, body = api.dispatch("POST", "REBALANCE",
+                              {"dryrun": "true",
+                               "get_response_timeout_ms": "60000"})
+    assert code == 200 and "clusterModelStatsBeforeOptimization" not in body
+
+    # data_from=valid_partitions relaxes the partition-coverage gate
+    code, body = api.dispatch("POST", "REBALANCE",
+                              {"dryrun": "true",
+                               "data_from": "valid_partitions",
+                               "get_response_timeout_ms": "60000"})
+    assert code == 200, body
+
+    # exclude_recently_removed_brokers: a drained broker cannot receive
+    # replicas on the next rebalance
+    app.executor.recently_removed_brokers.add(1)
+    code, body = api.dispatch("POST", "REBALANCE",
+                              {"dryrun": "true", "verbose": "true",
+                               "exclude_recently_removed_brokers": "true",
+                               "get_response_timeout_ms": "60000"})
+    assert code == 200, body
+    for p in body["proposals"]:
+        added = set(p["newReplicas"]) - set(p["oldReplicas"])
+        assert 1 not in added, p
+
+    # use_ready_default_goals with full window coverage = all default goals
+    code, body = api.dispatch("GET", "PROPOSALS",
+                              {"use_ready_default_goals": "true",
+                               "ignore_proposal_cache": "true",
+                               "get_response_timeout_ms": "60000"})
+    assert code == 200, body
+
+
+def test_operation_progress_steps_populated():
+    """In-flight 202 responses carry real OperationProgress steps
+    (async/progress/OperationProgress.java), not an empty list."""
+    app = _app()
+    api = rest.RestApi(app)
+    # zero timeout forces the in-progress path; then poll to completion
+    code, body = api.dispatch("POST", "REBALANCE",
+                              {"dryrun": "true",
+                               "get_response_timeout_ms": "0"})
+    tid = body["userTaskId"]
+    assert code in (200, 202)
+    deadline = time.time() + 120
+    steps = []
+    while time.time() < deadline:
+        code, body = api.dispatch("POST", "REBALANCE",
+                                  {"dryrun": "true", "user_task_id": tid,
+                                   "get_response_timeout_ms": "2000"})
+        info = api.user_tasks.get(tid)
+        steps = info.future.progress.snapshot()
+        if code == 200:
+            break
+    assert code == 200, body
+    descs = [s["step"] for s in steps]
+    assert any("cluster model" in d for d in descs), descs
+    assert any("Optimizing" in d for d in descs), descs
+    assert any("proposals" in d for d in descs), descs
