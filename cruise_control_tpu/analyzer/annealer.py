@@ -592,6 +592,9 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
     # targeted repair pass (analyzer/repair.py); cfg.topic_mode = "sparse"
     # forces exact in-step CSR counts at any scale instead.
     topic_on = "TopicReplicaDistributionGoal" in tuple(goal_names)
+    if cfg.topic_mode not in (None, "dense", "sparse", "off"):
+        raise ValueError(f"invalid topic_mode {cfg.topic_mode!r}: "
+                         "use dense | sparse | off")
     if not topic_on:
         topic_mode = "off"
     elif cfg.topic_mode is not None:

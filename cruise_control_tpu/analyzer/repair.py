@@ -13,13 +13,14 @@ This pass is the TPU-native version of the reference's targeted walks:
 1. enumerate the violating entities *exactly* (violating (broker, topic)
    cells via the sparse sort, brokers out of band per goal term, offline
    replicas, partitions led by out-of-band brokers) — cheap device scans;
-2. evaluate ONLY those replicas × a handful of sampled destinations with the
-   exact two-channel lexicographic deltas (annealer._move_delta /
-   ``_lead_delta`` with sparse topic counts — active at ANY scale);
-3. host-side greedy: accept the best non-conflicting improving moves
-   (disjoint source/destination brokers, partitions, topics — the same
-   additivity rule the annealer's conflict matrix enforces);
-4. apply as one batch, iterate until clean or no move improves.
+2. evaluate ONLY those replicas' candidate actions with the exact
+   two-channel lexicographic deltas — sampled destinations in bulk rounds,
+   EVERY destination via a broadcast row kernel in the targeted rounds,
+   plus replica swaps for sources pinned at band edges;
+3. host-side greedy: accept the best non-conflicting improving actions
+   under per-broker move budgets (deltas recompute exactly each round, so
+   the budget bounds intra-round staleness);
+4. apply as one padded batch, iterate until clean or nothing improves.
 
 Each round is a few jit calls over [N, k] candidate matrices where N is the
 number of *violating* replicas (thousands), never O(R·B).
@@ -54,9 +55,8 @@ class RepairConfig:
     dests_per_source: int = 8
     #: cap on candidate sources per round (padded bucket size)
     max_sources: int = 8192
-    #: source-count threshold below which EVERY legal destination is
-    #: evaluated — the convergence tail is a few hundred stubborn cells
-    #: whose improving destinations random sampling keeps missing
+    #: per-round source cap for the targeted phase (every destination is
+    #: evaluated for each source via the broadcast row kernel)
     full_dest_threshold: int = 2048
     #: swap partners sampled per stuck source replica
     swap_partners: int = 24
@@ -65,14 +65,13 @@ class RepairConfig:
     min_improvement: float = 1e-9
 
 
-def _bucket(n: int, cap: int, floor: int = 256) -> int:
-    """Next power-of-two bucket ≥ n (≤ cap), floored — every distinct bucket
-    size is a fresh XLA compile at 500K-replica shapes, so a dozen shrinking
-    tail buckets would cost more in compiles than all the device work."""
-    b = floor
-    while b < n and b < cap:
-        b <<= 1
-    return min(b, cap)
+def _bucket(n: int, cap: int, floor: int = 512) -> int:
+    """Two-tier bucket: ``floor`` for tail rounds, ``cap`` for bulk ones.
+    Exactly two compiled shapes per batch family — a continuum of shapes
+    made latency depend on which compiles happened to be cached, while a
+    single cap-sized shape made the (many) small tail rounds pay the full
+    big-batch cost every round."""
+    return floor if n <= floor else cap
 
 
 @partial(jax.jit, static_argnames=("topic_mode",))
@@ -85,15 +84,100 @@ def _move_deltas_batch(dt, th, weights, opts, st, initial_broker_of,
     return jax.vmap(jax.vmap(one, in_axes=(None, 0)))(src_r, dest_b)
 
 
-@partial(jax.jit, static_argnames=("topic_mode",))
-def _move_deltas_full(dt, th, weights, opts, st, initial_broker_of,
-                      topic_reps, src_r, dest_pool, topic_mode: str):
-    """f32[N, D, 2] exact deltas for sources × the whole destination pool."""
-    def one(r, b):
-        return AN._move_delta(dt, th, weights, opts, st, initial_broker_of,
-                              topic_mode, topic_reps, r, b)
-    return jax.vmap(jax.vmap(one, in_axes=(None, 0)),
-                    in_axes=(0, None))(src_r, dest_pool)
+@partial(jax.jit, static_argnames=("use_topic",))
+def _move_deltas_rows(dt, th, w, opts, st, initial_broker_of, src_r,
+                      use_topic: bool):
+    """f32[N, B] combined deltas for source replicas × EVERY broker.
+
+    Broadcast-style evaluation (the greedy engine's [R, B] pattern applied
+    to just the candidate rows): one pass of ~30 large fused ops instead of
+    N·B vmapped gather chains — ~20x cheaper per pair on TPU, which is what
+    makes whole-pool destination scans affordable in the repair tail."""
+    B = dt.num_brokers
+    N = src_r.shape[0]
+    p = dt.partition_of_replica[src_r]                               # [N]
+    a = st.broker_of[src_r]
+    is_leader = st.leader_of[p] == src_r
+    eff = (dt.replica_base_load[src_r]
+           + jnp.where(is_leader[:, None], dt.leader_extra[p], 0.0))  # [N,4]
+    pl = (dt.leader_extra[p, AN.res.NW_OUT]
+          + dt.replica_base_load[st.leader_of[p], AN.res.NW_OUT])     # [N]
+    lbi = jnp.where(is_leader, dt.leader_bytes_in[p], 0.0)
+    lead_f = is_leader.astype(jnp.float32)
+
+    f0 = OBJ.broker_cost(th, w, st.broker_load, st.replica_count,
+                         st.leader_count, st.potential_nw_out,
+                         st.leader_bytes_in)                          # [B,2]
+    h0 = OBJ.host_cost(th, w, st.host_load)                           # [H,2]
+    th_a = OBJ.gather_thresholds(th, a)
+    f_minus = OBJ.broker_cost(
+        th_a, w, st.broker_load[a] - eff, st.replica_count[a] - 1.0,
+        st.leader_count[a] - lead_f, st.potential_nw_out[a] - pl,
+        st.leader_bytes_in[a] - lbi)                                  # [N,2]
+    d_src = f_minus - f0[a]
+    f_plus = OBJ.broker_cost(
+        th, w,
+        st.broker_load[None, :, :] + eff[:, None, :],
+        st.replica_count[None, :] + 1.0,
+        st.leader_count[None, :] + lead_f[:, None],
+        st.potential_nw_out[None, :] + pl[:, None],
+        st.leader_bytes_in[None, :] + lbi[:, None])                   # [N,B,2]
+    d2 = d_src[:, None, :] + (f_plus - f0[None, :, :])
+
+    ha = dt.host_of_broker[a]                                         # [N]
+    hb = dt.host_of_broker                                            # [B]
+    h_minus = OBJ.host_cost(OBJ.gather_host_thresholds(th, ha), w,
+                            st.host_load[ha] - eff)                   # [N,2]
+    h_plus = OBJ.host_cost(OBJ.gather_host_thresholds(th, hb), w,
+                           st.host_load[hb][None, :, :]
+                           + eff[:, None, :])                         # [N,B,2]
+    cross = (ha[:, None] != hb[None, :]).astype(jnp.float32)[..., None]
+    d2 = d2 + ((h_minus - h0[ha])[:, None, :]
+               + (h_plus - h0[hb][None, :, :])) * cross
+
+    # rack delta: does any OTHER replica of p occupy the src/dst rack
+    reps = dt.replicas_of_partition[p]                                # [N,m]
+    valid_sib = (reps >= 0) & (reps != src_r[:, None])
+    sib_b = st.broker_of[jnp.clip(reps, 0)]
+    sib_rack = dt.rack_of_broker[sib_b]                               # [N,m]
+    occ_b = jnp.any((sib_rack[:, :, None] == dt.rack_of_broker[None, None, :])
+                    & valid_sib[:, :, None], axis=1)                  # [N,B]
+    occ_a = jnp.any(valid_sib & (sib_rack == dt.rack_of_broker[a][:, None]),
+                    axis=1)
+    d_rack = (occ_b.astype(jnp.float32)
+              - occ_a.astype(jnp.float32)[:, None])                   # [N,B]
+    d2 = d2 + d_rack[..., None] * jnp.stack([w.rack_viol, w.rack])
+
+    if use_topic:
+        t = dt.topic_of_partition[p]                                  # [N]
+        n_a = st.topic_count[a, t]                                    # [N]
+        n_b = st.topic_count[:, t].T                                  # [N,B]
+        u, l = th.topic_upper[t], th.topic_lower[t]
+        bc = AN._band_cost
+        dc_t = ((bc(n_a - 1.0, u, l) - bc(n_a, u, l))[:, None]
+                + bc(n_b + 1.0, u[:, None], l[:, None])
+                - bc(n_b, u[:, None], l[:, None]))
+        vi = lambda n, uu, ll: (bc(n, uu, ll) > 0).astype(jnp.float32)
+        dv_t = ((vi(n_a - 1.0, u, l) - vi(n_a, u, l))[:, None]
+                + vi(n_b + 1.0, u[:, None], l[:, None])
+                - vi(n_b, u[:, None], l[:, None]))
+        d2 = d2 + jnp.stack([w.topic_viol * dv_t, w.topic * dc_t], axis=-1)
+
+    on_init = a == initial_broker_of[src_r]
+    heals = dt.replica_offline[src_r] & on_init & dt.broker_alive[a]
+    back = (dt.replica_offline[src_r][:, None]
+            & (initial_broker_of[src_r][:, None] == jnp.arange(B)[None, :]))
+    d_heal = (back.astype(jnp.float32)
+              - heals.astype(jnp.float32)[:, None])
+    d2 = d2 + d_heal[..., None] * jnp.stack([w.healing_viol, w.healing])
+
+    sib_on_b = jnp.any((sib_b[:, :, None] == jnp.arange(B)[None, None, :])
+                       & valid_sib[:, :, None], axis=1)               # [N,B]
+    ok = (opts.replica_movable[src_r][:, None]
+          & opts.move_dest_ok[None, :]
+          & (a[:, None] != jnp.arange(B)[None, :])
+          & ~sib_on_b)
+    return jnp.where(ok, OBJ.combine(d2), AN._INF)
 
 
 @partial(jax.jit, static_argnames=("topic_mode",))
@@ -115,9 +199,9 @@ def _lead_deltas_batch(dt, th, weights, opts, st, src_p, slots):
         src_p, slots)
 
 
-@partial(jax.jit, static_argnames=("use_dense_topic",))
+@partial(jax.jit, static_argnames=("use_dense_topic", "check_under"))
 def _violating_state(dt, th, weights, st, offline, initial_broker_of,
-                     use_dense_topic: bool):
+                     use_dense_topic: bool, check_under: bool = False):
     """Device scan for violation sites, packed to minimize tunnel transfers:
     a per-replica category bitmask u8[R] (1=topic cell over, 2=rack dup,
     4=on band-violating broker/host, 8=unhealed offline), the per-broker
@@ -132,9 +216,22 @@ def _violating_state(dt, th, weights, st, offline, initial_broker_of,
     t_of_r = dt.topic_of_partition[dt.partition_of_replica]
     if use_dense_topic:
         cnt_r = st.topic_count[st.broker_of, t_of_r]
+        topic_w = weights.topic_viol > 0
         over_topic = ((cnt_r > th.topic_upper[t_of_r])
-                      & th.alive[st.broker_of]
-                      & (weights.topic_viol > 0))
+                      & th.alive[st.broker_of] & topic_w)
+        if check_under:
+            # under-lower cells: some alive broker holds fewer than lower(t)
+            # replicas of topic t. The fix is moving a replica of t ONTO
+            # that broker, so the movable sources are t's replicas sitting
+            # on brokers ABOVE the lower band (the full-destination scan
+            # finds the under-filled receiver). Guarded: the [B, T] min is a
+            # full-histogram reduction, and most clusters have lower = 0.
+            col_min = jnp.min(jnp.where(th.alive[:, None], st.topic_count,
+                                        jnp.inf), axis=0)       # [T]
+            donor_topic = ((col_min[t_of_r] < th.topic_lower[t_of_r])
+                           & (cnt_r > th.topic_lower[t_of_r])
+                           & th.alive[st.broker_of] & topic_w)
+            over_topic = over_topic | donor_topic
     else:
         over_topic = jnp.zeros_like(st.broker_of, bool)
     # rack: replica is a same-rack duplicate (second+ replica in its rack)
@@ -162,8 +259,9 @@ def _violating_state(dt, th, weights, st, offline, initial_broker_of,
     return mask, (viol_b > 0), headroom
 
 
-def _chain_state(dt, assign, num_topics_dense: int) -> AN.ChainState:
-    agg = compute_aggregates(dt, assign, num_topics_dense)
+def _chain_state(dt, assign, num_topics: int,
+                 track_topics: bool) -> AN.ChainState:
+    agg = compute_aggregates(dt, assign, num_topics if track_topics else 1)
     return AN.ChainState(
         broker_of=jnp.asarray(assign.broker_of, jnp.int32),
         leader_of=jnp.asarray(assign.leader_of, jnp.int32),
@@ -173,8 +271,7 @@ def _chain_state(dt, assign, num_topics_dense: int) -> AN.ChainState:
         leader_count=agg.leader_count.astype(jnp.float32),
         potential_nw_out=agg.potential_nw_out,
         leader_bytes_in=agg.leader_bytes_in,
-        topic_count=(agg.topic_count.astype(jnp.float32)
-                     if num_topics_dense > 1
+        topic_count=(agg.topic_count.astype(jnp.float32) if track_topics
                      else jnp.zeros((1, 1), jnp.float32)),
         energy=jnp.zeros((2,), jnp.float32),
     )
@@ -202,12 +299,11 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
     topic_mode = "dense" if topic_on else "off"
     topic_reps = jnp.full((1, 1), -1, jnp.int32)
 
-    st = _chain_state(dt, assign, num_topics if topic_on else 1)
+    st = _chain_state(dt, assign, num_topics, topic_on)
     alive_np = np.asarray(jax.device_get(dt.broker_alive))
     dest_pool = np.flatnonzero(np.asarray(jax.device_get(opts.move_dest_ok)))
     if dest_pool.size == 0:
         return assign, 0, 0
-    dest_pool_dev = jnp.asarray(dest_pool, jnp.int32)
     movable_np = np.asarray(jax.device_get(opts.replica_movable))
     part_of_r = np.asarray(jax.device_get(dt.partition_of_replica))
     topic_of_p = np.asarray(jax.device_get(dt.topic_of_partition))
@@ -222,10 +318,13 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
     # avoids re-transferring the 2 MB [R] array over the tunnel every round
     bo = np.array(jax.device_get(st.broker_of))
 
+    check_under = topic_on and bool(
+        float(jax.device_get(jnp.max(th.topic_lower))) > 0)
+
     def scan_state():
         mask, bad_b, headroom = _violating_state(
             dt, th, weights, st, jnp.asarray(offline_np),
-            initial_broker_of, topic_on)
+            initial_broker_of, topic_on, check_under)
         return (np.asarray(jax.device_get(mask)),
                 np.asarray(jax.device_get(bad_b)),
                 np.asarray(jax.device_get(headroom)))
@@ -317,14 +416,24 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
             apply_moves(acc_r, acc_b)
         if len(acc_r) < max(64, N // 64):
             break      # diminishing returns: hand over to the tail phases
-    # ---- phase 2 (tail): every violating entity (topic/rack cells, band
-    # and count brokers, offline), EVERY destination evaluated — the residue
-    # random destination sampling keeps missing. Count violations
-    # (ReplicaDistributionGoal) in particular can ONLY be fixed here: swaps
-    # preserve both brokers' replica counts by construction.
+    # ---- phase 2 (targeted): every violating entity, best action per
+    # source each round — a MOVE evaluated against EVERY broker (broadcast
+    # rows), or a SWAP with a sampled partner when the cell is pinned at a
+    # band edge (moving out would breach the source's lower band — a
+    # higher-priority violation — so only a load-preserving exchange
+    # improves; count violations conversely are only fixable by moves, since
+    # swaps preserve both brokers' replica counts). Interleaving the two
+    # action kinds lets each stuck source take whichever rescue applies
+    # instead of grinding move rounds before any swap is tried.
+    movable_pool = np.flatnonzero(movable_np)
     for _ in range(cfg.max_rounds):
         mask, bad_b, headroom = scan_state()
-        sources = np.flatnonzero((mask != 0) & movable_np)
+        cell_src = np.flatnonzero(((mask & 11) != 0) & movable_np)
+        band_src = np.flatnonzero((mask == 4) & movable_np)
+        n_band = min(band_src.size, 8 * max(int(bad_b.sum()), 1), 512)
+        if band_src.size > n_band:
+            band_src = rng.choice(band_src, size=n_band, replace=False)
+        sources = np.concatenate([cell_src, band_src])
         if sources.size == 0:
             break
         if sources.size > cfg.full_dest_threshold:
@@ -334,87 +443,72 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
         pad = _bucket(N, cfg.full_dest_threshold)
         src = np.full(pad, sources[0], np.int32)
         src[:N] = sources
-        d2 = _move_deltas_full(dt, th, weights, opts, st, initial_broker_of,
-                               topic_reps, jnp.asarray(src), dest_pool_dev,
-                               topic_mode)
-        d = np.array(jax.device_get(OBJ.combine(d2)))            # [pad, D]
-        d[N:] = _INF
-        best_k = np.argmin(d, axis=1)
-        best_d = d[np.arange(pad), best_k]
-        dests = np.broadcast_to(dest_pool, (pad, dest_pool.size))
-        acc_r, acc_b = accept_moves(best_d, best_k, src, dests, N,
-                                    per_broker_cap=2)
+        dmv = np.array(jax.device_get(_move_deltas_rows(
+            dt, th, weights, opts, st, initial_broker_of,
+            jnp.asarray(src), topic_on)))                        # [pad, B]
+        dmv[N:] = _INF
+        mv_k = np.argmin(dmv, axis=1)
+        mv_d = dmv[np.arange(pad), mv_k]
+        ks = cfg.swap_partners
+        r2 = movable_pool[rng.integers(0, movable_pool.size,
+                                       size=(pad, ks))].astype(np.int32)
+        dsw = np.array(jax.device_get(OBJ.combine(_swap_deltas_batch(
+            dt, th, weights, opts, st, initial_broker_of, topic_reps,
+            jnp.asarray(src), jnp.asarray(r2), topic_mode))))    # [pad, ks]
+        dsw[N:] = _INF
+        sw_k = np.argmin(dsw, axis=1)
+        sw_d = dsw[np.arange(pad), sw_k]
+
+        best = np.minimum(mv_d, sw_d)
+        order = np.argsort(best)
+        cnt_b: dict = {}
+        used_p: set = set()
+        acc_r: List[int] = []
+        acc_b: List[int] = []
+        n_sw = 0
+
+        def budget_ok(*brokers):
+            return all(cnt_b.get(x, 0) < 4 for x in brokers)
+
+        def consume(*brokers):
+            for x in brokers:
+                cnt_b[x] = cnt_b.get(x, 0) + 1
+
+        for i in order:
+            if not (best[i] < -cfg.min_improvement):
+                break
+            r = int(src[i])
+            a_b = int(bo[r])
+            pa = int(part_of_r[r])
+            if pa in used_p:
+                continue
+            if mv_d[i] <= sw_d[i]:
+                b_dst = int(mv_k[i])
+                if not budget_ok(a_b, b_dst):
+                    continue
+                consume(a_b, b_dst)
+                used_p.add(pa)
+                acc_r.append(r)
+                acc_b.append(b_dst)
+            else:
+                partner = int(r2[i, sw_k[i]])
+                b_b = int(bo[partner])
+                pb = int(part_of_r[partner])
+                if pb in used_p or not budget_ok(a_b, b_b):
+                    continue
+                consume(a_b, b_b)
+                used_p.update((pa, pb))
+                acc_r.extend((r, partner))
+                acc_b.extend((b_b, a_b))
+                n_sw += 1
         if _DEBUG:
-            print(f"[repair tail] srcs={N} improving="
-                  f"{int((best_d[:N] < -cfg.min_improvement).sum())} "
-                  f"accepted={len(acc_r)}", flush=True)
+            print(f"[repair targeted] srcs={N} improving="
+                  f"{int((best[:N] < -cfg.min_improvement).sum())} "
+                  f"accepted={len(acc_r) - n_sw} (swaps={n_sw})", flush=True)
         if not acc_r:
             break
         apply_moves(acc_r, acc_b)
-
-    # ---- phase 3 (swaps): violating entities pinned by band edges — a
-    # plain move out would breach the source broker's lower band (a
-    # higher-priority violation), so EXCHANGE the offending replica with one
-    # of comparable load elsewhere (ActionType.INTER_BROKER_REPLICA_SWAP,
-    # the same rescue the reference's swap-capable goals perform). Covers
-    # both stuck topic/rack cells and stuck band-violating brokers.
-    movable_pool = np.flatnonzero(movable_np)
-    for _ in range(cfg.max_rounds):
-        mask, bad_b, headroom = scan_state()
-        sources = np.flatnonzero(((mask & 7) != 0) & movable_np)
-        if sources.size == 0 or movable_pool.size == 0:
-            break
-        if sources.size > cfg.full_dest_threshold:
-            sources = rng.choice(sources, size=cfg.full_dest_threshold,
-                                 replace=False)
-        N = sources.size
-        pad = _bucket(N, cfg.full_dest_threshold)
-        r1 = np.full(pad, sources[0], np.int32)
-        r1[:N] = sources
-        k = cfg.swap_partners
-        r2 = movable_pool[rng.integers(0, movable_pool.size,
-                                       size=(pad, k))].astype(np.int32)
-        d2 = _swap_deltas_batch(dt, th, weights, opts, st,
-                                initial_broker_of, topic_reps,
-                                jnp.asarray(r1), jnp.asarray(r2),
-                                topic_mode)
-        d = np.array(jax.device_get(OBJ.combine(d2)))            # [pad, k]
-        d[N:] = _INF
-        best_k = np.argmin(d, axis=1)
-        best_d = d[np.arange(pad), best_k]
-        order = np.argsort(best_d)
-        cnt_b: dict = {}
-        used_p: set = set()
-        s_r: List[int] = []
-        s_p: List[int] = []
-        for i in order:
-            if not (best_d[i] < -cfg.min_improvement):
-                break
-            a_r = int(r1[i])
-            b_r = int(r2[i, best_k[i]])
-            a_b, b_b = int(bo[a_r]), int(bo[b_r])
-            pa, pb = int(part_of_r[a_r]), int(part_of_r[b_r])
-            if (cnt_b.get(a_b, 0) >= 4 or cnt_b.get(b_b, 0) >= 4
-                    or pa in used_p or pb in used_p):
-                continue
-            cnt_b[a_b] = cnt_b.get(a_b, 0) + 1
-            cnt_b[b_b] = cnt_b.get(b_b, 0) + 1
-            used_p.update((pa, pb))
-            s_r.append(a_r)
-            s_p.append(b_r)
-        if _DEBUG:
-            print(f"[repair swap] srcs={N} improving="
-                  f"{int((best_d[:N] < -cfg.min_improvement).sum())} "
-                  f"accepted={len(s_r)}", flush=True)
-        if not s_r:
-            break
-        # a swap = two moves in one batch
-        acc_r = s_r + s_p
-        acc_b = [int(bo[x]) for x in s_p] + [int(bo[x]) for x in s_r]
-        apply_moves(acc_r, acc_b)
-        total_swaps += len(s_r)
-        if len(s_r) < 4:
-            break      # diminishing returns
+        total_swaps += n_sw
 
     # ---- leadership repair: partitions led by brokers violating the
     # leadership-sensitive terms (LeaderReplicaDistribution, LeaderBytesIn,
@@ -500,11 +594,13 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
             total_moves, total_leads)
 
 
-@partial(jax.jit, static_argnames=("use_topic",))
+@partial(jax.jit, static_argnames=("use_topic",), donate_argnums=(1,))
 def _apply_batch(dt, st, r_vec, b_vec, use_topic: bool):
+    """``st`` is donated: the applies would otherwise copy the whole chain
+    state — including the ~300 MB dense topic histogram — every round."""
     return AN._apply_moves(dt, st, r_vec, b_vec, use_topic)
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=(1,))
 def _apply_leads_batch(dt, st, p_vec, new_leader_vec):
     return AN._apply_leads(dt, st, p_vec, new_leader_vec)

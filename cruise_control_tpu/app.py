@@ -346,8 +346,12 @@ class CruiseControlApp:
             if b in idx:
                 demoted[idx[b]] = True
         topo = dataclasses.replace(topo, broker_demoted=demoted)
+        # demotion only moves LEADERSHIP (DemoteBrokerRunnable semantics):
+        # immigrant-only mode pins every replica in place (only offline
+        # replicas may still relocate, preserving self-healing)
         options = G.build_options(topo,
-                                  excluded_brokers_for_leadership=broker_ids)
+                                  excluded_brokers_for_leadership=broker_ids,
+                                  only_move_immigrant_replicas=True)
         result = self._optimize(
             topo, assign, ("LeaderReplicaDistributionGoal",
                            "LeaderBytesInDistributionGoal",

@@ -182,3 +182,48 @@ def test_lexicographic_goal_priority(seed):
         assert vf[g] <= vp[g] + 1e-6, (
             f"goal {g}: full-list optimization leaves {vf[g]} violations "
             f"but prefix-only achieves {vp[g]}")
+
+
+def test_repair_row_kernel_matches_scalar_deltas():
+    """repair._move_deltas_rows (broadcast [N, B] kernel) must agree exactly
+    with the annealer's per-pair _move_delta on every (source, dest) —
+    locks the two delta implementations together."""
+    import jax.numpy as jnp2
+    from cruise_control_tpu.analyzer import annealer as AN2
+    from cruise_control_tpu.analyzer import objective as OBJ2
+    from cruise_control_tpu.analyzer import repair as REP
+    from cruise_control_tpu.common.resources import BalancingConstraint
+    from cruise_control_tpu.ops.aggregates import device_topology as devtopo
+    import jax as jax2
+
+    topo, assign = fixtures.random_cluster(fixtures.ClusterProperties(
+        num_racks=3, num_brokers=8, num_replicas=200, num_topics=15,
+        min_replication=2, max_replication=3), seed=21)
+    dt = devtopo(topo)
+    th = G.compute_thresholds(
+        dt, BalancingConstraint(),
+        __import__("cruise_control_tpu.ops.aggregates", fromlist=["compute_aggregates"]
+                   ).compute_aggregates(dt, assign, topo.num_topics))
+    w = __import__("cruise_control_tpu.analyzer.objective",
+                   fromlist=["build_weights"]).build_weights(G.DEFAULT_GOALS)
+    opts = G.default_options(topo)
+    init = jnp2.asarray(assign.broker_of)
+    st = REP._chain_state(dt, assign, topo.num_topics, True)
+    src = jnp2.asarray(np.arange(0, 200, 7), jnp2.int32)
+    rows = REP._move_deltas_rows(dt, th, w, opts, st, init, src, True)
+
+    def one(r, b):
+        d2 = AN2._move_delta(dt, th, w, opts, st, init, "dense",
+                             jnp2.full((1, 1), -1, jnp2.int32), r, b)
+        return OBJ2.combine(d2)
+    ref = jax2.vmap(jax2.vmap(one, in_axes=(None, 0)),
+                    in_axes=(0, None))(src, jnp2.arange(dt.num_brokers))
+    rows_np, ref_np = np.asarray(rows), np.asarray(ref)
+    # illegal moves use different huge markers (raw _INF vs combined inf);
+    # legality itself must agree exactly, legal deltas must agree numerically
+    illegal_rows = rows_np >= 1e30
+    illegal_ref = ref_np >= 1e30
+    np.testing.assert_array_equal(illegal_rows, illegal_ref)
+    legal = ~illegal_rows
+    np.testing.assert_allclose(rows_np[legal], ref_np[legal],
+                               rtol=1e-5, atol=1e-2)

@@ -189,10 +189,15 @@ def test_app_remove_brokers_drains():
 
 
 def test_app_demote_brokers():
+    """DemoteBrokerRunnable parity: leadership leaves the demoted broker and
+    replica placement is untouched (demotion is a leadership-only
+    operation — DemoteBrokerRunnable.java)."""
     app = _app()
     out = app.demote_brokers([1], dryrun=True)
     for p in out["proposals"]:
         assert p["newReplicas"][0] != 1     # leadership moved off broker 1
+        # replica SET preserved: only ordering (leadership) changes
+        assert set(p["newReplicas"]) == set(p["oldReplicas"]), p
 
 
 def test_app_topic_rf_change():
