@@ -46,8 +46,11 @@ def main():
         topo, assign = fixtures.synthetic_cluster(
             num_brokers=2_600, num_replicas=500_000, num_racks=40,
             num_topics=30_000, seed=seed)
-        cfg = AN.AnnealConfig(num_chains=16, steps=4096, swap_interval=256,
-                              tries_move=96, tries_lead=16, tries_swap=48)
+        # wide-batch shallow anneal: 4x candidate tries at 1/4 the
+        # sequential steps — same total candidates, ~40% of the wall-clock
+        # (per-step cost is strongly sub-linear in the try count)
+        cfg = AN.AnnealConfig(num_chains=16, steps=1024, swap_interval=128,
+                              tries_move=384, tries_lead=64, tries_swap=192)
         engine = "anneal"
     elif size == "medium":
         topo, assign = fixtures.synthetic_cluster(

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 from functools import partial
 from typing import List, Optional, Sequence, Tuple
 
@@ -51,13 +52,10 @@ _INF = float(np.float32(3.0e38))
 @dataclasses.dataclass(frozen=True)
 class RepairConfig:
     max_rounds: int = 30
-    #: destination candidates sampled per source replica
-    dests_per_source: int = 8
-    #: cap on candidate sources per round (padded bucket size)
-    max_sources: int = 8192
-    #: per-round source cap for the targeted phase (every destination is
-    #: evaluated for each source via the broadcast row kernel)
-    full_dest_threshold: int = 2048
+    #: inner repair rounds fused into one device dispatch
+    fused_inner: int = 4
+    #: violating sources examined per inner round
+    fused_sources: int = 1024
     #: swap partners sampled per stuck source replica
     swap_partners: int = 24
     #: leadership candidates per round
@@ -74,19 +72,8 @@ def _bucket(n: int, cap: int, floor: int = 512) -> int:
     return floor if n <= floor else cap
 
 
-@partial(jax.jit, static_argnames=("topic_mode",))
-def _move_deltas_batch(dt, th, weights, opts, st, initial_broker_of,
-                       topic_reps, src_r, dest_b, topic_mode: str):
-    """f32[N, k, 2] exact deltas for source replicas × candidate dests."""
-    def one(r, b):
-        return AN._move_delta(dt, th, weights, opts, st, initial_broker_of,
-                              topic_mode, topic_reps, r, b)
-    return jax.vmap(jax.vmap(one, in_axes=(None, 0)))(src_r, dest_b)
-
-
-@partial(jax.jit, static_argnames=("use_topic",))
-def _move_deltas_rows(dt, th, w, opts, st, initial_broker_of, src_r,
-                      use_topic: bool):
+def _move_rows_impl(dt, th, w, opts, st, initial_broker_of, src_r,
+                    use_topic: bool):
     """f32[N, B] combined deltas for source replicas × EVERY broker.
 
     Broadcast-style evaluation (the greedy engine's [R, B] pattern applied
@@ -180,14 +167,8 @@ def _move_deltas_rows(dt, th, w, opts, st, initial_broker_of, src_r,
     return jnp.where(ok, OBJ.combine(d2), AN._INF)
 
 
-@partial(jax.jit, static_argnames=("topic_mode",))
-def _swap_deltas_batch(dt, th, weights, opts, st, initial_broker_of,
-                       topic_reps, r1, r2, topic_mode: str):
-    """f32[N, k, 2] exact deltas for exchanging r1[i] with each r2[i, j]."""
-    def one(a, b):
-        return AN._swap_delta(dt, th, weights, opts, st, initial_broker_of,
-                              topic_mode, topic_reps, a, b)
-    return jax.vmap(jax.vmap(one, in_axes=(None, 0)))(r1, r2)
+_move_deltas_rows = partial(jax.jit, static_argnames=("use_topic",))(
+    _move_rows_impl)
 
 
 @jax.jit
@@ -199,64 +180,137 @@ def _lead_deltas_batch(dt, th, weights, opts, st, src_p, slots):
         src_p, slots)
 
 
-@partial(jax.jit, static_argnames=("use_dense_topic", "check_under"))
-def _violating_state(dt, th, weights, st, offline, initial_broker_of,
-                     use_dense_topic: bool, check_under: bool = False):
-    """Device scan for violation sites, packed to minimize tunnel transfers:
-    a per-replica category bitmask u8[R] (1=topic cell over, 2=rack dup,
-    4=on band-violating broker/host, 8=unhealed offline), the per-broker
-    violation indicator, and per-broker headroom for dest biasing."""
-    bt = G.broker_terms(th, st.broker_load, st.replica_count,
-                        st.leader_count, st.potential_nw_out,
-                        st.leader_bytes_in)
-    viol_b = jnp.sum(bt.violations * (weights.broker_terms_viol > 0), axis=-1)
-    h_viol, _ = G.host_terms(th, st.host_load)
-    viol_h = jnp.sum(h_viol * (weights.host_terms_viol > 0), axis=-1)
-    # replica in an over-upper (broker, topic) cell (dense histogram lookup)
+@partial(jax.jit,
+         static_argnames=("use_topic", "check_under", "n_inner", "n_src",
+                          "k_swap"),
+         donate_argnums=(4,))
+def _fused_targeted(dt, th, w, opts, st, offline, initial_broker_of,
+                    movable, movable_pool, key, min_improvement,
+                    use_topic: bool, check_under: bool, n_inner: int,
+                    n_src: int, k_swap: int):
+    """``n_inner`` repair rounds fused into ONE device program.
+
+    The host-driven round loop is tunnel-latency-bound (~0.8 s per round
+    regardless of batch size: scan + deltas + apply is 4-5 dispatches).
+    Here each inner round scans for violating replicas, evaluates every
+    source's best MOVE (broadcast [n_src, B] row kernel) and best SWAP
+    (k_swap sampled partners), resolves conflicts on-device with
+    scatter-min claims (one winner per source broker, destination broker,
+    and partition), applies the winners, and repeats — all inside one
+    ``lax.scan``. Claims are more conservative than the host loop's
+    per-broker budgets, but inner rounds are nearly free.
+    Returns (state, accepted_actions_total).
+    """
+    R = dt.num_replicas
+    B = dt.num_brokers
+    P = dt.num_partitions
     t_of_r = dt.topic_of_partition[dt.partition_of_replica]
-    if use_dense_topic:
-        cnt_r = st.topic_count[st.broker_of, t_of_r]
-        topic_w = weights.topic_viol > 0
-        over_topic = ((cnt_r > th.topic_upper[t_of_r])
-                      & th.alive[st.broker_of] & topic_w)
-        if check_under:
-            # under-lower cells: some alive broker holds fewer than lower(t)
-            # replicas of topic t. The fix is moving a replica of t ONTO
-            # that broker, so the movable sources are t's replicas sitting
-            # on brokers ABOVE the lower band (the full-destination scan
-            # finds the under-filled receiver). Guarded: the [B, T] min is a
-            # full-histogram reduction, and most clusters have lower = 0.
-            col_min = jnp.min(jnp.where(th.alive[:, None], st.topic_count,
-                                        jnp.inf), axis=0)       # [T]
-            donor_topic = ((col_min[t_of_r] < th.topic_lower[t_of_r])
-                           & (cnt_r > th.topic_lower[t_of_r])
-                           & th.alive[st.broker_of] & topic_w)
-            over_topic = over_topic | donor_topic
-    else:
-        over_topic = jnp.zeros_like(st.broker_of, bool)
-    # rack: replica is a same-rack duplicate (second+ replica in its rack)
-    reps = dt.replicas_of_partition[dt.partition_of_replica]     # [R, m]
-    m = reps.shape[1]
-    valid = reps >= 0
-    racks = dt.rack_of_broker[st.broker_of[jnp.clip(reps, 0)]]   # [R, m]
-    my_slot = jnp.argmax(reps == jnp.arange(dt.num_replicas)[:, None], axis=1)
-    my_rack = dt.rack_of_broker[st.broker_of]
-    earlier = jnp.arange(m)[None, :] < my_slot[:, None]
-    dup_rack = jnp.any((racks == my_rack[:, None]) & earlier & valid, axis=1)
-    dup_rack = dup_rack & (weights.rack_viol > 0)
-    # headroom: distance below the distribution upper band, worst resource —
-    # destinations near a band edge reject added load, so bias away from them
-    pct = st.broker_load / jnp.maximum(th.broker_capacity, 1e-30)
-    headroom = jnp.min(th.dist_upper_pct[None, :] - pct, axis=-1)
-    headroom = jnp.where(th.alive, headroom, -jnp.inf)
-    on_bad = ((viol_b > 0)[st.broker_of]
-              | (viol_h > 0)[dt.host_of_broker[st.broker_of]])
-    unhealed = offline & (st.broker_of == initial_broker_of)
-    mask = (over_topic.astype(jnp.uint8)
-            + 2 * dup_rack.astype(jnp.uint8)
-            + 4 * on_bad.astype(jnp.uint8)
-            + 8 * unhealed.astype(jnp.uint8))
-    return mask, (viol_b > 0), headroom
+    part_of = dt.partition_of_replica
+
+    def viol_flag(st):
+        bt = G.broker_terms(th, st.broker_load, st.replica_count,
+                            st.leader_count, st.potential_nw_out,
+                            st.leader_bytes_in)
+        viol_b = jnp.sum(bt.violations * (w.broker_terms_viol > 0), axis=-1)
+        h_viol, _ = G.host_terms(th, st.host_load)
+        viol_h = jnp.sum(h_viol * (w.host_terms_viol > 0), axis=-1)
+        if use_topic:
+            cnt_r = st.topic_count[st.broker_of, t_of_r]
+            topic_w = w.topic_viol > 0
+            over = ((cnt_r > th.topic_upper[t_of_r])
+                    & th.alive[st.broker_of] & topic_w)
+            if check_under:
+                col_min = jnp.min(jnp.where(th.alive[:, None],
+                                            st.topic_count, jnp.inf), axis=0)
+                over = over | ((col_min[t_of_r] < th.topic_lower[t_of_r])
+                               & (cnt_r > th.topic_lower[t_of_r])
+                               & th.alive[st.broker_of] & topic_w)
+        else:
+            over = jnp.zeros((R,), bool)
+        reps = dt.replicas_of_partition[part_of]
+        m = reps.shape[1]
+        valid = reps >= 0
+        racks = dt.rack_of_broker[st.broker_of[jnp.clip(reps, 0)]]
+        my_slot = jnp.argmax(reps == jnp.arange(R)[:, None], axis=1)
+        my_rack = dt.rack_of_broker[st.broker_of]
+        earlier = jnp.arange(m)[None, :] < my_slot[:, None]
+        dup_rack = (jnp.any((racks == my_rack[:, None]) & earlier & valid,
+                            axis=1) & (w.rack_viol > 0))
+        on_bad = ((viol_b > 0)[st.broker_of]
+                  | (viol_h > 0)[dt.host_of_broker[st.broker_of]])
+        unhealed = offline & (st.broker_of == initial_broker_of)
+        return (over | dup_rack | on_bad | unhealed) & movable
+
+    def inner(st, k):
+        flag = viol_flag(st)
+        # rotate the scan origin each round: nonzero picks the lowest
+        # indices, and a deterministic window could starve higher-index
+        # violators behind a stuck prefix
+        start = jax.random.randint(jax.random.fold_in(k, 7), (), 0, R)
+        rolled = jnp.roll(flag, -start)
+        src = jnp.nonzero(rolled, size=n_src, fill_value=-1)[0]
+        valid_src = src >= 0
+        srcc = jnp.where(valid_src, (src + start) % R, 0)
+        # best move per source over every broker
+        dmv = _move_rows_impl(dt, th, w, opts, st, initial_broker_of, srcc,
+                              use_topic)                         # [n_src, B]
+        dmv = jnp.where(valid_src[:, None], dmv, AN._INF)
+        mv_b = jnp.argmin(dmv, axis=1)
+        mv_d = jnp.take_along_axis(dmv, mv_b[:, None], axis=1)[:, 0]
+        # best swap per source over sampled partners
+        r2 = movable_pool[jax.random.randint(
+            k, (n_src, k_swap), 0, movable_pool.shape[0])]
+        dsw = jax.vmap(jax.vmap(
+            lambda a_r, b_r: OBJ.combine(AN._swap_delta(
+                dt, th, w, opts, st, initial_broker_of,
+                "dense" if use_topic else "off",
+                jnp.full((1, 1), -1, jnp.int32), a_r, b_r)),
+            in_axes=(None, 0)))(srcc, r2)                        # [n_src, k]
+        dsw = jnp.where(valid_src[:, None], dsw, AN._INF)
+        sw_j = jnp.argmin(dsw, axis=1)
+        sw_d = jnp.take_along_axis(dsw, sw_j[:, None], axis=1)[:, 0]
+        partner = jnp.take_along_axis(r2, sw_j[:, None], axis=1)[:, 0]
+
+        is_move = mv_d <= sw_d
+        act_d = jnp.minimum(mv_d, sw_d)
+        a_b = st.broker_of[srcc]
+        b_b = jnp.where(is_move, mv_b, st.broker_of[partner])
+        p_a = part_of[srcc]
+        p_b = jnp.where(is_move, p_a, part_of[partner])
+        # Exact two-pass claims: min delta per resource, then min INDEX among
+        # the delta-tied entries. A float index jitter would be absorbed by
+        # rounding at violation-channel magnitudes (~1e14), letting two tied
+        # actions on the same partition both "win" — whose double
+        # scatter-adds corrupt broker_of.
+        idx = jnp.arange(n_src, dtype=jnp.int32)
+        big = jnp.int32(n_src + 1)
+
+        def claim(targets_a, targets_b, size):
+            m1 = (jnp.full((size,), jnp.inf)
+                  .at[targets_a].min(act_d).at[targets_b].min(act_d))
+            tied_a = m1[targets_a] == act_d
+            tied_b = m1[targets_b] == act_d
+            m2 = (jnp.full((size,), big)
+                  .at[targets_a].min(jnp.where(tied_a, idx, big))
+                  .at[targets_b].min(jnp.where(tied_b, idx, big)))
+            return (m2[targets_a] == idx) & (m2[targets_b] == idx)
+
+        win = (claim(a_b, b_b, B) & claim(p_a, p_b, P)
+               & (act_d < -min_improvement) & valid_src)
+        # apply: a move is (src -> b_b); a swap is two moves; losers no-op
+        mv_sel = win & is_move
+        sw_sel = win & ~is_move
+        dst1 = jnp.where(mv_sel, b_b,
+                         jnp.where(sw_sel, st.broker_of[partner], a_b))
+        dst2 = jnp.where(sw_sel, a_b, st.broker_of[partner])
+        all_r = jnp.concatenate([srcc, partner])
+        all_b = jnp.concatenate([dst1, dst2])
+        st = AN._apply_moves(dt, st, all_r, all_b, use_topic)
+        return st, jnp.sum(win.astype(jnp.int32))
+
+    keys = jax.random.split(key, n_inner)
+    st, accepts = jax.lax.scan(inner, st, keys)
+    return st, jnp.sum(accepts)
 
 
 def _chain_state(dt, assign, num_topics: int,
@@ -282,7 +336,7 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
            num_topics: int, initial_broker_of: Optional[jax.Array] = None,
            config: Optional[RepairConfig] = None,
            seed: int = 0) -> Tuple[Assignment, int, int]:
-    """Iterative targeted repair; returns (assignment, moves, lead_moves)."""
+    """Iterative targeted repair; returns (assignment, actions, lead_moves)."""
     cfg = config or RepairConfig()
     rng = np.random.default_rng(seed)
     B = dt.num_brokers
@@ -291,224 +345,48 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
     if initial_broker_of is None:
         initial_broker_of = jnp.asarray(assign.broker_of, jnp.int32)
     # Repair runs on a SINGLE state, so the dense [B, T] topic histogram is
-    # affordable at any scale (one i32/f32 copy, ~300 MB at 2.6K×30K) and
+    # affordable at any scale (one f32 copy, ~300 MB at 2.6K x 30K) and
     # makes every topic count an O(1) lookup — unlike the annealer's
-    # per-chain copies, which forced the CSR/sparse path there.
+    # per-chain copies, which force the CSR/sparse path there.
     topic_on = bool(float(jax.device_get(weights.topic_viol)) > 0
                     or float(jax.device_get(weights.topic)) > 0)
-    topic_mode = "dense" if topic_on else "off"
-    topic_reps = jnp.full((1, 1), -1, jnp.int32)
 
     st = _chain_state(dt, assign, num_topics, topic_on)
-    alive_np = np.asarray(jax.device_get(dt.broker_alive))
     dest_pool = np.flatnonzero(np.asarray(jax.device_get(opts.move_dest_ok)))
     if dest_pool.size == 0:
         return assign, 0, 0
     movable_np = np.asarray(jax.device_get(opts.replica_movable))
     part_of_r = np.asarray(jax.device_get(dt.partition_of_replica))
-    topic_of_p = np.asarray(jax.device_get(dt.topic_of_partition))
-    host_of_b = np.asarray(jax.device_get(dt.host_of_broker))
     offline_np = np.asarray(jax.device_get(dt.replica_offline))
-    init_np = np.asarray(jax.device_get(initial_broker_of))
-
-    total_moves = 0
-    total_leads = 0
-    total_swaps = 0
-    # host mirror of broker_of, updated incrementally as moves apply —
-    # avoids re-transferring the 2 MB [R] array over the tunnel every round
-    bo = np.array(jax.device_get(st.broker_of))
-
     check_under = topic_on and bool(
         float(jax.device_get(jnp.max(th.topic_lower))) > 0)
 
-    def scan_state():
-        mask, bad_b, headroom = _violating_state(
-            dt, th, weights, st, jnp.asarray(offline_np),
-            initial_broker_of, topic_on, check_under)
-        return (np.asarray(jax.device_get(mask)),
-                np.asarray(jax.device_get(bad_b)),
-                np.asarray(jax.device_get(headroom)))
-
-    def accept_moves(best_d, best_k, src, dests, N, per_broker_cap):
-        """Greedy non-conflicting accept: per-broker move budget instead of
-        exclusive locks (deltas go slightly stale within a round, but every
-        round re-evaluates from the exactly-maintained state, and the budget
-        bounds the staleness)."""
-        order = np.argsort(best_d)
-        cnt_b: dict = {}
-        used_p: set = set()
-        acc_r: List[int] = []
-        acc_b: List[int] = []
-        for i in order:
-            if not (best_d[i] < -cfg.min_improvement):
-                break
-            r = int(src[i])
-            b_dst = int(dests[i, best_k[i]])
-            a_src = int(bo[r])
-            p = int(part_of_r[r])
-            if (cnt_b.get(a_src, 0) >= per_broker_cap
-                    or cnt_b.get(b_dst, 0) >= per_broker_cap
-                    or p in used_p):
-                continue
-            cnt_b[a_src] = cnt_b.get(a_src, 0) + 1
-            cnt_b[b_dst] = cnt_b.get(b_dst, 0) + 1
-            used_p.add(p)
-            acc_r.append(r)
-            acc_b.append(b_dst)
-        return acc_r, acc_b
-
-    def apply_moves(acc_r, acc_b):
-        nonlocal st, total_moves
-        # pad to a bucket with no-ops (dest == current broker) so the apply
-        # compiles once per bucket size, not once per acceptance count
-        napp = len(acc_r)
-        pad_a = _bucket(napp, cfg.max_sources)
-        r_arr = np.full(pad_a, acc_r[0], np.int32)
-        b_arr = np.full(pad_a, int(bo[acc_r[0]]), np.int32)
-        r_arr[:napp] = acc_r
-        b_arr[:napp] = acc_b
-        st = _apply_batch(dt, st, jnp.asarray(r_arr), jnp.asarray(b_arr),
-                          topic_on)
-        bo[np.asarray(acc_r)] = acc_b
-        total_moves += napp
-
-    # ---- phase 1 (bulk): every violating entity, sampled headroom-biased
-    # destinations, per-broker budget 4; hands over to the targeted phases
-    # once acceptance decays (grinding band-edge brokers here wastes rounds
-    # that the full-dest/swap phases resolve surgically)
-    for _ in range(cfg.max_rounds):
-        mask, bad_b, headroom = scan_state()
-        sources = np.flatnonzero((mask != 0) & movable_np)
-        if sources.size == 0:
-            break
-        if sources.size > cfg.max_sources:
-            sources = rng.choice(sources, size=cfg.max_sources, replace=False)
-        N = sources.size
-        pad = _bucket(N, cfg.max_sources)
-        src = np.full(pad, sources[0], np.int32)
-        src[:N] = sources
-        # bulk destinations: the annealed state packs brokers against the
-        # distribution bands, so uniform sampling mostly lands on brokers
-        # that reject added load — bias most samples toward the brokers with
-        # the most band headroom (the exact delta still rejects bad picks)
-        k = cfg.dests_per_source
-        hr = headroom[dest_pool]
-        top = dest_pool[np.argsort(-hr)[:max(dest_pool.size // 4, 1)]]
-        k_top = max(k - 2, 1)
-        dests = np.concatenate([
-            top[rng.integers(0, top.size, size=(pad, k_top))],
-            dest_pool[rng.integers(0, dest_pool.size, size=(pad, k - k_top))],
-        ], axis=1)
-        d2 = _move_deltas_batch(dt, th, weights, opts, st, initial_broker_of,
-                                topic_reps, jnp.asarray(src),
-                                jnp.asarray(dests, np.int32), topic_mode)
-        d = np.array(jax.device_get(OBJ.combine(d2)))            # [pad, k]
-        d[N:] = _INF
-        best_k = np.argmin(d, axis=1)
-        best_d = d[np.arange(pad), best_k]
-        acc_r, acc_b = accept_moves(best_d, best_k, src, dests, N,
-                                    per_broker_cap=4)
-        if _DEBUG:
-            print(f"[repair bulk] srcs={N} improving="
-                  f"{int((best_d[:N] < -cfg.min_improvement).sum())} "
-                  f"accepted={len(acc_r)}", flush=True)
-        if acc_r:
-            apply_moves(acc_r, acc_b)
-        if len(acc_r) < max(64, N // 64):
-            break      # diminishing returns: hand over to the tail phases
-    # ---- phase 2 (targeted): every violating entity, best action per
-    # source each round — a MOVE evaluated against EVERY broker (broadcast
-    # rows), or a SWAP with a sampled partner when the cell is pinned at a
-    # band edge (moving out would breach the source's lower band — a
-    # higher-priority violation — so only a load-preserving exchange
-    # improves; count violations conversely are only fixable by moves, since
-    # swaps preserve both brokers' replica counts). Interleaving the two
-    # action kinds lets each stuck source take whichever rescue applies
-    # instead of grinding move rounds before any swap is tried.
+    total_moves = 0
+    total_leads = 0
     movable_pool = np.flatnonzero(movable_np)
-    for _ in range(cfg.max_rounds):
-        mask, bad_b, headroom = scan_state()
-        cell_src = np.flatnonzero(((mask & 11) != 0) & movable_np)
-        band_src = np.flatnonzero((mask == 4) & movable_np)
-        n_band = min(band_src.size, 8 * max(int(bad_b.sum()), 1), 512)
-        if band_src.size > n_band:
-            band_src = rng.choice(band_src, size=n_band, replace=False)
-        sources = np.concatenate([cell_src, band_src])
-        if sources.size == 0:
-            break
-        if sources.size > cfg.full_dest_threshold:
-            sources = rng.choice(sources, size=cfg.full_dest_threshold,
-                                 replace=False)
-        N = sources.size
-        pad = _bucket(N, cfg.full_dest_threshold)
-        src = np.full(pad, sources[0], np.int32)
-        src[:N] = sources
-        dmv = np.array(jax.device_get(_move_deltas_rows(
-            dt, th, weights, opts, st, initial_broker_of,
-            jnp.asarray(src), topic_on)))                        # [pad, B]
-        dmv[N:] = _INF
-        mv_k = np.argmin(dmv, axis=1)
-        mv_d = dmv[np.arange(pad), mv_k]
-        ks = cfg.swap_partners
-        r2 = movable_pool[rng.integers(0, movable_pool.size,
-                                       size=(pad, ks))].astype(np.int32)
-        dsw = np.array(jax.device_get(OBJ.combine(_swap_deltas_batch(
-            dt, th, weights, opts, st, initial_broker_of, topic_reps,
-            jnp.asarray(src), jnp.asarray(r2), topic_mode))))    # [pad, ks]
-        dsw[N:] = _INF
-        sw_k = np.argmin(dsw, axis=1)
-        sw_d = dsw[np.arange(pad), sw_k]
-
-        best = np.minimum(mv_d, sw_d)
-        order = np.argsort(best)
-        cnt_b: dict = {}
-        used_p: set = set()
-        acc_r: List[int] = []
-        acc_b: List[int] = []
-        n_sw = 0
-
-        def budget_ok(*brokers):
-            return all(cnt_b.get(x, 0) < 4 for x in brokers)
-
-        def consume(*brokers):
-            for x in brokers:
-                cnt_b[x] = cnt_b.get(x, 0) + 1
-
-        for i in order:
-            if not (best[i] < -cfg.min_improvement):
-                break
-            r = int(src[i])
-            a_b = int(bo[r])
-            pa = int(part_of_r[r])
-            if pa in used_p:
-                continue
-            if mv_d[i] <= sw_d[i]:
-                b_dst = int(mv_k[i])
-                if not budget_ok(a_b, b_dst):
-                    continue
-                consume(a_b, b_dst)
-                used_p.add(pa)
-                acc_r.append(r)
-                acc_b.append(b_dst)
-            else:
-                partner = int(r2[i, sw_k[i]])
-                b_b = int(bo[partner])
-                pb = int(part_of_r[partner])
-                if pb in used_p or not budget_ok(a_b, b_b):
-                    continue
-                consume(a_b, b_b)
-                used_p.update((pa, pb))
-                acc_r.extend((r, partner))
-                acc_b.extend((b_b, a_b))
-                n_sw += 1
+    if movable_pool.size == 0:
+        return assign, 0, 0
+    movable_pool_dev = jnp.asarray(movable_pool, jnp.int32)
+    movable_dev = jnp.asarray(movable_np)
+    offline_dev = jnp.asarray(offline_np)
+    base_key = jax.random.PRNGKey(seed)
+    for outer in range(cfg.max_rounds):
+        _t_round = time.time()
+        st, n_acc = _fused_targeted(
+            dt, th, weights, opts, st, offline_dev, initial_broker_of,
+            movable_dev, movable_pool_dev, jax.random.fold_in(base_key, outer),
+            jnp.float32(cfg.min_improvement),
+            topic_on, check_under, cfg.fused_inner, cfg.fused_sources,
+            cfg.swap_partners)
+        n_acc = int(jax.device_get(n_acc))
         if _DEBUG:
-            print(f"[repair targeted] srcs={N} improving="
-                  f"{int((best[:N] < -cfg.min_improvement).sum())} "
-                  f"accepted={len(acc_r) - n_sw} (swaps={n_sw})", flush=True)
-        if not acc_r:
+            print(f"[repair fused] outer={outer} accepted={n_acc} "
+                  f"t={time.time()-_t_round:.2f}s", flush=True)
+        total_moves += n_acc
+        if n_acc == 0:
             break
-        apply_moves(acc_r, acc_b)
-        total_swaps += n_sw
+    bo = np.array(jax.device_get(st.broker_of))
+    lo = np.array(jax.device_get(st.leader_of))
 
     # ---- leadership repair: partitions led by brokers violating the
     # leadership-sensitive terms (LeaderReplicaDistribution, LeaderBytesIn,
@@ -522,7 +400,6 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
     # static structures fetched once; leadership is tracked incrementally on
     # the host (replica placement no longer changes in this phase)
     reps_np = np.asarray(jax.device_get(dt.replicas_of_partition))
-    lo = np.array(jax.device_get(st.leader_of))
     for _ in range(cfg.max_rounds):
         bt = G.broker_terms(th, st.broker_load, st.replica_count,
                             st.leader_count, st.potential_nw_out,

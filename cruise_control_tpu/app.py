@@ -76,7 +76,8 @@ class CruiseControlApp:
             max_allowed_extrapolations=config.get(
                 "max.allowed.extrapolations.per.partition"),
             sampling_interval_ms=config.get("metric.sampling.interval.ms"),
-            use_lr_model=config.get("use.linear.regression.model"))
+            use_lr_model=config.get("use.linear.regression.model"),
+            num_metric_fetchers=config.get("num.metric.fetchers"))
         self._metadata_source = metadata_source
         adapter = cluster_adapter or FakeClusterAdapter({})
         self.executor = Executor(

@@ -205,6 +205,10 @@ def _service_config_def() -> ConfigDef:
              "Min samples for a valid window.", at_least(1))
     d.define("max.allowed.extrapolations.per.partition", T.INT, 5, I.LOW,
              "Max extrapolated windows per partition.", at_least(0))
+    d.define("num.metric.fetchers", T.INT, 1, I.MEDIUM,
+             "Parallel metric fetcher tasks; partitions are assigned "
+             "round-robin across fetchers (MetricFetcherManager).",
+             at_least(1))
     d.define("metric.sampling.interval.ms", T.LONG, 60_000, I.MEDIUM,
              "Sampler period.", at_least(1))
     d.define("min.valid.partition.ratio", T.DOUBLE, 0.95, I.MEDIUM,

@@ -97,9 +97,13 @@ class LoadMonitor:
                  max_allowed_extrapolations: int = 5,
                  sampling_interval_ms: int = 60_000,
                  use_lr_model: bool = False,
+                 num_metric_fetchers: int = 1,
                  now_fn: Optional[Callable[[], int]] = None):
+        from cruise_control_tpu.monitor.fetcher import MetricFetcherManager
         self._metadata_source = metadata_source
         self._sampler = sampler
+        self._fetchers = MetricFetcherManager(sampler,
+                                              num_fetchers=num_metric_fetchers)
         self._capacity_resolver = capacity_resolver or StaticCapacityResolver(
             {res.CPU: 100.0, res.NW_IN: 1e9, res.NW_OUT: 1e9, res.DISK: 1e9})
         self._store = sample_store or NoopSampleStore()
@@ -177,6 +181,7 @@ class LoadMonitor:
         self._shutdown.set()
         if self._thread:
             self._thread.join(timeout=5)
+        self._fetchers.close()
         self._sampler.close()
         self._store.close()
 
@@ -243,7 +248,7 @@ class LoadMonitor:
         self._state = MonitorState.SAMPLING
         try:
             metadata = self._metadata_source.get_metadata()
-            ps, bs = self._sampler.get_samples(
+            ps, bs = self._fetchers.fetch(
                 metadata, now_ms - self.sampling_interval_ms, now_ms)
             for s in ps:
                 self._ingest_partition_sample(s)
@@ -273,7 +278,7 @@ class LoadMonitor:
             while t < end_ms:
                 step_end = min(t + self.sampling_interval_ms, end_ms)
                 metadata = self._metadata_source.get_metadata()
-                ps, bs = self._sampler.get_samples(metadata, t, step_end)
+                ps, bs = self._fetchers.fetch(metadata, t, step_end)
                 for s in bs:
                     lbi.append(s.leader_bytes_in)
                     lbo.append(s.leader_bytes_out)
@@ -302,7 +307,7 @@ class LoadMonitor:
             while t < end_ms:
                 step_end = min(t + self.sampling_interval_ms, end_ms)
                 metadata = self._metadata_source.get_metadata()
-                ps, bs = self._sampler.get_samples(metadata, t, step_end)
+                ps, bs = self._fetchers.fetch(metadata, t, step_end)
                 for s in ps:
                     self._ingest_partition_sample(s)
                 for s in bs:

@@ -380,3 +380,48 @@ def test_windowed_loads_in_model():
     avg = topo.expected_broker_utilization(np.asarray(assign.broker_of),
                                            is_lead, use_max=False)
     assert abs(avg[lead_broker, res2.NW_IN] - 300.0) < 1e-3
+
+
+def test_metric_fetcher_manager_partition_assignment():
+    """MetricFetcherManager (MetricFetcherManager.java:32-86): partitions
+    split round-robin across fetchers, results merged, broker samples
+    deduplicated, and a failing fetcher forfeits only its own slice."""
+    from cruise_control_tpu.monitor.fetcher import MetricFetcherManager
+    from cruise_control_tpu.monitor.load_monitor import LoadMonitor, StaticMetadataSource
+    from cruise_control_tpu.monitor.sampler import MetricSampler, SyntheticLoadSampler
+
+    md_src = _metadata()
+    sampler = SyntheticLoadSampler(seed=3)
+    single = MetricFetcherManager(sampler, num_fetchers=1)
+    multi = MetricFetcherManager(sampler, num_fetchers=3)
+    # assignment covers every partition exactly once
+    slices = multi.assign_partitions(md_src)
+    all_parts = [(p.topic, p.partition) for s in slices for p in s.partitions]
+    assert sorted(all_parts) == sorted((p.topic, p.partition)
+                                       for p in md_src.partitions)
+    ps1, bs1 = single.fetch(md_src, 0, W)
+    ps3, bs3 = multi.fetch(md_src, 0, W)
+    assert len(ps3) == len(ps1)
+    assert {b.broker_id for b in bs3} == {b.broker_id for b in bs1}
+
+    class Flaky(MetricSampler):
+        """Fails for the slice containing partition 0."""
+        def __init__(self, inner):
+            self.inner = inner
+        def get_samples(self, metadata, start_ms, end_ms):
+            if any(p.partition == 0 and p.topic == "T" for p in metadata.partitions):
+                raise RuntimeError("boom")
+            return self.inner.get_samples(metadata, start_ms, end_ms)
+
+    flaky = MetricFetcherManager(Flaky(sampler), num_fetchers=3)
+    psf, _ = flaky.fetch(md_src, 0, W)
+    assert 0 < len(psf) < len(ps1)           # one slice lost, others landed
+    assert flaky.stats["failed_fetchers"] == 1
+
+    # end-to-end through the monitor
+    lm = LoadMonitor(StaticMetadataSource(md_src), sampler, num_windows=3,
+                     window_ms=W, num_metric_fetchers=4)
+    for w in range(4):
+        lm.sample_once(now_ms=w * W + 30_000)
+    topo, assign = lm.cluster_model(now_ms=3 * W)
+    assert topo.num_partitions == len(md_src.partitions)
