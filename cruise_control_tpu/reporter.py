@@ -92,6 +92,11 @@ class BrokerMetricsSource:
     {(type, topic[, partition]): value} for topic/partition metrics
     (YammerMetricProcessor seam)."""
 
+    def begin_report(self) -> None:
+        """Called once by the reporter at the start of each reporting
+        interval — sources that snapshot/reset state do it here so the
+        three getters read one consistent collection."""
+
     def broker_metrics(self) -> Dict[str, float]:
         raise NotImplementedError
 
@@ -100,6 +105,278 @@ class BrokerMetricsSource:
 
     def partition_metrics(self) -> Dict[tuple, float]:
         return {}
+
+
+class Meter:
+    """Event-rate meter: mark() events, read events/sec since last tick
+    (Yammer Meter one-minute-rate seam, YammerMetricProcessor.java)."""
+
+    def __init__(self, now_fn=time.time):
+        self._now = now_fn
+        self._count = 0.0
+        self._last_ts = now_fn()
+        self._rate = 0.0
+        self._lock = threading.Lock()
+
+    def mark(self, n: float = 1.0):
+        with self._lock:
+            self._count += n
+
+    def tick(self) -> float:
+        """Rate over the elapsed interval; resets the interval window."""
+        with self._lock:
+            now = self._now()
+            dt = max(now - self._last_ts, 1e-9)
+            self._rate = self._count / dt
+            self._count = 0.0
+            self._last_ts = now
+            return self._rate
+
+    @property
+    def rate(self) -> float:
+        return self._rate
+
+
+class Histogram:
+    """Bounded reservoir; reports MAX/MEAN/50TH/999TH like the broker's
+    request-time Yammer histograms (RawMetricType *_MAX.._999TH)."""
+
+    def __init__(self, capacity: int = 4096):
+        self._values: List[float] = []
+        self._capacity = capacity
+        self._i = 0
+        self._lock = threading.Lock()
+
+    def update(self, value: float):
+        with self._lock:
+            if len(self._values) < self._capacity:
+                self._values.append(float(value))
+            else:       # ring overwrite keeps the reservoir recent
+                self._values[self._i % self._capacity] = float(value)
+            self._i += 1
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            vals = sorted(self._values)
+        if not vals:
+            return {"_MAX": 0.0, "_MEAN": 0.0, "_50TH": 0.0, "_999TH": 0.0}
+        n = len(vals)
+        return {"_MAX": vals[-1], "_MEAN": sum(vals) / n,
+                "_50TH": vals[n // 2],
+                "_999TH": vals[min(int(n * 0.999), n - 1)]}
+
+
+class BrokerMetricsRegistry:
+    """The broker-process metric surface the reporter walks each interval —
+    the rebuild of ``YammerMetricProcessor.java`` + ``MetricsUtils.java:443``:
+    named meters/histograms/gauges registered per raw-metric type (broker
+    scope) or per (type, topic[, partition]).
+
+    A broker runtime calls ``meter(...)`` / ``histogram(...)`` on its hot
+    paths; :class:`RegistryMetricsSource` converts the registry into the 63
+    raw-type records at reporting time.
+    """
+
+    def __init__(self, now_fn=time.time):
+        self._now = now_fn
+        self._meters: Dict[tuple, Meter] = {}
+        self._hists: Dict[tuple, Histogram] = {}
+        self._gauges: Dict[tuple, Callable[[], float]] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _key(mtype: str, topic: Optional[str], partition: Optional[int]):
+        return (mtype, topic, partition)
+
+    def meter(self, mtype: str, topic: Optional[str] = None,
+              partition: Optional[int] = None) -> Meter:
+        k = self._key(mtype, topic, partition)
+        with self._lock:
+            m = self._meters.get(k)
+            if m is None:
+                m = self._meters[k] = Meter(self._now)
+            return m
+
+    def histogram(self, base_type: str, topic: Optional[str] = None,
+                  partition: Optional[int] = None) -> Histogram:
+        """base_type without the _MAX/_MEAN/_50TH/_999TH suffix."""
+        k = self._key(base_type, topic, partition)
+        with self._lock:
+            h = self._hists.get(k)
+            if h is None:
+                h = self._hists[k] = Histogram()
+            return h
+
+    def gauge(self, mtype: str, fn: Callable[[], float],
+              topic: Optional[str] = None, partition: Optional[int] = None):
+        with self._lock:
+            self._gauges[self._key(mtype, topic, partition)] = fn
+
+    def collect(self) -> List[tuple]:
+        """[(mtype, topic, partition, value)] — the registry walk."""
+        out: List[tuple] = []
+        with self._lock:
+            meters = list(self._meters.items())
+            hists = list(self._hists.items())
+            gauges = list(self._gauges.items())
+        for (mtype, topic, part), m in meters:
+            out.append((mtype, topic, part, m.tick()))
+        for (base, topic, part), h in hists:
+            for suffix, v in h.snapshot().items():
+                out.append((base + suffix, topic, part, v))
+        for (mtype, topic, part), fn in gauges:
+            try:
+                out.append((mtype, topic, part, float(fn())))
+            except Exception:
+                pass
+        return out
+
+
+class RegistryMetricsSource(BrokerMetricsSource):
+    """BrokerMetricsSource over a BrokerMetricsRegistry (the default wiring
+    a broker runtime uses). Unknown names AND registrations whose key shape
+    does not match the metric's scope (e.g. a TOPIC_* meter registered
+    without a topic) are dropped, like MetricsUtils' interested-metrics
+    filter — a bad registration must never poison the report.
+
+    The registry is walked (meters ticked) once per reporting cycle in
+    :meth:`begin_report`; the getters read that collection. Direct callers
+    that skip ``begin_report`` get a lazy first walk."""
+
+    @staticmethod
+    def _scope_ok(mtype: str, topic, part) -> bool:
+        scope = RAW_METRIC_TYPES.get(mtype)
+        if scope is None:
+            return False
+        if scope == MetricScope.BROKER:
+            return topic is None and part is None
+        if scope == MetricScope.TOPIC:
+            return topic is not None and part is None
+        return topic is not None and part is not None
+
+    def __init__(self, registry: BrokerMetricsRegistry):
+        self.registry = registry
+        self._collected: Optional[List[tuple]] = None
+
+    def _walk(self):
+        self._collected = [
+            (t, topic, part, v) for (t, topic, part, v)
+            in self.registry.collect() if self._scope_ok(t, topic, part)]
+
+    def begin_report(self) -> None:
+        self._walk()
+
+    def _rows(self) -> List[tuple]:
+        if self._collected is None:
+            self._walk()
+        return self._collected
+
+    def broker_metrics(self) -> Dict[str, float]:
+        return {t: v for (t, topic, part, v) in self._rows()
+                if topic is None}
+
+    def topic_metrics(self) -> Dict[tuple, float]:
+        return {(t, topic): v for (t, topic, part, v) in self._rows()
+                if topic is not None and part is None}
+
+    def partition_metrics(self) -> Dict[tuple, float]:
+        return {(t, topic, part): v for (t, topic, part, v) in self._rows()
+                if part is not None}
+
+
+class ProcSystemMetricsSource(BrokerMetricsSource):
+    """Host-level collection from /proc + the log directories — the part of
+    the in-broker agent that measures the machine rather than the broker
+    internals: BROKER_CPU_UTIL from /proc/stat deltas (MetricsUtils maps the
+    broker's CPU gauge the same way) and PARTITION_SIZE from the on-disk
+    size of each ``<topic>-<partition>`` directory under the logdirs.
+    """
+
+    def __init__(self, logdirs: Iterable[str] = (), proc_stat: str = "/proc/stat"):
+        self._logdirs = list(logdirs)
+        self._proc_stat = proc_stat
+        self._last_cpu: Optional[tuple] = None
+
+    def _read_cpu(self) -> Optional[tuple]:
+        try:
+            with open(self._proc_stat) as f:
+                line = f.readline()
+        except OSError:
+            return None
+        parts = line.split()
+        if not parts or parts[0] != "cpu":
+            return None
+        vals = [float(x) for x in parts[1:]]
+        idle = vals[3] + (vals[4] if len(vals) > 4 else 0.0)  # idle+iowait
+        return (sum(vals), idle)
+
+    def broker_metrics(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        cur = self._read_cpu()
+        if cur is not None:
+            if self._last_cpu is not None:
+                dt = cur[0] - self._last_cpu[0]
+                didle = cur[1] - self._last_cpu[1]
+                if dt > 0:
+                    # percent, matching BrokerMetricSample.cpu_util units
+                    busy_pct = 100.0 * (1.0 - didle / dt)
+                    out["BROKER_CPU_UTIL"] = max(0.0, min(100.0, busy_pct))
+            self._last_cpu = cur
+        return out
+
+    def partition_metrics(self) -> Dict[tuple, float]:
+        import os
+        import re
+        sizes: Dict[tuple, float] = {}
+        pat = re.compile(r"^(?P<topic>.+)-(?P<part>\d+)$")
+        for root in self._logdirs:
+            try:
+                entries = os.listdir(root)
+            except OSError:
+                continue
+            for name in entries:
+                m = pat.match(name)
+                if not m:
+                    continue
+                d = os.path.join(root, name)
+                total = 0.0
+                try:
+                    for fn in os.listdir(d):
+                        try:
+                            total += os.path.getsize(os.path.join(d, fn))
+                        except OSError:
+                            pass
+                except OSError:
+                    continue
+                key = ("PARTITION_SIZE", m.group("topic"), int(m.group("part")))
+                sizes[key] = sizes.get(key, 0.0) + total
+        return sizes
+
+
+class CompositeMetricsSource(BrokerMetricsSource):
+    """Merge several sources (registry + system) into one report."""
+
+    def __init__(self, *sources: BrokerMetricsSource):
+        self.sources = sources
+
+    def begin_report(self) -> None:
+        for s in self.sources:
+            s.begin_report()
+
+    def _merged(self, attr) -> Dict:
+        out: Dict = {}
+        for s in self.sources:
+            out.update(getattr(s, attr)())
+        return out
+
+    def broker_metrics(self) -> Dict[str, float]:
+        return self._merged("broker_metrics")
+
+    def topic_metrics(self) -> Dict[tuple, float]:
+        return self._merged("topic_metrics")
+
+    def partition_metrics(self) -> Dict[tuple, float]:
+        return self._merged("partition_metrics")
 
 
 class MetricsReporter:
@@ -119,6 +396,7 @@ class MetricsReporter:
 
     def report_once(self) -> int:
         now = self._now()
+        self.source.begin_report()
         records: List[CruiseControlMetric] = []
         for mtype, value in self.source.broker_metrics().items():
             records.append(CruiseControlMetric(mtype, now, self.broker_id,
