@@ -46,10 +46,11 @@ def main():
         topo, assign = fixtures.synthetic_cluster(
             num_brokers=2_600, num_replicas=500_000, num_racks=40,
             num_topics=30_000, seed=seed)
-        # wide-batch shallow anneal: 4x candidate tries at 1/4 the
-        # sequential steps — same total candidates, ~40% of the wall-clock
-        # (per-step cost is strongly sub-linear in the try count)
-        cfg = AN.AnnealConfig(num_chains=16, steps=1024, swap_interval=128,
+        # wide-batch shallow anneal: high candidate tries at few sequential
+        # steps (per-step cost is strongly sub-linear in the try count);
+        # 512 steps measured equal-quality to 1024 (viol 0, balancedness
+        # 100) with the targeted repair pass absorbing the difference
+        cfg = AN.AnnealConfig(num_chains=16, steps=512, swap_interval=128,
                               tries_move=384, tries_lead=64, tries_swap=192)
         engine = "anneal"
     elif size == "medium":
@@ -100,4 +101,16 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception:
+        # transient TPU-tunnel failures (dropped remote_compile connections)
+        # poison the in-process backend; retry ONCE in a fresh process
+        if os.environ.get("CC_BENCH_RETRIED") == "1":
+            raise
+        import traceback
+        traceback.print_exc()
+        print("bench: transient failure, retrying in a fresh process",
+              file=sys.stderr, flush=True)
+        os.environ["CC_BENCH_RETRIED"] = "1"
+        os.execv(sys.executable, [sys.executable, os.path.abspath(__file__)])

@@ -65,6 +65,11 @@ class CruiseControlApp:
         self.constraint = config.balancing_constraint()
         self.default_goals = tuple(config.get("default.goals"))
         self.mesh = mesh
+        from cruise_control_tpu.models.cluster import set_static_cpu_weights
+        set_static_cpu_weights(
+            config.get("leader.network.inbound.weight.for.cpu.util"),
+            config.get("leader.network.outbound.weight.for.cpu.util"),
+            config.get("follower.network.inbound.weight.for.cpu.util"))
         self.load_monitor = LoadMonitor(
             metadata_source, sampler,
             capacity_resolver=capacity_resolver,
@@ -77,20 +82,37 @@ class CruiseControlApp:
                 "max.allowed.extrapolations.per.partition"),
             sampling_interval_ms=config.get("metric.sampling.interval.ms"),
             use_lr_model=config.get("use.linear.regression.model"),
-            num_metric_fetchers=config.get("num.metric.fetchers"))
+            num_metric_fetchers=config.get("num.metric.fetchers"),
+            broker_num_windows=config.get("num.broker.metrics.windows"),
+            broker_window_ms=config.get("broker.metrics.window.ms"),
+            min_samples_per_broker_window=config.get(
+                "min.samples.per.broker.metrics.window"),
+            max_allowed_extrapolations_per_broker=config.get(
+                "max.allowed.extrapolations.per.broker"))
         self._metadata_source = metadata_source
         adapter = cluster_adapter or FakeClusterAdapter({})
+        check_ms = config.get("execution.progress.check.interval.ms")
         self.executor = Executor(
             adapter,
             ExecutorConfig(
                 num_concurrent_partition_movements_per_broker=config.get(
                     "num.concurrent.partition.movements.per.broker"),
+                num_concurrent_intra_broker_partition_movements=config.get(
+                    "num.concurrent.intra.broker.partition.movements"),
                 num_concurrent_leader_movements=config.get(
                     "num.concurrent.leader.movements"),
-                execution_progress_check_interval_ms=config.get(
-                    "execution.progress.check.interval.ms"),
+                execution_progress_check_interval_ms=check_ms,
                 default_replication_throttle=config.get(
-                    "default.replication.throttle")))
+                    "default.replication.throttle"),
+                leadership_movement_timeout_rounds=max(
+                    1, int(config.get("leader.movement.timeout.ms")
+                           // max(check_ms, 1))),
+                task_execution_alerting_threshold_ms=config.get(
+                    "task.execution.alerting.threshold.ms"),
+                removal_history_retention_ms=config.get(
+                    "removal.history.retention.time.ms"),
+                demotion_history_retention_ms=config.get(
+                    "demotion.history.retention.time.ms")))
         notifier = SelfHealingNotifier(
             broker_failure_alert_threshold_ms=config.get(
                 "broker.failure.alert.threshold.ms"),
@@ -108,7 +130,9 @@ class CruiseControlApp:
             detectors={
                 "broker_failure": BrokerFailureDetector(
                     metadata_source,
-                    persist_path=config.get("failed.brokers.file.path") or None
+                    persist_path=config.get("failed.brokers.file.path") or None,
+                    report_backoff_ms=config.get(
+                        "broker.failure.detection.backoff.ms"),
                 ).detect,
                 "goal_violation": GoalViolationDetector(
                     self.load_monitor,
@@ -130,6 +154,14 @@ class CruiseControlApp:
                         "slow.broker.decommission.score")).detect,
             },
             interval_ms=config.get("anomaly.detection.interval.ms"),
+            intervals_ms={
+                "goal_violation": config.get(
+                    "goal.violation.detection.interval.ms"),
+                "metric_anomaly": config.get(
+                    "metric.anomaly.detection.interval.ms"),
+                "disk_failure": config.get(
+                    "disk.failure.detection.interval.ms"),
+            },
             recheck_delay_ms=config.get("anomaly.detection.recheck.delay.ms"))
         self._proposal_cache: Optional[CachedProposals] = None
         self._cache_lock = threading.Lock()
@@ -142,7 +174,8 @@ class CruiseControlApp:
 
     def startup(self):
         """KafkaCruiseControl.startUp (KafkaCruiseControl.java:156-165)."""
-        self.load_monitor.startup()
+        self.load_monitor.startup(
+            load_stored_samples=not self.config.get("skip.loading.samples"))
         self.anomaly_detector.start()
 
     def shutdown(self):
@@ -203,6 +236,45 @@ class CruiseControlApp:
             return tuple(g for g in self.default_goals if G.is_hard(g))
         return tuple(self.default_goals)
 
+    def _sanity_check_goals(self, goal_names: Optional[Sequence[str]],
+                            skip_hard_goal_check: bool) -> None:
+        """RunnableUtils.sanityCheckGoals: a request naming a custom goal
+        list must include every configured hard goal unless
+        skip_hard_goal_check=true."""
+        if not goal_names or skip_hard_goal_check:
+            return
+        hard = [g for g in self.config.get("hard.goals")
+                if g in self.default_goals]
+        missing = [g for g in hard if g not in goal_names]
+        if missing:
+            raise ValueError(
+                f"Missing hard goals {missing} in the provided goal list "
+                f"{list(goal_names)}. Add skip_hard_goal_check=true to "
+                "skip the check or include the hard goals.")
+
+    def _check_capacity_estimation(self, allow: bool) -> None:
+        """allow_capacity_estimation=false refuses to optimize on brokers
+        whose capacity fell back to the default (-1) entry."""
+        est = self.load_monitor.capacity_estimated_brokers
+        if not allow and est:
+            raise ValueError(
+                f"Broker capacities were estimated for {sorted(est)} and "
+                "allow_capacity_estimation is false.")
+
+    def _build_options(self, topo: ClusterTopology,
+                       excluded_topics: Sequence[str] = (),
+                       **kw) -> G.DeviceOptions:
+        """build_options + the standing topics.excluded.from.partition.movement
+        regex (every optimization, every entry point)."""
+        pattern = self.config.get("topics.excluded.from.partition.movement")
+        if pattern:
+            import re
+            rx = re.compile(pattern)
+            standing = [t for t in topo.topic_names if rx.fullmatch(t)]
+            excluded_topics = tuple(excluded_topics) + tuple(
+                t for t in standing if t not in set(excluded_topics))
+        return G.build_options(topo, excluded_topics=excluded_topics, **kw)
+
     def _exclusions(self, exclude_recently_removed: bool,
                     exclude_recently_demoted: bool) -> Dict[str, Sequence[int]]:
         """Excluded-broker sets from the executor's recent history
@@ -224,10 +296,13 @@ class CruiseControlApp:
                   use_ready_default_goals: bool = False,
                   exclude_recently_removed_brokers: bool = False,
                   exclude_recently_demoted_brokers: bool = False,
+                  skip_hard_goal_check: bool = False,
+                  allow_capacity_estimation: bool = True,
                   **option_kw) -> OPT.OptimizerResult:
         """ProposalsRunnable.getProposals: cached unless stale/bypassed."""
         if goal_names is None and use_ready_default_goals:
             goal_names = self._ready_goals()
+        self._sanity_check_goals(goal_names, skip_hard_goal_check)
         option_kw.update(self._exclusions(exclude_recently_removed_brokers,
                                           exclude_recently_demoted_brokers))
         use_cache = (not ignore_proposal_cache and not goal_names
@@ -242,7 +317,11 @@ class CruiseControlApp:
                             and age < self.config.get("proposal.expiration.ms")):
                         return c.result
         topo, assign = self._model(data_from=data_from)
-        options = (G.build_options(topo, **option_kw) if option_kw else None)
+        self._check_capacity_estimation(allow_capacity_estimation)
+        options = (self._build_options(topo, **option_kw)
+                   if option_kw or self.config.get(
+                       "topics.excluded.from.partition.movement")
+                   else None)
         result = self._optimize(topo, assign, goal_names, options)
         if use_cache:
             with self._cache_lock:
@@ -263,6 +342,9 @@ class CruiseControlApp:
                   exclude_recently_removed_brokers: bool = False,
                   exclude_recently_demoted_brokers: bool = False,
                   verbose: bool = False,
+                  skip_hard_goal_check: bool = False,
+                  allow_capacity_estimation: bool = True,
+                  executor_kw: Optional[dict] = None,
                   **_ignored) -> dict:
         """RebalanceRunnable.rebalance (RebalanceRunnable.java:130-144)."""
         if self_healing:
@@ -272,8 +354,10 @@ class CruiseControlApp:
             if self_healing else None)
         if goals is None and use_ready_default_goals:
             goals = self._ready_goals()
+        self._sanity_check_goals(goals, skip_hard_goal_check or self_healing)
         topo, assign = self._model(data_from=data_from)
-        options = G.build_options(
+        self._check_capacity_estimation(allow_capacity_estimation)
+        options = self._build_options(
             topo, excluded_topics=excluded_topics,
             requested_destination_broker_ids=destination_broker_ids,
             **self._exclusions(exclude_recently_removed_brokers,
@@ -282,35 +366,47 @@ class CruiseControlApp:
         summary = result.to_json(verbose=verbose)
         if not dryrun:
             exec_summary = self.executor.execute_proposals(
-                result.proposals, concurrency=concurrency)
+                result.proposals, concurrency=concurrency,
+                **(executor_kw or {}))
             summary["execution"] = exec_summary
         return summary
 
     def add_brokers(self, broker_ids: Sequence[int], dryrun: bool = True,
                     data_from: Optional[str] = None, verbose: bool = False,
+                    allow_capacity_estimation: bool = True,
+                    throttle_added_broker: Optional[int] = None,
+                    executor_kw: Optional[dict] = None,
                     **kw) -> dict:
         """AddBrokersRunnable: move load onto the new brokers."""
         topo, assign = self._model(data_from=data_from)
+        self._check_capacity_estimation(allow_capacity_estimation)
         ids = set(int(b) for b in broker_ids)
         new_mask = np.array([int(b) in ids for b in topo.broker_ids])
         topo = dataclasses.replace(topo, broker_new=new_mask)
-        options = G.build_options(topo,
+        options = self._build_options(topo,
                                   requested_destination_broker_ids=broker_ids)
         result = self._optimize(topo, assign, None, options)
         summary = result.to_json(verbose=verbose)
         if not dryrun:
+            ek = dict(executor_kw or {})
+            if throttle_added_broker is not None:
+                ek["replication_throttle"] = throttle_added_broker
             summary["execution"] = self.executor.execute_proposals(
-                result.proposals)
+                result.proposals, **ek)
         return summary
 
     def remove_brokers(self, broker_ids: Sequence[int], dryrun: bool = True,
                        self_healing: bool = False,
                        data_from: Optional[str] = None, verbose: bool = False,
+                       allow_capacity_estimation: bool = True,
+                       throttle_removed_broker: Optional[int] = None,
+                       executor_kw: Optional[dict] = None,
                        **kw) -> dict:
         """RemoveBrokersRunnable: drain the given brokers."""
         if self_healing:
             dryrun = False
         topo, assign = self._model(data_from=data_from)
+        self._check_capacity_estimation(allow_capacity_estimation)
         ids = set(int(b) for b in broker_ids)
         # removed brokers: not a legal destination; their replicas must leave
         idx = {int(b): i for i, b in enumerate(topo.broker_ids)}
@@ -322,24 +418,38 @@ class CruiseControlApp:
             offline |= (np.asarray(assign.broker_of) == r_i)
         topo = dataclasses.replace(topo, broker_alive=alive,
                                    replica_offline=offline)
-        options = G.build_options(
+        options = self._build_options(
             topo, excluded_brokers_for_replica_move=broker_ids,
             excluded_brokers_for_leadership=broker_ids)
         result = self._optimize(topo, assign, None, options)
         summary = result.to_json(verbose=verbose)
         if not dryrun:
+            ek = dict(executor_kw or {})
+            if throttle_removed_broker is not None:
+                ek["replication_throttle"] = throttle_removed_broker
             summary["execution"] = self.executor.execute_proposals(
-                result.proposals, removed_brokers=ids)
+                result.proposals, removed_brokers=ids, **ek)
         return summary
 
     def demote_brokers(self, broker_ids: Sequence[int], dryrun: bool = True,
                        self_healing: bool = False,
                        data_from: Optional[str] = None, verbose: bool = False,
+                       skip_urp_demotion: bool = False,
+                       exclude_follower_demotion: bool = False,
+                       allow_capacity_estimation: bool = True,
+                       executor_kw: Optional[dict] = None,
                        **kw) -> dict:
-        """DemoteBrokerRunnable: move leadership off the given brokers."""
+        """DemoteBrokerRunnable: move leadership off the given brokers.
+
+        ``skip_urp_demotion`` (DemoteBrokerParameters): leave partitions that
+        are currently under-replicated (offline replicas) untouched.
+        ``exclude_follower_demotion``: only leadership transfers, never
+        follower reordering — this build's demotion is leadership-only, so
+        the flag is accepted and already satisfied by construction."""
         if self_healing:
             dryrun = False
         topo, assign = self._model(data_from=data_from)
+        self._check_capacity_estimation(allow_capacity_estimation)
         ids = set(int(b) for b in broker_ids)
         idx = {int(b): i for i, b in enumerate(topo.broker_ids)}
         demoted = topo.broker_demoted.copy()
@@ -350,32 +460,50 @@ class CruiseControlApp:
         # demotion only moves LEADERSHIP (DemoteBrokerRunnable semantics):
         # immigrant-only mode pins every replica in place (only offline
         # replicas may still relocate, preserving self-healing)
-        options = G.build_options(topo,
+        options = self._build_options(topo,
                                   excluded_brokers_for_leadership=broker_ids,
                                   only_move_immigrant_replicas=True)
         result = self._optimize(
             topo, assign, ("LeaderReplicaDistributionGoal",
                            "LeaderBytesInDistributionGoal",
                            "PreferredLeaderElectionGoal"), options)
+        if skip_urp_demotion:
+            # partitions with an offline replica (URP) keep their leadership
+            urp = {f"{p.topic}-{p.partition}"
+                   for p in self._metadata_source.get_metadata().partitions
+                   if p.offline_replicas}
+            kept = [pr for pr in result.proposals
+                    if pr.topic_partition not in urp]
+            result = dataclasses.replace(
+                result, proposals=kept,
+                num_replica_movements=sum(len(pr.replicas_to_add)
+                                          for pr in kept),
+                num_leadership_movements=sum(1 for pr in kept
+                                             if pr.has_leader_action))
         summary = result.to_json(verbose=verbose)
         if not dryrun:
             summary["execution"] = self.executor.execute_proposals(
-                result.proposals, demoted_brokers=ids)
+                result.proposals, demoted_brokers=ids,
+                **(executor_kw or {}))
         return summary
 
     def fix_offline_replicas(self, dryrun: bool = True,
                              self_healing: bool = False,
                              data_from: Optional[str] = None,
-                             verbose: bool = False, **kw) -> dict:
+                             verbose: bool = False,
+                             allow_capacity_estimation: bool = True,
+                             executor_kw: Optional[dict] = None,
+                             **kw) -> dict:
         """FixOfflineReplicasRunnable: self-heal dead-disk/broker replicas."""
         if self_healing:
             dryrun = False
         topo, assign = self._model(data_from=data_from)
+        self._check_capacity_estimation(allow_capacity_estimation)
         result = self._optimize(topo, assign)
         summary = result.to_json(verbose=verbose)
         if not dryrun:
             summary["execution"] = self.executor.execute_proposals(
-                result.proposals)
+                result.proposals, **(executor_kw or {}))
         return summary
 
     def rebalance_disk(self, dryrun: bool = True, **kw) -> dict:
@@ -518,11 +646,19 @@ class CruiseControlApp:
             "AnomalyDetectorState": self.anomaly_detector.state_snapshot(),
         }
 
-    def kafka_cluster_state(self) -> dict:
+    def kafka_cluster_state(self, populate_disk_info: bool = False) -> dict:
         md = self._metadata_source.get_metadata()
         by_broker: Dict[int, Dict[str, int]] = {
             b.broker_id: {"replicaCount": 0, "leaderCount": 0,
                           "alive": b.alive} for b in md.brokers}
+        if populate_disk_info:
+            logdirs = self.executor.adapter.describe_logdirs()
+            for bid, dirs in logdirs.items():
+                if bid in by_broker:
+                    by_broker[bid]["OnlineLogDirs"] = sorted(
+                        d for d, ok in dirs.items() if ok)
+                    by_broker[bid]["OfflineLogDirs"] = sorted(
+                        d for d, ok in dirs.items() if not ok)
         urp, offline = [], []
         for p in md.partitions:
             for r in p.replicas:

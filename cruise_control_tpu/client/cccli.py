@@ -61,6 +61,29 @@ _BROKERS = Parameter("brokerid", "brokers", "csv-int",
                      "Comma-separated broker ids")
 _GOALS = Parameter("goals", "goals", "csv", "Goal list in priority order")
 
+#: GoalBasedOptimizationParameters shared by every optimization request
+_GOAL_BASED = (
+    Parameter("data_from", "data-from", "string",
+              "VALID_WINDOWS | VALID_PARTITIONS"),
+    Parameter("use_ready_default_goals", "use-ready-default-goals", "bool"),
+    Parameter("exclude_recently_removed_brokers",
+              "exclude-recently-removed-brokers", "bool"),
+    Parameter("exclude_recently_demoted_brokers",
+              "exclude-recently-demoted-brokers", "bool"),
+    Parameter("skip_hard_goal_check", "skip-hard-goal-check", "bool"),
+    Parameter("allow_capacity_estimation", "allow-capacity-estimation",
+              "bool"),
+    Parameter("verbose", "verbose", "bool"),
+)
+#: per-request executor overrides
+_EXECUTOR = (
+    Parameter("concurrent_leader_movements", "leader-concurrency", "int"),
+    Parameter("execution_progress_check_interval_ms",
+              "progress-check-interval-ms", "int"),
+    Parameter("replication_throttle", "replication-throttle", "int"),
+    Parameter("replica_movement_strategies", "movement-strategies", "csv"),
+)
+
 
 @dataclasses.dataclass(frozen=True)
 class Endpoint:
@@ -77,17 +100,28 @@ ENDPOINTS: List[Endpoint] = [
     Endpoint("state", "GET", "Cruise Control substates", (
         Parameter("substates", "substates", "csv",
                   "monitor,analyzer,executor,anomaly_detector"),)),
-    Endpoint("kafka_cluster_state", "GET", "Kafka cluster state"),
+    Endpoint("kafka_cluster_state", "GET", "Kafka cluster state", (
+        Parameter("populate_disk_info", "populate-disk-info", "bool"),)),
     Endpoint("load", "GET", "Per-broker load"),
     Endpoint("partition_load", "GET", "Top partition loads", (
         Parameter("resource", "resource", "string", "cpu|disk|network_inbound|network_outbound"),
-        Parameter("entries", "entries", "int", "Number of records"),)),
+        Parameter("entries", "entries", "int", "Number of records"),
+        Parameter("partition", "partition", "string", "Partition id or range N-M"),
+        Parameter("topic", "topic", "string", "Topic regex"),
+        Parameter("min_load", "min-load", "string"),
+        Parameter("max_load", "max-load", "string"),)),
     Endpoint("proposals", "GET", "Optimization proposals", (
         _GOALS,
-        Parameter("ignore_proposal_cache", "ignore-proposal-cache", "bool"),),
-             is_async=True),
-    Endpoint("user_tasks", "GET", "Active/completed user tasks"),
-    Endpoint("review_board", "GET", "Two-step review board"),
+        Parameter("ignore_proposal_cache", "ignore-proposal-cache", "bool"),
+        *_GOAL_BASED), is_async=True),
+    Endpoint("user_tasks", "GET", "Active/completed user tasks", (
+        Parameter("user_task_ids", "task-ids", "csv"),
+        Parameter("client_ids", "client-ids", "csv"),
+        Parameter("endpoints", "endpoints", "csv"),
+        Parameter("types", "types", "csv", "active,completed"),
+        Parameter("fetch_completed_task", "fetch-completed-task", "bool"),)),
+    Endpoint("review_board", "GET", "Two-step review board", (
+        Parameter("review_ids", "review-ids", "csv-int"),)),
     Endpoint("bootstrap", "GET", "Replay a historical sample range", (
         Parameter("start", "start", "int", "Range start ms"),
         Parameter("end", "end", "int", "Range end ms"),), is_async=True),
@@ -98,15 +132,28 @@ ENDPOINTS: List[Endpoint] = [
         Parameter("excluded_topics", "excluded-topics", "csv"),
         Parameter("destination_broker_ids", "destination-brokers", "csv-int"),
         Parameter("concurrent_partition_movements_per_broker",
-                  "concurrency", "int"),), is_async=True),
+                  "concurrency", "int"),
+        Parameter("rebalance_disk", "rebalance-disk", "bool",
+                  "Intra-broker (JBOD) disk rebalance"),
+        Parameter("kafka_assigner", "kafka-assigner", "bool",
+                  "Kafka-assigner mode"),
+        *_GOAL_BASED, *_EXECUTOR), is_async=True),
     Endpoint("add_broker", "POST", "Move load onto new brokers",
-             (_BROKERS, _DRYRUN), is_async=True),
+             (_BROKERS, _DRYRUN,
+              Parameter("throttle_added_broker", "throttle", "int"),
+              *_GOAL_BASED, *_EXECUTOR), is_async=True),
     Endpoint("remove_broker", "POST", "Drain brokers",
-             (_BROKERS, _DRYRUN), is_async=True),
+             (_BROKERS, _DRYRUN,
+              Parameter("throttle_removed_broker", "throttle", "int"),
+              *_GOAL_BASED, *_EXECUTOR), is_async=True),
     Endpoint("demote_broker", "POST", "Move leadership off brokers",
-             (_BROKERS, _DRYRUN), is_async=True),
+             (_BROKERS, _DRYRUN,
+              Parameter("skip_urp_demotion", "skip-urp-demotion", "bool"),
+              Parameter("exclude_follower_demotion",
+                        "exclude-follower-demotion", "bool"),
+              *_GOAL_BASED, *_EXECUTOR), is_async=True),
     Endpoint("fix_offline_replicas", "POST", "Self-heal offline replicas",
-             (_DRYRUN,), is_async=True),
+             (_DRYRUN, *_GOAL_BASED, *_EXECUTOR), is_async=True),
     Endpoint("stop_proposal_execution", "POST", "Stop the ongoing execution", (
         Parameter("force_stop", "force", "bool"),)),
     Endpoint("pause_sampling", "POST", "Pause metric sampling"),
@@ -118,7 +165,16 @@ ENDPOINTS: List[Endpoint] = [
                   "string"),
         Parameter("enable_self_healing", "enable-self-healing", "bool"),
         Parameter("concurrent_partition_movements_per_broker",
-                  "concurrency", "int"),)),
+                  "concurrency", "int"),
+        Parameter("concurrent_leader_movements", "leader-concurrency", "int"),
+        Parameter("concurrent_intra_broker_partition_movements",
+                  "intra-broker-concurrency", "int"),
+        Parameter("execution_progress_check_interval_ms",
+                  "progress-check-interval-ms", "int"),
+        Parameter("drop_recently_removed_brokers",
+                  "drop-recently-removed-brokers", "bool"),
+        Parameter("drop_recently_demoted_brokers",
+                  "drop-recently-demoted-brokers", "bool"),)),
     Endpoint("review", "POST", "Approve/discard review requests", (
         Parameter("approve", "approve", "csv-int"),
         Parameter("discard", "discard", "csv-int"),)),

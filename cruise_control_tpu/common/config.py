@@ -295,6 +295,115 @@ def _service_config_def() -> ConfigDef:
              "Kafka bootstrap servers (Kafka-backed deployments).")
     d.define("zookeeper.connect", T.STRING, "", I.MEDIUM,
              "ZooKeeper connect string (legacy deployments).")
+    # -- CPU estimation model (ModelParameters.java:21-29) ------------------
+    d.define("leader.network.inbound.weight.for.cpu.util", T.DOUBLE, 0.7,
+             I.LOW, "Static CPU attribution weight of leader bytes-in.")
+    d.define("leader.network.outbound.weight.for.cpu.util", T.DOUBLE, 0.15,
+             I.LOW, "Static CPU attribution weight of leader bytes-out.")
+    d.define("follower.network.inbound.weight.for.cpu.util", T.DOUBLE, 0.15,
+             I.LOW, "Static CPU attribution weight of follower bytes-in.")
+    d.define("linear.regression.model.cpu.util.bucket.size", T.INT, 5, I.LOW,
+             "CPU-utilization bucket width (percent) for LR training.")
+    d.define("linear.regression.model.min.num.cpu.util.buckets", T.INT, 5,
+             I.LOW, "Distinct CPU buckets required before the LR model "
+             "is considered trained.")
+    d.define("linear.regression.model.required.samples.per.bucket", T.INT,
+             10, I.LOW, "Samples per CPU bucket required for LR training.")
+    # -- broker-metric windows (separate aggregator) ------------------------
+    d.define("num.broker.metrics.windows", T.INT, None, I.MEDIUM,
+             "Broker metric sample aggregator window count "
+             "(default: num.partition.metrics.windows).")
+    d.define("broker.metrics.window.ms", T.LONG, None, I.MEDIUM,
+             "Broker metric aggregation window span "
+             "(default: partition.metrics.window.ms).")
+    d.define("min.samples.per.broker.metrics.window", T.INT, None, I.LOW,
+             "Minimum samples per broker window "
+             "(default: min.samples.per.partition.metrics.window).")
+    d.define("max.allowed.extrapolations.per.broker", T.INT, None, I.LOW,
+             "Max extrapolations per broker entity "
+             "(default: max.allowed.extrapolations.per.partition).")
+    # -- per-detector schedules (AnomalyDetector.java:167-180) --------------
+    d.define("goal.violation.detection.interval.ms", T.LONG, None, I.LOW,
+             "Goal-violation sweep interval; default anomaly interval.")
+    d.define("metric.anomaly.detection.interval.ms", T.LONG, None, I.LOW,
+             "Metric-anomaly sweep interval; default anomaly interval.")
+    d.define("disk.failure.detection.interval.ms", T.LONG, None, I.LOW,
+             "Disk-failure sweep interval; default anomaly interval.")
+    d.define("broker.failure.detection.backoff.ms", T.LONG, 300_000, I.LOW,
+             "Backoff before re-reporting a persisting broker failure.")
+    d.define("num.cached.recent.anomaly.states", T.INT, 10, I.LOW,
+             "Recent anomalies kept per type in the state snapshot.")
+    d.define("self.healing.exclude.recently.demoted.brokers", T.BOOLEAN,
+             True, I.MEDIUM, "Self-healing avoids leadership on recently "
+             "demoted brokers.")
+    d.define("self.healing.exclude.recently.removed.brokers", T.BOOLEAN,
+             True, I.MEDIUM, "Self-healing avoids replicas on recently "
+             "removed brokers.")
+    # -- executor -----------------------------------------------------------
+    d.define("num.concurrent.intra.broker.partition.movements", T.INT, 2,
+             I.MEDIUM, "Concurrent logdir moves per broker.")
+    d.define("leader.movement.timeout.ms", T.LONG, 180_000, I.MEDIUM,
+             "Leadership-movement batch timeout.")
+    d.define("task.execution.alerting.threshold.ms", T.LONG, 90_000, I.LOW,
+             "Warn when one execution task exceeds this duration.")
+    d.define("replica.movement.strategies", T.LIST,
+             ["PostponeUrpReplicaMovementStrategy",
+              "PrioritizeLargeReplicaMovementStrategy",
+              "PrioritizeSmallReplicaMovementStrategy"], I.LOW,
+             "Replica movement strategies available per request.")
+    d.define("default.replica.movement.strategies", T.LIST,
+             ["BaseReplicaMovementStrategy"], I.LOW,
+             "Strategy chain applied when a request names none.")
+    d.define("demotion.history.retention.time.ms", T.LONG, 1_209_600_000,
+             I.LOW, "How long a demoted broker counts as recently demoted.")
+    d.define("removal.history.retention.time.ms", T.LONG, 1_209_600_000,
+             I.LOW, "How long a removed broker counts as recently removed.")
+    # -- monitor / sampling -------------------------------------------------
+    d.define("skip.loading.samples", T.BOOLEAN, False, I.LOW,
+             "Skip sample-store replay at startup.")
+    d.define("sampling.allow.cpu.capacity.estimation", T.BOOLEAN, True,
+             I.LOW, "Samplers may estimate CPU capacity when unresolved.")
+    d.define("anomaly.detection.allow.capacity.estimation", T.BOOLEAN, True,
+             I.LOW, "Detectors may run on estimated broker capacities.")
+    d.define("topics.excluded.from.partition.movement", T.STRING, "", I.MEDIUM,
+             "Regex of topics never moved by any optimization.")
+    d.define("metric.sampler.partition.assignor.class", T.CLASS,
+             "DefaultPartitionAssignor", I.LOW,
+             "Partition→fetcher assignor implementation.")
+    d.define("topic.config.provider.class", T.CLASS,
+             "StaticTopicConfigProvider", I.LOW,
+             "Topic configuration provider implementation.")
+    # -- servlet / web ------------------------------------------------------
+    d.define("two.step.purgatory.max.requests", T.INT, 25, I.LOW,
+             "Max requests pending review in the purgatory.")
+    d.define("two.step.purgatory.retention.time.ms", T.LONG, 1_209_600_000,
+             I.LOW, "How long a reviewed request stays retrievable.")
+    d.define("request.reason.required", T.BOOLEAN, False, I.LOW,
+             "POST operations must carry a reason parameter.")
+    d.define("max.cached.completed.user.tasks", T.INT, 100, I.LOW,
+             "Completed user tasks kept for User-Task-ID polling.")
+    d.define("webserver.accesslog.enabled", T.BOOLEAN, True, I.LOW,
+             "Emit an NCSA-style access log line per request.")
+    d.define("webserver.accesslog.path", T.STRING, "", I.LOW,
+             "Access log file path ('' → service log stream).")
+    d.define("webserver.http.cors.enabled", T.BOOLEAN, False, I.LOW,
+             "Enable CORS headers on REST responses.")
+    d.define("webserver.http.cors.origin", T.STRING, "*", I.LOW,
+             "Access-Control-Allow-Origin value.")
+    d.define("webserver.http.cors.allowmethods", T.STRING,
+             "OPTIONS, GET, POST", I.LOW,
+             "Access-Control-Allow-Methods value.")
+    d.define("webserver.http.cors.exposeheaders", T.STRING, "User-Task-ID",
+             I.LOW, "Access-Control-Expose-Headers value.")
+    # -- pluggable classes --------------------------------------------------
+    d.define("executor.notifier.class", T.CLASS, "LoggingExecutorNotifier",
+             I.LOW, "ExecutorNotifier implementation.")
+    d.define("metric.anomaly.finder.class", T.CLASS,
+             "PercentileMetricAnomalyFinder", I.LOW,
+             "MetricAnomalyFinder implementation.")
+    d.define("network.client.provider.class", T.CLASS,
+             "DefaultNetworkClientProvider", I.LOW,
+             "Network client provider (Kafka adapter seam).")
     return d
 
 

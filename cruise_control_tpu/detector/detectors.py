@@ -50,10 +50,14 @@ class BrokerFailureDetector:
     times so detection survives restarts (ZK record → JSON file)."""
 
     def __init__(self, metadata_source, persist_path: Optional[str] = None,
-                 now_fn=_now_ms):
+                 report_backoff_ms: int = 0, now_fn=_now_ms):
         self._metadata_source = metadata_source
         self._path = persist_path
         self._now = now_fn
+        #: broker.failure.detection.backoff.ms — an UNCHANGED failure set is
+        #: re-reported at most this often; a change reports immediately
+        self._backoff_ms = report_backoff_ms
+        self._last_report_ms = -10**15
         self._failed_by_time: Dict[int, int] = {}
         if persist_path and os.path.exists(persist_path):
             with open(persist_path) as f:
@@ -79,6 +83,9 @@ class BrokerFailureDetector:
             with open(self._path, "w") as f:
                 json.dump({str(k): v for k, v in self._failed_by_time.items()}, f)
         if self._failed_by_time:
+            if not changed and now - self._last_report_ms < self._backoff_ms:
+                return None     # persisting failure inside the backoff window
+            self._last_report_ms = now
             return BrokerFailures(AnomalyType.BROKER_FAILURE, now,
                                   failed_brokers_by_time=dict(self._failed_by_time))
         return None
@@ -293,12 +300,19 @@ class AnomalyDetectorService:
                  has_ongoing_execution: Callable[[], bool] = lambda: False,
                  detectors: Optional[Dict[str, Callable[[], object]]] = None,
                  interval_ms: int = 300_000,
+                 intervals_ms: Optional[Dict[str, int]] = None,
                  recheck_delay_ms: Optional[int] = None, now_fn=_now_ms):
         self.notifier = notifier
         self.context = context
         self._has_exec = has_ongoing_execution
         self.detectors = detectors or {}
         self.interval_ms = interval_ms
+        #: per-detector schedule overrides (the reference schedules each
+        #: finder at its own rate, AnomalyDetector.java:167-180); a detector
+        #: without an override runs every ``interval_ms`` sweep.
+        self.intervals_ms = {k: v for k, v in (intervals_ms or {}).items()
+                             if v is not None}
+        self._next_due: Dict[str, int] = {}
         #: how long a deferred anomaly waits before its re-check
         self.recheck_delay_ms = (recheck_delay_ms if recheck_delay_ms is not None
                                  else interval_ms)
@@ -346,9 +360,15 @@ class AnomalyDetectorService:
             self.metrics["anomalies_detected"] += 1
 
     def sweep(self) -> int:
-        """One detection pass over all registered detectors."""
+        """One detection pass over the detectors that are due."""
         n = 0
+        now = self._now()
         for name, det in self.detectors.items():
+            custom = self.intervals_ms.get(name)
+            if custom is not None:
+                if now < self._next_due.get(name, 0):
+                    continue
+                self._next_due[name] = now + custom
             try:
                 found = det()
             except Exception:

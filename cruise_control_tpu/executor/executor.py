@@ -14,9 +14,12 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import logging
 import threading
 import time
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+logger = logging.getLogger(__name__)
 
 from cruise_control_tpu.analyzer.proposals import ExecutionProposal
 from cruise_control_tpu.executor.tasks import (
@@ -248,11 +251,19 @@ class ExecutorNotifier:
 @dataclasses.dataclass
 class ExecutorConfig:
     num_concurrent_partition_movements_per_broker: int = 5
+    num_concurrent_intra_broker_partition_movements: int = 2
     num_concurrent_leader_movements: int = 1000
     execution_progress_check_interval_ms: int = 10
     max_execution_progress_check_rounds: int = 10_000
     default_replication_throttle: Optional[int] = None
     leadership_movement_timeout_rounds: int = 100
+    #: warn when a single task stays in flight past this
+    #: (task.execution.alerting.threshold.ms)
+    task_execution_alerting_threshold_ms: int = 90_000
+    #: how long removed/demoted brokers stay in the recently-* sets
+    #: ({removal,demotion}.history.retention.time.ms)
+    removal_history_retention_ms: int = 1_209_600_000
+    demotion_history_retention_ms: int = 1_209_600_000
 
 
 class Executor:
@@ -272,10 +283,42 @@ class Executor:
         self._timed_out = False
         self._lock = threading.Lock()
         self.tracker = ExecutionTaskTracker()
+        self._interval_override_ms: Optional[int] = None
         self._planner: Optional[ExecutionTaskPlanner] = None
-        self.recently_removed_brokers: Set[int] = set()
-        self.recently_demoted_brokers: Set[int] = set()
+        self._removal_history: Dict[int, float] = {}   # broker → record ts (s)
+        self._demotion_history: Dict[int, float] = {}
         self._execution_history: List[dict] = []
+
+    # -- removal/demotion history (Executor.java:123-127 with the
+    # {removal,demotion}.history.retention.time.ms windows) --
+    def _pruned_history(self, hist: Dict[int, float],
+                        retention_ms: int) -> Set[int]:
+        cutoff = time.time() - retention_ms / 1000.0
+        for b in [b for b, ts in hist.items() if ts < cutoff]:
+            del hist[b]
+        return set(hist)
+
+    @property
+    def recently_removed_brokers(self) -> Set[int]:
+        return self._pruned_history(self._removal_history,
+                                    self.config.removal_history_retention_ms)
+
+    @property
+    def recently_demoted_brokers(self) -> Set[int]:
+        return self._pruned_history(self._demotion_history,
+                                    self.config.demotion_history_retention_ms)
+
+    def record_history(self, removed_brokers=(), demoted_brokers=()):
+        now = time.time()
+        self._removal_history.update({int(b): now for b in removed_brokers})
+        self._demotion_history.update({int(b): now for b in demoted_brokers})
+
+    def drop_history(self, removed: bool = False, demoted: bool = False):
+        """ADMIN drop_recently_removed/demoted_brokers."""
+        if removed:
+            self._removal_history.clear()
+        if demoted:
+            self._demotion_history.clear()
 
     # -- state --
     @property
@@ -311,6 +354,9 @@ class Executor:
                           demoted_brokers: Iterable[int] = (),
                           replication_throttle: Optional[int] = None,
                           concurrency: Optional[int] = None,
+                          leader_concurrency: Optional[int] = None,
+                          progress_check_interval_ms: Optional[int] = None,
+                          strategy_names: Sequence[str] = (),
                           logdir_moves: Sequence = ()) -> dict:
         """Synchronous execution of a proposal set; returns the summary.
         (The async layer runs this in an operation thread.)
@@ -327,14 +373,27 @@ class Executor:
         self._force_stop.clear()
         self._timed_out = False
         t0 = time.time()
-        planner = ExecutionTaskPlanner(self._strategy)
+        # per-request overrides (ParameterUtils: replica_movement_strategies,
+        # execution_progress_check_interval_ms, concurrent_leader_movements)
+        strategy = self._strategy
+        if strategy_names:
+            from cruise_control_tpu.executor.tasks import STRATEGIES
+            chain = None
+            for name in strategy_names:
+                cls = STRATEGIES.get(name)
+                if cls is None:
+                    raise ValueError(f"unknown replica movement strategy "
+                                     f"{name!r}; valid: {sorted(STRATEGIES)}")
+                chain = cls() if chain is None else chain.chain(cls())
+            strategy = chain
+        self._interval_override_ms = progress_check_interval_ms
+        planner = ExecutionTaskPlanner(strategy)
         planner.add_proposals(proposals)
         self._planner = planner
         self.tracker = ExecutionTaskTracker()
         self.tracker.register(planner.replica_tasks)
         self.tracker.register(planner.leadership_tasks)
-        self.recently_removed_brokers |= set(removed_brokers)
-        self.recently_demoted_brokers |= set(demoted_brokers)
+        self.record_history(removed_brokers, demoted_brokers)
 
         throttle = (replication_throttle
                     if replication_throttle is not None
@@ -358,13 +417,16 @@ class Executor:
                     ExecutorState.INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
                 report_progress(f"Executing {len(logdir_moves)} intra-broker "
                                 f"logdir movements")
-                self.adapter.alter_replica_logdirs(logdir_moves)
-                intra_moves_applied = len(logdir_moves)
+                for lb in self._logdir_batches(logdir_moves):
+                    self.adapter.alter_replica_logdirs(lb)
+                    intra_moves_applied += len(lb)
+                    if self._stop_requested.is_set():
+                        break
             self._state = ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS
             report_progress(
                 f"Executing {len(planner.leadership_tasks)} leadership "
                 f"movements")
-            self._move_leadership(planner)
+            self._move_leadership(planner, leader_concurrency)
         finally:
             if helper is not None:
                 helper.clear_throttles()
@@ -386,18 +448,39 @@ class Executor:
         return summary
 
     def execute_logdir_moves(self, moves) -> dict:
-        """Phase 2 (Executor.java:995): intra-broker logdir moves."""
+        """Phase 2 (Executor.java:995): intra-broker logdir moves, batched
+        per broker by num.concurrent.intra.broker.partition.movements."""
         with self._lock:
             if self.has_ongoing_execution:
                 raise RuntimeError("An execution is already in progress")
             self._state = ExecutorState.INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
         t0 = time.time()
         try:
-            self.adapter.alter_replica_logdirs(moves)
+            for batch in self._logdir_batches(moves):
+                self.adapter.alter_replica_logdirs(batch)
+                if self._stop_requested.is_set():
+                    break
             return {"intraBrokerMoves": len(moves),
                     "durationSeconds": round(time.time() - t0, 3)}
         finally:
             self._state = ExecutorState.NO_TASK_IN_PROGRESS
+
+    def _logdir_batches(self, moves) -> Iterable[list]:
+        """Round-robin batches with at most N in-flight logdir moves per
+        broker per round."""
+        per_broker = max(
+            1, self.config.num_concurrent_intra_broker_partition_movements)
+        queues: Dict[int, list] = {}
+        for m in moves:
+            queues.setdefault(getattr(m, "broker_id", 0), []).append(m)
+        while any(queues.values()):
+            batch = []
+            for b, q in queues.items():
+                batch.extend(q[:per_broker])
+                queues[b] = q[per_broker:]
+            if not batch:
+                break
+            yield batch
 
     # -- phases --
     def _move_replicas(self, planner: ExecutionTaskPlanner,
@@ -418,12 +501,14 @@ class Executor:
             self.adapter.execute_replica_reassignments(batch)
             self._wait_for(batch, self._replica_task_done)
 
-    def _move_leadership(self, planner: ExecutionTaskPlanner):
+    def _move_leadership(self, planner: ExecutionTaskPlanner,
+                         concurrency: Optional[int] = None):
         """Phase 3 (Executor.java:1050); leadership movements time out on
         their own (shorter) round budget."""
         while not self._stop_requested.is_set():
             batch = planner.next_leadership_batch(
-                self.config.num_concurrent_leader_movements)
+                concurrency
+                or self.config.num_concurrent_leader_movements)
             if not batch:
                 break
             now = int(time.time() * 1000)
@@ -467,7 +552,18 @@ class Executor:
         budget = (max_rounds if max_rounds is not None
                   else self.config.max_execution_progress_check_rounds)
         open_tasks = list(batch)
+        batch_t0 = time.time()
+        alerted = False
         while open_tasks and rounds < budget:
+            if (not alerted and (time.time() - batch_t0) * 1000
+                    > self.config.task_execution_alerting_threshold_ms):
+                # task.execution.alerting.threshold.ms: surface slow batches
+                alerted = True
+                logger.warning(
+                    "%d execution tasks still in flight after %.0f s "
+                    "(alerting threshold %.0f s)", len(open_tasks),
+                    time.time() - batch_t0,
+                    self.config.task_execution_alerting_threshold_ms / 1000.0)
             rounds += 1
             now = int(time.time() * 1000)
             still = []
@@ -495,7 +591,10 @@ class Executor:
                     self.tracker.mark(t, prev)
             open_tasks = still
             if open_tasks:
-                time.sleep(self.config.execution_progress_check_interval_ms / 1000.0)
+                time.sleep((self._interval_override_ms
+                            if self._interval_override_ms is not None
+                            else self.config.execution_progress_check_interval_ms)
+                           / 1000.0)
         if open_tasks:   # round budget exhausted
             self._timed_out = True
             now = int(time.time() * 1000)

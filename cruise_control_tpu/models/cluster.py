@@ -234,6 +234,18 @@ CPU_WEIGHT_LEADER_BYTES_OUT = 0.15
 CPU_WEIGHT_FOLLOWER_BYTES_IN = 0.15
 
 
+def set_static_cpu_weights(leader_bytes_in: float, leader_bytes_out: float,
+                           follower_bytes_in: float) -> None:
+    """Override the static attribution weights from config
+    ({leader,follower}.network.{inbound,outbound}.weight.for.cpu.util,
+    ModelParameters.java:21-29). Process-wide, set once at service init."""
+    global CPU_WEIGHT_LEADER_BYTES_IN, CPU_WEIGHT_LEADER_BYTES_OUT, \
+        CPU_WEIGHT_FOLLOWER_BYTES_IN
+    CPU_WEIGHT_LEADER_BYTES_IN = float(leader_bytes_in)
+    CPU_WEIGHT_LEADER_BYTES_OUT = float(leader_bytes_out)
+    CPU_WEIGHT_FOLLOWER_BYTES_IN = float(follower_bytes_in)
+
+
 def follower_cpu_util(leader_bytes_in, leader_bytes_out, leader_cpu):
     """ModelUtils.getFollowerCpuUtilFromLeaderLoad (ModelUtils.java:45-66)."""
     denom = (CPU_WEIGHT_LEADER_BYTES_IN * leader_bytes_in

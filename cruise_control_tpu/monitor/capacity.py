@@ -26,6 +26,10 @@ class BrokerCapacityInfo:
     capacity: np.ndarray                       # f32[4]
     disk_capacity_by_logdir: Optional[Dict[str, float]] = None
     num_cores: Optional[int] = None
+    #: True when this is the default (-1) entry standing in for a broker
+    #: with no explicit capacity — the reference's "estimated" capacity that
+    #: allow_capacity_estimation=false refuses to optimize on
+    is_estimated: bool = False
 
     @property
     def is_jbod(self) -> bool:
@@ -75,8 +79,11 @@ class FileCapacityResolver(BrokerCapacityResolver):
                 f"{path}: no default capacity entry (brokerId -1)")
 
     def capacity_for_broker(self, broker_id: int) -> BrokerCapacityInfo:
-        return self._by_id.get(int(broker_id),
-                               self._by_id[DEFAULT_CAPACITY_BROKER_ID])
+        info = self._by_id.get(int(broker_id))
+        if info is not None:
+            return info
+        return dataclasses.replace(self._by_id[DEFAULT_CAPACITY_BROKER_ID],
+                                   is_estimated=True)
 
 
 class StaticCapacityResolver(BrokerCapacityResolver):

@@ -98,6 +98,10 @@ class LoadMonitor:
                  sampling_interval_ms: int = 60_000,
                  use_lr_model: bool = False,
                  num_metric_fetchers: int = 1,
+                 broker_num_windows: Optional[int] = None,
+                 broker_window_ms: Optional[int] = None,
+                 min_samples_per_broker_window: Optional[int] = None,
+                 max_allowed_extrapolations_per_broker: Optional[int] = None,
                  now_fn: Optional[Callable[[], int]] = None):
         from cruise_control_tpu.monitor.fetcher import MetricFetcherManager
         self._metadata_source = metadata_source
@@ -114,13 +118,25 @@ class LoadMonitor:
         # broker aggregator reuses the same engine; metrics:
         # cpu/lbi/lbo/rbi/rbo/log-flush-time (the last feeds SlowBrokerFinder)
         self.broker_aggregator = MetricSampleAggregator(
-            num_windows=num_windows, window_ms=window_ms,
-            min_samples_per_window=min_samples_per_window,
-            max_allowed_extrapolations=max_allowed_extrapolations,
+            num_windows=(broker_num_windows if broker_num_windows is not None
+                         else num_windows),
+            window_ms=(broker_window_ms if broker_window_ms is not None
+                       else window_ms),
+            min_samples_per_window=(
+                min_samples_per_broker_window
+                if min_samples_per_broker_window is not None
+                else min_samples_per_window),
+            max_allowed_extrapolations=(
+                max_allowed_extrapolations_per_broker
+                if max_allowed_extrapolations_per_broker is not None
+                else max_allowed_extrapolations),
             num_metrics=6,
             strategies=[md.Strategy.AVG] * 6)
         self.window_ms = window_ms
         self.sampling_interval_ms = sampling_interval_ms
+        #: brokers whose capacity came from the default (-1) entry in the
+        #: last model build (allow_capacity_estimation gate)
+        self.capacity_estimated_brokers: List[int] = []
         self._state = MonitorState.NOT_STARTED
         self._pause_reason: Optional[str] = None
         self._lock = threading.RLock()
@@ -381,8 +397,11 @@ class LoadMonitor:
 
         b = ClusterModelBuilder()
         alive_brokers = set()
+        self.capacity_estimated_brokers: List[int] = []
         for bm in metadata.brokers:
             info = self._capacity_resolver.capacity_for_broker(bm.broker_id)
+            if getattr(info, "is_estimated", False):
+                self.capacity_estimated_brokers.append(bm.broker_id)
             b.create_broker(bm.rack or f"rack-of-{bm.broker_id}",
                             bm.host or f"host{bm.broker_id}", bm.broker_id,
                             {i: float(info.capacity[i])

@@ -23,11 +23,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from cruise_control_tpu.common import resources as res
-from cruise_control_tpu.models.cluster import (
-    CPU_WEIGHT_FOLLOWER_BYTES_IN,
-    CPU_WEIGHT_LEADER_BYTES_IN,
-    CPU_WEIGHT_LEADER_BYTES_OUT,
-)
+from cruise_control_tpu.models import cluster as _cluster   # live CPU weights
 from cruise_control_tpu.monitor import metricdef as md
 
 
@@ -128,11 +124,11 @@ def estimate_partition_cpu(leader_bytes_in: np.ndarray,
     partitions proportionally to the static linear model weights
     (CruiseControlMetricsProcessor.estimateLeaderCpuUtil +
     ModelParameters.java:21-29)."""
-    denom = (CPU_WEIGHT_LEADER_BYTES_IN * broker_leader_bytes_in
-             + CPU_WEIGHT_LEADER_BYTES_OUT * broker_leader_bytes_out
-             + CPU_WEIGHT_FOLLOWER_BYTES_IN * broker_follower_bytes_in)
-    num = (CPU_WEIGHT_LEADER_BYTES_IN * leader_bytes_in
-           + CPU_WEIGHT_LEADER_BYTES_OUT * leader_bytes_out)
+    denom = (_cluster.CPU_WEIGHT_LEADER_BYTES_IN * broker_leader_bytes_in
+             + _cluster.CPU_WEIGHT_LEADER_BYTES_OUT * broker_leader_bytes_out
+             + _cluster.CPU_WEIGHT_FOLLOWER_BYTES_IN * broker_follower_bytes_in)
+    num = (_cluster.CPU_WEIGHT_LEADER_BYTES_IN * leader_bytes_in
+           + _cluster.CPU_WEIGHT_LEADER_BYTES_OUT * leader_bytes_out)
     if denom <= 0:
         return np.zeros_like(np.asarray(leader_bytes_in, dtype=np.float64))
     return broker_cpu * num / denom

@@ -126,11 +126,13 @@ class UserTaskManager:
 
     def __init__(self, max_active_tasks: int = 25,
                  completed_retention_ms: int = 86_400_000,
+                 max_cached_completed: int = 100,
                  num_threads: int = 4, now_fn=_now_ms):
         self._active: Dict[str, UserTaskInfo] = {}
         self._completed: Dict[str, UserTaskInfo] = {}
         self._max_active = max_active_tasks
         self._retention_ms = completed_retention_ms
+        self._max_completed = max_cached_completed
         self._lock = threading.RLock()
         self._pool = ThreadPoolExecutor(max_workers=num_threads,
                                         thread_name_prefix="user-task")
@@ -170,6 +172,12 @@ class UserTaskManager:
                 self._completed[tid] = info
         for tid, info in list(self._completed.items()):
             if now - info.start_ms > self._retention_ms:
+                del self._completed[tid]
+        # size cap (max.cached.completed.user.tasks): oldest evicted first
+        if len(self._completed) > self._max_completed:
+            for tid, _ in sorted(self._completed.items(),
+                                 key=lambda kv: kv[1].start_ms
+                                 )[:len(self._completed) - self._max_completed]:
                 del self._completed[tid]
 
     def close(self):
@@ -222,6 +230,7 @@ class ReviewRequest:
     #: approval cannot be redeemed for a different request
     #: (Purgatory.java submit() executes the stored request's parameters)
     params: Dict[str, str] = dataclasses.field(default_factory=dict)
+    submitted_ms: int = 0
 
     def to_json(self) -> dict:
         return {"Id": self.review_id, "EndPoint": self.endpoint,
@@ -233,16 +242,37 @@ class ReviewRequest:
 class Purgatory:
     """Two-step verification (servlet/purgatory/Purgatory.java:42-166)."""
 
-    def __init__(self):
+    def __init__(self, max_requests: int = 25,
+                 retention_ms: int = 1_209_600_000, now_fn=_now_ms):
         self._requests: Dict[int, ReviewRequest] = {}
         self._next_id = 0
+        self._max_requests = max_requests
+        self._retention_ms = retention_ms
+        self._now = now_fn
         self._lock = threading.Lock()
+
+    def _evict_locked(self):
+        """Drop resolved requests past retention
+        (two.step.purgatory.retention.time.ms)."""
+        cutoff = self._now() - self._retention_ms
+        for rid in [rid for rid, r in self._requests.items()
+                    if r.status != ReviewStatus.PENDING_REVIEW
+                    and r.submitted_ms < cutoff]:
+            del self._requests[rid]
 
     def submit(self, endpoint: str, request_url: str, submitter: str,
                params: Optional[Dict[str, str]] = None) -> ReviewRequest:
         with self._lock:
+            self._evict_locked()
+            pending = sum(1 for r in self._requests.values()
+                          if r.status == ReviewStatus.PENDING_REVIEW)
+            if pending >= self._max_requests:
+                raise ValueError(
+                    f"purgatory is full ({pending} pending reviews, "
+                    f"max {self._max_requests})")
             r = ReviewRequest(self._next_id, endpoint, request_url, submitter,
-                              params=dict(params or {}))
+                              params=dict(params or {}),
+                              submitted_ms=self._now())
             self._requests[self._next_id] = r
             self._next_id += 1
             return r

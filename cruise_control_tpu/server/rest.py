@@ -73,7 +73,29 @@ def _goal_based_params(params: Dict[str, str]) -> dict:
             params, "exclude_recently_removed_brokers", False),
         exclude_recently_demoted_brokers=_parse_bool(
             params, "exclude_recently_demoted_brokers", False),
+        skip_hard_goal_check=_parse_bool(params, "skip_hard_goal_check",
+                                         False),
+        allow_capacity_estimation=_parse_bool(
+            params, "allow_capacity_estimation", True),
     )
+
+
+def _executor_params(params: Dict[str, str]) -> dict:
+    """Per-request executor overrides (ParameterUtils):
+    concurrent_leader_movements, execution_progress_check_interval_ms,
+    replication_throttle, replica_movement_strategies."""
+    kw: dict = {}
+    if params.get("concurrent_leader_movements"):
+        kw["leader_concurrency"] = int(params["concurrent_leader_movements"])
+    if params.get("execution_progress_check_interval_ms"):
+        kw["progress_check_interval_ms"] = int(
+            params["execution_progress_check_interval_ms"])
+    if params.get("replication_throttle"):
+        kw["replication_throttle"] = int(params["replication_throttle"])
+    strategies = _parse_csv(params, "replica_movement_strategies")
+    if strategies:
+        kw["strategy_names"] = strategies
+    return kw
 
 
 class RestApi:
@@ -86,12 +108,16 @@ class RestApi:
         self.user_tasks = UserTaskManager(
             max_active_tasks=cfg.get("max.active.user.tasks"),
             completed_retention_ms=cfg.get(
-                "completed.user.task.retention.time.ms"))
+                "completed.user.task.retention.time.ms"),
+            max_cached_completed=cfg.get("max.cached.completed.user.tasks"))
         self.sessions = SessionManager(
             max_expiry_ms=cfg.get("webserver.session.maxExpiryPeriodMs"))
-        self.purgatory = Purgatory() if cfg.get(
-            "two.step.verification.enabled") else None
+        self.purgatory = Purgatory(
+            max_requests=cfg.get("two.step.purgatory.max.requests"),
+            retention_ms=cfg.get("two.step.purgatory.retention.time.ms"),
+        ) if cfg.get("two.step.verification.enabled") else None
         self.prefix = cfg.get("webserver.api.urlprefix").rstrip("/")
+        self.reason_required = bool(cfg.get("request.reason.required"))
 
     # ------------------------------------------------------------- dispatch
 
@@ -106,6 +132,13 @@ class RestApi:
             return 405, {"errorMessage": f"{endpoint} requires POST"}
         if method == "POST" and endpoint not in POST_ENDPOINTS:
             return 405, {"errorMessage": f"{endpoint} requires GET"}
+        # request.reason.required (ParameterUtils.java reason handling):
+        # every POST operation must say why it was issued
+        if (method == "POST" and self.reason_required
+                and endpoint != "REVIEW" and not params.get("reason")):
+            return 400, {"errorMessage":
+                         f"{endpoint} requires a reason parameter "
+                         "(request.reason.required=true)"}
 
         # two-step verification (Purgatory.java:116-166)
         consumed_review: Optional[int] = None
@@ -113,8 +146,11 @@ class RestApi:
                 and endpoint in REVIEWABLE):
             review_id = params.get("review_id")
             if review_id is None:
-                r = self.purgatory.submit(endpoint, request_url, client_id,
-                                          params=params)
+                try:
+                    r = self.purgatory.submit(endpoint, request_url, client_id,
+                                              params=params)
+                except ValueError as e:    # purgatory full
+                    return 429, {"errorMessage": str(e)}
                 return 202, {"reviewResult": r.to_json(),
                              "message": "Submitted for review; approve via "
                                         "REVIEW then resubmit with review_id."}
@@ -185,7 +221,9 @@ class RestApi:
         return 200, state
 
     def _kafka_cluster_state(self, params, client_id, request_url):
-        return 200, self.app.kafka_cluster_state()
+        return 200, self.app.kafka_cluster_state(
+            populate_disk_info=_parse_bool(params, "populate_disk_info",
+                                           False))
 
     def _metrics(self, params, client_id, request_url):
         from cruise_control_tpu.common.metrics import REGISTRY
@@ -248,7 +286,26 @@ class RestApi:
         lo = np.asarray(assign.leader_of)
         leader_load = (topo.replica_base_load[lo]
                        + topo.leader_extra)               # [P,4]
-        order = np.argsort(-leader_load[:, sort_res])[:n]
+        keep = np.ones(leader_load.shape[0], bool)
+        # partition range "N" or "N-M" (PartitionLoadParameters)
+        prange = params.get("partition")
+        if prange:
+            lohi = str(prange).split("-")
+            p0 = int(lohi[0]); p1 = int(lohi[-1])
+            keep &= ((topo.partition_index >= p0)
+                     & (topo.partition_index <= p1))
+        tpat = params.get("topic")
+        if tpat:
+            import re
+            rx = re.compile(tpat)
+            tmask = np.array([bool(rx.fullmatch(t)) for t in topo.topic_names])
+            keep &= tmask[topo.topic_of_partition]
+        if params.get("min_load"):
+            keep &= leader_load[:, sort_res] >= float(params["min_load"])
+        if params.get("max_load"):
+            keep &= leader_load[:, sort_res] <= float(params["max_load"])
+        masked = np.where(keep, leader_load[:, sort_res], -np.inf)
+        order = np.argsort(-masked)[:min(n, int(keep.sum()))]
         bo = np.asarray(assign.broker_of)
         records = []
         for p in order:
@@ -268,14 +325,43 @@ class RestApi:
         return 200, {"records": records, "version": 1}
 
     def _user_tasks(self, params, client_id, request_url):
-        return 200, {"userTasks": [t.to_json()
-                                   for t in self.user_tasks.all_tasks()],
-                     "version": 1}
+        """UserTasksParameters: user_task_ids, client_ids, endpoints, types
+        (Active/Completed), fetch_completed_task (include the result)."""
+        tasks = self.user_tasks.all_tasks()
+        ids = set(_parse_csv(params, "user_task_ids"))
+        if ids:
+            tasks = [t for t in tasks if t.task_id in ids]
+        clients = set(_parse_csv(params, "client_ids"))
+        if clients:
+            tasks = [t for t in tasks if t.client_id in clients]
+        endpoints = {e.upper() for e in _parse_csv(params, "endpoints")}
+        if endpoints:
+            tasks = [t for t in tasks if t.endpoint.upper() in endpoints]
+        types = {t.lower() for t in _parse_csv(params, "types")}
+        if types:
+            tasks = [t for t in tasks
+                     if ("completed" if t.future.done() else "active")
+                     in types]
+        fetch = _parse_bool(params, "fetch_completed_task", False)
+        out = []
+        for t in tasks:
+            d = t.to_json()
+            if fetch and t.future.done():
+                try:
+                    d["result"] = t.future.result(timeout=0)
+                except Exception as e:
+                    d["result"] = {"errorMessage": str(e)}
+            out.append(d)
+        return 200, {"userTasks": out, "version": 1}
 
     def _review_board(self, params, client_id, request_url):
         if self.purgatory is None:
             return 400, {"errorMessage": "two-step verification disabled"}
-        return 200, {"requestInfo": self.purgatory.board(), "version": 1}
+        board = self.purgatory.board()
+        rids = set(_parse_csv_ints(params, "review_ids"))
+        if rids:
+            board = [r for r in board if r["Id"] in rids]
+        return 200, {"requestInfo": board, "version": 1}
 
     def _bootstrap(self, params, client_id, request_url):
         start = int(params.get("start", 0))
@@ -332,6 +418,9 @@ class RestApi:
         if params.get("concurrent_partition_movements_per_broker"):
             kw["concurrency"] = int(
                 params["concurrent_partition_movements_per_broker"])
+        ek = _executor_params(params)
+        if ek:
+            kw["executor_kw"] = ek
         return self._async_op("REBALANCE", params, client_id, request_url,
                               lambda: self.app.rebalance(**kw))
 
@@ -342,10 +431,18 @@ class RestApi:
         dry = _parse_bool(params, "dryrun", True)
         verbose = _parse_bool(params, "verbose", False)
         df = params.get("data_from")
+        gb = _goal_based_params(params)
+        tab = (int(params["throttle_added_broker"])
+               if params.get("throttle_added_broker") else None)
+        ek = _executor_params(params)
         return self._async_op("ADD_BROKER", params, client_id, request_url,
                               lambda: self.app.add_brokers(
                                   ids, dryrun=dry, verbose=verbose,
-                                  data_from=df))
+                                  data_from=df,
+                                  allow_capacity_estimation=gb[
+                                      "allow_capacity_estimation"],
+                                  throttle_added_broker=tab,
+                                  executor_kw=ek))
 
     def _remove_broker(self, params, client_id, request_url):
         ids = _parse_csv_ints(params, "brokerid")
@@ -354,10 +451,18 @@ class RestApi:
         dry = _parse_bool(params, "dryrun", True)
         verbose = _parse_bool(params, "verbose", False)
         df = params.get("data_from")
+        gb = _goal_based_params(params)
+        trb = (int(params["throttle_removed_broker"])
+               if params.get("throttle_removed_broker") else None)
+        ek = _executor_params(params)
         return self._async_op("REMOVE_BROKER", params, client_id, request_url,
                               lambda: self.app.remove_brokers(
                                   ids, dryrun=dry, verbose=verbose,
-                                  data_from=df))
+                                  data_from=df,
+                                  allow_capacity_estimation=gb[
+                                      "allow_capacity_estimation"],
+                                  throttle_removed_broker=trb,
+                                  executor_kw=ek))
 
     def _demote_broker(self, params, client_id, request_url):
         ids = _parse_csv_ints(params, "brokerid")
@@ -366,19 +471,32 @@ class RestApi:
         dry = _parse_bool(params, "dryrun", True)
         verbose = _parse_bool(params, "verbose", False)
         df = params.get("data_from")
+        skip_urp = _parse_bool(params, "skip_urp_demotion", False)
+        excl_follower = _parse_bool(params, "exclude_follower_demotion",
+                                    False)
+        ace = _parse_bool(params, "allow_capacity_estimation", True)
+        ek = _executor_params(params)
         return self._async_op("DEMOTE_BROKER", params, client_id, request_url,
                               lambda: self.app.demote_brokers(
                                   ids, dryrun=dry, verbose=verbose,
-                                  data_from=df))
+                                  data_from=df,
+                                  skip_urp_demotion=skip_urp,
+                                  exclude_follower_demotion=excl_follower,
+                                  allow_capacity_estimation=ace,
+                                  executor_kw=ek))
 
     def _fix_offline_replicas(self, params, client_id, request_url):
         dry = _parse_bool(params, "dryrun", True)
         verbose = _parse_bool(params, "verbose", False)
         df = params.get("data_from")
+        ek = _executor_params(params)
+        ace = _parse_bool(params, "allow_capacity_estimation", True)
         return self._async_op(
             "FIX_OFFLINE_REPLICAS", params, client_id, request_url,
             lambda: self.app.fix_offline_replicas(
-                dryrun=dry, verbose=verbose, data_from=df))
+                dryrun=dry, verbose=verbose, data_from=df,
+                allow_capacity_estimation=ace,
+                executor_kw=ek))
 
     def _stop_proposal_execution(self, params, client_id, request_url):
         return 200, self.app.stop_execution(
@@ -408,6 +526,27 @@ class RestApi:
             n = int(params["concurrent_partition_movements_per_broker"])
             self.app.executor.config.num_concurrent_partition_movements_per_broker = n
             out["concurrentPartitionMovementsPerBroker"] = n
+        if "concurrent_leader_movements" in params:
+            n = int(params["concurrent_leader_movements"])
+            self.app.executor.config.num_concurrent_leader_movements = n
+            out["concurrentLeaderMovements"] = n
+        if "concurrent_intra_broker_partition_movements" in params:
+            n = int(params["concurrent_intra_broker_partition_movements"])
+            self.app.executor.config\
+                .num_concurrent_intra_broker_partition_movements = n
+            out["concurrentIntraBrokerPartitionMovements"] = n
+        if "execution_progress_check_interval_ms" in params:
+            n = int(params["execution_progress_check_interval_ms"])
+            self.app.executor.config.execution_progress_check_interval_ms = n
+            out["executionProgressCheckIntervalMs"] = n
+        if _parse_bool(params, "drop_recently_removed_brokers", False):
+            dropped = sorted(self.app.executor.recently_removed_brokers)
+            self.app.executor.drop_history(removed=True)
+            out["droppedRecentlyRemovedBrokers"] = dropped
+        if _parse_bool(params, "drop_recently_demoted_brokers", False):
+            dropped = sorted(self.app.executor.recently_demoted_brokers)
+            self.app.executor.drop_history(demoted=True)
+            out["droppedRecentlyDemotedBrokers"] = dropped
         if not out:
             return 400, {"errorMessage": "no admin action specified"}
         return 200, out
@@ -438,6 +577,23 @@ class RestApi:
                 topic_pattern=topic, replication_factor=int(rf), dryrun=dry))
 
 
+def _to_plaintext(payload, indent: int = 0) -> str:
+    """Flat key/value text rendering for json=false responses."""
+    pad = " " * indent
+    if isinstance(payload, dict):
+        lines = []
+        for k, v in payload.items():
+            if isinstance(v, (dict, list)):
+                lines.append(f"{pad}{k}:")
+                lines.append(_to_plaintext(v, indent + 2))
+            else:
+                lines.append(f"{pad}{k}: {v}")
+        return "\n".join(lines)
+    if isinstance(payload, list):
+        return "\n".join(_to_plaintext(v, indent) for v in payload)
+    return f"{pad}{payload}"
+
+
 class _Handler(BaseHTTPRequestHandler):
     api: RestApi = None     # injected by serve()
 
@@ -458,12 +614,31 @@ class _Handler(BaseHTTPRequestHandler):
         code, payload = self.api.dispatch(
             method, endpoint or "STATE", params,
             client_id=self.client_address[0], request_url=self.path)
-        data = json.dumps(payload, indent=2, default=str).encode()
+        # json=false → text/plain rendering (the reference's default wire
+        # format; ParameterUtils JSON_PARAM)
+        as_json = str(params.get("json", "true")).strip().lower() != "false"
+        if as_json:
+            data = json.dumps(payload, indent=2, default=str).encode()
+            ctype = "application/json"
+        else:
+            data = _to_plaintext(payload).encode()
+            ctype = "text/plain"
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        self._cors_headers()
         self.end_headers()
         self.wfile.write(data)
+
+    def _cors_headers(self):
+        cfg = self.api.app.config
+        if cfg.get("webserver.http.cors.enabled"):
+            self.send_header("Access-Control-Allow-Origin",
+                             cfg.get("webserver.http.cors.origin"))
+            self.send_header("Access-Control-Allow-Methods",
+                             cfg.get("webserver.http.cors.allowmethods"))
+            self.send_header("Access-Control-Expose-Headers",
+                             cfg.get("webserver.http.cors.exposeheaders"))
 
     def do_GET(self):
         self._do("GET")
@@ -471,10 +646,27 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         self._do("POST")
 
-    def log_message(self, fmt, *args):   # NCSA-style access log to stderr
+    def do_OPTIONS(self):    # CORS preflight
+        self.send_response(200)
+        self._cors_headers()
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def log_message(self, fmt, *args):   # NCSA-style access log
+        cfg = self.api.app.config
+        if not cfg.get("webserver.accesslog.enabled"):
+            return
+        line = f"{self.client_address[0]} - {args[0] if args else ''}"
+        path = cfg.get("webserver.accesslog.path")
+        if path:
+            try:
+                with open(path, "a") as f:
+                    f.write(line + "\n")
+                return
+            except OSError:
+                pass
         import sys
-        print(f"{self.client_address[0]} - {args[0] if args else ''}",
-              file=sys.stderr)
+        print(line, file=sys.stderr)
 
 
 def serve(app: CruiseControlApp, port: Optional[int] = None,

@@ -1,0 +1,236 @@
+"""Config-key wiring tests for the long tail of the 133-key surface
+(KafkaCruiseControlConfig.java): each test proves a key changes real
+behavior, not just parses."""
+
+import time
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.common.config import CruiseControlConfig
+from cruise_control_tpu.server.async_ops import (
+    Purgatory,
+    ReviewStatus,
+    UserTaskManager,
+)
+
+from tests.test_server import W, _app, _metadata
+
+
+def test_purgatory_max_requests_and_retention():
+    clock = [1000]
+    p = Purgatory(max_requests=2, retention_ms=500, now_fn=lambda: clock[0])
+    p.submit("REBALANCE", "/r", "alice")
+    r2 = p.submit("REBALANCE", "/r", "bob")
+    with pytest.raises(ValueError, match="full"):
+        p.submit("REBALANCE", "/r", "carol")
+    # resolving one frees a slot once retention passes
+    p.review(r2.review_id, approve=False)
+    clock[0] += 1000
+    r4 = p.submit("DEMOTE_BROKER", "/d", "dave")
+    assert r4.status == ReviewStatus.PENDING_REVIEW
+    assert all(r["Id"] != r2.review_id for r in p.board())  # evicted
+
+
+def test_user_task_completed_cache_cap():
+    clock = [0]
+    m = UserTaskManager(max_active_tasks=50, completed_retention_ms=10**9,
+                        max_cached_completed=3, now_fn=lambda: clock[0])
+    infos = []
+    for i in range(5):
+        clock[0] += 10
+        infos.append(m.create_task("STATE", "/s", "c", lambda fut: i))
+    for info in infos:
+        info.future.result(timeout=5)
+    clock[0] += 10
+    assert len(m.all_tasks()) == 3       # oldest two evicted by the cap
+    assert m.get(infos[0].task_id) is None
+    assert m.get(infos[-1].task_id) is not None
+    m.close()
+
+
+def test_request_reason_required():
+    app = _app(overrides={"request.reason.required": True})
+    from cruise_control_tpu.server.rest import RestApi
+    api = RestApi(app)
+    code, body = api.dispatch("POST", "PAUSE_SAMPLING", {})
+    assert code == 400 and "reason" in body["errorMessage"]
+    code, _ = api.dispatch("POST", "PAUSE_SAMPLING", {"reason": "maint"})
+    assert code == 200
+    code, _ = api.dispatch("POST", "RESUME_SAMPLING", {"reason": "done"})
+    assert code == 200
+
+
+def test_executor_history_retention():
+    from cruise_control_tpu.executor.executor import (
+        Executor, ExecutorConfig, FakeClusterAdapter)
+    ex = Executor(FakeClusterAdapter({}),
+                  ExecutorConfig(removal_history_retention_ms=50,
+                                 demotion_history_retention_ms=10**9))
+    ex.record_history(removed_brokers=[1, 2], demoted_brokers=[3])
+    assert ex.recently_removed_brokers == {1, 2}
+    assert ex.recently_demoted_brokers == {3}
+    time.sleep(0.1)
+    assert ex.recently_removed_brokers == set()     # retention expired
+    assert ex.recently_demoted_brokers == {3}       # long retention remains
+
+
+def test_detector_interval_overrides():
+    from cruise_control_tpu.detector.detectors import AnomalyDetectorService
+    from cruise_control_tpu.detector.anomalies import SelfHealingNotifier
+    calls = {"fast": 0, "slow": 0}
+    clock = [0]
+    svc = AnomalyDetectorService(
+        SelfHealingNotifier(),
+        detectors={"fast": lambda: calls.__setitem__("fast", calls["fast"] + 1),
+                   "slow": lambda: calls.__setitem__("slow", calls["slow"] + 1)},
+        interval_ms=100,
+        intervals_ms={"slow": 1000, "missing": None},
+        now_fn=lambda: clock[0])
+    for t in (0, 100, 200, 300):
+        clock[0] = t
+        svc.sweep()
+    assert calls["fast"] == 4          # every sweep
+    assert calls["slow"] == 1          # due again only at t=1000
+    clock[0] = 1000
+    svc.sweep()
+    assert calls["slow"] == 2
+
+
+def test_static_cpu_weights_configurable():
+    from cruise_control_tpu.models import cluster as C
+    orig = (C.CPU_WEIGHT_LEADER_BYTES_IN, C.CPU_WEIGHT_LEADER_BYTES_OUT,
+            C.CPU_WEIGHT_FOLLOWER_BYTES_IN)
+    try:
+        _app(overrides={
+            "leader.network.inbound.weight.for.cpu.util": 0.5,
+            "leader.network.outbound.weight.for.cpu.util": 0.3,
+            "follower.network.inbound.weight.for.cpu.util": 0.2})
+        assert C.CPU_WEIGHT_LEADER_BYTES_IN == 0.5
+        # follower CPU derivation shifts with the weights
+        v = C.follower_cpu_util(100.0, 100.0, 10.0)
+        assert v == pytest.approx(10.0 * (0.2 * 100) / (0.5 * 100 + 0.3 * 100))
+    finally:
+        C.set_static_cpu_weights(*orig)
+
+
+def test_topics_excluded_from_partition_movement():
+    app = _app(overrides={
+        "topics.excluded.from.partition.movement": "T",
+        "optimizer.engine": "greedy"})
+    r = app.proposals()
+    # the only topic is excluded → nothing may move (offline-free cluster)
+    assert r.num_replica_movements == 0
+
+
+def test_broker_window_overrides_decouple_from_partition_windows():
+    app = _app(overrides={"num.broker.metrics.windows": 7,
+                          "broker.metrics.window.ms": 2 * W})
+    assert app.load_monitor.broker_aggregator.num_windows == 7
+    assert app.load_monitor.broker_aggregator.window_ms == 2 * W
+    assert app.load_monitor.partition_aggregator.num_windows == 3
+
+
+def test_leader_movement_timeout_rounds_derived():
+    app = _app(overrides={"leader.movement.timeout.ms": 500,
+                          "execution.progress.check.interval.ms": 100})
+    assert app.executor.config.leadership_movement_timeout_rounds == 5
+
+
+def test_intra_broker_logdir_batches():
+    from cruise_control_tpu.executor.executor import (
+        Executor, ExecutorConfig, FakeClusterAdapter)
+    from cruise_control_tpu.analyzer.intra_broker import LogdirMove
+
+    class RecordingAdapter(FakeClusterAdapter):
+        def __init__(self):
+            super().__init__({})
+            self.batches = []
+
+        def alter_replica_logdirs(self, moves):
+            self.batches.append(list(moves))
+
+    ad = RecordingAdapter()
+    ex = Executor(ad, ExecutorConfig(
+        num_concurrent_intra_broker_partition_movements=2))
+    moves = [LogdirMove("T", p, broker_id=b, from_logdir="d0",
+                        to_logdir="d1", data_size=1.0)
+             for b in (0, 1) for p in range(5)]
+    out = ex.execute_logdir_moves(moves)
+    assert out["intraBrokerMoves"] == 10
+    # per round: <= 2 per broker, two brokers → <= 4 per batch
+    assert [len(b) for b in ad.batches] == [4, 4, 2]
+    for batch in ad.batches:
+        for b in (0, 1):
+            assert sum(1 for m in batch if m.broker_id == b) <= 2
+
+
+def test_skip_loading_samples():
+    calls = []
+
+    class SpyStore:
+        def load_samples(self, *a, **k):
+            calls.append("load")
+
+        def store_samples(self, *a, **k):
+            pass
+
+        def close(self):
+            pass
+
+    from cruise_control_tpu.app import CruiseControlApp
+    from cruise_control_tpu.executor.executor import FakeClusterAdapter
+    from cruise_control_tpu.monitor.load_monitor import StaticMetadataSource
+    from cruise_control_tpu.monitor.sampler import SyntheticLoadSampler
+    cfg = CruiseControlConfig({"skip.loading.samples": True,
+                               "failed.brokers.file.path": ""})
+    app = CruiseControlApp(cfg, StaticMetadataSource(_metadata()),
+                           SyntheticLoadSampler(seed=1),
+                           cluster_adapter=FakeClusterAdapter({}),
+                           sample_store=SpyStore())
+    app.startup()
+    app.shutdown()
+    assert calls == []
+
+
+def test_broker_failure_report_backoff():
+    from cruise_control_tpu.detector.detectors import BrokerFailureDetector
+    from cruise_control_tpu.monitor.load_monitor import StaticMetadataSource
+    md = _metadata(dead=(2,))
+    clock = [1000]
+    det = BrokerFailureDetector(StaticMetadataSource(md),
+                                report_backoff_ms=500,
+                                now_fn=lambda: clock[0])
+    assert det.detect() is not None          # first sighting reports
+    clock[0] += 100
+    assert det.detect() is None              # unchanged set inside backoff
+    clock[0] += 500
+    assert det.detect() is not None          # backoff elapsed, re-reported
+    # a CHANGED failure set reports immediately, backoff notwithstanding
+    clock[0] += 100
+    md2 = _metadata(dead=(2, 3))
+    det._metadata_source = StaticMetadataSource(md2)
+    a = det.detect()
+    assert a is not None and set(a.failed_brokers_by_time) == {2, 3}
+
+
+def test_demote_skip_urp_keeps_urp_partition_leadership():
+    from cruise_control_tpu.monitor.sampler import (
+        BrokerMetadata, ClusterMetadata, PartitionMetadata)
+    # partition 0 is under-replicated (offline replica on broker 1)
+    brokers = [BrokerMetadata(i, rack=f"r{i % 3}", host=f"h{i}")
+               for i in range(4)]
+    parts = [PartitionMetadata("T", p, leader=p % 4,
+                               replicas=(p % 4, (p + 1) % 4),
+                               offline_replicas=(1,) if p == 0 else ())
+             for p in range(12)]
+    md = ClusterMetadata(brokers=brokers, partitions=parts, generation=1)
+    app = _app(metadata=md)
+    out = app.demote_brokers([0], dryrun=True, skip_urp_demotion=True)
+    for pr in out["proposals"]:
+        tp = f'{pr["topicPartition"]["topic"]}-{pr["topicPartition"]["partition"]}'
+        assert tp != "T-0", "URP partition must not be demoted"
+    # counts match the filtered proposal list
+    assert out["numLeadershipMovements"] == sum(
+        1 for pr in out["proposals"]
+        if pr.get("newLeader") is not None or pr["newReplicas"][0] != pr["oldReplicas"][0])

@@ -375,7 +375,7 @@ def test_goal_based_parameter_surface():
 
     # exclude_recently_removed_brokers: a drained broker cannot receive
     # replicas on the next rebalance
-    app.executor.recently_removed_brokers.add(1)
+    app.executor.record_history(removed_brokers=[1])
     code, body = api.dispatch("POST", "REBALANCE",
                               {"dryrun": "true", "verbose": "true",
                                "exclude_recently_removed_brokers": "true",
