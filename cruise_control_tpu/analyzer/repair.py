@@ -316,9 +316,13 @@ def _fused_targeted(dt, th, w, opts, st, offline, initial_broker_of,
 def _chain_state(dt, assign, num_topics: int,
                  track_topics: bool) -> AN.ChainState:
     agg = compute_aggregates(dt, assign, num_topics if track_topics else 1)
+    # COPY the assignment arrays: the fused-apply jits donate the chain
+    # state, and jnp.asarray on a device array is a no-copy alias — without
+    # the copy, repair() would delete the CALLER's assign buffers (any reuse
+    # of the input assignment after repair crashes with INVALID_ARGUMENT)
     return AN.ChainState(
-        broker_of=jnp.asarray(assign.broker_of, jnp.int32),
-        leader_of=jnp.asarray(assign.leader_of, jnp.int32),
+        broker_of=jnp.asarray(assign.broker_of, jnp.int32) + 0,
+        leader_of=jnp.asarray(assign.leader_of, jnp.int32) + 0,
         broker_load=agg.broker_load,
         host_load=agg.host_load,
         replica_count=agg.replica_count.astype(jnp.float32),

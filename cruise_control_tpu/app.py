@@ -65,6 +65,9 @@ class CruiseControlApp:
         self.constraint = config.balancing_constraint()
         self.default_goals = tuple(config.get("default.goals"))
         self.mesh = mesh
+        import re
+        _pat = config.get("topics.excluded.from.partition.movement")
+        self._excluded_topics_rx = re.compile(_pat) if _pat else None
         from cruise_control_tpu.models.cluster import set_static_cpu_weights
         set_static_cpu_weights(
             config.get("leader.network.inbound.weight.for.cpu.util"),
@@ -92,9 +95,18 @@ class CruiseControlApp:
         self._metadata_source = metadata_source
         adapter = cluster_adapter or FakeClusterAdapter({})
         check_ms = config.get("execution.progress.check.interval.ms")
+        # default.replica.movement.strategies: the strategy chain used when
+        # a request names none
+        from cruise_control_tpu.executor.tasks import STRATEGIES
+        _chain = None
+        for _name in config.get("default.replica.movement.strategies"):
+            _cls = STRATEGIES.get(_name)
+            if _cls is not None:
+                _chain = _cls() if _chain is None else _chain.chain(_cls())
         self.executor = Executor(
             adapter,
-            ExecutorConfig(
+            strategy=_chain,
+            config=ExecutorConfig(
                 num_concurrent_partition_movements_per_broker=config.get(
                     "num.concurrent.partition.movements.per.broker"),
                 num_concurrent_intra_broker_partition_movements=config.get(
@@ -136,7 +148,9 @@ class CruiseControlApp:
                 ).detect,
                 "goal_violation": GoalViolationDetector(
                     self.load_monitor,
-                    goal_names=tuple(config.get("anomaly.detection.goals"))
+                    goal_names=tuple(config.get("anomaly.detection.goals")),
+                    allow_capacity_estimation=config.get(
+                        "anomaly.detection.allow.capacity.estimation"),
                 ).detect,
                 "disk_failure": DiskFailureDetector(
                     adapter.describe_logdirs).detect,
@@ -162,7 +176,8 @@ class CruiseControlApp:
                 "disk_failure": config.get(
                     "disk.failure.detection.interval.ms"),
             },
-            recheck_delay_ms=config.get("anomaly.detection.recheck.delay.ms"))
+            recheck_delay_ms=config.get("anomaly.detection.recheck.delay.ms"),
+            num_cached_states=config.get("num.cached.recent.anomaly.states"))
         self._proposal_cache: Optional[CachedProposals] = None
         self._cache_lock = threading.Lock()
         self._default_requirements = ModelCompletenessRequirements(
@@ -265,11 +280,10 @@ class CruiseControlApp:
                        excluded_topics: Sequence[str] = (),
                        **kw) -> G.DeviceOptions:
         """build_options + the standing topics.excluded.from.partition.movement
-        regex (every optimization, every entry point)."""
-        pattern = self.config.get("topics.excluded.from.partition.movement")
-        if pattern:
-            import re
-            rx = re.compile(pattern)
+        regex (every optimization, every entry point); the pattern is fixed
+        at config time, so it is compiled once in __init__."""
+        if self._excluded_topics_rx is not None:
+            rx = self._excluded_topics_rx
             standing = [t for t in topo.topic_names if rx.fullmatch(t)]
             excluded_topics = tuple(excluded_topics) + tuple(
                 t for t in standing if t not in set(excluded_topics))
@@ -315,6 +329,11 @@ class CruiseControlApp:
                     age = time.time() * 1000 - c.computed_at_ms
                     if (not c.generation.is_stale(gen)
                             and age < self.config.get("proposal.expiration.ms")):
+                        # the cached result was computed on the same model
+                        # build the estimation gate refers to — enforce it
+                        # on cache hits too
+                        self._check_capacity_estimation(
+                            allow_capacity_estimation)
                         return c.result
         topo, assign = self._model(data_from=data_from)
         self._check_capacity_estimation(allow_capacity_estimation)
@@ -349,6 +368,12 @@ class CruiseControlApp:
         """RebalanceRunnable.rebalance (RebalanceRunnable.java:130-144)."""
         if self_healing:
             dryrun = False
+            exclude_recently_removed_brokers = (
+                exclude_recently_removed_brokers or self.config.get(
+                    "self.healing.exclude.recently.removed.brokers"))
+            exclude_recently_demoted_brokers = (
+                exclude_recently_demoted_brokers or self.config.get(
+                    "self.healing.exclude.recently.demoted.brokers"))
         goals = goal_names or (
             tuple(self.config.get("self.healing.goals")) or None
             if self_healing else None)
@@ -374,6 +399,9 @@ class CruiseControlApp:
     def add_brokers(self, broker_ids: Sequence[int], dryrun: bool = True,
                     data_from: Optional[str] = None, verbose: bool = False,
                     allow_capacity_estimation: bool = True,
+                    use_ready_default_goals: bool = False,
+                    exclude_recently_removed_brokers: bool = False,
+                    exclude_recently_demoted_brokers: bool = False,
                     throttle_added_broker: Optional[int] = None,
                     executor_kw: Optional[dict] = None,
                     **kw) -> dict:
@@ -383,9 +411,12 @@ class CruiseControlApp:
         ids = set(int(b) for b in broker_ids)
         new_mask = np.array([int(b) in ids for b in topo.broker_ids])
         topo = dataclasses.replace(topo, broker_new=new_mask)
-        options = self._build_options(topo,
-                                  requested_destination_broker_ids=broker_ids)
-        result = self._optimize(topo, assign, None, options)
+        options = self._build_options(
+            topo, requested_destination_broker_ids=broker_ids,
+            **self._exclusions(exclude_recently_removed_brokers,
+                               exclude_recently_demoted_brokers))
+        goals = self._ready_goals() if use_ready_default_goals else None
+        result = self._optimize(topo, assign, goals, options)
         summary = result.to_json(verbose=verbose)
         if not dryrun:
             ek = dict(executor_kw or {})
@@ -399,12 +430,21 @@ class CruiseControlApp:
                        self_healing: bool = False,
                        data_from: Optional[str] = None, verbose: bool = False,
                        allow_capacity_estimation: bool = True,
+                       use_ready_default_goals: bool = False,
+                       exclude_recently_removed_brokers: bool = False,
+                       exclude_recently_demoted_brokers: bool = False,
                        throttle_removed_broker: Optional[int] = None,
                        executor_kw: Optional[dict] = None,
                        **kw) -> dict:
         """RemoveBrokersRunnable: drain the given brokers."""
         if self_healing:
             dryrun = False
+            exclude_recently_removed_brokers = (
+                exclude_recently_removed_brokers or self.config.get(
+                    "self.healing.exclude.recently.removed.brokers"))
+            exclude_recently_demoted_brokers = (
+                exclude_recently_demoted_brokers or self.config.get(
+                    "self.healing.exclude.recently.demoted.brokers"))
         topo, assign = self._model(data_from=data_from)
         self._check_capacity_estimation(allow_capacity_estimation)
         ids = set(int(b) for b in broker_ids)
@@ -418,10 +458,17 @@ class CruiseControlApp:
             offline |= (np.asarray(assign.broker_of) == r_i)
         topo = dataclasses.replace(topo, broker_alive=alive,
                                    replica_offline=offline)
+        excl = self._exclusions(exclude_recently_removed_brokers,
+                                exclude_recently_demoted_brokers)
+        no_replicas = set(int(b) for b in broker_ids) | set(
+            excl.get("excluded_brokers_for_replica_move", ()))
+        no_leadership = set(int(b) for b in broker_ids) | set(
+            excl.get("excluded_brokers_for_leadership", ()))
         options = self._build_options(
-            topo, excluded_brokers_for_replica_move=broker_ids,
-            excluded_brokers_for_leadership=broker_ids)
-        result = self._optimize(topo, assign, None, options)
+            topo, excluded_brokers_for_replica_move=sorted(no_replicas),
+            excluded_brokers_for_leadership=sorted(no_leadership))
+        goals = self._ready_goals() if use_ready_default_goals else None
+        result = self._optimize(topo, assign, goals, options)
         summary = result.to_json(verbose=verbose)
         if not dryrun:
             ek = dict(executor_kw or {})
@@ -437,6 +484,7 @@ class CruiseControlApp:
                        skip_urp_demotion: bool = False,
                        exclude_follower_demotion: bool = False,
                        allow_capacity_estimation: bool = True,
+                       exclude_recently_demoted_brokers: bool = False,
                        executor_kw: Optional[dict] = None,
                        **kw) -> dict:
         """DemoteBrokerRunnable: move leadership off the given brokers.
@@ -460,9 +508,12 @@ class CruiseControlApp:
         # demotion only moves LEADERSHIP (DemoteBrokerRunnable semantics):
         # immigrant-only mode pins every replica in place (only offline
         # replicas may still relocate, preserving self-healing)
-        options = self._build_options(topo,
-                                  excluded_brokers_for_leadership=broker_ids,
-                                  only_move_immigrant_replicas=True)
+        no_leadership = set(int(b) for b in broker_ids)
+        if exclude_recently_demoted_brokers:
+            no_leadership |= self.executor.recently_demoted_brokers
+        options = self._build_options(
+            topo, excluded_brokers_for_leadership=sorted(no_leadership),
+            only_move_immigrant_replicas=True)
         result = self._optimize(
             topo, assign, ("LeaderReplicaDistributionGoal",
                            "LeaderBytesInDistributionGoal",
@@ -492,14 +543,27 @@ class CruiseControlApp:
                              data_from: Optional[str] = None,
                              verbose: bool = False,
                              allow_capacity_estimation: bool = True,
+                             use_ready_default_goals: bool = False,
+                             exclude_recently_removed_brokers: bool = False,
+                             exclude_recently_demoted_brokers: bool = False,
                              executor_kw: Optional[dict] = None,
                              **kw) -> dict:
         """FixOfflineReplicasRunnable: self-heal dead-disk/broker replicas."""
         if self_healing:
             dryrun = False
+            exclude_recently_removed_brokers = (
+                exclude_recently_removed_brokers or self.config.get(
+                    "self.healing.exclude.recently.removed.brokers"))
+            exclude_recently_demoted_brokers = (
+                exclude_recently_demoted_brokers or self.config.get(
+                    "self.healing.exclude.recently.demoted.brokers"))
         topo, assign = self._model(data_from=data_from)
         self._check_capacity_estimation(allow_capacity_estimation)
-        result = self._optimize(topo, assign)
+        excl = self._exclusions(exclude_recently_removed_brokers,
+                                exclude_recently_demoted_brokers)
+        options = self._build_options(topo, **excl) if excl else None
+        goals = self._ready_goals() if use_ready_default_goals else None
+        result = self._optimize(topo, assign, goals, options)
         summary = result.to_json(verbose=verbose)
         if not dryrun:
             summary["execution"] = self.executor.execute_proposals(

@@ -141,19 +141,29 @@ ENDPOINTS: List[Endpoint] = [
     Endpoint("add_broker", "POST", "Move load onto new brokers",
              (_BROKERS, _DRYRUN,
               Parameter("throttle_added_broker", "throttle", "int"),
-              *_GOAL_BASED, *_EXECUTOR), is_async=True),
+              *[p for p in _GOAL_BASED if p.name != "skip_hard_goal_check"],
+              *_EXECUTOR), is_async=True),
     Endpoint("remove_broker", "POST", "Drain brokers",
              (_BROKERS, _DRYRUN,
               Parameter("throttle_removed_broker", "throttle", "int"),
-              *_GOAL_BASED, *_EXECUTOR), is_async=True),
+              *[p for p in _GOAL_BASED if p.name != "skip_hard_goal_check"],
+              *_EXECUTOR), is_async=True),
     Endpoint("demote_broker", "POST", "Move leadership off brokers",
              (_BROKERS, _DRYRUN,
               Parameter("skip_urp_demotion", "skip-urp-demotion", "bool"),
               Parameter("exclude_follower_demotion",
                         "exclude-follower-demotion", "bool"),
-              *_GOAL_BASED, *_EXECUTOR), is_async=True),
+              Parameter("data_from", "data-from", "string"),
+              Parameter("exclude_recently_demoted_brokers",
+                        "exclude-recently-demoted-brokers", "bool"),
+              Parameter("allow_capacity_estimation",
+                        "allow-capacity-estimation", "bool"),
+              Parameter("verbose", "verbose", "bool"),
+              *_EXECUTOR), is_async=True),
     Endpoint("fix_offline_replicas", "POST", "Self-heal offline replicas",
-             (_DRYRUN, *_GOAL_BASED, *_EXECUTOR), is_async=True),
+             (_DRYRUN,
+              *[p for p in _GOAL_BASED if p.name != "skip_hard_goal_check"],
+              *_EXECUTOR), is_async=True),
     Endpoint("stop_proposal_execution", "POST", "Stop the ongoing execution", (
         Parameter("force_stop", "force", "bool"),)),
     Endpoint("pause_sampling", "POST", "Pause metric sampling"),

@@ -361,10 +361,9 @@ def _service_config_def() -> ConfigDef:
     # -- monitor / sampling -------------------------------------------------
     d.define("skip.loading.samples", T.BOOLEAN, False, I.LOW,
              "Skip sample-store replay at startup.")
-    d.define("sampling.allow.cpu.capacity.estimation", T.BOOLEAN, True,
-             I.LOW, "Samplers may estimate CPU capacity when unresolved.")
     d.define("anomaly.detection.allow.capacity.estimation", T.BOOLEAN, True,
-             I.LOW, "Detectors may run on estimated broker capacities.")
+             I.LOW, "Goal-violation detection may run on estimated broker "
+             "capacities (default -1 entry); false skips the sweep instead.")
     d.define("topics.excluded.from.partition.movement", T.STRING, "", I.MEDIUM,
              "Regex of topics never moved by any optimization.")
     d.define("metric.sampler.partition.assignor.class", T.CLASS,
@@ -382,6 +381,16 @@ def _service_config_def() -> ConfigDef:
              "POST operations must carry a reason parameter.")
     d.define("max.cached.completed.user.tasks", T.INT, 100, I.LOW,
              "Completed user tasks kept for User-Task-ID polling.")
+    for _etype, _label in (("cruise.control.admin", "CRUISE_CONTROL_ADMIN"),
+                           ("cruise.control.monitor", "CRUISE_CONTROL_MONITOR"),
+                           ("kafka.admin", "KAFKA_ADMIN"),
+                           ("kafka.monitor", "KAFKA_MONITOR")):
+        d.define(f"completed.{_etype}.user.task.retention.time.ms", T.LONG,
+                 None, I.LOW, f"Retention for completed {_label} tasks "
+                 "(default: the global retention).")
+        d.define(f"max.cached.completed.{_etype}.user.tasks", T.INT, None,
+                 I.LOW, f"Cache cap for completed {_label} tasks "
+                 "(default: only the global cap applies).")
     d.define("webserver.accesslog.enabled", T.BOOLEAN, True, I.LOW,
              "Emit an NCSA-style access log line per request.")
     d.define("webserver.accesslog.path", T.STRING, "", I.LOW,

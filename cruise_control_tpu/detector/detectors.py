@@ -95,10 +95,12 @@ class GoalViolationDetector:
     """Runs the anomaly-detection goal list against a fresh model."""
 
     def __init__(self, load_monitor, goal_names: Optional[Sequence[str]] = None,
-                 now_fn=_now_ms):
+                 allow_capacity_estimation: bool = True, now_fn=_now_ms):
         from cruise_control_tpu.analyzer import goals as G
         self._lm = load_monitor
         self._goals = tuple(goal_names or G.ANOMALY_DETECTION_GOALS)
+        #: anomaly.detection.allow.capacity.estimation
+        self._allow_estimation = allow_capacity_estimation
         self._now = now_fn
 
     def detect(self) -> Optional[GoalViolations]:
@@ -113,6 +115,9 @@ class GoalViolationDetector:
             topo, assign = self._lm.cluster_model(now_ms=self._now())
         except NotEnoughValidWindowsError:
             return None
+        if (not self._allow_estimation
+                and self._lm.capacity_estimated_brokers):
+            return None      # refuse to judge goals on estimated capacities
         dt = device_topology(topo)
         agg = compute_aggregates(dt, assign, topo.num_topics)
         th = G.compute_thresholds(dt, BalancingConstraint(), agg)
@@ -301,7 +306,8 @@ class AnomalyDetectorService:
                  detectors: Optional[Dict[str, Callable[[], object]]] = None,
                  interval_ms: int = 300_000,
                  intervals_ms: Optional[Dict[str, int]] = None,
-                 recheck_delay_ms: Optional[int] = None, now_fn=_now_ms):
+                 recheck_delay_ms: Optional[int] = None,
+                 num_cached_states: int = 20, now_fn=_now_ms):
         self.notifier = notifier
         self.context = context
         self._has_exec = has_ongoing_execution
@@ -316,6 +322,8 @@ class AnomalyDetectorService:
         #: how long a deferred anomaly waits before its re-check
         self.recheck_delay_ms = (recheck_delay_ms if recheck_delay_ms is not None
                                  else interval_ms)
+        #: num.cached.recent.anomaly.states — history depth in state snapshots
+        self.num_cached_states = num_cached_states
         self._queue: List[_Queued] = []
         self._seq = 0
         self._lock = threading.RLock()
@@ -360,15 +368,16 @@ class AnomalyDetectorService:
             self.metrics["anomalies_detected"] += 1
 
     def sweep(self) -> int:
-        """One detection pass over the detectors that are due."""
+        """One detection pass over the detectors that are due. A detector
+        runs at its override interval when configured, else every
+        ``interval_ms`` (due-tracked, so the loop may tick faster)."""
         n = 0
         now = self._now()
         for name, det in self.detectors.items():
-            custom = self.intervals_ms.get(name)
-            if custom is not None:
-                if now < self._next_due.get(name, 0):
-                    continue
-                self._next_due[name] = now + custom
+            interval = self.intervals_ms.get(name, self.interval_ms)
+            if now < self._next_due.get(name, -10**15):
+                continue
+            self._next_due[name] = now + interval
             try:
                 found = det()
             except Exception:
@@ -443,7 +452,11 @@ class AnomalyDetectorService:
             self._thread.join(timeout=5)
 
     def _run(self):
-        while not self._shutdown.wait(self.interval_ms / 1000.0):
+        # wake at the FASTEST configured cadence so a per-detector interval
+        # shorter than anomaly.detection.interval.ms actually takes effect;
+        # sweep() gates each detector on its own due time
+        tick_ms = min([self.interval_ms] + list(self.intervals_ms.values()))
+        while not self._shutdown.wait(tick_ms / 1000.0):
             self.sweep()
             self.handle_pending()
 
@@ -453,7 +466,7 @@ class AnomalyDetectorService:
                 "selfHealingEnabled": {
                     t.value: v for t, v in
                     self.notifier.self_healing_enabled().items()},
-                "recentAnomalies": self.history[-20:],
+                "recentAnomalies": self.history[-self.num_cached_states:],
                 "metrics": dict(self.metrics),
                 "queuedAnomalies": len(self._queue),
             }

@@ -285,18 +285,22 @@ class Executor:
         self.tracker = ExecutionTaskTracker()
         self._interval_override_ms: Optional[int] = None
         self._planner: Optional[ExecutionTaskPlanner] = None
+        self._history_lock = threading.Lock()
         self._removal_history: Dict[int, float] = {}   # broker → record ts (s)
         self._demotion_history: Dict[int, float] = {}
         self._execution_history: List[dict] = []
 
     # -- removal/demotion history (Executor.java:123-127 with the
-    # {removal,demotion}.history.retention.time.ms windows) --
+    # {removal,demotion}.history.retention.time.ms windows). Readers prune
+    # in place, so every access goes through the history lock — REST
+    # threads, ADMIN drops, and executions touch these concurrently.
     def _pruned_history(self, hist: Dict[int, float],
                         retention_ms: int) -> Set[int]:
-        cutoff = time.time() - retention_ms / 1000.0
-        for b in [b for b, ts in hist.items() if ts < cutoff]:
-            del hist[b]
-        return set(hist)
+        with self._history_lock:
+            cutoff = time.time() - retention_ms / 1000.0
+            for b in [b for b, ts in hist.items() if ts < cutoff]:
+                del hist[b]
+            return set(hist)
 
     @property
     def recently_removed_brokers(self) -> Set[int]:
@@ -310,15 +314,19 @@ class Executor:
 
     def record_history(self, removed_brokers=(), demoted_brokers=()):
         now = time.time()
-        self._removal_history.update({int(b): now for b in removed_brokers})
-        self._demotion_history.update({int(b): now for b in demoted_brokers})
+        with self._history_lock:
+            self._removal_history.update(
+                {int(b): now for b in removed_brokers})
+            self._demotion_history.update(
+                {int(b): now for b in demoted_brokers})
 
     def drop_history(self, removed: bool = False, demoted: bool = False):
         """ADMIN drop_recently_removed/demoted_brokers."""
-        if removed:
-            self._removal_history.clear()
-        if demoted:
-            self._demotion_history.clear()
+        with self._history_lock:
+            if removed:
+                self._removal_history.clear()
+            if demoted:
+                self._demotion_history.clear()
 
     # -- state --
     @property
@@ -455,12 +463,15 @@ class Executor:
                 raise RuntimeError("An execution is already in progress")
             self._state = ExecutorState.INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
         t0 = time.time()
+        applied = 0
         try:
             for batch in self._logdir_batches(moves):
                 self.adapter.alter_replica_logdirs(batch)
+                applied += len(batch)
                 if self._stop_requested.is_set():
                     break
-            return {"intraBrokerMoves": len(moves),
+            return {"intraBrokerMoves": applied,
+                    "stopped": applied < len(moves),
                     "durationSeconds": round(time.time() - t0, 3)}
         finally:
             self._state = ExecutorState.NO_TASK_IN_PROGRESS

@@ -127,12 +127,24 @@ class UserTaskManager:
     def __init__(self, max_active_tasks: int = 25,
                  completed_retention_ms: int = 86_400_000,
                  max_cached_completed: int = 100,
+                 retention_ms_by_type: Optional[Dict[str, int]] = None,
+                 max_completed_by_type: Optional[Dict[str, int]] = None,
+                 endpoint_type_fn: Optional[Callable[[str], str]] = None,
                  num_threads: int = 4, now_fn=_now_ms):
         self._active: Dict[str, UserTaskInfo] = {}
         self._completed: Dict[str, UserTaskInfo] = {}
         self._max_active = max_active_tasks
         self._retention_ms = completed_retention_ms
         self._max_completed = max_cached_completed
+        #: per-EndpointType overrides (completed.<type>.user.task.retention
+        #: .time.ms / max.cached.completed.<type>.user.tasks)
+        self._retention_by_type = {k: v for k, v
+                                   in (retention_ms_by_type or {}).items()
+                                   if v is not None}
+        self._max_by_type = {k: v for k, v
+                             in (max_completed_by_type or {}).items()
+                             if v is not None}
+        self._type_of = endpoint_type_fn or (lambda endpoint: "")
         self._lock = threading.RLock()
         self._pool = ThreadPoolExecutor(max_workers=num_threads,
                                         thread_name_prefix="user-task")
@@ -171,9 +183,23 @@ class UserTaskManager:
                 del self._active[tid]
                 self._completed[tid] = info
         for tid, info in list(self._completed.items()):
-            if now - info.start_ms > self._retention_ms:
+            retention = self._retention_by_type.get(
+                self._type_of(info.endpoint), self._retention_ms)
+            if now - info.start_ms > retention:
                 del self._completed[tid]
-        # size cap (max.cached.completed.user.tasks): oldest evicted first
+        # size caps: per endpoint type where configured, then the global
+        # max.cached.completed.user.tasks — oldest evicted first
+        if self._max_by_type:
+            by_type: Dict[str, List[str]] = {}
+            for tid, info in self._completed.items():
+                by_type.setdefault(self._type_of(info.endpoint),
+                                   []).append(tid)
+            for etype, cap in self._max_by_type.items():
+                tids = by_type.get(etype, [])
+                if len(tids) > cap:
+                    tids.sort(key=lambda t: self._completed[t].start_ms)
+                    for tid in tids[:len(tids) - cap]:
+                        del self._completed[tid]
         if len(self._completed) > self._max_completed:
             for tid, _ in sorted(self._completed.items(),
                                  key=lambda kv: kv[1].start_ms

@@ -39,6 +39,24 @@ ALL_ENDPOINTS = GET_ENDPOINTS + POST_ENDPOINTS
 REVIEWABLE = {"ADD_BROKER", "REMOVE_BROKER", "FIX_OFFLINE_REPLICAS",
               "REBALANCE", "DEMOTE_BROKER", "TOPIC_CONFIGURATION"}
 
+#: EndpointType classification (CruiseControlEndPoint.java:17-36) — drives
+#: per-type completed-task retention/caching
+ENDPOINT_TYPES = {
+    "BOOTSTRAP": "CRUISE_CONTROL_ADMIN", "TRAIN": "CRUISE_CONTROL_ADMIN",
+    "PAUSE_SAMPLING": "CRUISE_CONTROL_ADMIN",
+    "RESUME_SAMPLING": "CRUISE_CONTROL_ADMIN",
+    "ADMIN": "CRUISE_CONTROL_ADMIN", "REVIEW": "CRUISE_CONTROL_ADMIN",
+    "STATE": "CRUISE_CONTROL_MONITOR", "USER_TASKS": "CRUISE_CONTROL_MONITOR",
+    "REVIEW_BOARD": "CRUISE_CONTROL_MONITOR",
+    "METRICS": "CRUISE_CONTROL_MONITOR",
+    "LOAD": "KAFKA_MONITOR", "PARTITION_LOAD": "KAFKA_MONITOR",
+    "PROPOSALS": "KAFKA_MONITOR", "KAFKA_CLUSTER_STATE": "KAFKA_MONITOR",
+    "ADD_BROKER": "KAFKA_ADMIN", "REMOVE_BROKER": "KAFKA_ADMIN",
+    "FIX_OFFLINE_REPLICAS": "KAFKA_ADMIN", "REBALANCE": "KAFKA_ADMIN",
+    "STOP_PROPOSAL_EXECUTION": "KAFKA_ADMIN", "DEMOTE_BROKER": "KAFKA_ADMIN",
+    "TOPIC_CONFIGURATION": "KAFKA_ADMIN",
+}
+
 
 def _parse_bool(params: dict, name: str, default: bool) -> bool:
     v = params.get(name)
@@ -105,11 +123,22 @@ class RestApi:
     def __init__(self, app: CruiseControlApp):
         self.app = app
         cfg = app.config
+        _types = (("cruise.control.admin", "CRUISE_CONTROL_ADMIN"),
+                  ("cruise.control.monitor", "CRUISE_CONTROL_MONITOR"),
+                  ("kafka.admin", "KAFKA_ADMIN"),
+                  ("kafka.monitor", "KAFKA_MONITOR"))
         self.user_tasks = UserTaskManager(
             max_active_tasks=cfg.get("max.active.user.tasks"),
             completed_retention_ms=cfg.get(
                 "completed.user.task.retention.time.ms"),
-            max_cached_completed=cfg.get("max.cached.completed.user.tasks"))
+            max_cached_completed=cfg.get("max.cached.completed.user.tasks"),
+            retention_ms_by_type={
+                label: cfg.get(f"completed.{key}.user.task.retention.time.ms")
+                for key, label in _types},
+            max_completed_by_type={
+                label: cfg.get(f"max.cached.completed.{key}.user.tasks")
+                for key, label in _types},
+            endpoint_type_fn=lambda e: ENDPOINT_TYPES.get(e.upper(), ""))
         self.sessions = SessionManager(
             max_expiry_ms=cfg.get("webserver.session.maxExpiryPeriodMs"))
         self.purgatory = Purgatory(
@@ -118,6 +147,16 @@ class RestApi:
         ) if cfg.get("two.step.verification.enabled") else None
         self.prefix = cfg.get("webserver.api.urlprefix").rstrip("/")
         self.reason_required = bool(cfg.get("request.reason.required"))
+        self._accesslog_lock = threading.Lock()
+        self._accesslog_file = None
+
+    def close(self):
+        if self._accesslog_file:
+            try:
+                self._accesslog_file.close()
+            except OSError:
+                pass
+        self.user_tasks.close()
 
     # ------------------------------------------------------------- dispatch
 
@@ -132,14 +171,6 @@ class RestApi:
             return 405, {"errorMessage": f"{endpoint} requires POST"}
         if method == "POST" and endpoint not in POST_ENDPOINTS:
             return 405, {"errorMessage": f"{endpoint} requires GET"}
-        # request.reason.required (ParameterUtils.java reason handling):
-        # every POST operation must say why it was issued
-        if (method == "POST" and self.reason_required
-                and endpoint != "REVIEW" and not params.get("reason")):
-            return 400, {"errorMessage":
-                         f"{endpoint} requires a reason parameter "
-                         "(request.reason.required=true)"}
-
         # two-step verification (Purgatory.java:116-166)
         consumed_review: Optional[int] = None
         if (method == "POST" and self.purgatory is not None
@@ -170,6 +201,16 @@ class RestApi:
                     reviewed[k] = params[k]
             params = reviewed
             request_url = r.request_url
+
+        # request.reason.required (ParameterUtils.java reason handling):
+        # every POST operation must say why it was issued. Checked AFTER the
+        # purgatory swap so an approved resubmission is judged on the params
+        # as reviewed (which carried the reason).
+        if (method == "POST" and self.reason_required
+                and endpoint != "REVIEW" and not params.get("reason")):
+            return 400, {"errorMessage":
+                         f"{endpoint} requires a reason parameter "
+                         "(request.reason.required=true)"}
 
         try:
             handler = getattr(self, f"_{endpoint.lower()}")
@@ -432,15 +473,15 @@ class RestApi:
         verbose = _parse_bool(params, "verbose", False)
         df = params.get("data_from")
         gb = _goal_based_params(params)
+        gb.pop("skip_hard_goal_check", None)   # no custom goal list here
+        gb.pop("data_from", None)              # passed explicitly
         tab = (int(params["throttle_added_broker"])
                if params.get("throttle_added_broker") else None)
         ek = _executor_params(params)
         return self._async_op("ADD_BROKER", params, client_id, request_url,
                               lambda: self.app.add_brokers(
                                   ids, dryrun=dry, verbose=verbose,
-                                  data_from=df,
-                                  allow_capacity_estimation=gb[
-                                      "allow_capacity_estimation"],
+                                  data_from=df, **gb,
                                   throttle_added_broker=tab,
                                   executor_kw=ek))
 
@@ -452,15 +493,15 @@ class RestApi:
         verbose = _parse_bool(params, "verbose", False)
         df = params.get("data_from")
         gb = _goal_based_params(params)
+        gb.pop("skip_hard_goal_check", None)
+        gb.pop("data_from", None)
         trb = (int(params["throttle_removed_broker"])
                if params.get("throttle_removed_broker") else None)
         ek = _executor_params(params)
         return self._async_op("REMOVE_BROKER", params, client_id, request_url,
                               lambda: self.app.remove_brokers(
                                   ids, dryrun=dry, verbose=verbose,
-                                  data_from=df,
-                                  allow_capacity_estimation=gb[
-                                      "allow_capacity_estimation"],
+                                  data_from=df, **gb,
                                   throttle_removed_broker=trb,
                                   executor_kw=ek))
 
@@ -475,6 +516,7 @@ class RestApi:
         excl_follower = _parse_bool(params, "exclude_follower_demotion",
                                     False)
         ace = _parse_bool(params, "allow_capacity_estimation", True)
+        erd = _parse_bool(params, "exclude_recently_demoted_brokers", False)
         ek = _executor_params(params)
         return self._async_op("DEMOTE_BROKER", params, client_id, request_url,
                               lambda: self.app.demote_brokers(
@@ -483,6 +525,7 @@ class RestApi:
                                   skip_urp_demotion=skip_urp,
                                   exclude_follower_demotion=excl_follower,
                                   allow_capacity_estimation=ace,
+                                  exclude_recently_demoted_brokers=erd,
                                   executor_kw=ek))
 
     def _fix_offline_replicas(self, params, client_id, request_url):
@@ -490,12 +533,13 @@ class RestApi:
         verbose = _parse_bool(params, "verbose", False)
         df = params.get("data_from")
         ek = _executor_params(params)
-        ace = _parse_bool(params, "allow_capacity_estimation", True)
+        gb = _goal_based_params(params)
+        gb.pop("skip_hard_goal_check", None)   # fixed default-goal list
+        gb.pop("data_from", None)
         return self._async_op(
             "FIX_OFFLINE_REPLICAS", params, client_id, request_url,
             lambda: self.app.fix_offline_replicas(
-                dryrun=dry, verbose=verbose, data_from=df,
-                allow_capacity_estimation=ace,
+                dryrun=dry, verbose=verbose, data_from=df, **gb,
                 executor_kw=ek))
 
     def _stop_proposal_execution(self, params, client_id, request_url):
@@ -659,12 +703,23 @@ class _Handler(BaseHTTPRequestHandler):
         line = f"{self.client_address[0]} - {args[0] if args else ''}"
         path = cfg.get("webserver.accesslog.path")
         if path:
-            try:
-                with open(path, "a") as f:
-                    f.write(line + "\n")
-                return
-            except OSError:
-                pass
+            # one handle for the server lifetime, opened lazily under a lock
+            # (ThreadingHTTPServer logs concurrently); open failures are NOT
+            # cached, so file logging resumes once the path is writable
+            with self.api._accesslog_lock:
+                f = self.api._accesslog_file
+                if f is None:
+                    try:
+                        f = self.api._accesslog_file = open(
+                            path, "a", buffering=1)
+                    except OSError:
+                        f = None
+                if f is not None:
+                    try:
+                        f.write(line + "\n")
+                        return
+                    except OSError:
+                        pass
         import sys
         print(line, file=sys.stderr)
 

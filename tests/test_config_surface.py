@@ -234,3 +234,32 @@ def test_demote_skip_urp_keeps_urp_partition_leadership():
     assert out["numLeadershipMovements"] == sum(
         1 for pr in out["proposals"]
         if pr.get("newLeader") is not None or pr["newReplicas"][0] != pr["oldReplicas"][0])
+
+
+def test_per_endpoint_type_task_retention():
+    from cruise_control_tpu.server.rest import ENDPOINT_TYPES
+    clock = [0]
+    m = UserTaskManager(
+        max_active_tasks=50, completed_retention_ms=10**9,
+        max_cached_completed=100,
+        retention_ms_by_type={"KAFKA_ADMIN": 50},
+        max_completed_by_type={"KAFKA_MONITOR": 1},
+        endpoint_type_fn=lambda e: ENDPOINT_TYPES.get(e, ""),
+        now_fn=lambda: clock[0])
+    a = m.create_task("REBALANCE", "/r", "c", lambda fut: 1)   # KAFKA_ADMIN
+    b1 = m.create_task("PROPOSALS", "/p", "c", lambda fut: 2)  # KAFKA_MONITOR
+    clock[0] += 10
+    b2 = m.create_task("PROPOSALS", "/p", "c", lambda fut: 3)  # KAFKA_MONITOR
+    s = m.create_task("STATE", "/s", "c", lambda fut: 4)       # CC_MONITOR
+    for t in (a, b1, b2, s):
+        t.future.result(timeout=5)
+    clock[0] += 20
+    m._expire()
+    # KAFKA_MONITOR capped at 1: oldest proposals task evicted
+    assert m.get(b1.task_id) is None and m.get(b2.task_id) is not None
+    # KAFKA_ADMIN retention 50ms: still present at t=30
+    assert m.get(a.task_id) is not None
+    clock[0] += 40                     # t=70 > 50ms retention for KAFKA_ADMIN
+    assert m.get(a.task_id) is None
+    assert m.get(s.task_id) is not None    # global retention still holds
+    m.close()

@@ -227,3 +227,34 @@ def test_repair_row_kernel_matches_scalar_deltas():
     legal = ~illegal_rows
     np.testing.assert_allclose(rows_np[legal], ref_np[legal],
                                rtol=1e-5, atol=1e-2)
+
+
+def test_repair_does_not_consume_input_assignment():
+    """repair() jits donate the chain state internally; the input Assignment
+    must survive — calling repair twice on the same input (or reading the
+    input afterwards) previously crashed with a deleted-buffer error."""
+    import jax.numpy as jnp2
+    from cruise_control_tpu.analyzer import objective as OBJ2
+    from cruise_control_tpu.analyzer import repair as REP
+    from cruise_control_tpu.common.resources import BalancingConstraint
+    from cruise_control_tpu.ops.aggregates import (
+        compute_aggregates as agg2, device_topology as devtopo)
+
+    topo, assign = fixtures.random_cluster(fixtures.ClusterProperties(
+        num_racks=3, num_brokers=8, num_replicas=200, num_topics=15,
+        min_replication=2, max_replication=3), seed=5)
+    dt = devtopo(topo)
+    th = G.compute_thresholds(dt, BalancingConstraint(),
+                              agg2(dt, assign, topo.num_topics))
+    w = OBJ2.build_weights(G.DEFAULT_GOALS)
+    opts = G.default_options(topo)
+    init = jnp2.asarray(assign.broker_of)
+    f1, m1, l1 = REP.repair(dt, assign, th, w, opts, topo.num_topics,
+                            initial_broker_of=init, seed=0)
+    # the input is intact and reusable
+    np.asarray(assign.broker_of)
+    f2, m2, l2 = REP.repair(dt, assign, th, w, opts, topo.num_topics,
+                            initial_broker_of=init, seed=0)
+    np.testing.assert_array_equal(np.asarray(f1.broker_of),
+                                  np.asarray(f2.broker_of))
+    assert (m1, l1) == (m2, l2)
