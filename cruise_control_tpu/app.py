@@ -220,7 +220,8 @@ class CruiseControlApp:
             anneal_config=self._anneal_config(),
             mesh=self.mesh)
 
-    def _model(self, requirements=None, data_from: Optional[str] = None
+    def _model(self, requirements=None, data_from: Optional[str] = None,
+               now_ms: Optional[int] = None
                ) -> Tuple[ClusterTopology, Assignment]:
         """``data_from`` (ParameterUtils.DataFrom,
         GoalBasedOptimizationParameters.java:37-46): VALID_WINDOWS demands
@@ -240,7 +241,8 @@ class CruiseControlApp:
                     include_all_topics=True)
             else:
                 requirements = self._default_requirements
-        return self.load_monitor.cluster_model(requirements=requirements)
+        return self.load_monitor.cluster_model(now_ms=now_ms,
+                                               requirements=requirements)
 
     def _ready_goals(self) -> Tuple[str, ...]:
         """GoalOptimizer readyGoals approximation: with fewer valid windows

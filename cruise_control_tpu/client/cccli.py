@@ -102,14 +102,16 @@ ENDPOINTS: List[Endpoint] = [
                   "monitor,analyzer,executor,anomaly_detector"),)),
     Endpoint("kafka_cluster_state", "GET", "Kafka cluster state", (
         Parameter("populate_disk_info", "populate-disk-info", "bool"),)),
-    Endpoint("load", "GET", "Per-broker load"),
+    Endpoint("load", "GET", "Per-broker load", (
+        Parameter("time", "time", "int", "Load as of this epoch ms"),)),
     Endpoint("partition_load", "GET", "Top partition loads", (
         Parameter("resource", "resource", "string", "cpu|disk|network_inbound|network_outbound"),
         Parameter("entries", "entries", "int", "Number of records"),
         Parameter("partition", "partition", "string", "Partition id or range N-M"),
         Parameter("topic", "topic", "string", "Topic regex"),
-        Parameter("min_load", "min-load", "string"),
-        Parameter("max_load", "max-load", "string"),)),
+        Parameter("brokerid", "brokers", "csv-int", "Leader broker filter"),
+        Parameter("max_load", "max-load", "bool",
+                  "Report max-window load instead of the average"),)),
     Endpoint("proposals", "GET", "Optimization proposals", (
         _GOALS,
         Parameter("ignore_proposal_cache", "ignore-proposal-cache", "bool"),

@@ -283,7 +283,10 @@ class RestApi:
                 **kw).to_json(verbose=verbose))
 
     def _load(self, params, client_id, request_url):
-        topo, assign = self.app._model()
+        # time: build the load view as of this epoch-ms (windows completed
+        # by then; LoadRunnable TIME_PARAM)
+        t = int(params["time"]) if params.get("time") else None
+        topo, assign = self.app._model(now_ms=t)
         from cruise_control_tpu.ops.aggregates import (
             compute_aggregates, device_topology)
         import numpy as np
@@ -325,8 +328,18 @@ class RestApi:
             str(params.get("resource", "disk")).lower(), res.DISK)
         n = int(params.get("entries", 50))
         lo = np.asarray(assign.leader_of)
-        leader_load = (topo.replica_base_load[lo]
-                       + topo.leader_extra)               # [P,4]
+        # max_load=true reports the MAX over metric windows instead of the
+        # collapsed average (PartitionLoadParameters max_load/avg_load
+        # booleans; model/Load.java:84-118 expectedUtilizationFor)
+        use_max = _parse_bool(params, "max_load", False)
+        windowed = use_max and topo.replica_base_load_windows is not None
+        if windowed:
+            win = (topo.replica_base_load_windows[lo]
+                   + topo.leader_extra_windows)           # [P,W,4]
+            leader_load = win.max(axis=1)
+        else:
+            leader_load = (topo.replica_base_load[lo]
+                           + topo.leader_extra)           # [P,4]
         keep = np.ones(leader_load.shape[0], bool)
         # partition range "N" or "N-M" (PartitionLoadParameters)
         prange = params.get("partition")
@@ -341,10 +354,10 @@ class RestApi:
             rx = re.compile(tpat)
             tmask = np.array([bool(rx.fullmatch(t)) for t in topo.topic_names])
             keep &= tmask[topo.topic_of_partition]
-        if params.get("min_load"):
-            keep &= leader_load[:, sort_res] >= float(params["min_load"])
-        if params.get("max_load"):
-            keep &= leader_load[:, sort_res] <= float(params["max_load"])
+        want = _parse_csv_ints(params, "brokerid")
+        if want:
+            bo_l = np.asarray(assign.broker_of)[lo]
+            keep &= np.isin(np.asarray(topo.broker_ids)[bo_l], want)
         masked = np.where(keep, leader_load[:, sort_res], -np.inf)
         order = np.argsort(-masked)[:min(n, int(keep.sum()))]
         bo = np.asarray(assign.broker_of)
@@ -363,7 +376,10 @@ class RestApi:
                 "networkInbound": float(leader_load[p, res.NW_IN]),
                 "networkOutbound": float(leader_load[p, res.NW_OUT]),
             })
-        return 200, {"records": records, "version": 1}
+        # maxWindowLoad says whether max_load semantics were actually honored
+        # (false = the model carries no windowed series, values are averages)
+        return 200, {"records": records, "maxWindowLoad": windowed,
+                     "version": 1}
 
     def _user_tasks(self, params, client_id, request_url):
         """UserTasksParameters: user_task_ids, client_ids, endpoints, types

@@ -263,3 +263,32 @@ def test_per_endpoint_type_task_retention():
     assert m.get(a.task_id) is None
     assert m.get(s.task_id) is not None    # global retention still holds
     m.close()
+
+
+def test_partition_load_max_window_and_broker_filter():
+    from cruise_control_tpu.server.rest import RestApi
+    app = _app()
+    api = RestApi(app)
+    code, avg_body = api.dispatch("GET", "PARTITION_LOAD",
+                                  {"resource": "network_inbound",
+                                   "entries": "100"})
+    assert code == 200
+    code, max_body = api.dispatch("GET", "PARTITION_LOAD",
+                                  {"resource": "network_inbound",
+                                   "entries": "100", "max_load": "true"})
+    assert code == 200
+    by_tp = {(r["topic"], r["partition"]): r["networkInbound"]
+             for r in avg_body["records"]}
+    # max-over-windows dominates the average for every partition
+    hits = 0
+    for r in max_body["records"]:
+        key = (r["topic"], r["partition"])
+        if key in by_tp:
+            assert r["networkInbound"] >= by_tp[key] - 1e-6
+            hits += 1
+    assert hits > 0
+    # brokerid filter: only partitions led by broker 0
+    code, body = api.dispatch("GET", "PARTITION_LOAD",
+                              {"brokerid": "0", "entries": "100"})
+    assert code == 200
+    assert body["records"] and all(r["leader"] == 0 for r in body["records"])
