@@ -366,6 +366,9 @@ class AnomalyDetectorService:
                 ready_at_ms=displaced_ready))
             self._seq += 1
             self.metrics["anomalies_detected"] += 1
+            from cruise_control_tpu.common.metrics import REGISTRY
+            REGISTRY.counter(
+                f"anomaly-rate-{anomaly.anomaly_type.value.lower()}")
 
     def sweep(self) -> int:
         """One detection pass over the detectors that are due. A detector
@@ -423,6 +426,8 @@ class AnomalyDetectorService:
                     fix_result = a.fix(self.context)
                     record["fixResult"] = bool(fix_result)
                     self.metrics["fixes_triggered"] += 1
+                    from cruise_control_tpu.common.metrics import REGISTRY
+                    REGISTRY.counter("self-healing-fix-rate")
                 except Exception as e:   # fix failures must not kill the loop
                     record["fixError"] = str(e)
                     self.metrics["fixes_failed"] += 1

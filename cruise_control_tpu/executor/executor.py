@@ -409,6 +409,7 @@ class Executor:
         helper = (ReplicationThrottleHelper(self.adapter, throttle)
                   if throttle is not None else None)
         intra_moves_applied = 0
+        crashed = True      # cleared on the clean path through the try
         try:
             # inside the try: a partial throttle-set failure must still clear
             # what was applied and release the executor state
@@ -435,6 +436,7 @@ class Executor:
                 f"Executing {len(planner.leadership_tasks)} leadership "
                 f"movements")
             self._move_leadership(planner, leader_concurrency)
+            crashed = False
         finally:
             if helper is not None:
                 helper.clear_throttles()
@@ -449,9 +451,15 @@ class Executor:
             self._execution_history.append(summary)
             self._state = ExecutorState.NO_TASK_IN_PROGRESS
             self._planner = None
-            if self._stop_requested.is_set():
+            from cruise_control_tpu.common.metrics import REGISTRY
+            if crashed:
+                REGISTRY.counter("execution-failed-rate")
+                self.notifier.on_execution_stopped(summary)
+            elif self._stop_requested.is_set():
+                REGISTRY.counter("execution-stopped-rate")
                 self.notifier.on_execution_stopped(summary)
             else:
+                REGISTRY.counter("execution-finished-rate")
                 self.notifier.on_execution_finished(summary)
         return summary
 

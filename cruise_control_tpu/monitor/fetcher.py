@@ -54,9 +54,20 @@ class MetricFetcherManager:
     def fetch(self, metadata: ClusterMetadata, start_ms: int, end_ms: int
               ) -> Tuple[List[PartitionMetricSample], List[BrokerMetricSample]]:
         """One sampling interval's fetch across all fetchers."""
+        from cruise_control_tpu.common.metrics import REGISTRY
         self.stats["fetches"] += 1
+        with REGISTRY.timer("partition-samples-fetcher-timer").time():
+            return self._fetch(metadata, start_ms, end_ms)
+
+    def _fetch(self, metadata, start_ms, end_ms):
+        from cruise_control_tpu.common.metrics import REGISTRY
         if self._pool is None:
-            return self._sampler.get_samples(metadata, start_ms, end_ms)
+            try:
+                return self._sampler.get_samples(metadata, start_ms, end_ms)
+            except Exception:
+                self.stats["failed_fetchers"] += 1
+                REGISTRY.counter("partition-samples-fetcher-failure-rate")
+                raise
         futures = [
             self._pool.submit(self._sampler.get_samples, md, start_ms, end_ms)
             for md in self.assign_partitions(metadata)]
@@ -73,6 +84,7 @@ class MetricFetcherManager:
                     ps, bs = f.result()
                 except Exception:
                     self.stats["failed_fetchers"] += 1
+                    REGISTRY.counter("partition-samples-fetcher-failure-rate")
                     continue        # this fetcher's slice is lost; carry on
                 psamples.extend(ps)
                 for b in bs:        # broker metrics dedupe across fetchers
@@ -84,6 +96,8 @@ class MetricFetcherManager:
             for f in futures:
                 f.cancel()
             self.stats["failed_fetchers"] += len(futures) - done
+            REGISTRY.counter("partition-samples-fetcher-failure-rate",
+                             len(futures) - done)
         return psamples, list(broker_samples.values())
 
     def close(self):
