@@ -20,6 +20,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+import logging
+logger = logging.getLogger(__name__)
+
 from cruise_control_tpu.analyzer import goals as G
 from cruise_control_tpu.analyzer import optimizer as OPT
 from cruise_control_tpu.analyzer.annealer import AnnealConfig
@@ -41,7 +44,11 @@ from cruise_control_tpu.executor.executor import (
 )
 from cruise_control_tpu.models.cluster import Assignment, ClusterTopology
 from cruise_control_tpu.monitor.aggregator import ModelCompletenessRequirements
-from cruise_control_tpu.monitor.load_monitor import LoadMonitor, MetadataSource
+from cruise_control_tpu.monitor.load_monitor import (
+    LoadMonitor,
+    MetadataSource,
+    NotEnoughValidWindowsError,
+)
 from cruise_control_tpu.monitor.sampler import MetricSampler
 
 
@@ -180,6 +187,10 @@ class CruiseControlApp:
             num_cached_states=config.get("num.cached.recent.anomaly.states"))
         self._proposal_cache: Optional[CachedProposals] = None
         self._cache_lock = threading.Lock()
+        self._precompute_thread: Optional[threading.Thread] = None
+        self._precompute_shutdown = threading.Event()
+        #: serializes the default-goal cacheable computation
+        self._compute_gate = threading.Lock()
         self._default_requirements = ModelCompletenessRequirements(
             min_required_num_windows=1,
             min_monitored_partitions_percentage=config.get(
@@ -192,10 +203,69 @@ class CruiseControlApp:
         self.load_monitor.startup(
             load_stored_samples=not self.config.get("skip.loading.samples"))
         self.anomaly_detector.start()
+        # proposal precompute loop (GoalOptimizer.run, GoalOptimizer.java:
+        # 126-176): keep the default-goal proposal cache warm so PROPOSALS /
+        # REBALANCE requests hit a ready result. Disabled with
+        # num.proposal.precompute.threads=0.
+        if self.config.get("num.proposal.precompute.threads") > 0:
+            self._precompute_shutdown.clear()
+            self._precompute_thread = threading.Thread(
+                target=self._precompute_loop, daemon=True,
+                name="proposal-precompute")
+            self._precompute_thread.start()
 
     def shutdown(self):
+        self._precompute_shutdown.set()
+        if self._precompute_thread is not None:
+            self._precompute_thread.join(timeout=5)
         self.anomaly_detector.shutdown()
         self.load_monitor.shutdown()
+
+    def _cached_result_if_fresh(self) -> Optional[OPT.OptimizerResult]:
+        """THE freshness rule (shared by the request path, the precompute
+        loop, and state reporting): same model generation and younger than
+        proposal.expiration.ms."""
+        with self._cache_lock:
+            c = self._proposal_cache
+            if c is None:
+                return None
+            gen = self.load_monitor.model_generation()
+            age = time.time() * 1000 - c.computed_at_ms
+            if (not c.generation.is_stale(gen)
+                    and age < self.config.get("proposal.expiration.ms")):
+                return c.result
+            return None
+
+    def _cache_is_fresh(self) -> bool:
+        return self._cached_result_if_fresh() is not None
+
+    def precompute_tick(self) -> bool:
+        """One precompute check: recompute the default-goal proposals when
+        the cache is missing/stale/expired. Returns True if it computed."""
+        if self._cache_is_fresh():
+            return False
+        if not self._compute_gate.acquire(blocking=False):
+            return False         # a request thread is already computing
+        try:
+            if self._cache_is_fresh():
+                return False
+            self._compute_and_cache()
+            return True
+        except NotEnoughValidWindowsError:
+            return False         # monitor not ready yet: expected at startup
+        except Exception:
+            logger.warning("proposal precompute failed", exc_info=True)
+            return False
+        finally:
+            self._compute_gate.release()
+
+    def _precompute_loop(self):
+        # re-check at a fraction of the expiration so a generation change is
+        # picked up promptly; the computation itself rate-limits the loop
+        interval_s = max(
+            1.0, min(self.config.get("proposal.expiration.ms") / 4000.0, 30.0))
+        while not self._precompute_shutdown.wait(interval_s):
+            self.precompute_tick()
 
     # ------------------------------------------------------------- optimize
 
@@ -324,31 +394,44 @@ class CruiseControlApp:
         use_cache = (not ignore_proposal_cache and not goal_names
                      and not option_kw and not data_from)
         if use_cache:
-            with self._cache_lock:
-                c = self._proposal_cache
-                if c is not None:
-                    gen = self.load_monitor.model_generation()
-                    age = time.time() * 1000 - c.computed_at_ms
-                    if (not c.generation.is_stale(gen)
-                            and age < self.config.get("proposal.expiration.ms")):
-                        # the cached result was computed on the same model
-                        # build the estimation gate refers to — enforce it
-                        # on cache hits too
-                        self._check_capacity_estimation(
-                            allow_capacity_estimation)
-                        return c.result
+            cached = self._cached_result_if_fresh()
+            if cached is not None:
+                # the cached result was computed on the same model build
+                # the estimation gate refers to — enforce it on hits too
+                self._check_capacity_estimation(allow_capacity_estimation)
+                return cached
+            # one default-goal computation at a time: concurrent requests
+            # (and the precompute tick) wait, then re-check the cache the
+            # winner just filled (GoalOptimizer._cacheLock semantics)
+            with self._compute_gate:
+                cached = self._cached_result_if_fresh()
+                if cached is not None:
+                    self._check_capacity_estimation(allow_capacity_estimation)
+                    return cached
+                return self._compute_and_cache(allow_capacity_estimation)
         topo, assign = self._model(data_from=data_from)
         self._check_capacity_estimation(allow_capacity_estimation)
         options = (self._build_options(topo, **option_kw)
                    if option_kw or self.config.get(
                        "topics.excluded.from.partition.movement")
                    else None)
-        result = self._optimize(topo, assign, goal_names, options)
-        if use_cache:
-            with self._cache_lock:
-                self._proposal_cache = CachedProposals(
-                    result, self.load_monitor.model_generation(),
-                    int(time.time() * 1000))
+        return self._optimize(topo, assign, goal_names, options)
+
+    def _compute_and_cache(self, allow_capacity_estimation: bool = True
+                           ) -> OPT.OptimizerResult:
+        """The default-goal cacheable computation (callers hold
+        ``_compute_gate``)."""
+        topo, assign = self._model()
+        self._check_capacity_estimation(allow_capacity_estimation)
+        options = (self._build_options(topo)
+                   if self.config.get(
+                       "topics.excluded.from.partition.movement")
+                   else None)
+        result = self._optimize(topo, assign, None, options)
+        with self._cache_lock:
+            self._proposal_cache = CachedProposals(
+                result, self.load_monitor.model_generation(),
+                int(time.time() * 1000))
         return result
 
     # ----------------------------------------------- operations (runnables)

@@ -419,3 +419,24 @@ def test_operation_progress_steps_populated():
     assert any("cluster model" in d for d in descs), descs
     assert any("Optimizing" in d for d in descs), descs
     assert any("proposals" in d for d in descs), descs
+
+
+def test_proposal_precompute_tick_warms_cache():
+    """GoalOptimizer precompute-loop parity (GoalOptimizer.java:126-176):
+    the tick computes when the cache is cold/stale, skips when fresh, and a
+    subsequent PROPOSALS request is served from the warmed cache."""
+    app = _app()
+    assert app._cache_is_fresh() is False
+    assert app.precompute_tick() is True          # cold → computes
+    assert app._cache_is_fresh() is True
+    assert app.precompute_tick() is False         # fresh → skips
+    cached = app._proposal_cache
+    r = app.proposals()
+    assert r is cached.result                     # request hits the cache
+    # a new metadata generation invalidates the cache for the next tick
+    import dataclasses as _dc
+    src = app._metadata_source
+    src.metadata = _dc.replace(src.metadata,
+                               generation=src.metadata.generation + 1)
+    assert app._cache_is_fresh() is False
+    assert app.precompute_tick() is True          # stale → recomputes
