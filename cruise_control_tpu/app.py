@@ -207,7 +207,12 @@ class CruiseControlApp:
         # 126-176): keep the default-goal proposal cache warm so PROPOSALS /
         # REBALANCE requests hit a ready result. Disabled with
         # num.proposal.precompute.threads=0.
-        if self.config.get("num.proposal.precompute.threads") > 0:
+        n_pre = self.config.get("num.proposal.precompute.threads")
+        if n_pre > 1:
+            logger.info("num.proposal.precompute.threads=%d: the device "
+                        "computation is serialized by the compute gate, so "
+                        "one precompute thread runs", n_pre)
+        if n_pre > 0:
             self._precompute_shutdown.clear()
             self._precompute_thread = threading.Thread(
                 target=self._precompute_loop, daemon=True,
@@ -264,6 +269,7 @@ class CruiseControlApp:
         # picked up promptly; the computation itself rate-limits the loop
         interval_s = max(
             1.0, min(self.config.get("proposal.expiration.ms") / 4000.0, 30.0))
+        self.precompute_tick()      # warm immediately, don't wait one interval
         while not self._precompute_shutdown.wait(interval_s):
             self.precompute_tick()
 
@@ -421,6 +427,10 @@ class CruiseControlApp:
                            ) -> OPT.OptimizerResult:
         """The default-goal cacheable computation (callers hold
         ``_compute_gate``)."""
+        # capture the generation BEFORE building the model: a metadata/sample
+        # change during the (long) optimization must leave the cache stale,
+        # not be masked by a post-compute generation read
+        gen0 = self.load_monitor.model_generation()
         topo, assign = self._model()
         self._check_capacity_estimation(allow_capacity_estimation)
         options = (self._build_options(topo)
@@ -430,8 +440,7 @@ class CruiseControlApp:
         result = self._optimize(topo, assign, None, options)
         with self._cache_lock:
             self._proposal_cache = CachedProposals(
-                result, self.load_monitor.model_generation(),
-                int(time.time() * 1000))
+                result, gen0, int(time.time() * 1000))
         return result
 
     # ----------------------------------------------- operations (runnables)
