@@ -579,17 +579,43 @@ class CruiseControlApp:
                        exclude_follower_demotion: bool = False,
                        allow_capacity_estimation: bool = True,
                        exclude_recently_demoted_brokers: bool = False,
+                       broker_id_and_logdirs: Optional[
+                           Dict[int, Sequence[str]]] = None,
                        executor_kw: Optional[dict] = None,
                        **kw) -> dict:
-        """DemoteBrokerRunnable: move leadership off the given brokers.
+        """DemoteBrokerRunnable: move leadership off the given brokers
+        and/or the given disks.
 
         ``skip_urp_demotion`` (DemoteBrokerParameters): leave partitions that
         are currently under-replicated (offline replicas) untouched.
         ``exclude_follower_demotion``: only leadership transfers, never
         follower reordering — this build's demotion is leadership-only, so
-        the flag is accepted and already satisfied by construction."""
+        the flag is accepted and already satisfied by construction.
+        ``broker_id_and_logdirs``: demote DISKS — partitions whose leader
+        replica resides on a named (broker, logdir) move leadership to the
+        first eligible other replica (DemoteBrokerRunnable.java:150-158,
+        disk DEMOTED state + PreferredLeaderElectionGoal)."""
         if self_healing:
             dryrun = False
+        if broker_id_and_logdirs and (
+                set(int(b) for b in broker_ids)
+                & set(int(b) for b in broker_id_and_logdirs)):
+            raise ValueError("Attempt to demote the broker and its disk in "
+                             "the same request is not allowed.")
+        if broker_id_and_logdirs:
+            # disk demotion (optionally combined with broker demotion): the
+            # deterministic PreferredLeaderElection walk covers both — any
+            # partition led from a demoted disk OR broker elects its first
+            # eligible replica (DemoteBrokerRunnable.java:150-158)
+            return self._demote_disks(
+                broker_id_and_logdirs,
+                demoted_broker_ids=set(int(b) for b in broker_ids),
+                dryrun=dryrun, verbose=verbose,
+                data_from=data_from, skip_urp_demotion=skip_urp_demotion,
+                allow_capacity_estimation=allow_capacity_estimation,
+                exclude_recently_demoted_brokers=(
+                    exclude_recently_demoted_brokers),
+                executor_kw=executor_kw)
         topo, assign = self._model(data_from=data_from)
         self._check_capacity_estimation(allow_capacity_estimation)
         ids = set(int(b) for b in broker_ids)
@@ -629,6 +655,107 @@ class CruiseControlApp:
         if not dryrun:
             summary["execution"] = self.executor.execute_proposals(
                 result.proposals, demoted_brokers=ids,
+                **(executor_kw or {}))
+        return summary
+
+    def _demote_disks(self, broker_id_and_logdirs: Dict[int, Sequence[str]],
+                      dryrun: bool, verbose: bool,
+                      data_from: Optional[str],
+                      skip_urp_demotion: bool,
+                      exclude_recently_demoted_brokers: bool,
+                      executor_kw: Optional[dict],
+                      demoted_broker_ids: Optional[set] = None,
+                      allow_capacity_estimation: bool = True) -> dict:
+        """Disk demotion: deterministic leadership election off the demoted
+        disks (the leadership-only core of PreferredLeaderElectionGoal with
+        the named disks in DEMOTED state). ``demoted_broker_ids`` extends
+        the walk to whole brokers for combined broker+disk requests."""
+        from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+        from cruise_control_tpu.common import resources as res
+        topo, assign = self._model(data_from=data_from)
+        self._check_capacity_estimation(allow_capacity_estimation)
+        if not topo.has_disks:
+            raise ValueError("cluster model has no JBOD disk information")
+        demoted_broker_ids = demoted_broker_ids or set()
+        name_to_disk = {}
+        for d in range(topo.num_disks):
+            b_row = int(topo.broker_of_disk[d])
+            name_to_disk[(int(topo.broker_ids[b_row]),
+                          topo.disk_names[d])] = d
+        demoted_disks = set()
+        for b, logdirs in broker_id_and_logdirs.items():
+            for ld in logdirs:
+                d = name_to_disk.get((int(b), ld))
+                if d is None:
+                    raise ValueError(f"Broker {b} does not have logdir {ld}.")
+                demoted_disks.add(d)
+        no_leadership_brokers = (self.executor.recently_demoted_brokers
+                                 if exclude_recently_demoted_brokers
+                                 else set())
+        urp = ({f"{p.topic}-{p.partition}"
+                for p in self._metadata_source.get_metadata().partitions
+                if p.offline_replicas} if skip_urp_demotion else set())
+
+        bo = np.asarray(assign.broker_of)
+        lo = np.asarray(assign.leader_of)
+        dof = topo.disk_of_replica
+        proposals = []
+        skipped: List[str] = []
+        for pi in range(topo.num_partitions):
+            leader_r = int(lo[pi])
+            leader_ext = int(topo.broker_ids[bo[leader_r]])
+            if (int(dof[leader_r]) not in demoted_disks
+                    and leader_ext not in demoted_broker_ids):
+                continue
+            topic = topo.topic_names[topo.topic_of_partition[pi]]
+            part = int(topo.partition_index[pi])
+            if f"{topic}-{part}" in urp:
+                continue
+            slots = topo.replicas_of_partition[pi]
+            slots = slots[slots >= 0]
+            # first eligible replica in preferred order: alive broker, disk
+            # not demoted, broker not leadership-excluded
+            new_leader_r = None
+            for r in slots:
+                r = int(r)
+                if r == leader_r:
+                    continue
+                b_row = int(bo[r])
+                b_ext = int(topo.broker_ids[b_row])
+                if (topo.broker_alive[b_row]
+                        and int(dof[r]) not in demoted_disks
+                        and b_ext not in demoted_broker_ids
+                        and b_ext not in no_leadership_brokers):
+                    new_leader_r = r
+                    break
+            if new_leader_r is None:
+                skipped.append(f"{topic}-{part}")
+                continue            # no eligible replica: leadership stays
+            ext = [int(topo.broker_ids[bo[int(r)]]) for r in slots]
+            old_leader = int(topo.broker_ids[bo[leader_r]])
+            new_first = int(topo.broker_ids[bo[new_leader_r]])
+            new_order = ([new_first]
+                         + [b for b in ext if b != new_first])
+            proposals.append(ExecutionProposal(
+                topic=topic, partition=part, old_leader=old_leader,
+                old_replicas=tuple([old_leader]
+                                   + [b for b in ext if b != old_leader]),
+                new_replicas=tuple(new_order),
+                data_size=float(topo.replica_base_load[leader_r, res.DISK])))
+        summary = {
+            "proposals": [p.to_json() for p in proposals],
+            "numReplicaMovements": 0,
+            "numLeadershipMovements": len(proposals),
+            "demotedDisks": [f"{b}-{ld}"
+                             for b, lds in broker_id_and_logdirs.items()
+                             for ld in lds],
+            "demotedBrokers": sorted(demoted_broker_ids),
+        }
+        if verbose:
+            summary["partitionsWithoutEligibleLeader"] = skipped
+        if not dryrun:
+            summary["execution"] = self.executor.execute_proposals(
+                proposals, demoted_brokers=demoted_broker_ids,
                 **(executor_kw or {}))
         return summary
 

@@ -128,7 +128,9 @@ ENDPOINTS: List[Endpoint] = [
         Parameter("start", "start", "int", "Range start ms"),
         Parameter("end", "end", "int", "Range end ms"),), is_async=True),
     Endpoint("train", "GET", "Train the CPU estimation model", (
-        Parameter("start", "start", "int"), Parameter("end", "end", "int"),)),
+        Parameter("start", "start", "int"), Parameter("end", "end", "int"),
+        Parameter("clearmetrics", "clearmetrics", "bool",
+                  "Clear previous training samples (default true)"),)),
     Endpoint("rebalance", "POST", "Rebalance the cluster", (
         _DRYRUN, _GOALS,
         Parameter("excluded_topics", "excluded-topics", "csv"),
@@ -152,6 +154,8 @@ ENDPOINTS: List[Endpoint] = [
               *_EXECUTOR), is_async=True),
     Endpoint("demote_broker", "POST", "Move leadership off brokers",
              (_BROKERS, _DRYRUN,
+              Parameter("brokerid_and_logdirs", "broker-logdirs", "csv",
+                        "Demote disks: brokerId-logdir pairs"),
               Parameter("skip_urp_demotion", "skip-urp-demotion", "bool"),
               Parameter("exclude_follower_demotion",
                         "exclude-follower-demotion", "bool"),

@@ -275,20 +275,28 @@ class LoadMonitor:
         finally:
             self._state = prev
 
-    def train(self, start_ms: int, end_ms: int) -> dict:
+    def train(self, start_ms: int, end_ms: int,
+              clear_metrics: bool = True) -> dict:
         """TrainingTask (LoadMonitorTaskRunner.java:138-188): sample the
         historical range, fit the linear-regression CPU model from the
         broker samples (LinearRegressionModelParameters.java:81), and — when
         ``use.linear.regression.model`` — install it in the sampler so
         subsequent partition CPU estimation uses the trained coefficients.
+
+        ``clear_metrics`` (TRAIN clearmetrics, default true): start from an
+        empty training set; false accumulates onto previous TRAIN calls'
+        samples, refitting over the union.
         """
         from cruise_control_tpu.models.cluster import LinearRegressionCpuModel
         prev = self._state
         self._state = MonitorState.TRAINING
-        lbi: list = []
-        lbo: list = []
-        fbi: list = []
-        cpu: list = []
+        # accumulation lists are instance state (clearmetrics=false spans
+        # TRAIN calls) → fetch+append+fit under the monitor lock so two
+        # concurrent TRAIN tasks cannot interleave feature/target rows
+        self._lock.acquire()
+        if clear_metrics or not hasattr(self, "_train_acc"):
+            self._train_acc = ([], [], [], [])
+        lbi, lbo, fbi, cpu = self._train_acc
         try:
             t = start_ms
             while t < end_ms:
@@ -311,6 +319,7 @@ class LoadMonitor:
             if self.cpu_model.trained and self._use_lr_model:
                 self._sampler.set_cpu_model(self.cpu_model)
         finally:
+            self._lock.release()
             self._state = prev
         return self.cpu_model.to_json()
 

@@ -445,9 +445,11 @@ class RestApi:
         if end - start > max_span:
             return 400, {"errorMessage":
                          f"training range too large (max {max_span} ms)"}
+        clear = _parse_bool(params, "clearmetrics", True)
         return self._async_op(
             "TRAIN", params, client_id, request_url,
-            lambda: {"train": self.app.load_monitor.train(start, end),
+            lambda: {"train": self.app.load_monitor.train(
+                         start, end, clear_metrics=clear),
                      "startMs": start, "endMs": end})
 
     # ------------------------------------------------------------ POST
@@ -523,11 +525,26 @@ class RestApi:
 
     def _demote_broker(self, params, client_id, request_url):
         ids = _parse_csv_ints(params, "brokerid")
-        if not ids:
-            return 400, {"errorMessage": "brokerid parameter required"}
         dry = _parse_bool(params, "dryrun", True)
         verbose = _parse_bool(params, "verbose", False)
         df = params.get("data_from")
+        # brokerid_and_logdirs=b1-logdir1,b2-logdir2 (disk demotion;
+        # broker id before the FIRST dash, logdir may itself contain dashes)
+        bld = {}
+        if params.get("brokerid_and_logdirs"):
+            for ent in str(params["brokerid_and_logdirs"]).split(","):
+                ent = ent.strip()
+                if not ent:
+                    continue
+                b, _, ld = ent.partition("-")
+                if not ld or not b.isdigit():
+                    return 400, {"errorMessage":
+                                 f"bad brokerid_and_logdirs entry {ent!r}; "
+                                 "expected brokerId-logdir"}
+                bld.setdefault(int(b), []).append(ld)
+        if not ids and not bld:
+            return 400, {"errorMessage": "brokerid or brokerid_and_logdirs "
+                                         "parameter required"}
         skip_urp = _parse_bool(params, "skip_urp_demotion", False)
         excl_follower = _parse_bool(params, "exclude_follower_demotion",
                                     False)
@@ -542,6 +559,7 @@ class RestApi:
                                   exclude_follower_demotion=excl_follower,
                                   allow_capacity_estimation=ace,
                                   exclude_recently_demoted_brokers=erd,
+                                  broker_id_and_logdirs=bld or None,
                                   executor_kw=ek))
 
     def _fix_offline_replicas(self, params, client_id, request_url):

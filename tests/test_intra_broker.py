@@ -105,3 +105,48 @@ def test_kafka_assigner_disk_distribution():
     before = np.zeros(topo.num_brokers)
     np.add.at(before, np.asarray(assign.broker_of), dload)
     assert load.std() < before.std()
+
+
+def test_demote_disks_moves_leadership_off_named_logdirs():
+    """DemoteBrokerRunnable disk demotion (brokerid_and_logdirs): partitions
+    led from a demoted (broker, logdir) move leadership to the first
+    eligible other replica; replicas never move."""
+    from tests.test_server import _app
+    topo, assign = _jbod_model()
+    app = _app()
+    app._model = lambda **kw: (topo, assign)   # JBOD model under the app
+
+    out = app.demote_brokers([], broker_id_and_logdirs={0: ["/d1"]},
+                             dryrun=True)
+    # every broker-0 leader replica lives on /d1 → all 6 partitions demote
+    assert out["numLeadershipMovements"] == 6
+    assert out["numReplicaMovements"] == 0
+    for p in out["proposals"]:
+        assert p["newReplicas"][0] == 1          # leadership to broker 1
+        assert set(p["newReplicas"]) == set(p["oldReplicas"])
+
+    # unknown logdir is rejected
+    with pytest.raises(ValueError, match="does not have logdir"):
+        app.demote_brokers([], broker_id_and_logdirs={0: ["/nope"]})
+    # demoting a broker and its disk together is rejected
+    with pytest.raises(ValueError, match="not allowed"):
+        app.demote_brokers([0], broker_id_and_logdirs={0: ["/d1"]})
+
+
+def test_demote_broker_and_disk_combined():
+    """Combined broker+disk demotion: partitions led by the demoted broker
+    AND partitions led from the demoted disk both elect new leaders; a
+    replica on either is never an eligible target."""
+    from tests.test_server import _app
+    topo, assign = _jbod_model()
+    app = _app()
+    app._model = lambda **kw: (topo, assign)
+    # broker 0 leads everything; demote broker 1's /d2 (no leaders there) +
+    # broker 0 itself → all leadership must land on broker 1 (its /d1)
+    out = app.demote_brokers([0], broker_id_and_logdirs={1: ["/d2"]},
+                             dryrun=True, verbose=True)
+    assert out["numLeadershipMovements"] == 6
+    assert out["demotedBrokers"] == [0]
+    for p in out["proposals"]:
+        assert p["newReplicas"][0] == 1
+    assert out["partitionsWithoutEligibleLeader"] == []
