@@ -187,6 +187,12 @@ class FakeClusterAdapter(ClusterAdapter):
             n, leader = self._pending_ple[tp]
             if n <= 1:
                 self.leaders[tp] = leader
+                # the real adapter writes the leader-first reorder before the
+                # election; mirror it so order-sensitive logic sees the same
+                reps = self.replicas.get(tp)
+                if reps and leader in reps:
+                    self.replicas[tp] = tuple(
+                        [leader] + [b for b in reps if b != leader])
                 del self._pending_ple[tp]
             else:
                 self._pending_ple[tp] = (n - 1, leader)

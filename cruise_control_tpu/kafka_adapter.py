@@ -85,6 +85,33 @@ class KafkaMetadataSource(MetadataSource):
                                generation=self._generation)
 
 
+class KafkaMetricsTransport:
+    """Reporter transport producing serialized records to the metrics topic
+    (the reference's default wire: CruiseControlMetricsReporter produces to
+    ``__CruiseControlMetrics``; KafkaMetricsTopicSampler consumes it)."""
+
+    def __init__(self, config, topic: str = METRICS_TOPIC, producer=None):
+        self.topic = topic
+        if producer is not None:    # injectable for tests
+            self._producer = producer
+        else:
+            kafka = _require_kafka()
+            self._producer = kafka.KafkaProducer(
+                bootstrap_servers=config.get("bootstrap.servers"),
+                value_serializer=lambda d: json.dumps(d).encode())
+
+    def send(self, records) -> None:
+        for r in records:
+            self._producer.send(self.topic, r.to_json())
+        self._producer.flush()
+
+    def close(self):
+        try:
+            self._producer.close()
+        except Exception:
+            pass
+
+
 class KafkaMetricsTopicSampler(MetricSampler):
     """Consume raw reporter records and fold them into samples
     (CruiseControlMetricsProcessor.process, :102)."""
@@ -205,6 +232,20 @@ class KafkaClusterAdapter:
         self._admin.alter_partition_reassignments(assignments)
 
     def execute_preferred_leader_elections(self, tasks):
+        """Leadership movement against real Kafka is TWO steps: preferred
+        election only promotes the FIRST replica of the stored assignment,
+        so a leadership-only proposal (same broker set, new order) must
+        first write the reorder — a no-data-movement reassignment — and
+        then trigger the election. Skipping the reorder re-elects the old
+        leader and the task would spin to its timeout."""
+        reorders = {}
+        for t in tasks:
+            want = list(t.proposal.new_replicas)
+            old = list(t.proposal.old_replicas)
+            if old != want and set(old) == set(want):
+                reorders[(t.proposal.topic, t.proposal.partition)] = want
+        if reorders:
+            self._admin.alter_partition_reassignments(reorders)
         parts = [(t.proposal.topic, t.proposal.partition) for t in tasks]
         self._admin.perform_leader_election("PREFERRED", parts)
 

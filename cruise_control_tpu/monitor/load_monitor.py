@@ -143,6 +143,7 @@ class LoadMonitor:
         self._shutdown = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._model_semaphore = threading.Semaphore(2)
+        self._train_lock = threading.Lock()
         self._bootstrap_progress: Optional[float] = None
         # trained CPU model (TRAIN endpoint / LinearRegressionModelParameters)
         from cruise_control_tpu.models.cluster import LinearRegressionCpuModel
@@ -288,15 +289,20 @@ class LoadMonitor:
         samples, refitting over the union.
         """
         from cruise_control_tpu.models.cluster import LinearRegressionCpuModel
+        # one TRAIN at a time (its own lock — pause/resume stay responsive
+        # during a long historical fetch); prev-state captured under the
+        # lock so serialized TRAINs restore the true pre-training state
+        self._train_lock.acquire()
         prev = self._state
         self._state = MonitorState.TRAINING
-        # accumulation lists are instance state (clearmetrics=false spans
-        # TRAIN calls) → fetch+append+fit under the monitor lock so two
-        # concurrent TRAIN tasks cannot interleave feature/target rows
-        self._lock.acquire()
         if clear_metrics or not hasattr(self, "_train_acc"):
             self._train_acc = ([], [], [], [])
-        lbi, lbo, fbi, cpu = self._train_acc
+        # fetch into LOCALS; merge into the accumulator only on success so a
+        # failed range never pollutes later clearmetrics=false fits
+        lbi: list = []
+        lbo: list = []
+        fbi: list = []
+        cpu: list = []
         try:
             t = start_ms
             while t < end_ms:
@@ -315,12 +321,15 @@ class LoadMonitor:
                 for s in bs:
                     self._ingest_broker_sample(s)
                 t = step_end
-            self.cpu_model = LinearRegressionCpuModel.fit(lbi, lbo, fbi, cpu)
+            acc = self._train_acc
+            acc[0].extend(lbi); acc[1].extend(lbo)
+            acc[2].extend(fbi); acc[3].extend(cpu)
+            self.cpu_model = LinearRegressionCpuModel.fit(*acc)
             if self.cpu_model.trained and self._use_lr_model:
                 self._sampler.set_cpu_model(self.cpu_model)
         finally:
-            self._lock.release()
             self._state = prev
+            self._train_lock.release()
         return self.cpu_model.to_json()
 
     def bootstrap(self, start_ms: int, end_ms: int):

@@ -86,6 +86,25 @@ class InMemoryMetricsTransport(MetricsTransport):
         self.records.extend(records)
 
 
+class HttpMetricsTransport(MetricsTransport):
+    """POSTs each batch as a JSON array to a collector URL. Send failures
+    raise to the caller — the reporting loop already drops a failed
+    interval and carries on (CruiseControlMetricsReporter.run swallows and
+    logs per-interval errors the same way)."""
+
+    def __init__(self, url: str, timeout_s: float = 10.0):
+        self.url = url
+        self.timeout_s = timeout_s
+
+    def send(self, records):
+        import urllib.request
+        data = json.dumps([r.to_json() for r in records]).encode()
+        req = urllib.request.Request(
+            self.url, data=data, method="POST",
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=self.timeout_s).read()
+
+
 class BrokerMetricsSource:
     """Reads the co-located broker's current metric values:
     {raw_metric_type: value} for broker metrics and

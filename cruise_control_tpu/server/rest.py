@@ -545,6 +545,10 @@ class RestApi:
         if not ids and not bld:
             return 400, {"errorMessage": "brokerid or brokerid_and_logdirs "
                                          "parameter required"}
+        if bld and set(ids) & set(bld):
+            return 400, {"errorMessage":
+                         "Attempt to demote the broker and its disk in the "
+                         "same request is not allowed."}
         skip_urp = _parse_bool(params, "skip_urp_demotion", False)
         excl_follower = _parse_bool(params, "exclude_follower_demotion",
                                     False)

@@ -173,3 +173,42 @@ def test_reassignments_and_elections(fake_kafka):
     ad.execute_preferred_leader_elections([t2])
     kind, mode, parts = ad._admin.calls[-1]
     assert kind == "election" and parts == [("T", 3)]
+
+
+def test_ple_writes_reorder_before_election():
+    """Leadership-only proposals against real Kafka must write the replica
+    reorder (no-data-movement reassignment) before the preferred election —
+    otherwise the old first replica is re-elected."""
+    import types
+    from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+    from cruise_control_tpu.kafka_adapter import KafkaClusterAdapter
+
+    calls = []
+
+    class FakeAdmin:
+        def alter_partition_reassignments(self, assignments):
+            calls.append(("reassign", dict(assignments)))
+
+        def perform_leader_election(self, kind, parts):
+            calls.append(("elect", kind, list(parts)))
+
+        def describe_topics(self, topics):
+            return [{"topic": topics[0],
+                     "partitions": [{"partition": 0, "replicas": [1, 2],
+                                     "leader": 1}]}]
+
+    ad = KafkaClusterAdapter.__new__(KafkaClusterAdapter)
+    ad._admin = FakeAdmin()
+    prop = ExecutionProposal(topic="T", partition=0, old_leader=1,
+                             old_replicas=(1, 2), new_replicas=(2, 1),
+                             data_size=1.0)
+    task = types.SimpleNamespace(proposal=prop)
+    # replica-set change (not a pure reorder) must NOT resubmit reassignment
+    prop2 = ExecutionProposal(topic="T", partition=1, old_leader=1,
+                              old_replicas=(1, 2), new_replicas=(3, 2),
+                              data_size=1.0)
+    task2 = types.SimpleNamespace(proposal=prop2)
+    ad.execute_preferred_leader_elections([task, task2])
+    assert calls[0] == ("reassign", {("T", 0): [2, 1]})
+    assert calls[1][0] == "elect" and calls[1][1] == "PREFERRED"
+    assert ("T", 1) not in calls[0][1]

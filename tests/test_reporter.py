@@ -187,3 +187,60 @@ def test_partition_metrics_direct_call_lazily_walks():
     reg.gauge("PARTITION_SIZE", lambda: 77.0, topic="T", partition=0)
     src = RegistryMetricsSource(reg)
     assert src.partition_metrics()[("PARTITION_SIZE", "T", 0)] == 77.0
+
+
+def test_http_metrics_transport_round_trip():
+    """HttpMetricsTransport POSTs the batch as JSON to a collector URL."""
+    import json as _json
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+    from cruise_control_tpu.reporter import HttpMetricsTransport
+    received = []
+
+    class H(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers["Content-Length"])
+            received.append(_json.loads(self.rfile.read(n).decode()))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        t = HttpMetricsTransport(f"http://127.0.0.1:{srv.server_address[1]}/")
+        rep = MetricsReporter(3, FakeSource(), t, now_fn=lambda: 77)
+        n = rep.report_once()
+        assert n == 9
+        assert len(received) == 1 and len(received[0]) == 9
+        assert received[0][0]["brokerId"] == 3
+    finally:
+        srv.shutdown()
+
+
+def test_kafka_metrics_transport_with_fake_producer():
+    from cruise_control_tpu.kafka_adapter import KafkaMetricsTransport
+
+    class FakeProducer:
+        def __init__(self):
+            self.sent = []
+            self.flushed = 0
+
+        def send(self, topic, value):
+            self.sent.append((topic, value))
+
+        def flush(self):
+            self.flushed += 1
+
+        def close(self):
+            pass
+
+    prod = FakeProducer()
+    t = KafkaMetricsTransport(config=None, producer=prod)
+    MetricsReporter(5, FakeSource(), t, now_fn=lambda: 9).report_once()
+    assert len(prod.sent) == 9 and prod.flushed == 1
+    assert all(topic == "__CruiseControlMetrics" for topic, _ in prod.sent)
+    assert prod.sent[0][1]["brokerId"] == 5
