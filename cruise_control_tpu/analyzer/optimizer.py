@@ -98,6 +98,9 @@ class OptimizerResult:
     engine: str
     wall_time_s: float
     final_assignment: Assignment = None
+    #: per-broker utilization rows before/after (response/stats BrokerStats)
+    broker_stats_before: Optional[List[dict]] = None
+    broker_stats_after: Optional[List[dict]] = None
 
     @property
     def violated_goals_before(self) -> List[str]:
@@ -127,9 +130,15 @@ class OptimizerResult:
         }
         if verbose:
             # servlet/response/stats BrokerStats "Statistics" payloads:
-            # the full ClusterModelStats before and after optimization
+            # the full ClusterModelStats before and after optimization,
+            # plus the per-broker utilization rows
             out["clusterModelStatsBeforeOptimization"] = self.stats_before
             out["clusterModelStatsAfterOptimization"] = self.stats_after
+            if self.broker_stats_before is not None:
+                out["loadBeforeOptimization"] = {
+                    "brokers": self.broker_stats_before}
+                out["loadAfterOptimization"] = {
+                    "brokers": self.broker_stats_after}
             out["goalSummaryDetail"] = [
                 {"goal": s.name, "hard": s.hard,
                  "violationsBefore": s.violations_before,
@@ -137,6 +146,35 @@ class OptimizerResult:
                  "costBefore": s.cost_before, "costAfter": s.cost_after}
                 for s in self.goal_summaries]
         return out
+
+
+def _broker_rows(dt, topo, assign, agg=None) -> List[dict]:
+    """Per-broker rows of the BrokerStats payload
+    (servlet/response/stats/BrokerStats.java): utilization per resource +
+    replica/leader counts + potential NW out."""
+    from cruise_control_tpu.common import resources as res
+    if agg is None:
+        agg = compute_aggregates(dt, assign, 1)
+    broker_ids = (topo.broker_ids if topo.broker_ids is not None
+                  else list(range(topo.num_brokers)))
+    load = np.asarray(jax.device_get(agg.broker_load))
+    cnt = np.asarray(jax.device_get(agg.replica_count))
+    lead = np.asarray(jax.device_get(agg.leader_count))
+    pot = np.asarray(jax.device_get(agg.potential_nw_out))
+    rows = []
+    for i in range(topo.num_brokers):
+        rows.append({
+            "Broker": int(broker_ids[i]),
+            "BrokerState": "ALIVE" if topo.broker_alive[i] else "DEAD",
+            "Replicas": int(cnt[i]),
+            "Leaders": int(lead[i]),
+            "CpuPct": round(float(load[i, res.CPU]), 3),
+            "DiskMB": round(float(load[i, res.DISK]), 3),
+            "NwInRate": round(float(load[i, res.NW_IN]), 3),
+            "NwOutRate": round(float(load[i, res.NW_OUT]), 3),
+            "PnwOutRate": round(float(pot[i]), 3),
+        })
+    return rows
 
 
 def _stats_dict(dt, assign, constraint, num_topics,
@@ -258,6 +296,11 @@ def optimize(topo: ClusterTopology, assign: Assignment,
     proposal_timer.update(time.time() - t0)
     return OptimizerResult(
         proposals=props,
+        # the reference's OptimizerResult also carries broker stats on every
+        # computation; the after-rows cost one [B] aggregate pass (~1% of the
+        # bench budget), before-rows reuse agg0
+        broker_stats_before=_broker_rows(dt, topo, assign, agg=agg0),
+        broker_stats_after=_broker_rows(dt, topo, final),
         goal_summaries=summaries,
         stats_before=stats_before,
         stats_after=stats_after,

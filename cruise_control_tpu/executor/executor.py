@@ -125,7 +125,7 @@ class FakeClusterAdapter(ClusterAdapter):
     def execute_preferred_leader_elections(self, tasks):
         for t in tasks:
             self._pending_ple[t.proposal.topic_partition] = (
-                self.latency, t.proposal.new_replicas[0])
+                self.latency, t.proposal.new_replicas)
 
     def current_replicas(self, tp):
         self._tick(tp)
@@ -184,18 +184,17 @@ class FakeClusterAdapter(ClusterAdapter):
             else:
                 self._pending[tp] = (n - 1, target)
         if tp in self._pending_ple:
-            n, leader = self._pending_ple[tp]
+            n, new_order = self._pending_ple[tp]
             if n <= 1:
-                self.leaders[tp] = leader
-                # the real adapter writes the leader-first reorder before the
-                # election; mirror it so order-sensitive logic sees the same
+                self.leaders[tp] = new_order[0]
+                # the real adapter writes the FULL proposal order before the
+                # election; mirror it exactly when it is a pure reorder
                 reps = self.replicas.get(tp)
-                if reps and leader in reps:
-                    self.replicas[tp] = tuple(
-                        [leader] + [b for b in reps if b != leader])
+                if reps and set(reps) == set(new_order):
+                    self.replicas[tp] = tuple(new_order)
                 del self._pending_ple[tp]
             else:
-                self._pending_ple[tp] = (n - 1, leader)
+                self._pending_ple[tp] = (n - 1, new_order)
 
 
 class ReplicationThrottleHelper:

@@ -207,6 +207,11 @@ class LoadMonitor:
             if self._state in (MonitorState.RUNNING, MonitorState.SAMPLING):
                 self._state = MonitorState.PAUSED
                 self._pause_reason = reason
+            elif self._state == MonitorState.TRAINING:
+                # a pause issued during TRAIN takes effect when training
+                # finishes (train() restores this instead of its prev state)
+                self._pause_after_training = reason
+                self._pause_reason = reason
 
     def resume(self, reason: str = "Resumed by user"):
         with self._lock:
@@ -294,6 +299,7 @@ class LoadMonitor:
         # lock so serialized TRAINs restore the true pre-training state
         self._train_lock.acquire()
         prev = self._state
+        self._pause_after_training: Optional[str] = None
         self._state = MonitorState.TRAINING
         if clear_metrics or not hasattr(self, "_train_acc"):
             self._train_acc = ([], [], [], [])
@@ -328,7 +334,11 @@ class LoadMonitor:
             if self.cpu_model.trained and self._use_lr_model:
                 self._sampler.set_cpu_model(self.cpu_model)
         finally:
-            self._state = prev
+            with self._lock:
+                self._state = (MonitorState.PAUSED
+                               if self._pause_after_training is not None
+                               else prev)
+                self._pause_after_training = None
             self._train_lock.release()
         return self.cpu_model.to_json()
 

@@ -102,7 +102,8 @@ class HttpMetricsTransport(MetricsTransport):
         req = urllib.request.Request(
             self.url, data=data, method="POST",
             headers={"Content-Type": "application/json"})
-        urllib.request.urlopen(req, timeout=self.timeout_s).read()
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            resp.read()
 
 
 class BrokerMetricsSource:

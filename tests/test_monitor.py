@@ -6,6 +6,7 @@ building from a fake metadata source + synthetic sampler.
 
 import json
 import os
+import time
 
 import numpy as np
 import pytest
@@ -425,3 +426,35 @@ def test_metric_fetcher_manager_partition_assignment():
         lm.sample_once(now_ms=w * W + 30_000)
     topo, assign = lm.cluster_model(now_ms=3 * W)
     assert topo.num_partitions == len(md_src.partitions)
+
+
+def test_pause_during_training_takes_effect_after():
+    """A pause issued while TRAIN holds the monitor in TRAINING state must
+    not be silently dropped: it applies when training finishes."""
+    import threading as _t
+    from cruise_control_tpu.monitor.load_monitor import (
+        LoadMonitor, MonitorState, StaticMetadataSource)
+    from cruise_control_tpu.monitor.sampler import SyntheticLoadSampler
+    lm = LoadMonitor(StaticMetadataSource(_metadata()),
+                     SyntheticLoadSampler(seed=2), window_ms=W)
+    lm._state = MonitorState.RUNNING
+    gate = _t.Event()
+    orig_fetch = lm._fetchers.fetch
+
+    def slow_fetch(md, s, e):
+        gate.wait(5)
+        return orig_fetch(md, s, e)
+
+    lm._fetchers.fetch = slow_fetch
+    th = _t.Thread(target=lambda: lm.train(0, W))
+    th.start()
+    for _ in range(100):
+        if lm.state == MonitorState.TRAINING:
+            break
+        time.sleep(0.01)
+    lm.pause("maintenance")
+    gate.set()
+    th.join(timeout=10)
+    assert lm.state == MonitorState.PAUSED       # pause survived training
+    lm.resume()
+    assert lm.state == MonitorState.RUNNING

@@ -440,3 +440,22 @@ def test_proposal_precompute_tick_warms_cache():
                                generation=src.metadata.generation + 1)
     assert app._cache_is_fresh() is False
     assert app.precompute_tick() is True          # stale → recomputes
+
+
+def test_verbose_response_has_per_broker_stats():
+    """response/stats BrokerStats parity: verbose proposals carry per-broker
+    before/after rows; total replica counts are conserved."""
+    app = _app()
+    r = app.proposals(ignore_proposal_cache=True)
+    body = r.to_json(verbose=True)
+    before = body["loadBeforeOptimization"]["brokers"]
+    after = body["loadAfterOptimization"]["brokers"]
+    assert len(before) == len(after) == 6
+    assert sum(b["Replicas"] for b in before) == sum(
+        b["Replicas"] for b in after) == 60
+    assert sum(b["Leaders"] for b in after) == 30
+    for row in after:
+        assert {"Broker", "BrokerState", "CpuPct", "DiskMB", "NwInRate",
+                "NwOutRate", "PnwOutRate"} <= set(row)
+    # non-verbose responses stay lean
+    assert "loadBeforeOptimization" not in r.to_json(verbose=False)
