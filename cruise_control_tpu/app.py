@@ -123,9 +123,8 @@ class CruiseControlApp:
                 execution_progress_check_interval_ms=check_ms,
                 default_replication_throttle=config.get(
                     "default.replication.throttle"),
-                leadership_movement_timeout_rounds=max(
-                    1, int(config.get("leader.movement.timeout.ms")
-                           // max(check_ms, 1))),
+                leader_movement_timeout_ms=config.get(
+                    "leader.movement.timeout.ms"),
                 task_execution_alerting_threshold_ms=config.get(
                     "task.execution.alerting.threshold.ms"),
                 removal_history_retention_ms=config.get(
@@ -332,12 +331,15 @@ class CruiseControlApp:
     def _sanity_check_goals(self, goal_names: Optional[Sequence[str]],
                             skip_hard_goal_check: bool) -> None:
         """RunnableUtils.sanityCheckGoals: a request naming a custom goal
-        list must include every configured hard goal unless
-        skip_hard_goal_check=true."""
+        list must include EVERY configured hard goal (not just those also in
+        default.goals — KafkaCruiseControlUtils.java:179-190) unless
+        skip_hard_goal_check=true. A lone PreferredLeaderElectionGoal list is
+        exempt, matching the reference's special case."""
         if not goal_names or skip_hard_goal_check:
             return
-        hard = [g for g in self.config.get("hard.goals")
-                if g in self.default_goals]
+        if list(goal_names) == ["PreferredLeaderElectionGoal"]:
+            return
+        hard = list(self.config.get("hard.goals"))
         missing = [g for g in hard if g not in goal_names]
         if missing:
             raise ValueError(

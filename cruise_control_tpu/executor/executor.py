@@ -261,7 +261,10 @@ class ExecutorConfig:
     execution_progress_check_interval_ms: int = 10
     max_execution_progress_check_rounds: int = 10_000
     default_replication_throttle: Optional[int] = None
-    leadership_movement_timeout_rounds: int = 100
+    #: leader.movement.timeout.ms — wall-clock bound on one leadership batch;
+    #: the round budget is derived from the EFFECTIVE check interval at
+    #: execution time so a per-request interval override cannot stretch it
+    leader_movement_timeout_ms: int = 180_000
     #: warn when a single task stays in flight past this
     #: (task.execution.alerting.threshold.ms)
     task_execution_alerting_threshold_ms: int = 90_000
@@ -378,16 +381,10 @@ class Executor:
         replica moves, intra-broker logdir moves (``logdir_moves``), then
         leadership moves.
         """
-        with self._lock:
-            if self.has_ongoing_execution:
-                raise RuntimeError("An execution is already in progress")
-            self._state = ExecutorState.STARTING_EXECUTION
-        self._stop_requested.clear()
-        self._force_stop.clear()
-        self._timed_out = False
-        t0 = time.time()
         # per-request overrides (ParameterUtils: replica_movement_strategies,
-        # execution_progress_check_interval_ms, concurrent_leader_movements)
+        # execution_progress_check_interval_ms, concurrent_leader_movements).
+        # Resolved BEFORE any state transition: an unknown strategy name must
+        # reject the request, not wedge the executor in STARTING_EXECUTION.
         strategy = self._strategy
         if strategy_names:
             from cruise_control_tpu.executor.tasks import STRATEGIES
@@ -399,20 +396,35 @@ class Executor:
                                      f"{name!r}; valid: {sorted(STRATEGIES)}")
                 chain = cls() if chain is None else chain.chain(cls())
             strategy = chain
-        self._interval_override_ms = progress_check_interval_ms
-        planner = ExecutionTaskPlanner(strategy)
-        planner.add_proposals(proposals)
-        self._planner = planner
-        self.tracker = ExecutionTaskTracker()
-        self.tracker.register(planner.replica_tasks)
-        self.tracker.register(planner.leadership_tasks)
-        self.record_history(removed_brokers, demoted_brokers)
+        with self._lock:
+            if self.has_ongoing_execution:
+                raise RuntimeError("An execution is already in progress")
+            self._state = ExecutorState.STARTING_EXECUTION
+        try:
+            # any setup failure (malformed proposal, history/notifier error)
+            # must release STARTING_EXECUTION — not just the strategy check
+            self._stop_requested.clear()
+            self._force_stop.clear()
+            self._timed_out = False
+            t0 = time.time()
+            self._interval_override_ms = progress_check_interval_ms
+            planner = ExecutionTaskPlanner(strategy)
+            planner.add_proposals(proposals)
+            self._planner = planner
+            self.tracker = ExecutionTaskTracker()
+            self.tracker.register(planner.replica_tasks)
+            self.tracker.register(planner.leadership_tasks)
+            self.record_history(removed_brokers, demoted_brokers)
 
-        throttle = (replication_throttle
-                    if replication_throttle is not None
-                    else self.config.default_replication_throttle)
-        helper = (ReplicationThrottleHelper(self.adapter, throttle)
-                  if throttle is not None else None)
+            throttle = (replication_throttle
+                        if replication_throttle is not None
+                        else self.config.default_replication_throttle)
+            helper = (ReplicationThrottleHelper(self.adapter, throttle)
+                      if throttle is not None else None)
+        except BaseException:
+            self._state = ExecutorState.NO_TASK_IN_PROGRESS
+            self._planner = None
+            raise
         intra_moves_applied = 0
         crashed = True      # cleared on the clean path through the try
         try:
@@ -541,7 +553,20 @@ class Executor:
                 self.tracker.mark(t, TaskState.PENDING)
             self.adapter.execute_preferred_leader_elections(batch)
             self._wait_for(batch, self._leader_task_done,
-                           max_rounds=self.config.leadership_movement_timeout_rounds)
+                           max_rounds=self._leadership_round_budget())
+
+    def _effective_check_interval_ms(self) -> int:
+        return (self._interval_override_ms
+                if self._interval_override_ms is not None
+                else self.config.execution_progress_check_interval_ms)
+
+    def _leadership_round_budget(self) -> int:
+        """leader.movement.timeout.ms ÷ the EFFECTIVE per-round interval —
+        a per-request progress_check_interval_ms override changes the sleep,
+        so computing rounds at init would let the override stretch the
+        wall-clock timeout (Executor.java bounds it in time, not rounds)."""
+        return max(1, int(self.config.leader_movement_timeout_ms
+                          // max(self._effective_check_interval_ms(), 1)))
 
     def _replica_task_done(self, task: ExecutionTask) -> Optional[TaskState]:
         tp = task.proposal.topic_partition
@@ -615,10 +640,7 @@ class Executor:
                     self.tracker.mark(t, prev)
             open_tasks = still
             if open_tasks:
-                time.sleep((self._interval_override_ms
-                            if self._interval_override_ms is not None
-                            else self.config.execution_progress_check_interval_ms)
-                           / 1000.0)
+                time.sleep(self._effective_check_interval_ms() / 1000.0)
         if open_tasks:   # round budget exhausted
             self._timed_out = True
             now = int(time.time() * 1000)

@@ -144,6 +144,9 @@ class LoadMonitor:
         self._thread: Optional[threading.Thread] = None
         self._model_semaphore = threading.Semaphore(2)
         self._train_lock = threading.Lock()
+        #: state to restore when TRAIN finishes, overriding the pre-training
+        #: state: pause/resume issued during a TRAIN land here
+        self._post_train_state: Optional[MonitorState] = None
         self._bootstrap_progress: Optional[float] = None
         # trained CPU model (TRAIN endpoint / LinearRegressionModelParameters)
         from cruise_control_tpu.models.cluster import LinearRegressionCpuModel
@@ -210,13 +213,19 @@ class LoadMonitor:
             elif self._state == MonitorState.TRAINING:
                 # a pause issued during TRAIN takes effect when training
                 # finishes (train() restores this instead of its prev state)
-                self._pause_after_training = reason
+                self._post_train_state = MonitorState.PAUSED
                 self._pause_reason = reason
 
     def resume(self, reason: str = "Resumed by user"):
         with self._lock:
             if self._state == MonitorState.PAUSED:
                 self._state = MonitorState.RUNNING
+                self._pause_reason = reason
+            elif self._state == MonitorState.TRAINING:
+                # resume during TRAIN: cancels a pending pause AND resumes a
+                # monitor that was PAUSED before training started — either
+                # way the post-training state is RUNNING
+                self._post_train_state = MonitorState.RUNNING
                 self._pause_reason = reason
 
     def _run(self):
@@ -298,9 +307,10 @@ class LoadMonitor:
         # during a long historical fetch); prev-state captured under the
         # lock so serialized TRAINs restore the true pre-training state
         self._train_lock.acquire()
-        prev = self._state
-        self._pause_after_training: Optional[str] = None
-        self._state = MonitorState.TRAINING
+        with self._lock:        # a concurrent pause() must not be clobbered
+            prev = self._state
+            self._post_train_state = None
+            self._state = MonitorState.TRAINING
         if clear_metrics or not hasattr(self, "_train_acc"):
             self._train_acc = ([], [], [], [])
         # fetch into LOCALS; merge into the accumulator only on success so a
@@ -335,10 +345,10 @@ class LoadMonitor:
                 self._sampler.set_cpu_model(self.cpu_model)
         finally:
             with self._lock:
-                self._state = (MonitorState.PAUSED
-                               if self._pause_after_training is not None
+                self._state = (self._post_train_state
+                               if self._post_train_state is not None
                                else prev)
-                self._pause_after_training = None
+                self._post_train_state = None
             self._train_lock.release()
         return self.cpu_model.to_json()
 

@@ -278,12 +278,13 @@ class Purgatory:
         self._lock = threading.Lock()
 
     def _evict_locked(self):
-        """Drop resolved requests past retention
-        (two.step.purgatory.retention.time.ms)."""
+        """Drop requests past retention — by submission age REGARDLESS of
+        status (Purgatory.java:254 removeOldRequests): stale unreviewed
+        submissions must age out too, or ``max_requests`` of them would
+        return 429 to every reviewable POST forever."""
         cutoff = self._now() - self._retention_ms
         for rid in [rid for rid, r in self._requests.items()
-                    if r.status != ReviewStatus.PENDING_REVIEW
-                    and r.submitted_ms < cutoff]:
+                    if r.submitted_ms < cutoff]:
             del self._requests[rid]
 
     def submit(self, endpoint: str, request_url: str, submitter: str,

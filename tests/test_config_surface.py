@@ -32,6 +32,22 @@ def test_purgatory_max_requests_and_retention():
     assert all(r["Id"] != r2.review_id for r in p.board())  # evicted
 
 
+def test_purgatory_evicts_stale_unreviewed_requests():
+    """Purgatory.java:254 removeOldRequests evicts by submission age
+    regardless of status: stale PENDING_REVIEW submissions must not occupy
+    slots forever (or the purgatory 429s every reviewable POST)."""
+    clock = [1000]
+    p = Purgatory(max_requests=2, retention_ms=500, now_fn=lambda: clock[0])
+    p.submit("REBALANCE", "/r", "alice")
+    p.submit("REBALANCE", "/r", "bob")
+    with pytest.raises(ValueError, match="full"):
+        p.submit("REBALANCE", "/r", "carol")
+    clock[0] += 1000        # both stale, never reviewed
+    r = p.submit("REBALANCE", "/r", "carol")
+    assert r.status == ReviewStatus.PENDING_REVIEW
+    assert len(p.board()) == 1
+
+
 def test_user_task_completed_cache_cap():
     clock = [0]
     m = UserTaskManager(max_active_tasks=50, completed_retention_ms=10**9,
@@ -134,7 +150,11 @@ def test_broker_window_overrides_decouple_from_partition_windows():
 def test_leader_movement_timeout_rounds_derived():
     app = _app(overrides={"leader.movement.timeout.ms": 500,
                           "execution.progress.check.interval.ms": 100})
-    assert app.executor.config.leadership_movement_timeout_rounds == 5
+    # rounds derived from the EFFECTIVE interval at execution time: a
+    # per-request interval override must not stretch the wall-clock timeout
+    assert app.executor._leadership_round_budget() == 5
+    app.executor._interval_override_ms = 250
+    assert app.executor._leadership_round_budget() == 2
 
 
 def test_intra_broker_logdir_batches():

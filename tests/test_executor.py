@@ -202,7 +202,7 @@ def test_round_exhaustion_marks_tasks_dead_and_times_out():
     ex = Executor(adapter, ExecutorConfig(
         execution_progress_check_interval_ms=1,
         max_execution_progress_check_rounds=3,
-        leadership_movement_timeout_rounds=3))
+        leader_movement_timeout_ms=3))
     summary = ex.execute_proposals(props)
     assert summary["timedOut"]
     counts = summary["taskCounts"]["INTER_BROKER_REPLICA_ACTION"]
@@ -250,3 +250,18 @@ def test_rejects_concurrent_executions():
         ex.execute_proposals(props)
     ex.stop_execution()
     th.join(timeout=30)
+
+
+def test_unknown_strategy_rejects_without_wedging_executor():
+    """An unknown replica_movement_strategies name (reachable straight from
+    REST) must reject the request BEFORE any state transition — previously it
+    raised between STARTING_EXECUTION and the try/finally, permanently
+    wedging the executor with 'An execution is already in progress'."""
+    props = [_proposal("t", 0, [0, 1], [2, 1])]
+    adapter = _adapter_for(props)
+    ex = Executor(adapter, ExecutorConfig(execution_progress_check_interval_ms=1))
+    with pytest.raises(ValueError, match="unknown replica movement strategy"):
+        ex.execute_proposals(props, strategy_names=["NoSuchStrategy"])
+    assert ex.state == ExecutorState.NO_TASK_IN_PROGRESS
+    summary = ex.execute_proposals(props)      # executor still usable
+    assert summary["taskCounts"]["INTER_BROKER_REPLICA_ACTION"]["COMPLETED"] == 1

@@ -458,3 +458,67 @@ def test_pause_during_training_takes_effect_after():
     assert lm.state == MonitorState.PAUSED       # pause survived training
     lm.resume()
     assert lm.state == MonitorState.RUNNING
+
+
+def test_resume_during_training_cancels_pending_pause():
+    """pause → resume while TRAIN is running must leave the monitor RUNNING
+    when training finishes (resume clears _pause_after_training)."""
+    import threading as _t
+    from cruise_control_tpu.monitor.load_monitor import (
+        LoadMonitor, MonitorState, StaticMetadataSource)
+    from cruise_control_tpu.monitor.sampler import SyntheticLoadSampler
+    lm = LoadMonitor(StaticMetadataSource(_metadata()),
+                     SyntheticLoadSampler(seed=2), window_ms=W)
+    lm._state = MonitorState.RUNNING
+    gate = _t.Event()
+    orig_fetch = lm._fetchers.fetch
+
+    def slow_fetch(md, s, e):
+        gate.wait(5)
+        return orig_fetch(md, s, e)
+
+    lm._fetchers.fetch = slow_fetch
+    th = _t.Thread(target=lambda: lm.train(0, W))
+    th.start()
+    for _ in range(100):
+        if lm.state == MonitorState.TRAINING:
+            break
+        time.sleep(0.01)
+    lm.pause("maintenance")
+    lm.resume("never mind")
+    gate.set()
+    th.join(timeout=10)
+    assert lm.state == MonitorState.RUNNING
+
+
+def test_resume_during_training_of_previously_paused_monitor():
+    """A monitor PAUSED before TRAIN starts, then resumed mid-TRAIN, must be
+    RUNNING when training finishes (the resume is not silently lost to the
+    captured pre-training state)."""
+    import threading as _t
+    from cruise_control_tpu.monitor.load_monitor import (
+        LoadMonitor, MonitorState, StaticMetadataSource)
+    from cruise_control_tpu.monitor.sampler import SyntheticLoadSampler
+    lm = LoadMonitor(StaticMetadataSource(_metadata()),
+                     SyntheticLoadSampler(seed=2), window_ms=W)
+    lm._state = MonitorState.RUNNING
+    lm.pause("maintenance")
+    assert lm.state == MonitorState.PAUSED
+    gate = _t.Event()
+    orig_fetch = lm._fetchers.fetch
+
+    def slow_fetch(md, s, e):
+        gate.wait(5)
+        return orig_fetch(md, s, e)
+
+    lm._fetchers.fetch = slow_fetch
+    th = _t.Thread(target=lambda: lm.train(0, W))
+    th.start()
+    for _ in range(100):
+        if lm.state == MonitorState.TRAINING:
+            break
+        time.sleep(0.01)
+    lm.resume("maintenance over")
+    gate.set()
+    th.join(timeout=10)
+    assert lm.state == MonitorState.RUNNING
