@@ -25,9 +25,10 @@ import numpy as np
 # persistent XLA compile cache: the proposal-computation graph compiles once
 # per shape, then every service/bench invocation reuses it (the steady state
 # a long-running rebalancer service actually sees)
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                   ".jax_cache"))
+_CACHE_DIR = os.environ.get(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _CACHE_DIR)
 
 
 def main():
@@ -35,6 +36,13 @@ def main():
     seed = int(os.environ.get("BENCH_SEED", "0"))
 
     import jax
+    # the env var alone is NOT enough here: the axon sitecustomize imports
+    # jax at interpreter startup — BEFORE this file's os.environ call — so
+    # the config default has already been materialized without the cache
+    # dir. Setting it through the config makes the persistent cache work
+    # across processes on this backend (verified: a second process reloads
+    # a TPU executable in <1 s instead of recompiling).
+    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     from cruise_control_tpu.analyzer import annealer as AN
@@ -47,10 +55,12 @@ def main():
             num_brokers=2_600, num_replicas=500_000, num_racks=40,
             num_topics=30_000, seed=seed)
         # wide-batch shallow anneal: high candidate tries at few sequential
-        # steps (per-step cost is strongly sub-linear in the try count);
-        # 512 steps measured equal-quality to 1024 (viol 0, balancedness
-        # 100) with the targeted repair pass absorbing the difference
-        cfg = AN.AnnealConfig(num_chains=16, steps=512, swap_interval=128,
+        # steps (per-step cost is strongly sub-linear in the try count).
+        # 320 steps / swap 64 measured equal-quality to 512 and 1024 (viol
+        # 8→0, balancedness 100.0) with the targeted repair pass absorbing
+        # the difference — repair accepts grew ~3.5K → ~4.8K, see
+        # docs/PERF.md
+        cfg = AN.AnnealConfig(num_chains=16, steps=320, swap_interval=64,
                               tries_move=384, tries_lead=64, tries_swap=192)
         engine = "anneal"
     elif size == "medium":
@@ -79,6 +89,15 @@ def main():
     r = OPT.optimize(topo, assign, engine=engine, anneal_config=cfg, seed=seed + 1)
     elapsed = time.time() - t0
 
+    # ---- cluster-model-creation at bench scale (LoadMonitor.java:178
+    # cluster-model-creation-timer): windowed aggregation result + cluster
+    # metadata -> ClusterTopology arrays -> device upload. The aggregation
+    # itself (numpy window collapse) is inside _build_model's input; the
+    # timed region covers metadata+windows -> model arrays -> TPU transfer.
+    model_build_s = None
+    if size == "linkedin":
+        model_build_s = _measure_model_build(topo, assign)
+
     target = 30.0
     out = {
         "metric": f"full_goal_proposal_wall_clock_{size}",
@@ -97,7 +116,67 @@ def main():
         "num_leadership_movements": r.num_leadership_movements,
         "device": str(jax.devices()[0].platform),
     }
+    if model_build_s is not None:
+        out["model_build_s"] = model_build_s
     print(json.dumps(out))
+
+
+def _measure_model_build(topo, assign):
+    """Time LoadMonitor._build_model (bulk path) + device upload on the
+    bench model: metadata objects + a 4-window aggregation result for every
+    partition → ClusterTopology/Assignment → DeviceTopology on the TPU.
+
+    The replica slots of ``replicas_of_partition`` are REPLICA ids; the
+    broker each sits on comes from the initial assignment."""
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from cruise_control_tpu.monitor import metricdef as md
+    from cruise_control_tpu.monitor.aggregator import (
+        AggregationResult, Completeness)
+    from cruise_control_tpu.monitor.load_monitor import (
+        LoadMonitor, StaticMetadataSource)
+    from cruise_control_tpu.monitor.sampler import (
+        BrokerMetadata, ClusterMetadata, PartitionMetadata, SyntheticLoadSampler)
+    from cruise_control_tpu.ops.aggregates import device_topology
+
+    P = topo.num_partitions
+    t_of = np.asarray(topo.topic_of_partition)
+    reps = np.asarray(topo.replicas_of_partition)
+    lead_slot = np.asarray(topo.initial_leader_slot)
+    pidx = (np.asarray(topo.partition_index)
+            if topo.partition_index is not None
+            else np.arange(P, dtype=np.int32))
+    names = (topo.topic_names if topo.topic_names
+             else tuple(f"T{t}" for t in range(int(t_of.max()) + 1)))
+    bo = np.asarray(jax.device_get(assign.broker_of))
+    brokers = [BrokerMetadata(i, rack=f"r{int(r)}", host=f"h{i}", alive=True)
+               for i, r in enumerate(np.asarray(topo.rack_of_broker))]
+    rng = np.random.default_rng(7)
+    parts = []
+    for p in range(P):
+        rr = tuple(int(bo[r]) for r in reps[p] if r >= 0)
+        parts.append(PartitionMetadata(
+            names[int(t_of[p])], int(pidx[p]),
+            leader=rr[min(int(lead_slot[p]), len(rr) - 1)], replicas=rr))
+    metadata = ClusterMetadata(brokers=brokers, partitions=parts, generation=1)
+    W = 4
+    entities = [(pm.topic, pm.partition) for pm in parts]
+    values = rng.exponential(50.0, (P, W, md.NUM_MODEL_METRICS))
+    result = AggregationResult(
+        entities=entities, values=values,
+        window_times=np.arange(W, dtype=np.int64) * 60_000,
+        extrapolations=np.zeros((P, W), np.int8),
+        completeness=Completeness(np.ones(W, np.float32), 1.0, 1, W, P),
+        generation=1)
+    lm = LoadMonitor(StaticMetadataSource(metadata), SyntheticLoadSampler())
+    t0 = _time.time()
+    topo2, assign2 = lm._build_model(metadata, result)
+    dt2 = device_topology(topo2)
+    jax.block_until_ready(dt2.replica_base_load)
+    return round(_time.time() - t0, 3)
 
 
 if __name__ == "__main__":

@@ -674,8 +674,19 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
     chains, temps = _run_pt(chains, temps0, keys, dt, th, weights, opts,
                             movable_idx, dest_idx, initial_broker_of,
                             topic_reps, cfg, topic_mode, n_rounds)
-    energies = _rescore_chains(chains, dt, th, weights, initial_broker_of,
-                               topic_mode, num_topics)           # f32[C, 2]
+    if mesh is not None and topic_mode in ("dense", "off"):
+        # replica-sharded exact rescore (parallel/sharding.py): the per-chain
+        # O(R) gathers and segment-sums run on replica shards with one psum,
+        # so no device materializes C× all-R intermediates. Parity with
+        # _rescore_chains is locked by test_parallel.py.
+        from cruise_control_tpu.parallel.sharding import sharded_chain_energies
+        energies = sharded_chain_energies(
+            mesh, dt, th, weights, chains.broker_of, chains.leader_of,
+            initial_broker_of, use_topic=use_topic,
+            topic_count=chains.topic_count if use_topic else None)
+    else:
+        energies = _rescore_chains(chains, dt, th, weights, initial_broker_of,
+                                   topic_mode, num_topics)       # f32[C, 2]
     # lexicographic best chain, combined in f64 on host — the f32 combined
     # scalar would absorb the cost channel under any hard violation
     e2 = np.asarray(jax.device_get(energies), np.float64)

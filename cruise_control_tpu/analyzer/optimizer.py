@@ -17,8 +17,13 @@ greedy polish finishes the repair.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
+
+#: CC_PHASE_DEBUG=1 prints a per-phase wall-clock budget of each optimize()
+#: call (the profile the bench notes cite)
+_PHASE_DEBUG = os.environ.get("CC_PHASE_DEBUG", "") == "1"
 
 import jax
 import jax.numpy as jnp
@@ -178,11 +183,42 @@ def _broker_rows(dt, topo, assign, agg=None) -> List[dict]:
 
 
 def _stats_dict(dt, assign, constraint, num_topics,
-                sparse_topic: bool = False) -> dict:
-    st = compute_cluster_stats(dt, assign, constraint, num_topics,
+                sparse_topic: bool = False, agg=None) -> dict:
+    st = compute_cluster_stats(dt, assign, constraint, num_topics, agg=agg,
                                sparse_topic=sparse_topic)
     host = jax.device_get(st._asdict())     # one transfer for all fields
     return {k: np.asarray(v).tolist() for k, v in host.items()}
+
+
+def _sharded_broker_aggregates(mesh, dt, assign, init_broker, num_topics,
+                               sparse_topic):
+    """BrokerAggregates via the replica-sharded exact reduction
+    (parallel/sharding.py sharded_aggregates): each device reduces its
+    replica/partition shard, one psum combines. The dense [B, T] topic
+    histogram is only rebuilt when the dense topic scoring path needs it
+    (small models); at scale ``sparse_topic`` scores topics by sort."""
+    from cruise_control_tpu.ops.aggregates import BrokerAggregates
+    from cruise_control_tpu.parallel.sharding import sharded_aggregates
+    bo = jnp.asarray(assign.broker_of, jnp.int32)
+    lo = jnp.asarray(assign.leader_of, jnp.int32)
+    sa = sharded_aggregates(mesh, dt, bo[None, :], lo[None, :], init_broker)
+    B = dt.num_brokers
+    if sparse_topic:
+        topic_count = jnp.zeros((B, 1), jnp.int32)
+    else:
+        t_of_r = dt.topic_of_partition[dt.partition_of_replica]
+        topic_count = jax.ops.segment_sum(
+            jnp.ones_like(bo), bo * num_topics + t_of_r,
+            num_segments=B * num_topics).reshape(B, num_topics)
+    offline_count = jax.ops.segment_sum(
+        dt.replica_offline.astype(jnp.int32), bo, num_segments=B)
+    return BrokerAggregates(
+        broker_load=sa.broker_load[0], host_load=sa.host_load[0],
+        replica_count=sa.replica_count[0].astype(jnp.int32),
+        leader_count=sa.leader_count[0].astype(jnp.int32),
+        potential_nw_out=sa.potential_nw_out[0],
+        leader_bytes_in=sa.leader_bytes_in[0],
+        topic_count=topic_count, offline_count=offline_count)
 
 
 def _balancedness(goal_names, violations) -> float:
@@ -209,13 +245,30 @@ def optimize(topo: ClusterTopology, assign: Assignment,
     from cruise_control_tpu.server.async_ops import report_progress
     proposal_timer = REGISTRY.timer("proposal-computation-timer")
     t0 = time.time()
+    _tp = [t0]
+
+    def _mark(phase: str):
+        if _PHASE_DEBUG:
+            now = time.time()
+            print(f"[optimize phase] {phase}: {now - _tp[0]:.2f}s",
+                  flush=True)
+            _tp[0] = now
+
     constraint = constraint or BalancingConstraint()
     opts = options if options is not None else G.default_options(topo)
     goal_names = tuple(goal_names)
     dt = device_topology(topo)
     num_topics = topo.num_topics
     sparse_topic = topo.num_brokers * num_topics > TOPIC_DENSE_LIMIT
-    agg0 = compute_aggregates(dt, assign, 1 if sparse_topic else num_topics)
+    if mesh is not None:
+        # replica-axis sharded production path (SURVEY §7 step 3): the O(R)
+        # exact aggregation runs on replica shards across the mesh
+        agg0 = _sharded_broker_aggregates(
+            mesh, dt, assign, jnp.asarray(assign.broker_of, jnp.int32),
+            num_topics, sparse_topic)
+    else:
+        agg0 = compute_aggregates(dt, assign,
+                                  1 if sparse_topic else num_topics)
     from cruise_control_tpu.ops.aggregates import topic_totals
     th = G.compute_thresholds(
         dt, constraint, agg0,
@@ -223,12 +276,14 @@ def optimize(topo: ClusterTopology, assign: Assignment,
     weights = OBJ.build_weights(goal_names)
     init_broker = jnp.asarray(assign.broker_of, jnp.int32)
 
+    _mark("setup")
     before = OBJ.evaluate_objective(dt, assign, th, weights, goal_names,
                                     num_topics, init_broker, agg0,
                                     sparse_topic=sparse_topic)
     stats_before = _stats_dict(dt, assign, constraint, num_topics,
-                               sparse_topic=sparse_topic)
+                               sparse_topic=sparse_topic, agg=agg0)
 
+    _mark("eval+stats before")
     if engine == "auto":
         engine = ("greedy" if topo.num_replicas * topo.num_brokers <= GREEDY_LIMIT
                   else "anneal")
@@ -247,6 +302,7 @@ def optimize(topo: ClusterTopology, assign: Assignment,
                                   initial_broker_of=init_broker,
                                   mesh=mesh)
         final = ares.assignment
+        _mark("anneal")
         # targeted repair (analyzer/repair.py): walk exactly the violating
         # cells/brokers the stochastic search left behind — the reference's
         # per-goal violation walks, at any scale
@@ -254,27 +310,43 @@ def optimize(topo: ClusterTopology, assign: Assignment,
         from cruise_control_tpu.analyzer import repair as REP
         final, _, _ = REP.repair(dt, final, th, weights, opts, num_topics,
                                  initial_broker_of=init_broker, seed=seed)
-        # hard-goal polish: if violations remain and the model fits the
-        # greedy engine, finish with deterministic descent.
-        interim = OBJ.evaluate_objective(dt, final, th, weights, goal_names,
-                                         num_topics, init_broker,
-                                         sparse_topic=sparse_topic)
+        _mark("repair")
+    else:
+        raise ValueError(f"unknown engine {engine!r}")
+
+    # the after-eval passes a precomputed agg JUST LIKE the before-eval:
+    # with both call sites shaped identically they share one compiled
+    # program — an eval that computes aggregates internally is a second
+    # full trace+compile (~55 s of the cold start for nothing)
+    agg_after = (_sharded_broker_aggregates(mesh, dt, final, init_broker,
+                                            num_topics, sparse_topic)
+                 if mesh is not None else
+                 compute_aggregates(dt, final,
+                                    1 if sparse_topic else num_topics))
+    after = OBJ.evaluate_objective(dt, final, th, weights, goal_names,
+                                   num_topics, init_broker, agg_after,
+                                   sparse_topic=sparse_topic)
+    if engine == "anneal":
+        # hard-goal polish: if violations remain after repair and the model
+        # fits the greedy engine, finish with deterministic descent. The
+        # check reuses the post-optimization evaluation (one full eval, not
+        # two) and re-evaluates only when a polish actually ran.
         hard_mask = np.array([G.is_hard(g) for g in goal_names] + [True])
-        if (np.asarray(interim.penalties.violations)[hard_mask].sum() > 0
+        if (np.asarray(after.penalties.violations)[hard_mask].sum() > 0
                 and topo.num_replicas * topo.num_brokers <= GREEDY_LIMIT):
             # pass the TRUE original placement: healing accounting must not
             # re-penalize offline replicas the annealer already relocated
             gres = GR.optimize_greedy(dt, final, th, weights, opts, num_topics,
                                       initial_broker_of=init_broker)
             final = gres.assignment
-    else:
-        raise ValueError(f"unknown engine {engine!r}")
-
-    after = OBJ.evaluate_objective(dt, final, th, weights, goal_names,
-                                   num_topics, init_broker,
-                                   sparse_topic=sparse_topic)
+            agg_after = compute_aggregates(dt, final,
+                                           1 if sparse_topic else num_topics)
+            after = OBJ.evaluate_objective(dt, final, th, weights, goal_names,
+                                           num_topics, init_broker, agg_after,
+                                           sparse_topic=sparse_topic)
     stats_after = _stats_dict(dt, final, constraint, num_topics,
-                              sparse_topic=sparse_topic)
+                              sparse_topic=sparse_topic, agg=agg_after)
+    _mark("eval+stats after")
     report_progress("Decoding execution proposals")
     props = PR.diff(topo, assign, final)
     # movement counts derived from the proposal diff so both engines report
@@ -282,6 +354,7 @@ def optimize(topo: ClusterTopology, assign: Assignment,
     n_moves = sum(len(p.replicas_to_add) for p in props)
     n_lead = sum(1 for p in props if p.has_leader_action)
 
+    _mark("proposal diff")
     names_ext = goal_names + (G.SELF_HEALING_TERM,)
     vb = np.asarray(before.penalties.violations)
     va = np.asarray(after.penalties.violations)
@@ -293,14 +366,17 @@ def optimize(topo: ClusterTopology, assign: Assignment,
                     cost_before=float(cb[i]), cost_after=float(ca[i]))
         for i, g in enumerate(names_ext)]
 
+    rows_before = _broker_rows(dt, topo, assign, agg=agg0)
+    rows_after = _broker_rows(dt, topo, final, agg=agg_after)
+    _mark("broker stats rows")
     proposal_timer.update(time.time() - t0)
     return OptimizerResult(
         proposals=props,
         # the reference's OptimizerResult also carries broker stats on every
-        # computation; the after-rows cost one [B] aggregate pass (~1% of the
-        # bench budget), before-rows reuse agg0
-        broker_stats_before=_broker_rows(dt, topo, assign, agg=agg0),
-        broker_stats_after=_broker_rows(dt, topo, final),
+        # computation; both row sets reuse the aggregates already computed
+        # for the before/after evaluations — no extra device pass
+        broker_stats_before=rows_before,
+        broker_stats_after=rows_after,
         goal_summaries=summaries,
         stats_before=stats_before,
         stats_after=stats_after,

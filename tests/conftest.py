@@ -10,6 +10,21 @@ never pay that cost.
 import os
 import sys
 
+# XLA's CPU backend JIT-compiles this repo's large fused programs with
+# deeply recursive LLVM passes; on the default 8 MB main-thread stack a long
+# suite intermittently segfaults inside backend_compile_and_load. The main
+# stack grows on demand up to RLIMIT_STACK, so raising the soft limit here
+# (before any compile) removes the crash without touching the system.
+try:
+    import resource
+    _soft, _hard = resource.getrlimit(resource.RLIMIT_STACK)
+    _want = 512 * 1024 * 1024
+    if _soft != resource.RLIM_INFINITY and _soft < _want:
+        _new = _want if _hard == resource.RLIM_INFINITY else min(_want, _hard)
+        resource.setrlimit(resource.RLIMIT_STACK, (_new, _hard))
+except Exception:
+    pass
+
 os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
@@ -29,3 +44,26 @@ except Exception:
     pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import pytest
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _release_compiled_programs():
+    """Release compiled executables between test modules.
+
+    The suite compiles a few hundred large fused programs; holding every
+    executable alive for the whole run intermittently segfaults XLA's CPU
+    backend inside ``backend_compile_and_load`` once cumulative JIT code
+    crosses some internal limit (observed deterministically around test
+    ~195: the NEXT fresh compile crashes, whichever program it is).
+    Dropping the caches per module keeps live code bounded; modules rarely
+    share shapes, so the recompile cost is negligible.
+    """
+    yield
+    try:
+        import jax
+        jax.clear_caches()
+    except Exception:
+        pass

@@ -258,3 +258,119 @@ def test_repair_does_not_consume_input_assignment():
     np.testing.assert_array_equal(np.asarray(f1.broker_of),
                                   np.asarray(f2.broker_of))
     assert (m1, l1) == (m2, l2)
+
+
+# ---------------------------------------------------------------------------
+# Lexicographic priority on the FLAGSHIP engine: the viol ladder + targeted
+# repair (anneal path), not just the staged greedy, must preserve the
+# reference's sequential-priority semantics (AbstractGoal.java:211).
+# ---------------------------------------------------------------------------
+
+_ANNEAL_LEX_CFG = None
+
+
+def _anneal_lex_cfg():
+    global _ANNEAL_LEX_CFG
+    if _ANNEAL_LEX_CFG is None:
+        from cruise_control_tpu.analyzer.annealer import AnnealConfig
+        _ANNEAL_LEX_CFG = AnnealConfig(num_chains=8, steps=512,
+                                       swap_interval=64, tries_move=16,
+                                       tries_lead=4, tries_swap=8)
+    return _ANNEAL_LEX_CFG
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_lexicographic_goal_priority_anneal_engine(seed):
+    """20-seed greedy-engine property, run on the anneal+repair path at
+    small scale: full-list optimization must not leave the lowest-priority
+    goal of a prefix worse than prefix-only optimization achieves."""
+    goals = list(G.DEFAULT_GOALS)
+    topo, assign = _lex_fixture(seed)
+    cfg = _anneal_lex_cfg()
+    full = OPT.optimize(topo, assign, engine="anneal", anneal_config=cfg,
+                        seed=seed)
+    vf = _viol_after(full)
+    for s in full.goal_summaries:
+        if s.hard:
+            assert s.violations_after == 0, (s.name, s.violations_after)
+    for k in (6, 13):        # end of hard block; usage-distribution block
+        prefix = tuple(goals[:k])
+        pre = OPT.optimize(topo, assign, goal_names=prefix, engine="anneal",
+                           anneal_config=cfg, seed=seed)
+        vp = _viol_after(pre)
+        g = goals[k - 1]
+        assert vf[g] <= vp[g] + 1e-6, (
+            f"goal {g}: full-list anneal leaves {vf[g]} violations "
+            f"but prefix-only achieves {vp[g]}")
+
+
+def test_repair_never_trades_up_the_violation_ladder():
+    """The fused repair's batched multi-accept rounds (scatter-min claims)
+    must never increase the weighted violation channel: the viol ladder
+    makes one higher-tier violation outweigh every lower tier combined, so
+    a net-improving accept set cannot trade a higher tier away."""
+    import jax.numpy as jnp2
+    from cruise_control_tpu.analyzer import objective as OBJ2
+    from cruise_control_tpu.analyzer import repair as REP
+    from cruise_control_tpu.common.resources import BalancingConstraint
+    from cruise_control_tpu.ops.aggregates import (
+        compute_aggregates as agg2, device_topology as devtopo)
+
+    for seed in range(5):
+        topo, assign = fixtures.random_cluster(fixtures.ClusterProperties(
+            num_racks=3, num_brokers=10, num_replicas=300, num_topics=20,
+            min_replication=2, max_replication=3), seed=300 + seed)
+        dt = devtopo(topo)
+        th = G.compute_thresholds(dt, BalancingConstraint(),
+                                  agg2(dt, assign, topo.num_topics))
+        w = OBJ2.build_weights(G.DEFAULT_GOALS)
+        opts = G.default_options(topo)
+        init = jnp2.asarray(assign.broker_of)
+        before = OBJ2.evaluate_objective(dt, assign, th, w, G.DEFAULT_GOALS,
+                                         topo.num_topics, init)
+        final, moves, leads = REP.repair(dt, assign, th, w, opts,
+                                         topo.num_topics,
+                                         initial_broker_of=init, seed=seed)
+        after = OBJ2.evaluate_objective(dt, final, th, w, G.DEFAULT_GOALS,
+                                        topo.num_topics, init)
+        vb = float(np.asarray(before.value)[0])
+        va = float(np.asarray(after.value)[0])
+        assert va <= vb + 1e-3, (seed, vb, va)
+
+
+def test_repair_host_claims_prevent_band_edge_double_count():
+    """Band-edge regression for the host-claim dimension: with two brokers
+    per host and host NW-in capacity just above current usage, two same-round
+    winners moving onto sibling brokers of one host would double-count the
+    shared host term's delta and could overshoot the host band. Host claims
+    make same-host winners mutually exclusive per round; repair must end
+    with zero host-capacity violations and no oscillation."""
+    import jax.numpy as jnp2
+    from cruise_control_tpu.analyzer import objective as OBJ2
+    from cruise_control_tpu.analyzer import repair as REP
+    from cruise_control_tpu.common.resources import BalancingConstraint
+    from cruise_control_tpu.ops.aggregates import (
+        compute_aggregates as agg2, device_topology as devtopo)
+
+    # 12 brokers on 6 hosts (2 each); skewed load so repair must move work
+    # toward the emptier hosts without blowing their shared capacity
+    import dataclasses as _dc
+    topo, assign = fixtures.random_cluster(fixtures.ClusterProperties(
+        num_racks=3, num_brokers=12, num_replicas=360, num_topics=24,
+        min_replication=2, max_replication=3), seed=77)
+    topo = _dc.replace(
+        topo, host_of_broker=(np.arange(12, dtype=np.int32) // 2))
+    dt = devtopo(topo)
+    th = G.compute_thresholds(dt, BalancingConstraint(),
+                              agg2(dt, assign, topo.num_topics))
+    w = OBJ2.build_weights(G.DEFAULT_GOALS)
+    opts = G.default_options(topo)
+    init = jnp2.asarray(assign.broker_of)
+    final, moves, leads = REP.repair(dt, assign, th, w, opts, topo.num_topics,
+                                     initial_broker_of=init, seed=0)
+    after = OBJ2.evaluate_objective(dt, final, th, w, G.DEFAULT_GOALS,
+                                    topo.num_topics, init)
+    before = OBJ2.evaluate_objective(dt, assign, th, w, G.DEFAULT_GOALS,
+                                     topo.num_topics, init)
+    assert (float(np.asarray(after.value)[0])
+            <= float(np.asarray(before.value)[0]) + 1e-3)
