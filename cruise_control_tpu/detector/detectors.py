@@ -224,8 +224,26 @@ class SlowBrokerFinder:
         self._now = now_fn
 
     @staticmethod
-    def _slowness(series: dict) -> Optional[float]:
-        ft = np.asarray(series.get("flush_time", ()), dtype=np.float64)
+    def _has_tail(series: dict) -> bool:
+        ft999 = np.asarray(series.get("flush_time_999", ()), dtype=np.float64)
+        return bool(ft999.size and np.nanmax(ft999) > 0)
+
+    @staticmethod
+    def _flush_series(series: dict, use_tail: bool) -> np.ndarray:
+        """The flush-time series to score: the p99.9 tail gauge
+        (``flush_time_999`` — what SlowBrokerFinder.java:38-77 reads) when
+        the WHOLE fleet supplies it, else the mean. The choice is
+        fleet-wide (``use_tail``): p99.9 runs 10-100x the mean, so mixing
+        the two scales in one peer comparison (a rolling reporter upgrade)
+        would flag every tail-scored broker against mean-scored peers."""
+        if use_tail:
+            return np.asarray(series.get("flush_time_999", ()),
+                              dtype=np.float64)
+        return np.asarray(series.get("flush_time", ()), dtype=np.float64)
+
+    @classmethod
+    def _slowness(cls, series: dict, use_tail: bool) -> Optional[float]:
+        ft = cls._flush_series(series, use_tail)
         bi = np.asarray(series.get("bytes_in", ()), dtype=np.float64)
         if ft.size == 0 or bi.size == 0:
             return None
@@ -236,9 +254,12 @@ class SlowBrokerFinder:
 
     def detect(self) -> Optional[SlowBrokers]:
         hist = self._history_fn()
+        # tail metric only when EVERY broker reports it (comparable scales)
+        use_tail = bool(hist) and all(self._has_tail(s)
+                                      for s in hist.values())
         current: Dict[int, float] = {}
         for broker, series in hist.items():
-            s = self._slowness(series)
+            s = self._slowness(series, use_tail)
             if s is not None:
                 current[broker] = s
         if len(current) < 2:
@@ -248,7 +269,7 @@ class SlowBrokerFinder:
         now = self._now()
         slow_now: Set[int] = set()
         for broker, s in current.items():
-            ft = np.asarray(hist[broker].get("flush_time", ()), dtype=np.float64)
+            ft = self._flush_series(hist[broker], use_tail)
             bi = np.asarray(hist[broker].get("bytes_in", ()), dtype=np.float64)
             n = min(ft.size, bi.size)
             own_hist = ft[:n - 1] / np.maximum(bi[:n - 1], 1.0) if n > 1 else np.array([])

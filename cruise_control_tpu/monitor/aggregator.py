@@ -119,6 +119,10 @@ class MetricSampleAggregator:
         self._count = np.zeros((cap, W1), np.int32)
         self._oldest_window: Optional[int] = None  # window index (time//window_ms)
         self.generation = 0
+        #: monotonic count of accepted samples — generation only bumps on
+        #: new entities / window rolls, so completeness-derived caches also
+        #: need to observe plain ingestion
+        self.samples_ingested = 0
 
     # -- bookkeeping --------------------------------------------------------
 
@@ -192,6 +196,7 @@ class MetricSampleAggregator:
                                                    self._latest[row, slot])
                 self._latest_t[row, slot] = time_ms
             self._count[row, slot] += 1
+            self.samples_ingested += 1
             return True
 
     # -- aggregate ----------------------------------------------------------

@@ -116,7 +116,12 @@ class LoadMonitor:
             min_samples_per_window=min_samples_per_window,
             max_allowed_extrapolations=max_allowed_extrapolations)
         # broker aggregator reuses the same engine; metrics:
-        # cpu/lbi/lbo/rbi/rbo/log-flush-time (the last feeds SlowBrokerFinder)
+        # cpu/lbi/lbo/rbi/rbo/log-flush-time-mean + log-flush-time p99.9.
+        # The tail column aggregates with MAX: the broker's Yammer histogram
+        # already computed the in-window percentile
+        # (BROKER_LOG_FLUSH_TIME_MS_999TH), so the window keeps the WORST
+        # tail seen — averaging it back out would hide exactly the spiky
+        # broker SlowBrokerFinder.java:38-77 exists to catch.
         self.broker_aggregator = MetricSampleAggregator(
             num_windows=(broker_num_windows if broker_num_windows is not None
                          else num_windows),
@@ -130,8 +135,8 @@ class LoadMonitor:
                 max_allowed_extrapolations_per_broker
                 if max_allowed_extrapolations_per_broker is not None
                 else max_allowed_extrapolations),
-            num_metrics=6,
-            strategies=[md.Strategy.AVG] * 6)
+            num_metrics=7,
+            strategies=[md.Strategy.AVG] * 6 + [md.Strategy.MAX])
         self.window_ms = window_ms
         self.sampling_interval_ms = sampling_interval_ms
         #: brokers whose capacity came from the default (-1) entry in the
@@ -245,9 +250,17 @@ class LoadMonitor:
             (s.topic, s.partition), s.time_ms, metrics, group=s.topic)
 
     def _ingest_broker_sample(self, s):
+        # extras arrive under either the short synthetic keys or the raw
+        # reporter type names (process_raw_metrics passes raw types through)
+        flush_mean = s.extra.get(
+            "log_flush_time_ms",
+            s.extra.get("BROKER_LOG_FLUSH_TIME_MS_MEAN", np.nan))
+        flush_999 = s.extra.get(
+            "log_flush_time_ms_999th",
+            s.extra.get("BROKER_LOG_FLUSH_TIME_MS_999TH", np.nan))
         vec = np.array([s.cpu_util, s.leader_bytes_in, s.leader_bytes_out,
                         s.replication_bytes_in, s.replication_bytes_out,
-                        s.extra.get("log_flush_time_ms", np.nan)])
+                        flush_mean, flush_999])
         self.broker_aggregator.add_sample(s.broker_id, s.time_ms, vec)
 
     def broker_metric_history(self, now_ms: Optional[int] = None
@@ -257,18 +270,24 @@ class LoadMonitor:
         ``KafkaPartitionMetricSampleAggregator``'s broker twin:
         ``MetricAnomalyDetector.java:29-72``, ``SlowBrokerFinder.java:38-77``).
 
-        Returns ``{broker_id: {"cpu", "bytes_in", "flush_time": f64[W]}}``
-        with windows oldest-first; the newest window is each series' tail.
+        Returns ``{broker_id: {"cpu", "bytes_in", "flush_time",
+        "flush_time_999": f64[W]}}`` with windows oldest-first; the newest
+        window is each series' tail. ``flush_time_999`` carries the
+        MAX-aggregated in-broker p99.9 log-flush gauge — the metric the
+        reference's slow-broker scoring actually uses
+        (``SlowBrokerFinder.java:38-77``); ``flush_time`` is the mean
+        fallback for reporters without histogram percentiles.
         """
         now_ms = now_ms or self._now()
         result = self.broker_aggregator.aggregate(now_ms)
         out: Dict[int, Dict[str, np.ndarray]] = {}
         for i, broker in enumerate(result.entities):
-            v = result.values[i]                  # [W, 6]
+            v = result.values[i]                  # [W, 7]
             out[int(broker)] = {
                 "cpu": v[:, 0],
                 "bytes_in": v[:, 1] + v[:, 3],    # leader + replication in
                 "flush_time": v[:, 5],
+                "flush_time_999": v[:, 6],
             }
         return out
 
@@ -374,6 +393,39 @@ class LoadMonitor:
 
     # ------------------------------------------------------------ model build
 
+    def sample_extrapolations(self, now_ms: Optional[int] = None
+                              ) -> Dict[str, list]:
+        """Per-partition extrapolation records for STATE super_verbose
+        (CruiseControlState.writeSuperVerbose / SampleExtrapolation): which
+        windows of which partitions were filled in, and how."""
+        from cruise_control_tpu.monitor.aggregator import Extrapolation
+        now_ms = now_ms or self._now()
+        result = self.partition_aggregator.aggregate(now_ms)
+        flaws: Dict[str, list] = {}
+        ex = result.extrapolations
+        ords = list(Extrapolation)
+        for i, ent in enumerate(result.entities):
+            rows = np.flatnonzero(ex[i])
+            if rows.size:
+                topic, part = ent
+                flaws[f"{topic}-{part}"] = [
+                    {"window": int(result.window_times[w]),
+                     "extrapolation": ords[int(ex[i, w])].value}
+                    for w in rows]
+        return flaws
+
+    def meet_completeness_requirements(
+            self, requirements: ModelCompletenessRequirements,
+            now_ms: Optional[int] = None) -> bool:
+        """True when the monitored load satisfies ``requirements``
+        (LoadMonitor.java:585-601): the number of windows valid AT THE
+        REQUIREMENTS' monitored-partition ratio meets the required window
+        count. Used per goal to compute ready goals."""
+        now_ms = now_ms or self._now()
+        result = self.partition_aggregator.aggregate(now_ms, requirements)
+        return (result.completeness.num_valid_windows
+                >= requirements.min_required_num_windows)
+
     def cluster_model(self, now_ms: Optional[int] = None,
                       requirements: ModelCompletenessRequirements
                       = ModelCompletenessRequirements(),
@@ -403,7 +455,18 @@ class LoadMonitor:
                     f"{requirements.min_monitored_partitions_percentage}")
             return self._build_model(metadata, result)
 
+    #: partition count above which model build switches to the vectorized
+    #: bulk path (same semantics, locked by a parity test)
+    BULK_BUILD_THRESHOLD = 20_000
+
     def _build_model(self, metadata: ClusterMetadata, result: AggregationResult):
+        if len(metadata.partitions) >= self.BULK_BUILD_THRESHOLD:
+            # LinkedIn scale: the per-replica builder calls would dominate
+            # the whole REBALANCE wall-clock (~1.5M python dict operations);
+            # the bulk path assembles the same arrays vectorized —
+            # cluster-model-creation at scale is seconds, not minutes
+            # (LoadMonitor.java:178 cluster-model-creation-timer).
+            return self._build_model_bulk(metadata, result)
         # collapse windows per metric strategy: AVG metrics average valid
         # windows (Load.expectedUtilizationFor, Load.java:84-118), LATEST
         # takes the newest window.
@@ -482,3 +545,189 @@ class LoadMonitor:
                                      if is_leader else None),
                     load_windows=lw if is_leader else fw)
         return b.build()
+
+    def _build_model_bulk(self, metadata: ClusterMetadata,
+                          result: AggregationResult):
+        """Vectorized model build: identical output to the builder path
+        (parity-locked by ``test_bulk_model_build_matches_builder``) with
+        the per-replica python calls replaced by array assembly. The only
+        remaining python is one cheap pass over the partition metadata."""
+        from cruise_control_tpu.models.cluster import (
+            ClusterTopology, derive_follower_load, initial_assignment,
+            leadership_extra_from_leader_load)
+
+        # ---- broker axis (B is small; the python loop is negligible) ----
+        brokers = metadata.brokers
+        B = len(brokers)
+        self.capacity_estimated_brokers = []
+        rack_names: List[str] = []
+        rack_idx: Dict[str, int] = {}
+        host_keys: List[str] = []
+        rack_of_host: Dict[str, str] = {}
+        capacity = np.zeros((B, res.NUM_RESOURCES), np.float32)
+        alive = np.zeros(B, bool)
+        broker_ids = np.zeros(B, np.int32)
+        rack_of_broker_name: List[str] = []
+        host_of_broker_name: List[str] = []
+        for i, bm in enumerate(brokers):
+            info = self._capacity_resolver.capacity_for_broker(bm.broker_id)
+            if getattr(info, "is_estimated", False):
+                self.capacity_estimated_brokers.append(bm.broker_id)
+            rack = bm.rack or f"rack-of-{bm.broker_id}"
+            host = bm.host or f"host{bm.broker_id}"
+            if rack not in rack_idx:
+                rack_idx[rack] = len(rack_names)
+                rack_names.append(rack)
+            if host not in rack_of_host:
+                rack_of_host[host] = rack
+                host_keys.append(host)
+            rack_of_broker_name.append(rack)
+            host_of_broker_name.append(host)
+            capacity[i] = np.asarray(
+                [float(info.capacity[k]) for k in range(res.NUM_RESOURCES)],
+                np.float32)
+            alive[i] = bm.alive
+            broker_ids[i] = bm.broker_id
+        host_names = sorted(rack_of_host)          # builder sorts host names
+        host_idx = {h: i for i, h in enumerate(host_names)}
+        rack_of_broker = np.asarray([rack_idx[r] for r in rack_of_broker_name],
+                                    np.int32)
+        host_of_broker = np.asarray([host_idx[h] for h in host_of_broker_name],
+                                    np.int32)
+        broker_index = {int(b): i for i, b in enumerate(broker_ids)}
+
+        # ---- partition selection + topic first-seen order (builder parity:
+        # topics index in create_replica call order, partitions sorted by
+        # (topic index, partition number)) ----
+        ent_row = {e: i for i, e in enumerate(result.entities)}
+        topic_index: Dict[str, int] = {}
+        topic_names: List[str] = []
+        kept: List = []
+        rows_list: List[int] = []
+        for pm in metadata.partitions:
+            if pm.leader < 0 or not pm.replicas:
+                continue
+            row = ent_row.get((pm.topic, pm.partition))
+            if row is None:
+                continue                     # unmonitored: excluded
+            if pm.topic not in topic_index:
+                topic_index[pm.topic] = len(topic_names)
+                topic_names.append(pm.topic)
+            kept.append(pm)
+            rows_list.append(row)
+        P = len(kept)
+        if P == 0:
+            from cruise_control_tpu.models.cluster import ClusterModelBuilder
+            b = ClusterModelBuilder()
+            for i, bm in enumerate(brokers):
+                b.create_broker(rack_of_broker_name[i], host_of_broker_name[i],
+                                bm.broker_id, capacity[i], alive=bool(alive[i]))
+            return b.build()
+        t_of = np.fromiter((topic_index[pm.topic] for pm in kept), np.int32, P)
+        part_num = np.fromiter((pm.partition for pm in kept), np.int32, P)
+        order = np.lexsort((part_num, t_of))
+        kept = [kept[i] for i in order]
+        rows = np.asarray(rows_list, np.int64)[order]
+        t_of = t_of[order]
+        part_num = part_num[order]
+
+        # ---- replica structure ----
+        rf = np.fromiter((len(pm.replicas) for pm in kept), np.int32, P)
+        R = int(rf.sum())
+        max_rf = int(rf.max())
+        flat_broker_id = np.fromiter(
+            (bid for pm in kept for bid in pm.replicas), np.int64, R)
+        # broker id → dense index via sorted-id searchsorted (ids unique)
+        id_sort = np.argsort(broker_ids, kind="stable")
+        sorted_ids = broker_ids[id_sort]
+        pos = np.searchsorted(sorted_ids, flat_broker_id)
+        if (pos >= B).any() or (sorted_ids[np.minimum(pos, B - 1)]
+                                != flat_broker_id).any():
+            raise ValueError("replica on unknown broker id")
+        broker_of = id_sort[pos].astype(np.int32)
+        starts = np.zeros(P + 1, np.int64)
+        np.cumsum(rf, out=starts[1:])
+        pid = np.repeat(np.arange(P, dtype=np.int32), rf)
+        slot = np.arange(R, dtype=np.int64) - starts[pid]
+        replicas_of_partition = np.full((P, max_rf), -1, np.int32)
+        replicas_of_partition[pid, slot] = np.arange(R, dtype=np.int32)
+        leader_id = np.fromiter((pm.leader for pm in kept), np.int64, P)
+        is_leader = flat_broker_id == leader_id[pid]
+        # leader slot: FIRST matching replica (builder: is_leader on match)
+        first_match = np.full(P, np.iinfo(np.int64).max)
+        np.minimum.at(first_match, pid[is_leader], slot[is_leader])
+        if (first_match == np.iinfo(np.int64).max).any():
+            bad = int(np.flatnonzero(
+                first_match == np.iinfo(np.int64).max)[0])
+            raise ValueError(
+                f"partition ({kept[bad].topic},{kept[bad].partition}) "
+                "has no leader")
+        leader_position = first_match
+        # offline: explicitly reported, or hosted on a dead broker
+        off = ~alive[broker_of]
+        off_pos = starts[:-1]
+        for i, pm in enumerate(kept):      # rare branch: most pms have none
+            if pm.offline_replicas:
+                offset = int(off_pos[i])
+                for j, bid in enumerate(pm.replicas):
+                    if bid in pm.offline_replicas:
+                        off[offset + j] = True
+
+        # ---- loads (vectorized collapse identical to the builder path) ----
+        vals = result.values                              # [E, W, M]
+        avg = vals.mean(axis=1)
+        collapsed = avg.copy()
+        for mm in md.ModelMetric:
+            if md.METRIC_STRATEGY[mm] == md.Strategy.LATEST:
+                collapsed[:, mm] = vals[:, -1, mm]
+        leader_load = np.zeros((P, res.NUM_RESOURCES), np.float32)
+        leader_load[:, res.CPU] = np.nan_to_num(
+            collapsed[rows, md.ModelMetric.CPU_USAGE])
+        leader_load[:, res.DISK] = np.nan_to_num(
+            collapsed[rows, md.ModelMetric.DISK_USAGE])
+        leader_load[:, res.NW_IN] = np.nan_to_num(
+            collapsed[rows, md.ModelMetric.LEADER_BYTES_IN])
+        leader_load[:, res.NW_OUT] = np.nan_to_num(
+            collapsed[rows, md.ModelMetric.LEADER_BYTES_OUT])
+        leader_extra = leadership_extra_from_leader_load(leader_load)
+        follower_load = leader_load - leader_extra       # == leader base load
+        W = vals.shape[1]
+        vr = vals[rows]                       # ONE [P, W, M] gather, not four
+        win_res = np.zeros((P, W, res.NUM_RESOURCES), np.float32)
+        win_res[:, :, res.CPU] = np.nan_to_num(
+            vr[:, :, md.ModelMetric.CPU_USAGE])
+        win_res[:, :, res.DISK] = np.nan_to_num(
+            vr[:, :, md.ModelMetric.DISK_USAGE])
+        win_res[:, :, res.NW_IN] = np.nan_to_num(
+            vr[:, :, md.ModelMetric.LEADER_BYTES_IN])
+        win_res[:, :, res.NW_OUT] = np.nan_to_num(
+            vr[:, :, md.ModelMetric.LEADER_BYTES_OUT])
+        leader_extra_windows = leadership_extra_from_leader_load(win_res)
+        follower_windows = win_res - leader_extra_windows
+
+        topo = ClusterTopology(
+            rack_of_broker=rack_of_broker,
+            host_of_broker=host_of_broker,
+            capacity=capacity,
+            broker_alive=alive,
+            broker_new=np.zeros(B, bool),
+            broker_demoted=np.zeros(B, bool),
+            broker_bad_disks=np.zeros(B, bool),
+            partition_of_replica=pid,
+            topic_of_partition=t_of,
+            replicas_of_partition=replicas_of_partition,
+            rf_of_partition=rf,
+            initial_leader_slot=leader_position,
+            replica_offline=off,
+            replica_base_load=follower_load[pid],
+            leader_extra=leader_extra,
+            leader_bytes_in=leader_load[:, res.NW_IN].copy(),
+            topic_names=tuple(topic_names),
+            partition_index=part_num,
+            broker_ids=broker_ids,
+            host_names=tuple(host_names),
+            rack_names=tuple(rack_names),
+            replica_base_load_windows=follower_windows[pid],
+            leader_extra_windows=leader_extra_windows,
+        )
+        return topo, initial_assignment(topo, broker_of)

@@ -355,3 +355,53 @@ def test_metric_anomaly_detected_through_monitor_history():
                 cpu_util=95.0 if spike else 20.0, leader_bytes_in=1000.0))
     found = finder()
     assert any(a.broker_id == 1 and a.metric == "cpu" for a in found)
+
+
+def test_slow_broker_tail_latency_spike_with_flat_mean_detected():
+    """SlowBrokerFinder.java:38-77 scores the 99.9th-percentile log-flush
+    gauge, not the mean: a broker whose MEAN flush time stays flat while its
+    p99.9 tail spikes must still be demoted. The broker aggregator keeps the
+    tail column under a MAX window strategy so the spike survives
+    aggregation."""
+    from cruise_control_tpu.monitor.sampler import BrokerMetricSample
+    app, adapter = _service_app({"num.partition.metrics.windows": 8,
+                                 "slow.broker.demotion.score": 3})
+    finder = app.anomaly_detector.detectors["slow_broker"]
+    t0 = 4 * W
+    windows = []
+    for w in range(8):
+        now = t0 + w * W
+        app.load_monitor._now = lambda now=now: now + W
+        for b in range(4):
+            tail = 40.0 if (b != 3 or w < 3) else 40.0 * 4.0 ** (w - 2)
+            app.load_monitor._ingest_broker_sample(BrokerMetricSample(
+                broker_id=b, time_ms=now + 1000, cpu_util=20.0,
+                leader_bytes_in=1000.0,
+                extra={"log_flush_time_ms": 10.0,       # mean flat everywhere
+                       "log_flush_time_ms_999th": tail}))
+        windows.append(finder())
+    found = [a for a in windows if a is not None]
+    assert found, "tail-latency-spiking broker never detected"
+    assert 3 in found[-1].slow_brokers_by_time
+
+    # the history the finder saw really was the percentile column
+    hist = app.load_monitor.broker_metric_history()
+    assert hist[3]["flush_time_999"][-1] > 100.0
+    assert hist[3]["flush_time"][-1] == pytest.approx(10.0)
+
+
+def test_slow_broker_kafka_raw_type_extras_flow_to_history():
+    """The Kafka reporter path stores extras under the RAW type names
+    (process_raw_metrics passes them through); the monitor must pick up
+    BROKER_LOG_FLUSH_TIME_MS_{MEAN,999TH} just like the short keys."""
+    from cruise_control_tpu.monitor.sampler import BrokerMetricSample
+    app, adapter = _service_app()
+    for w in range(1, 4):
+        app.load_monitor._ingest_broker_sample(BrokerMetricSample(
+            broker_id=9, time_ms=w * W + 1000, cpu_util=20.0,
+            leader_bytes_in=1000.0,
+            extra={"BROKER_LOG_FLUSH_TIME_MS_MEAN": 12.0,
+                   "BROKER_LOG_FLUSH_TIME_MS_999TH": 220.0}))
+    hist = app.load_monitor.broker_metric_history()
+    assert hist[9]["flush_time"][-1] == pytest.approx(12.0)
+    assert hist[9]["flush_time_999"][-1] == pytest.approx(220.0)

@@ -522,3 +522,63 @@ def test_resume_during_training_of_previously_paused_monitor():
     gate.set()
     th.join(timeout=10)
     assert lm.state == MonitorState.RUNNING
+
+
+def test_bulk_model_build_matches_builder():
+    """_build_model_bulk (the vectorized LinkedIn-scale path) must produce
+    exactly the same ClusterTopology arrays and Assignment as the builder
+    path — dead brokers, offline replicas, unmonitored partitions, mixed
+    replication factors, interleaved topics, non-contiguous broker ids."""
+    import dataclasses as _dc
+    import numpy as _np
+    from cruise_control_tpu.monitor.aggregator import (
+        AggregationResult, Completeness)
+    from cruise_control_tpu.monitor import metricdef as _md
+    from cruise_control_tpu.monitor.load_monitor import (
+        LoadMonitor, StaticMetadataSource)
+    from cruise_control_tpu.monitor.sampler import (
+        BrokerMetadata, ClusterMetadata, PartitionMetadata,
+        SyntheticLoadSampler)
+
+    rng = _np.random.default_rng(11)
+    ids = [10, 3, 7, 22, 15, 4]                       # non-contiguous, unsorted
+    brokers = [BrokerMetadata(b, rack=f"r{i % 3}", host=f"h{b}",
+                              alive=(b != 22)) for i, b in enumerate(ids)]
+    parts = []
+    for p in range(40):
+        topic = f"T{p % 5}"
+        rf = 2 + (p % 2)
+        reps = tuple(int(x) for x in rng.choice(ids, size=rf, replace=False))
+        offline = (reps[1],) if p % 11 == 0 else ()
+        parts.append(PartitionMetadata(topic, p // 5, leader=reps[0],
+                                       replicas=reps,
+                                       offline_replicas=offline))
+    metadata = ClusterMetadata(brokers=brokers, partitions=parts, generation=1)
+    W = 3
+    # leave two partitions unmonitored
+    entities = [(pm.topic, pm.partition) for pm in parts[:-2]]
+    values = rng.exponential(40.0, (len(entities), W, _md.NUM_MODEL_METRICS))
+    result = AggregationResult(
+        entities=entities, values=values,
+        window_times=_np.arange(W, dtype=_np.int64) * 60_000,
+        extrapolations=_np.zeros((len(entities), W), _np.int8),
+        completeness=Completeness(_np.ones(W, _np.float32), 1.0, 1, W,
+                                  len(entities)),
+        generation=1)
+    lm = LoadMonitor(StaticMetadataSource(metadata), SyntheticLoadSampler())
+    topo_a, assign_a = lm._build_model(metadata, result)     # builder (small)
+    topo_b, assign_b = lm._build_model_bulk(metadata, result)
+
+    for f in _dc.fields(topo_a):
+        va, vb = getattr(topo_a, f.name), getattr(topo_b, f.name)
+        if va is None or isinstance(va, tuple):
+            assert va == vb or (va is None and vb is None), f.name
+        else:
+            _np.testing.assert_allclose(
+                _np.asarray(va, dtype=_np.float64),
+                _np.asarray(vb, dtype=_np.float64),
+                rtol=1e-6, atol=1e-6, err_msg=f.name)
+    _np.testing.assert_array_equal(_np.asarray(assign_a.broker_of),
+                                   _np.asarray(assign_b.broker_of))
+    _np.testing.assert_array_equal(_np.asarray(assign_a.leader_of),
+                                   _np.asarray(assign_b.leader_of))
