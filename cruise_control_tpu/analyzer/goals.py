@@ -101,6 +101,44 @@ def is_hard(goal: str) -> bool:
     return goal in HARD_GOALS
 
 
+#: goals that need only the latest window over ALL topics
+#: (RackAwareGoal.java:120-123, ReplicaCapacityGoal.java:91-93,
+#: ReplicaDistributionAbstractGoal.java:105-107,
+#: TopicReplicaDistributionGoal.java:189-191,
+#: PreferredLeaderElectionGoal.java:178-180 — all
+#: (MIN_NUM_VALID_WINDOWS_FOR_SELF_HEALING=1, ratio 0, includeAllTopics))
+_SNAPSHOT_ALL_TOPIC_GOALS = frozenset({
+    "RackAwareGoal", "ReplicaCapacityGoal", "ReplicaDistributionGoal",
+    "LeaderReplicaDistributionGoal", "TopicReplicaDistributionGoal",
+    "PreferredLeaderElectionGoal",
+})
+
+#: resource capacity goals: latest window at the configured monitored ratio,
+#: all topics (CapacityGoal.java:111-114)
+_CAPACITY_REQ_GOALS = frozenset(_CAPACITY_GOAL_RESOURCE)
+
+
+def completeness_requirements(goal: str, num_windows: int,
+                              min_monitored_ratio: float):
+    """Per-goal ModelCompletenessRequirements (``Goal.java:126-148``
+    implementations): what the monitored load must cover before this goal's
+    optimization is meaningful. Distribution goals need half the window
+    history at the configured partition coverage
+    (``ResourceDistributionGoal.java:147-149``,
+    ``PotentialNwOutGoal.java:137-139``,
+    ``LeaderBytesInDistributionGoal.java:126-128``); capacity and
+    structural goals act on the latest snapshot."""
+    from cruise_control_tpu.monitor.aggregator import (
+        ModelCompletenessRequirements)
+    if goal in _SNAPSHOT_ALL_TOPIC_GOALS:
+        return ModelCompletenessRequirements(1, 0.0, True)
+    if goal in _CAPACITY_REQ_GOALS:
+        return ModelCompletenessRequirements(1, min_monitored_ratio, True)
+    # distribution family: ResourceDistribution/PotentialNwOut/LeaderBytesIn
+    return ModelCompletenessRequirements(
+        max(1, num_windows // 2), min_monitored_ratio, False)
+
+
 def band_cost(n, upper, lower):
     """Out-of-band distance normalized by the upper bound — the shared soft
     band-penalty shape used by the goal terms and both engines' deltas."""

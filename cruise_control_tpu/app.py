@@ -194,6 +194,9 @@ class CruiseControlApp:
             min_required_num_windows=1,
             min_monitored_partitions_percentage=config.get(
                 "min.valid.partition.ratio"))
+        #: (cache key, goals) for _ready_goals — readiness is stable within
+        #: one (aggregator generation, window)
+        self._ready_goals_cache: Optional[tuple] = None
 
     # ----------------------------------------------------------------- boot
 
@@ -296,7 +299,8 @@ class CruiseControlApp:
             mesh=self.mesh)
 
     def _model(self, requirements=None, data_from: Optional[str] = None,
-               now_ms: Optional[int] = None
+               now_ms: Optional[int] = None,
+               min_valid_partition_ratio: Optional[float] = None
                ) -> Tuple[ClusterTopology, Assignment]:
         """``data_from`` (ParameterUtils.DataFrom,
         GoalBasedOptimizationParameters.java:37-46): VALID_WINDOWS demands
@@ -316,17 +320,43 @@ class CruiseControlApp:
                     include_all_topics=True)
             else:
                 requirements = self._default_requirements
+        if min_valid_partition_ratio is not None:
+            # ParameterUtils.MIN_VALID_PARTITION_RATIO_PARAM: per-request
+            # override of min.valid.partition.ratio on the model gate
+            import dataclasses as _dc
+            requirements = _dc.replace(
+                requirements,
+                min_monitored_partitions_percentage=min_valid_partition_ratio)
         return self.load_monitor.cluster_model(now_ms=now_ms,
                                                requirements=requirements)
 
     def _ready_goals(self) -> Tuple[str, ...]:
-        """GoalOptimizer readyGoals approximation: with fewer valid windows
-        than the monitor keeps, only the hard (anomaly-detection) goals are
-        considered ready; with full coverage all default goals are."""
-        snap = self.load_monitor.state_snapshot()
-        if snap["numValidWindows"] < self.load_monitor.partition_aggregator.num_windows:
-            return tuple(g for g in self.default_goals if G.is_hard(g))
-        return tuple(self.default_goals)
+        """GoalOptimizer ready goals (KafkaCruiseControl.java:714-717): a
+        default goal is ready iff the monitored load meets THAT goal's own
+        ModelCompletenessRequirements (Goal.java:126-148) — snapshot goals
+        become ready after one window at any coverage, distribution goals
+        only once half the window history is valid at the configured
+        monitored-partition ratio."""
+        agg = self.load_monitor.partition_aggregator
+        # readiness only changes when samples/windows change: cache by
+        # (aggregator generation, current window) so a polled STATE endpoint
+        # does not re-aggregate the full [E, W, M] history per request
+        key = (agg.generation, agg.samples_ingested,
+               self.load_monitor._now() // agg.window_ms)
+        cached = self._ready_goals_cache
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        num_windows = agg.num_windows
+        min_ratio = self.config.get("min.valid.partition.ratio")
+        reqs = {g: G.completeness_requirements(g, num_windows, min_ratio)
+                for g in self.default_goals}
+        # only ~3 distinct requirement tuples exist across the goal set;
+        # evaluate each ONCE (each check is a full window aggregation)
+        met = {r: self.load_monitor.meet_completeness_requirements(r)
+               for r in set(reqs.values())}
+        ready = tuple(g for g in self.default_goals if met[reqs[g]])
+        self._ready_goals_cache = (key, ready)
+        return ready
 
     def _sanity_check_goals(self, goal_names: Optional[Sequence[str]],
                             skip_hard_goal_check: bool) -> None:
@@ -387,6 +417,7 @@ class CruiseControlApp:
     def proposals(self, goal_names: Optional[Sequence[str]] = None,
                   ignore_proposal_cache: bool = False,
                   data_from: Optional[str] = None,
+                  min_valid_partition_ratio: Optional[float] = None,
                   use_ready_default_goals: bool = False,
                   exclude_recently_removed_brokers: bool = False,
                   exclude_recently_demoted_brokers: bool = False,
@@ -400,7 +431,8 @@ class CruiseControlApp:
         option_kw.update(self._exclusions(exclude_recently_removed_brokers,
                                           exclude_recently_demoted_brokers))
         use_cache = (not ignore_proposal_cache and not goal_names
-                     and not option_kw and not data_from)
+                     and not option_kw and not data_from
+                     and min_valid_partition_ratio is None)
         if use_cache:
             cached = self._cached_result_if_fresh()
             if cached is not None:
@@ -417,7 +449,8 @@ class CruiseControlApp:
                     self._check_capacity_estimation(allow_capacity_estimation)
                     return cached
                 return self._compute_and_cache(allow_capacity_estimation)
-        topo, assign = self._model(data_from=data_from)
+        topo, assign = self._model(data_from=data_from,
+                                   min_valid_partition_ratio=min_valid_partition_ratio)
         self._check_capacity_estimation(allow_capacity_estimation)
         options = (self._build_options(topo, **option_kw)
                    if option_kw or self.config.get(
@@ -453,6 +486,7 @@ class CruiseControlApp:
                   destination_broker_ids: Sequence[int] = (),
                   concurrency: Optional[int] = None,
                   data_from: Optional[str] = None,
+                  min_valid_partition_ratio: Optional[float] = None,
                   use_ready_default_goals: bool = False,
                   exclude_recently_removed_brokers: bool = False,
                   exclude_recently_demoted_brokers: bool = False,
@@ -476,7 +510,8 @@ class CruiseControlApp:
         if goals is None and use_ready_default_goals:
             goals = self._ready_goals()
         self._sanity_check_goals(goals, skip_hard_goal_check or self_healing)
-        topo, assign = self._model(data_from=data_from)
+        topo, assign = self._model(data_from=data_from,
+                                   min_valid_partition_ratio=min_valid_partition_ratio)
         self._check_capacity_estimation(allow_capacity_estimation)
         options = self._build_options(
             topo, excluded_topics=excluded_topics,
@@ -493,7 +528,9 @@ class CruiseControlApp:
         return summary
 
     def add_brokers(self, broker_ids: Sequence[int], dryrun: bool = True,
-                    data_from: Optional[str] = None, verbose: bool = False,
+                    data_from: Optional[str] = None,
+                    min_valid_partition_ratio: Optional[float] = None,
+                    verbose: bool = False,
                     allow_capacity_estimation: bool = True,
                     use_ready_default_goals: bool = False,
                     exclude_recently_removed_brokers: bool = False,
@@ -502,7 +539,8 @@ class CruiseControlApp:
                     executor_kw: Optional[dict] = None,
                     **kw) -> dict:
         """AddBrokersRunnable: move load onto the new brokers."""
-        topo, assign = self._model(data_from=data_from)
+        topo, assign = self._model(data_from=data_from,
+                                   min_valid_partition_ratio=min_valid_partition_ratio)
         self._check_capacity_estimation(allow_capacity_estimation)
         ids = set(int(b) for b in broker_ids)
         new_mask = np.array([int(b) in ids for b in topo.broker_ids])
@@ -524,7 +562,9 @@ class CruiseControlApp:
 
     def remove_brokers(self, broker_ids: Sequence[int], dryrun: bool = True,
                        self_healing: bool = False,
-                       data_from: Optional[str] = None, verbose: bool = False,
+                       data_from: Optional[str] = None,
+                       min_valid_partition_ratio: Optional[float] = None,
+                       verbose: bool = False,
                        allow_capacity_estimation: bool = True,
                        use_ready_default_goals: bool = False,
                        exclude_recently_removed_brokers: bool = False,
@@ -541,7 +581,8 @@ class CruiseControlApp:
             exclude_recently_demoted_brokers = (
                 exclude_recently_demoted_brokers or self.config.get(
                     "self.healing.exclude.recently.demoted.brokers"))
-        topo, assign = self._model(data_from=data_from)
+        topo, assign = self._model(data_from=data_from,
+                                   min_valid_partition_ratio=min_valid_partition_ratio)
         self._check_capacity_estimation(allow_capacity_estimation)
         ids = set(int(b) for b in broker_ids)
         # removed brokers: not a legal destination; their replicas must leave
@@ -576,7 +617,9 @@ class CruiseControlApp:
 
     def demote_brokers(self, broker_ids: Sequence[int], dryrun: bool = True,
                        self_healing: bool = False,
-                       data_from: Optional[str] = None, verbose: bool = False,
+                       data_from: Optional[str] = None,
+                       min_valid_partition_ratio: Optional[float] = None,
+                       verbose: bool = False,
                        skip_urp_demotion: bool = False,
                        exclude_follower_demotion: bool = False,
                        allow_capacity_estimation: bool = True,
@@ -613,12 +656,15 @@ class CruiseControlApp:
                 broker_id_and_logdirs,
                 demoted_broker_ids=set(int(b) for b in broker_ids),
                 dryrun=dryrun, verbose=verbose,
-                data_from=data_from, skip_urp_demotion=skip_urp_demotion,
+                data_from=data_from,
+                min_valid_partition_ratio=min_valid_partition_ratio,
+                skip_urp_demotion=skip_urp_demotion,
                 allow_capacity_estimation=allow_capacity_estimation,
                 exclude_recently_demoted_brokers=(
                     exclude_recently_demoted_brokers),
                 executor_kw=executor_kw)
-        topo, assign = self._model(data_from=data_from)
+        topo, assign = self._model(data_from=data_from,
+                                   min_valid_partition_ratio=min_valid_partition_ratio)
         self._check_capacity_estimation(allow_capacity_estimation)
         ids = set(int(b) for b in broker_ids)
         idx = {int(b): i for i, b in enumerate(topo.broker_ids)}
@@ -667,14 +713,17 @@ class CruiseControlApp:
                       exclude_recently_demoted_brokers: bool,
                       executor_kw: Optional[dict],
                       demoted_broker_ids: Optional[set] = None,
-                      allow_capacity_estimation: bool = True) -> dict:
+                      allow_capacity_estimation: bool = True,
+                      min_valid_partition_ratio: Optional[float] = None
+                      ) -> dict:
         """Disk demotion: deterministic leadership election off the demoted
         disks (the leadership-only core of PreferredLeaderElectionGoal with
         the named disks in DEMOTED state). ``demoted_broker_ids`` extends
         the walk to whole brokers for combined broker+disk requests."""
         from cruise_control_tpu.analyzer.proposals import ExecutionProposal
         from cruise_control_tpu.common import resources as res
-        topo, assign = self._model(data_from=data_from)
+        topo, assign = self._model(data_from=data_from,
+                                   min_valid_partition_ratio=min_valid_partition_ratio)
         self._check_capacity_estimation(allow_capacity_estimation)
         if not topo.has_disks:
             raise ValueError("cluster model has no JBOD disk information")
@@ -764,6 +813,7 @@ class CruiseControlApp:
     def fix_offline_replicas(self, dryrun: bool = True,
                              self_healing: bool = False,
                              data_from: Optional[str] = None,
+                             min_valid_partition_ratio: Optional[float] = None,
                              verbose: bool = False,
                              allow_capacity_estimation: bool = True,
                              use_ready_default_goals: bool = False,
@@ -780,7 +830,8 @@ class CruiseControlApp:
             exclude_recently_demoted_brokers = (
                 exclude_recently_demoted_brokers or self.config.get(
                     "self.healing.exclude.recently.demoted.brokers"))
-        topo, assign = self._model(data_from=data_from)
+        topo, assign = self._model(data_from=data_from,
+                                   min_valid_partition_ratio=min_valid_partition_ratio)
         self._check_capacity_estimation(allow_capacity_estimation)
         excl = self._exclusions(exclude_recently_removed_brokers,
                                 exclude_recently_demoted_brokers)
@@ -815,12 +866,30 @@ class CruiseControlApp:
             summary["execution"] = self.executor.execute_logdir_moves(moves)
         return summary
 
-    def rebalance_kafka_assigner(self, dryrun: bool = True, **kw) -> dict:
+    def rebalance_kafka_assigner(self, dryrun: bool = True,
+                                 removed_brokers: Sequence[int] = (),
+                                 **kw) -> dict:
         """Kafka-assigner mode (analyzer/kafkaassigner): deterministic even
-        rack-aware placement + disk-usage balancing."""
+        rack-aware placement + disk-usage balancing.
+
+        ``removed_brokers``: REMOVE_BROKER with kafka_assigner=true — the
+        decommissioned brokers are treated as dead for the placement (the
+        reference marks them dead before running the assigner goals,
+        RemoveBrokerRunnable kafka-assigner mode), so every replica leaves
+        them. ADD_BROKER needs no special casing: the even placement spreads
+        onto the new brokers by construction."""
         from cruise_control_tpu.analyzer import intra_broker as IB
         from cruise_control_tpu.analyzer import proposals as PR
         topo, assign = self._model()
+        if removed_brokers:
+            idx = {int(b): i for i, b in enumerate(
+                topo.broker_ids if topo.broker_ids is not None
+                else range(topo.num_brokers))}
+            alive = topo.broker_alive.copy()
+            for b in removed_brokers:
+                if int(b) in idx:
+                    alive[idx[int(b)]] = False
+            topo = dataclasses.replace(topo, broker_alive=alive)
         new = IB.kafka_assigner_even_rack_aware(topo, assign)
         new = IB.kafka_assigner_disk_usage_distribution(topo, new)
         props = PR.diff(topo, assign, new)
@@ -829,24 +898,47 @@ class CruiseControlApp:
                                               for p in props),
                    "mode": "kafka_assigner"}
         if not dryrun:
-            summary["execution"] = self.executor.execute_proposals(props)
+            summary["execution"] = self.executor.execute_proposals(
+                props, removed_brokers=removed_brokers)
         return summary
 
     def update_topic_replication_factor(self, topic_pattern: str,
                                         replication_factor: int,
-                                        dryrun: bool = True, **kw) -> dict:
+                                        dryrun: bool = True,
+                                        skip_rack_awareness_check: bool = False,
+                                        **kw) -> dict:
         """UpdateTopicConfigurationRunnable: change matching topics' RF
         (ClusterModel.createOrDeleteReplicas, ClusterModel.java:906).
 
         Increase: add replicas on rack-diverse, least-loaded brokers that do
         not already host the partition. Decrease: drop follower replicas
-        from the tail (never the leader)."""
+        from the tail (never the leader). ``skip_rack_awareness_check``
+        (ParameterUtils SKIP_RACK_AWARENESS_CHECK_PARAM): without it, an RF
+        higher than the number of alive racks is rejected — it could not be
+        placed rack-aware."""
         import re
 
         from cruise_control_tpu.analyzer.proposals import ExecutionProposal
         from cruise_control_tpu.common import resources as res
         pat = re.compile(topic_pattern)
         topo, assign = self._model()
+        if not skip_rack_awareness_check and replication_factor > 1:
+            # only an RF INCREASE places new replicas; a decrease drops tail
+            # followers and needs no rack headroom
+            tmask = np.array([bool(pat.fullmatch(t))
+                              for t in topo.topic_names])
+            matched = tmask[topo.topic_of_partition]
+            increases = bool(
+                (np.asarray(topo.rf_of_partition)[matched]
+                 < replication_factor).any())
+            alive_racks = len({int(r) for r, a in zip(topo.rack_of_broker,
+                                                      topo.broker_alive) if a})
+            if increases and replication_factor > alive_racks:
+                raise ValueError(
+                    f"replication factor {replication_factor} exceeds the "
+                    f"number of alive racks ({alive_racks}); rack-aware "
+                    "placement is impossible. Set "
+                    "skip_rack_awareness_check=true to proceed anyway.")
         bo = np.asarray(assign.broker_of)
         lo = np.asarray(assign.leader_of)
         ids = np.asarray(topo.broker_ids)
@@ -921,17 +1013,25 @@ class CruiseControlApp:
 
     # ----------------------------------------------------------------- state
 
-    def state(self) -> dict:
-        """CruiseControlState for the STATE endpoint."""
-        return {
+    def state(self, super_verbose: bool = False) -> dict:
+        """CruiseControlState for the STATE endpoint. ``super_verbose``
+        (CruiseControlState.writeSuperVerbose): adds the extrapolated
+        metric-sample flaws and the linear-regression model state."""
+        out = {
             "MonitorState": self.load_monitor.state_snapshot(),
             "ExecutorState": self.executor.state_snapshot(),
             "AnalyzerState": {
                 "isProposalReady": self._proposal_cache is not None,
-                "readyGoals": list(self.default_goals),
+                "readyGoals": list(self._ready_goals()),
             },
             "AnomalyDetectorState": self.anomaly_detector.state_snapshot(),
         }
+        if super_verbose:
+            out["MonitorState"]["extrapolatedMetricSamples"] = (
+                self.load_monitor.sample_extrapolations())
+            out["MonitorState"]["linearRegressionModelState"] = (
+                self.load_monitor.cpu_model.to_json())
+        return out
 
     def kafka_cluster_state(self, populate_disk_info: bool = False) -> dict:
         md = self._metadata_source.get_metadata()

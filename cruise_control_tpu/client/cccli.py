@@ -73,6 +73,8 @@ _GOAL_BASED = (
     Parameter("skip_hard_goal_check", "skip-hard-goal-check", "bool"),
     Parameter("allow_capacity_estimation", "allow-capacity-estimation",
               "bool"),
+    Parameter("min_valid_partition_ratio", "min-valid-partition-ratio",
+              "string", "Per-request completeness ratio override"),
     Parameter("verbose", "verbose", "bool"),
 )
 #: per-request executor overrides
@@ -99,7 +101,9 @@ class Endpoint:
 ENDPOINTS: List[Endpoint] = [
     Endpoint("state", "GET", "Cruise Control substates", (
         Parameter("substates", "substates", "csv",
-                  "monitor,analyzer,executor,anomaly_detector"),)),
+                  "monitor,analyzer,executor,anomaly_detector"),
+        Parameter("super_verbose", "super-verbose", "bool",
+                  "Include sample-extrapolation flaws and CPU model state"),)),
     Endpoint("kafka_cluster_state", "GET", "Kafka cluster state", (
         Parameter("populate_disk_info", "populate-disk-info", "bool"),)),
     Endpoint("load", "GET", "Per-broker load", (
@@ -111,10 +115,14 @@ ENDPOINTS: List[Endpoint] = [
         Parameter("topic", "topic", "string", "Topic regex"),
         Parameter("brokerid", "brokers", "csv-int", "Leader broker filter"),
         Parameter("max_load", "max-load", "bool",
-                  "Report max-window load instead of the average"),)),
+                  "Report max-window load instead of the average"),
+        Parameter("avg_load", "avg-load", "bool",
+                  "Force the average even when max-load is set"),)),
     Endpoint("proposals", "GET", "Optimization proposals", (
         _GOALS,
         Parameter("ignore_proposal_cache", "ignore-proposal-cache", "bool"),
+        Parameter("kafka_assigner", "kafka-assigner", "bool",
+                  "Kafka-assigner mode"),
         *_GOAL_BASED), is_async=True),
     Endpoint("user_tasks", "GET", "Active/completed user tasks", (
         Parameter("user_task_ids", "task-ids", "csv"),
@@ -144,11 +152,15 @@ ENDPOINTS: List[Endpoint] = [
         *_GOAL_BASED, *_EXECUTOR), is_async=True),
     Endpoint("add_broker", "POST", "Move load onto new brokers",
              (_BROKERS, _DRYRUN,
+              Parameter("kafka_assigner", "kafka-assigner", "bool",
+                        "Kafka-assigner mode"),
               Parameter("throttle_added_broker", "throttle", "int"),
               *[p for p in _GOAL_BASED if p.name != "skip_hard_goal_check"],
               *_EXECUTOR), is_async=True),
     Endpoint("remove_broker", "POST", "Drain brokers",
              (_BROKERS, _DRYRUN,
+              Parameter("kafka_assigner", "kafka-assigner", "bool",
+                        "Kafka-assigner mode"),
               Parameter("throttle_removed_broker", "throttle", "int"),
               *[p for p in _GOAL_BASED if p.name != "skip_hard_goal_check"],
               *_EXECUTOR), is_async=True),
@@ -164,6 +176,9 @@ ENDPOINTS: List[Endpoint] = [
                         "exclude-recently-demoted-brokers", "bool"),
               Parameter("allow_capacity_estimation",
                         "allow-capacity-estimation", "bool"),
+              Parameter("min_valid_partition_ratio",
+                        "min-valid-partition-ratio", "string",
+                        "Per-request completeness ratio override"),
               Parameter("verbose", "verbose", "bool"),
               *_EXECUTOR), is_async=True),
     Endpoint("fix_offline_replicas", "POST", "Self-heal offline replicas",
@@ -197,6 +212,8 @@ ENDPOINTS: List[Endpoint] = [
     Endpoint("topic_configuration", "POST", "Change topic replication factor", (
         Parameter("topic", "topic", "string", "Topic regex"),
         Parameter("replication_factor", "replication-factor", "int"),
+        Parameter("skip_rack_awareness_check", "skip-rack-awareness-check",
+                  "bool", "Allow RF above the alive-rack count"),
         _DRYRUN,), is_async=True),
 ]
 

@@ -65,6 +65,15 @@ class ClusterAdapter:
     def in_progress_reassignments(self) -> Set[str]:
         raise NotImplementedError
 
+    def cancel_reassignments(self, tasks: Sequence[ExecutionTask]) -> None:
+        """Actively cancel the in-flight reassignments of ``tasks``, rolling
+        each partition back to a safe (pre-move) target — the adapter-side
+        half of a graceful abort (Executor.java abort handling +
+        ExecutorUtils.scala:22-34; KIP-455 cancellation post-2.4). Adapters
+        that cannot cancel may leave this unimplemented; the executor then
+        falls back to bookkeeping-only aborts."""
+        raise NotImplementedError
+
     # -- replication throttling (ReplicationThrottleHelper.java:29-79 seam):
     # per-broker leader/follower rates + per-topic throttled replica lists.
     def set_broker_throttle_rate(self, broker_ids: Sequence[int],
@@ -137,6 +146,12 @@ class FakeClusterAdapter(ClusterAdapter):
 
     def in_progress_reassignments(self):
         return set(self._pending)
+
+    def cancel_reassignments(self, tasks):
+        """Stop the pending moves: the partition keeps its current replica
+        set (the old assignment — the fake applies atomically on completion)."""
+        for t in tasks:
+            self._pending.pop(t.proposal.topic_partition, None)
 
     def set_broker_throttle_rate(self, broker_ids, rate):
         for b in broker_ids:
@@ -616,6 +631,7 @@ class Executor:
             rounds += 1
             now = int(time.time() * 1000)
             still = []
+            aborting: List[ExecutionTask] = []
             stopping = self._stop_requested.is_set()
             forced = self._force_stop.is_set()
             for t in open_tasks:
@@ -629,8 +645,7 @@ class Executor:
                                 t.proposal.topic_partition)):
                         t.transition(TaskState.ABORTING, now)
                         self.tracker.mark(t, TaskState.IN_PROGRESS)
-                        t.transition(TaskState.ABORTED, now)
-                        self.tracker.mark(t, TaskState.ABORTING)
+                        aborting.append(t)
                         continue
                 if outcome is None:
                     still.append(t)
@@ -638,6 +653,30 @@ class Executor:
                     prev = t.state
                     t.transition(outcome, now)
                     self.tracker.mark(t, prev)
+            if aborting:
+                # adapter-side cancel BEFORE marking ABORTED: a graceful
+                # abort rewrites the in-flight reassignment to a safe
+                # target, it does not merely stop the bookkeeping (forced
+                # stop is the drop-without-cancel path)
+                try:
+                    self.adapter.cancel_reassignments(aborting)
+                except NotImplementedError:
+                    logger.warning(
+                        "%s cannot cancel reassignments; aborting %d tasks "
+                        "in bookkeeping only", type(self.adapter).__name__,
+                        len(aborting))
+                except Exception:
+                    # a transient admin-API failure must not crash the stop:
+                    # the tasks still transition to ABORTED (the tracker's
+                    # per-broker accounting depends on it) and the operator
+                    # sees the failure in the log
+                    logger.exception(
+                        "cancel_reassignments failed for %d tasks during "
+                        "graceful stop; marking them ABORTED anyway",
+                        len(aborting))
+                for t in aborting:
+                    t.transition(TaskState.ABORTED, now)
+                    self.tracker.mark(t, TaskState.ABORTING)
             open_tasks = still
             if open_tasks:
                 time.sleep(self._effective_check_interval_ms() / 1000.0)

@@ -269,6 +269,16 @@ class KafkaClusterAdapter:
         out = self._admin.list_partition_reassignments()
         return {f"{t}-{p}" for (t, p) in out}
 
+    def cancel_reassignments(self, tasks):
+        """Graceful abort: KIP-455 cancellation — a null replica list per
+        partition reverts the in-flight reassignment to the pre-move state
+        (the post-2.4 equivalent of the reference's ZK-node rewrite,
+        ExecutorUtils.scala:22-34)."""
+        cancels = {(t.proposal.topic, t.proposal.partition): None
+                   for t in tasks}
+        if cancels:
+            self._admin.alter_partition_reassignments(cancels)
+
     # Dynamic-config sources in DescribeConfigs responses (Kafka protocol
     # ConfigSource): 1 = TOPIC_CONFIG (a topic's dynamic override),
     # 2 = DYNAMIC_BROKER_CONFIG. 3/4/5 are default/static sources that must

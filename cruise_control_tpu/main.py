@@ -82,15 +82,35 @@ def main(argv=None) -> int:
                         help="do not start the periodic sampler thread")
     args = parser.parse_args(argv)
 
+    # persistent XLA compile cache: a service restart reloads the compiled
+    # proposal programs from disk instead of re-paying minutes of XLA
+    # compile. Set through jax.config (not just the env var): backends whose
+    # sitecustomize imports jax before this line would otherwise have
+    # materialized the config default without the cache dir.
+    import os
+
+    import jax
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.getcwd(), ".jax_cache"))
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     from cruise_control_tpu.common.config import CruiseControlConfig
     from cruise_control_tpu.server import rest
     config = CruiseControlConfig(properties_file=args.config)
     if args.demo or not config.get("bootstrap.servers"):
         app = build_demo_app(config)
-        # prime a few windows so the model is immediately buildable
+        # prime a few windows so the model is immediately buildable. The
+        # windows must END AT WALL TIME: the monitor clock is real time, so
+        # epoch-anchored sample timestamps would all be ancient and every
+        # model build would fail the completeness gate.
+        import time as _time
         w = config.get("partition.metrics.window.ms")
-        for i in range(config.get("num.partition.metrics.windows") + 1):
-            app.load_monitor.sample_once(now_ms=i * w + w // 2)
+        n = config.get("num.partition.metrics.windows")
+        now = int(_time.time() * 1000)
+        for i in range(n + 1):
+            app.load_monitor.sample_once(now_ms=now - (n - i) * w)
     else:
         app = build_kafka_app(config)
 

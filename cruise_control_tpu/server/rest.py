@@ -95,6 +95,9 @@ def _goal_based_params(params: Dict[str, str]) -> dict:
                                          False),
         allow_capacity_estimation=_parse_bool(
             params, "allow_capacity_estimation", True),
+        min_valid_partition_ratio=(
+            float(params["min_valid_partition_ratio"])
+            if params.get("min_valid_partition_ratio") else None),
     )
 
 
@@ -252,7 +255,8 @@ class RestApi:
     # ------------------------------------------------------------ GET
 
     def _state(self, params, client_id, request_url):
-        state = self.app.state()
+        state = self.app.state(
+            super_verbose=_parse_bool(params, "super_verbose", False))
         substates = _parse_csv(params, "substates")
         if substates:
             want = {s.lower() for s in substates}
@@ -271,6 +275,12 @@ class RestApi:
         return 200, REGISTRY.snapshot()
 
     def _proposals(self, params, client_id, request_url):
+        if _parse_bool(params, "kafka_assigner", False):
+            # ProposalsParameters accepts KAFKA_ASSIGNER_MODE_PARAM: the
+            # proposals come from the deterministic assigner goals
+            return self._async_op(
+                "PROPOSALS", params, client_id, request_url,
+                lambda: self.app.rebalance_kafka_assigner(dryrun=True))
         goals = _parse_csv(params, "goals") or None
         ignore_cache = _parse_bool(params, "ignore_proposal_cache", False)
         verbose = _parse_bool(params, "verbose", False)
@@ -330,8 +340,10 @@ class RestApi:
         lo = np.asarray(assign.leader_of)
         # max_load=true reports the MAX over metric windows instead of the
         # collapsed average (PartitionLoadParameters max_load/avg_load
-        # booleans; model/Load.java:84-118 expectedUtilizationFor)
-        use_max = _parse_bool(params, "max_load", False)
+        # booleans; model/Load.java:84-118 expectedUtilizationFor);
+        # avg_load=true explicitly forces the average even with max_load set
+        use_max = (_parse_bool(params, "max_load", False)
+                   and not _parse_bool(params, "avg_load", False))
         windowed = use_max and topo.replica_base_load_windows is not None
         if windowed:
             win = (topo.replica_base_load_windows[lo]
@@ -488,6 +500,12 @@ class RestApi:
         if not ids:
             return 400, {"errorMessage": "brokerid parameter required"}
         dry = _parse_bool(params, "dryrun", True)
+        if _parse_bool(params, "kafka_assigner", False):
+            # AddedOrRemovedBrokerParameters accepts kafka_assigner: the
+            # even placement spreads onto the new brokers deterministically
+            return self._async_op(
+                "ADD_BROKER", params, client_id, request_url,
+                lambda: self.app.rebalance_kafka_assigner(dryrun=dry))
         verbose = _parse_bool(params, "verbose", False)
         df = params.get("data_from")
         gb = _goal_based_params(params)
@@ -508,6 +526,13 @@ class RestApi:
         if not ids:
             return 400, {"errorMessage": "brokerid parameter required"}
         dry = _parse_bool(params, "dryrun", True)
+        if _parse_bool(params, "kafka_assigner", False):
+            # kafka-assigner decommission: removed brokers become dead for
+            # the deterministic placement, so every replica drains off them
+            return self._async_op(
+                "REMOVE_BROKER", params, client_id, request_url,
+                lambda: self.app.rebalance_kafka_assigner(
+                    dryrun=dry, removed_brokers=ids))
         verbose = _parse_bool(params, "verbose", False)
         df = params.get("data_from")
         gb = _goal_based_params(params)
@@ -554,11 +579,14 @@ class RestApi:
                                     False)
         ace = _parse_bool(params, "allow_capacity_estimation", True)
         erd = _parse_bool(params, "exclude_recently_demoted_brokers", False)
+        mvpr = (float(params["min_valid_partition_ratio"])
+                if params.get("min_valid_partition_ratio") else None)
         ek = _executor_params(params)
         return self._async_op("DEMOTE_BROKER", params, client_id, request_url,
                               lambda: self.app.demote_brokers(
                                   ids, dryrun=dry, verbose=verbose,
                                   data_from=df,
+                                  min_valid_partition_ratio=mvpr,
                                   skip_urp_demotion=skip_urp,
                                   exclude_follower_demotion=excl_follower,
                                   allow_capacity_estimation=ace,
@@ -653,10 +681,12 @@ class RestApi:
             return 400, {"errorMessage":
                          "topic and replication_factor parameters required"}
         dry = _parse_bool(params, "dryrun", True)
+        skip_rack = _parse_bool(params, "skip_rack_awareness_check", False)
         return self._async_op(
             "TOPIC_CONFIGURATION", params, client_id, request_url,
             lambda: self.app.update_topic_replication_factor(
-                topic_pattern=topic, replication_factor=int(rf), dryrun=dry))
+                topic_pattern=topic, replication_factor=int(rf), dryrun=dry,
+                skip_rack_awareness_check=skip_rack))
 
 
 def _to_plaintext(payload, indent: int = 0) -> str:

@@ -312,3 +312,46 @@ def test_partition_load_max_window_and_broker_filter():
                               {"brokerid": "0", "entries": "100"})
     assert code == 200
     assert body["records"] and all(r["leader"] == 0 for r in body["records"])
+
+
+def test_per_goal_completeness_requirements_gate_ready_goals():
+    """Ready goals honor each goal's own ModelCompletenessRequirements
+    (Goal.java:126-148, KafkaCruiseControl.java:714-717): with ONE valid
+    window of a four-window history, snapshot goals (RackAware, capacity,
+    replica-count families — 1 window) are ready while the distribution
+    family (ResourceDistributionGoal.java:147-149 — num_windows/2 valid
+    windows at the monitored ratio) is not."""
+    from cruise_control_tpu.app import CruiseControlApp
+    from cruise_control_tpu.monitor.load_monitor import StaticMetadataSource
+    from cruise_control_tpu.monitor.sampler import SyntheticLoadSampler
+    from cruise_control_tpu.executor.executor import FakeClusterAdapter
+    from cruise_control_tpu.common.config import CruiseControlConfig
+    from tests.test_server import _metadata
+
+    cfg = CruiseControlConfig({
+        "optimizer.engine": "greedy",
+        "partition.metrics.window.ms": W,
+        "num.partition.metrics.windows": 4,
+        "min.valid.partition.ratio": 0.95,
+        "failed.brokers.file.path": ""})
+    md = _metadata()
+    app = CruiseControlApp(cfg, StaticMetadataSource(md),
+                           SyntheticLoadSampler(seed=4),
+                           cluster_adapter=FakeClusterAdapter({}))
+    app.load_monitor._now = lambda: 4 * W
+    app.load_monitor.sample_once(now_ms=30_000)            # one valid window
+
+    ready = set(app._ready_goals())
+    distribution = {"PotentialNwOutGoal", "DiskUsageDistributionGoal",
+                    "NetworkInboundUsageDistributionGoal",
+                    "NetworkOutboundUsageDistributionGoal",
+                    "CpuUsageDistributionGoal", "LeaderBytesInDistributionGoal"}
+    assert ready & distribution == set(), ready
+    assert "RackAwareGoal" in ready
+    assert "DiskCapacityGoal" in ready
+    assert "ReplicaDistributionGoal" in ready
+
+    # fill the history: every default goal becomes ready
+    for w in range(1, 4):
+        app.load_monitor.sample_once(now_ms=w * W + 30_000)
+    assert set(app._ready_goals()) == set(app.default_goals)

@@ -265,3 +265,108 @@ def test_unknown_strategy_rejects_without_wedging_executor():
     assert ex.state == ExecutorState.NO_TASK_IN_PROGRESS
     summary = ex.execute_proposals(props)      # executor still usable
     assert summary["taskCounts"]["INTER_BROKER_REPLICA_ACTION"]["COMPLETED"] == 1
+
+
+def test_graceful_stop_cancels_reassignments_at_adapter():
+    """Graceful stop actively cancels the in-flight reassignment at the
+    ADAPTER (Executor.java abort + ExecutorUtils.scala:22-34 /
+    KIP-455 cancellation) — not just task-state bookkeeping: the adapter's
+    pending moves are withdrawn and the partitions keep their old replicas.
+    Forced stop, by contrast, drops tasks without any adapter-side cancel."""
+    cancelled = []
+
+    class SpyAdapter(FakeClusterAdapter):
+        def cancel_reassignments(self, tasks):
+            cancelled.extend(t.proposal.topic_partition for t in tasks)
+            super().cancel_reassignments(tasks)
+
+    props = [_proposal("t", i, [0, 1], [2, 1]) for i in range(4)]
+    adapter = SpyAdapter({p.topic_partition: p.old_replicas for p in props},
+                         latency_polls=10_000)      # never completes on its own
+    ex = Executor(adapter, ExecutorConfig(
+        execution_progress_check_interval_ms=5,
+        num_concurrent_partition_movements_per_broker=4))
+    done = {}
+    th = threading.Thread(
+        target=lambda: done.update(summary=ex.execute_proposals(props)))
+    th.start()
+    time.sleep(0.05)
+    ex.stop_execution(forced=False)
+    th.join(timeout=30)
+    assert done["summary"]["stopped"] and not done["summary"]["forcedStop"]
+    counts = done["summary"]["taskCounts"]["INTER_BROKER_REPLICA_ACTION"]
+    assert counts.get("ABORTED", 0) >= 1
+    assert len(cancelled) >= 1                      # adapter-side cancel observed
+    for tp in cancelled:
+        assert tp not in adapter.in_progress_reassignments()
+        assert adapter.replicas[tp] == (0, 1)       # rolled back / never applied
+
+    # forced stop on a fresh executor: NO adapter-side cancel, tasks DEAD
+    cancelled.clear()
+    adapter2 = SpyAdapter({p.topic_partition: p.old_replicas for p in props},
+                          latency_polls=10_000)
+    ex2 = Executor(adapter2, ExecutorConfig(
+        execution_progress_check_interval_ms=5,
+        num_concurrent_partition_movements_per_broker=4))
+    th2 = threading.Thread(
+        target=lambda: done.update(summary2=ex2.execute_proposals(props)))
+    th2.start()
+    time.sleep(0.05)
+    ex2.stop_execution(forced=True)
+    th2.join(timeout=30)
+    counts2 = done["summary2"]["taskCounts"]["INTER_BROKER_REPLICA_ACTION"]
+    assert counts2.get("DEAD", 0) >= 1
+    assert cancelled == []                          # forced = drop, no cancel
+
+
+def test_adapter_without_cancel_still_aborts_in_bookkeeping():
+    """An adapter that leaves cancel_reassignments unimplemented must not
+    break graceful stop: tasks still transition to ABORTED."""
+
+    class NoCancelAdapter(FakeClusterAdapter):
+        def cancel_reassignments(self, tasks):
+            raise NotImplementedError
+
+    props = [_proposal("t", i, [0, 1], [2, 1]) for i in range(2)]
+    adapter = NoCancelAdapter(
+        {p.proposal.topic_partition if hasattr(p, "proposal")
+         else p.topic_partition: p.old_replicas for p in props},
+        latency_polls=10_000)
+    ex = Executor(adapter, ExecutorConfig(
+        execution_progress_check_interval_ms=5,
+        num_concurrent_partition_movements_per_broker=2))
+    done = {}
+    th = threading.Thread(
+        target=lambda: done.update(summary=ex.execute_proposals(props)))
+    th.start()
+    time.sleep(0.05)
+    ex.stop_execution(forced=False)
+    th.join(timeout=30)
+    counts = done["summary"]["taskCounts"]["INTER_BROKER_REPLICA_ACTION"]
+    assert counts.get("ABORTED", 0) >= 1
+
+
+def test_hung_adapter_triggers_alerting_threshold_warning(caplog):
+    """task.execution.alerting.threshold.ms: a batch stuck in flight past
+    the threshold logs the alert (the reference fires a sensor + warning),
+    and the round budget eventually marks the stragglers DEAD — driven by a
+    genuinely HUNG adapter, not synthetic latency that completes."""
+    import logging
+
+    class HungAdapter(FakeClusterAdapter):
+        def current_replicas(self, tp):       # never progresses
+            return self.replicas.get(tp, ())
+
+    props = [_proposal("t", 0, [0, 1], [2, 1])]
+    adapter = HungAdapter({p.topic_partition: p.old_replicas for p in props})
+    ex = Executor(adapter, ExecutorConfig(
+        execution_progress_check_interval_ms=5,
+        max_execution_progress_check_rounds=30,
+        task_execution_alerting_threshold_ms=20))
+    with caplog.at_level(logging.WARNING,
+                         logger="cruise_control_tpu.executor.executor"):
+        summary = ex.execute_proposals(props)
+    assert summary["timedOut"]
+    counts = summary["taskCounts"]["INTER_BROKER_REPLICA_ACTION"]
+    assert counts.get("DEAD", 0) == 1
+    assert any("alerting threshold" in r.message for r in caplog.records)

@@ -459,3 +459,83 @@ def test_verbose_response_has_per_broker_stats():
                 "NwOutRate", "PnwOutRate"} <= set(row)
     # non-verbose responses stay lean
     assert "loadBeforeOptimization" not in r.to_json(verbose=False)
+
+
+def test_tail_parameters_surface():
+    """The last four ParameterUtils params: min_valid_partition_ratio,
+    avg_load, super_verbose, skip_rack_awareness_check."""
+    app = _app()
+    api = rest.RestApi(app)
+
+    # min_valid_partition_ratio: an impossible per-request ratio fails the
+    # completeness gate; an explicit 0.0 passes it
+    code, body = api.dispatch("GET", "PROPOSALS",
+                              {"ignore_proposal_cache": "true",
+                               "min_valid_partition_ratio": "1.5",
+                               "get_response_timeout_ms": "60000"})
+    assert code == 500 and ("ratio" in body["errorMessage"]
+                            or "valid windows" in body["errorMessage"]), body
+    code, body = api.dispatch("GET", "PROPOSALS",
+                              {"ignore_proposal_cache": "true",
+                               "min_valid_partition_ratio": "0.0",
+                               "get_response_timeout_ms": "60000"})
+    assert code == 200, body
+
+    # avg_load=true overrides max_load (PartitionLoadParameters)
+    code, body_max = api.dispatch("GET", "PARTITION_LOAD",
+                                  {"max_load": "true", "entries": "5"})
+    assert code == 200
+    code, body_avg = api.dispatch("GET", "PARTITION_LOAD",
+                                  {"max_load": "true", "avg_load": "true",
+                                   "entries": "5"})
+    assert code == 200
+
+    # super_verbose STATE carries sample-extrapolation flaws and the LR
+    # model state (CruiseControlState.writeSuperVerbose)
+    code, state = api.dispatch("GET", "STATE", {"super_verbose": "true"})
+    assert code == 200
+    assert "extrapolatedMetricSamples" in state["MonitorState"]
+    assert "linearRegressionModelState" in state["MonitorState"]
+    code, state = api.dispatch("GET", "STATE", {})
+    assert "extrapolatedMetricSamples" not in state["MonitorState"]
+
+    # skip_rack_awareness_check: RF above the alive-rack count is rejected
+    # unless skipped (_metadata uses 3 racks)
+    code, body = api.dispatch("POST", "TOPIC_CONFIGURATION",
+                              {"topic": "T", "replication_factor": "5",
+                               "get_response_timeout_ms": "60000"})
+    assert code == 500 and "rack" in body["errorMessage"], body
+    code, body = api.dispatch("POST", "TOPIC_CONFIGURATION",
+                              {"topic": "T", "replication_factor": "5",
+                               "skip_rack_awareness_check": "true",
+                               "get_response_timeout_ms": "60000"})
+    assert code == 200, body
+
+
+def test_kafka_assigner_mode_on_proposals_and_remove():
+    """KAFKA_ASSIGNER_MODE_PARAM is valid on PROPOSALS and
+    ADD/REMOVE_BROKER (AddedOrRemovedBrokerParameters.java:32,
+    ProposalsParameters.java:36), not just REBALANCE. REMOVE with the flag
+    drains the removed brokers via the deterministic assigner placement."""
+    app = _app()
+    api = rest.RestApi(app)
+    code, body = api.dispatch("GET", "PROPOSALS",
+                              {"kafka_assigner": "true",
+                               "get_response_timeout_ms": "60000"})
+    assert code == 200, body
+    assert body["mode"] == "kafka_assigner"
+
+    code, body = api.dispatch("POST", "REMOVE_BROKER",
+                              {"brokerid": "2", "kafka_assigner": "true",
+                               "dryrun": "true",
+                               "get_response_timeout_ms": "60000"})
+    assert code == 200, body
+    assert body["mode"] == "kafka_assigner"
+    for p in body["proposals"]:
+        assert 2 not in p["newReplicas"], p     # drained off broker 2
+
+    code, body = api.dispatch("POST", "ADD_BROKER",
+                              {"brokerid": "0", "kafka_assigner": "true",
+                               "dryrun": "true",
+                               "get_response_timeout_ms": "60000"})
+    assert code == 200, body
