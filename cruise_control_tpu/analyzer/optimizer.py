@@ -162,10 +162,11 @@ def _broker_rows(dt, topo, assign, agg=None) -> List[dict]:
         agg = compute_aggregates(dt, assign, 1)
     broker_ids = (topo.broker_ids if topo.broker_ids is not None
                   else list(range(topo.num_brokers)))
-    load = np.asarray(jax.device_get(agg.broker_load))
-    cnt = np.asarray(jax.device_get(agg.replica_count))
-    lead = np.asarray(jax.device_get(agg.leader_count))
-    pot = np.asarray(jax.device_get(agg.potential_nw_out))
+    # one batched transfer: four separate device_gets each pay the
+    # device-tunnel round trip
+    load, cnt, lead, pot = map(np.asarray, jax.device_get(
+        (agg.broker_load, agg.replica_count, agg.leader_count,
+         agg.potential_nw_out)))
     rows = []
     for i in range(topo.num_brokers):
         rows.append({
@@ -348,11 +349,11 @@ def optimize(topo: ClusterTopology, assign: Assignment,
                               sparse_topic=sparse_topic, agg=agg_after)
     _mark("eval+stats after")
     report_progress("Decoding execution proposals")
-    props = PR.diff(topo, assign, final)
     # movement counts derived from the proposal diff so both engines report
-    # the same thing the executor will do.
-    n_moves = sum(len(p.replicas_to_add) for p in props)
-    n_lead = sum(1 for p in props if p.has_leader_action)
+    # the same thing the executor will do; the vectorized stats avoid the
+    # ~150K per-proposal set-differences of the property accessors
+    props, n_moves, n_lead, data_to_move = PR.diff(topo, assign, final,
+                                                   with_stats=True)
 
     _mark("proposal diff")
     names_ext = goal_names + (G.SELF_HEALING_TERM,)
@@ -384,8 +385,7 @@ def optimize(topo: ClusterTopology, assign: Assignment,
         balancedness_after=_balancedness(goal_names, va),
         num_replica_movements=n_moves,
         num_leadership_movements=n_lead,
-        inter_broker_data_to_move=float(sum(p.inter_broker_data_to_move()
-                                            for p in props)),
+        inter_broker_data_to_move=data_to_move,
         engine=engine,
         wall_time_s=time.time() - t0,
         final_assignment=final,

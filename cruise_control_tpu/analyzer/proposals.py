@@ -80,9 +80,12 @@ def _broker_ids(topo: ClusterTopology) -> np.ndarray:
     return np.arange(topo.num_brokers, dtype=np.int32)
 
 
-def diff(topo: ClusterTopology, initial: Assignment, final: Assignment
-         ) -> List[ExecutionProposal]:
+def diff(topo: ClusterTopology, initial: Assignment, final: Assignment,
+         with_stats: bool = False):
     """Set of proposals for every changed partition (AnalyzerUtils.getDiff).
+
+    ``with_stats``: also return ``(n_replica_moves, n_leadership_moves,
+    inter_broker_data_to_move)`` computed vectorized from the id matrices.
 
     Replica-list order: the new leader first, then the surviving replicas in
     their original slot order (the reference preserves insertion order with
@@ -110,7 +113,7 @@ def diff(topo: ClusterTopology, initial: Assignment, final: Assignment
     changed = (ib != fb2).any(axis=1) | (init_l != fin_l)
     idxs = np.flatnonzero(changed)
     if idxs.size == 0:
-        return []
+        return ([], 0, 0, 0.0) if with_stats else []
 
     reps_c = reps[idxs]                                      # [N, m]
     valid_c = valid[idxs]
@@ -124,8 +127,10 @@ def diff(topo: ClusterTopology, initial: Assignment, final: Assignment
         order = np.argsort(key, axis=1, kind="stable")
         return np.take_along_axis(broker_ids_mat, order, axis=1)
 
-    old_sorted = leader_first(ib_ids, init_l[idxs]).tolist()
-    new_sorted = leader_first(fb_ids, fin_l[idxs]).tolist()
+    old_mat = leader_first(ib_ids, init_l[idxs])             # [N, m]
+    new_mat = leader_first(fb_ids, fin_l[idxs])
+    old_sorted = old_mat.tolist()
+    new_sorted = new_mat.tolist()
     old_leader = ids[init_b[init_l[idxs]]].tolist()
     disk_c = disk[idxs].astype(float).tolist()
     t_of_p = np.asarray(topo.topic_of_partition)[idxs].tolist()
@@ -133,7 +138,7 @@ def diff(topo: ClusterTopology, initial: Assignment, final: Assignment
     pidx = (np.asarray(topo.partition_index)[idxs].tolist()
             if topo.partition_index is not None else idxs.tolist())
 
-    return [
+    props = [
         ExecutionProposal(
             topic=tnames[t] if tnames else str(t),
             partition=pi,
@@ -144,3 +149,14 @@ def diff(topo: ClusterTopology, initial: Assignment, final: Assignment
         )
         for t, pi, ol, olist, nlist, dz in zip(
             t_of_p, pidx, old_leader, old_sorted, new_sorted, disk_c)]
+    if not with_stats:
+        return props
+    # movement stats vectorized over the leader-first id matrices computed
+    # above — the same numbers `replicas_to_add`/`has_leader_action` yield
+    # per proposal, but without ~150K python set-differences at scale
+    in_old = (new_mat[:, :, None] == old_mat[:, None, :]).any(axis=2)
+    adds = ((~in_old) & (new_mat != -1)).sum(axis=1)         # [N]
+    n_moves = int(adds.sum())
+    n_lead = int((new_mat[:, 0] != np.asarray(old_leader)).sum())
+    data_to_move = float((disk[idxs] * adds).sum())
+    return props, n_moves, n_lead, data_to_move

@@ -374,3 +374,22 @@ def test_repair_host_claims_prevent_band_edge_double_count():
                                      topo.num_topics, init)
     assert (float(np.asarray(after.value)[0])
             <= float(np.asarray(before.value)[0]) + 1e-3)
+
+
+def test_diff_with_stats_matches_per_proposal_properties():
+    """diff(with_stats=True)'s vectorized movement stats must equal the
+    sums of the per-proposal property accessors (replicas_to_add,
+    has_leader_action, inter_broker_data_to_move)."""
+    topo, assign = fixtures.random_cluster(fixtures.ClusterProperties(
+        num_racks=3, num_brokers=10, num_replicas=400, num_topics=25,
+        min_replication=2, max_replication=3), seed=99)
+    r = OPT.optimize(topo, assign, engine="greedy")
+    final = r.final_assignment
+    props, n_moves, n_lead, data = PR.diff(topo, assign, final,
+                                           with_stats=True)
+    assert n_moves == sum(len(p.replicas_to_add) for p in props)
+    assert n_lead == sum(1 for p in props if p.has_leader_action)
+    assert data == pytest.approx(sum(p.inter_broker_data_to_move()
+                                     for p in props), rel=1e-6)
+    assert r.num_replica_movements == n_moves
+    assert r.num_leadership_movements == n_lead
