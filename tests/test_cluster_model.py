@@ -145,3 +145,21 @@ def test_random_cluster_builds_and_checks():
     util = np.asarray(broker_resource_utilization(dt, compute_aggregates(dt, assign, topo.num_topics)))
     assert util.shape == (8, 4)
     assert (util >= 0).all()
+
+
+def test_sanity_check_at_reference_stress_scale():
+    """BASELINE.md row 1: the reference tunes its float-summation epsilon at
+    ~800,000 replicas (Resource.java:23-27). The array model's invariant
+    cross-validation (replica-level vs broker/host-level load sums) must
+    hold at that scale too — f32 segment sums over 800K effective loads."""
+    from cruise_control_tpu.models import fixtures as FX
+    from cruise_control_tpu.ops.aggregates import device_topology
+    from cruise_control_tpu.ops.stats import sanity_check
+
+    topo, assign = FX.synthetic_cluster(
+        num_brokers=3_000, num_replicas=800_000, num_racks=40,
+        num_topics=10_000, seed=9)
+    assert topo.num_replicas >= 799_000
+    dt = device_topology(topo)
+    checks = sanity_check(dt, assign, 1)
+    assert all(checks.values()), checks
