@@ -114,6 +114,17 @@ def main():
         "balancedness_after": round(r.balancedness_after, 2),
         "num_replica_movements": r.num_replica_movements,
         "num_leadership_movements": r.num_leadership_movements,
+        # soft-cost channel: the violation metrics above hide the band-cost
+        # quality axis; tracking the summed SOFT-goal cost guards future
+        # speed tuning against silently degrading balance quality (hard
+        # goals' violation-proportional costs are already covered by the
+        # violated_goals counters)
+        "soft_cost_before": round(sum(s.cost_before
+                                      for s in r.goal_summaries
+                                      if not s.hard), 3),
+        "soft_cost_after": round(sum(s.cost_after
+                                     for s in r.goal_summaries
+                                     if not s.hard), 3),
         "device": str(jax.devices()[0].platform),
     }
     if model_build_s is not None:
