@@ -453,20 +453,28 @@ class LoadMonitor:
                     f"monitored partition ratio "
                     f"{result.completeness.valid_entity_ratio:.3f} below "
                     f"{requirements.min_monitored_partitions_percentage}")
-            return self._build_model(metadata, result)
+            return self._build_model(
+                metadata, result,
+                include_all_topics=requirements.include_all_topics)
 
     #: partition count above which model build switches to the vectorized
     #: bulk path (same semantics, locked by a parity test)
     BULK_BUILD_THRESHOLD = 20_000
 
-    def _build_model(self, metadata: ClusterMetadata, result: AggregationResult):
+    def _build_model(self, metadata: ClusterMetadata, result: AggregationResult,
+                     include_all_topics: bool = False):
+        """``include_all_topics`` (ModelCompletenessRequirements): include
+        UNMONITORED partitions with zero load instead of dropping them —
+        structural goals (rack, counts, PLE, RF changes) must see every
+        partition even when its windows are invalid."""
         if len(metadata.partitions) >= self.BULK_BUILD_THRESHOLD:
             # LinkedIn scale: the per-replica builder calls would dominate
             # the whole REBALANCE wall-clock (~1.5M python dict operations);
             # the bulk path assembles the same arrays vectorized —
             # cluster-model-creation at scale is seconds, not minutes
             # (LoadMonitor.java:178 cluster-model-creation-timer).
-            return self._build_model_bulk(metadata, result)
+            return self._build_model_bulk(metadata, result,
+                                          include_all_topics)
         # collapse windows per metric strategy: AVG metrics average valid
         # windows (Load.expectedUtilizationFor, Load.java:84-118), LATEST
         # takes the newest window.
@@ -511,6 +519,7 @@ class LoadMonitor:
             if bm.alive:
                 alive_brokers.add(bm.broker_id)
 
+        zero_m = np.zeros(md.NUM_MODEL_METRICS, np.float32)
         monitored = 0
         for pm in metadata.partitions:
             if pm.leader < 0 or not pm.replicas:
@@ -518,9 +527,12 @@ class LoadMonitor:
             ent = (pm.topic, pm.partition)
             m = load_by_entity.get(ent)
             if m is None:
-                continue            # unmonitored partition: excluded (the
+                if not include_all_topics:
+                    continue        # unmonitored partition: excluded (the
                                     # completeness gate already accounted it)
-            monitored += 1
+                m = zero_m          # included structurally, zero load
+            else:
+                monitored += 1
             leader_load = np.zeros(res.NUM_RESOURCES, np.float32)
             leader_load[res.CPU] = np.nan_to_num(m[md.ModelMetric.CPU_USAGE])
             leader_load[res.DISK] = np.nan_to_num(m[md.ModelMetric.DISK_USAGE])
