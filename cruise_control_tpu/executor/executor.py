@@ -437,8 +437,9 @@ class Executor:
             helper = (ReplicationThrottleHelper(self.adapter, throttle)
                       if throttle is not None else None)
         except BaseException:
-            self._state = ExecutorState.NO_TASK_IN_PROGRESS
-            self._planner = None
+            with self._lock:        # match the acquisition path's discipline
+                self._state = ExecutorState.NO_TASK_IN_PROGRESS
+                self._planner = None
             raise
         intra_moves_applied = 0
         crashed = True      # cleared on the clean path through the try

@@ -559,11 +559,19 @@ class LoadMonitor:
         return b.build()
 
     def _build_model_bulk(self, metadata: ClusterMetadata,
-                          result: AggregationResult):
+                          result: AggregationResult,
+                          include_all_topics: bool = False):
         """Vectorized model build: identical output to the builder path
         (parity-locked by ``test_bulk_model_build_matches_builder``) with
         the per-replica python calls replaced by array assembly. The only
-        remaining python is one cheap pass over the partition metadata."""
+        remaining python is one cheap pass over the partition metadata.
+
+        ``include_all_topics`` keeps UNMONITORED partitions with zero load
+        (row sentinel -1 masked out of the gather below), matching the
+        builder path and the reference's populate-with-zero behavior for
+        partitions whose windows are invalid (LoadMonitor.java:469-541) —
+        structural goals (rack, counts, PLE, RF changes) must see every
+        partition."""
         from cruise_control_tpu.models.cluster import (
             ClusterTopology, derive_follower_load, initial_assignment,
             leadership_extra_from_leader_load)
@@ -621,7 +629,9 @@ class LoadMonitor:
                 continue
             row = ent_row.get((pm.topic, pm.partition))
             if row is None:
-                continue                     # unmonitored: excluded
+                if not include_all_topics:
+                    continue                 # unmonitored: excluded
+                row = -1                     # included structurally, zero load
             if pm.topic not in topic_index:
                 topic_index[pm.topic] = len(topic_names)
                 topic_names.append(pm.topic)
@@ -686,25 +696,40 @@ class LoadMonitor:
                         off[offset + j] = True
 
         # ---- loads (vectorized collapse identical to the builder path) ----
+        # rows == -1 marks unmonitored partitions kept by include_all_topics:
+        # gather through a clamped index, then zero those rows (zero_m parity
+        # with the builder path).
         vals = result.values                              # [E, W, M]
-        avg = vals.mean(axis=1)
-        collapsed = avg.copy()
-        for mm in md.ModelMetric:
-            if md.METRIC_STRATEGY[mm] == md.Strategy.LATEST:
-                collapsed[:, mm] = vals[:, -1, mm]
+        monitored_mask = rows >= 0
+        safe_rows = np.where(monitored_mask, rows, 0)
+        W = vals.shape[1]
+        no_entities = vals.shape[0] == 0     # every kept partition unmonitored
+        if no_entities:
+            # builder parity: with zero monitored entities no replica carries
+            # load_windows, so the builder emits n_windows == 0 (windows
+            # fields None); collapse over a zero row and drop windows below
+            collapsed = np.zeros((1, md.NUM_MODEL_METRICS), np.float32)
+            vals = np.zeros((1, W, md.NUM_MODEL_METRICS), np.float32)
+        else:
+            avg = vals.mean(axis=1)
+            collapsed = avg.copy()
+            for mm in md.ModelMetric:
+                if md.METRIC_STRATEGY[mm] == md.Strategy.LATEST:
+                    collapsed[:, mm] = vals[:, -1, mm]
         leader_load = np.zeros((P, res.NUM_RESOURCES), np.float32)
         leader_load[:, res.CPU] = np.nan_to_num(
-            collapsed[rows, md.ModelMetric.CPU_USAGE])
+            collapsed[safe_rows, md.ModelMetric.CPU_USAGE])
         leader_load[:, res.DISK] = np.nan_to_num(
-            collapsed[rows, md.ModelMetric.DISK_USAGE])
+            collapsed[safe_rows, md.ModelMetric.DISK_USAGE])
         leader_load[:, res.NW_IN] = np.nan_to_num(
-            collapsed[rows, md.ModelMetric.LEADER_BYTES_IN])
+            collapsed[safe_rows, md.ModelMetric.LEADER_BYTES_IN])
         leader_load[:, res.NW_OUT] = np.nan_to_num(
-            collapsed[rows, md.ModelMetric.LEADER_BYTES_OUT])
+            collapsed[safe_rows, md.ModelMetric.LEADER_BYTES_OUT])
+        leader_load[~monitored_mask] = 0.0
         leader_extra = leadership_extra_from_leader_load(leader_load)
         follower_load = leader_load - leader_extra       # == leader base load
-        W = vals.shape[1]
-        vr = vals[rows]                       # ONE [P, W, M] gather, not four
+        vr = vals[safe_rows]                  # ONE [P, W, M] gather, not four
+        vr[~monitored_mask] = 0.0
         win_res = np.zeros((P, W, res.NUM_RESOURCES), np.float32)
         win_res[:, :, res.CPU] = np.nan_to_num(
             vr[:, :, md.ModelMetric.CPU_USAGE])
@@ -739,7 +764,9 @@ class LoadMonitor:
             broker_ids=broker_ids,
             host_names=tuple(host_names),
             rack_names=tuple(rack_names),
-            replica_base_load_windows=follower_windows[pid],
-            leader_extra_windows=leader_extra_windows,
+            replica_base_load_windows=(None if no_entities
+                                       else follower_windows[pid]),
+            leader_extra_windows=(None if no_entities
+                                  else leader_extra_windows),
         )
         return topo, initial_assignment(topo, broker_of)

@@ -524,11 +524,17 @@ def test_resume_during_training_of_previously_paused_monitor():
     assert lm.state == MonitorState.RUNNING
 
 
-def test_bulk_model_build_matches_builder():
+@pytest.mark.parametrize("include_all_topics", [False, True])
+def test_bulk_model_build_matches_builder(monkeypatch, include_all_topics):
     """_build_model_bulk (the vectorized LinkedIn-scale path) must produce
     exactly the same ClusterTopology arrays and Assignment as the builder
     path — dead brokers, offline replicas, unmonitored partitions, mixed
-    replication factors, interleaved topics, non-contiguous broker ids."""
+    replication factors, interleaved topics, non-contiguous broker ids.
+    The bulk leg enters through the PUBLIC ``_build_model`` dispatch (with
+    ``BULK_BUILD_THRESHOLD`` lowered) so the call-site arity is covered —
+    round 3 shipped an arity mismatch this test's direct call missed.
+    ``include_all_topics=True`` checks zero-load inclusion of unmonitored
+    partitions on BOTH paths (LoadMonitor.java:469-541)."""
     import dataclasses as _dc
     import numpy as _np
     from cruise_control_tpu.monitor.aggregator import (
@@ -566,8 +572,11 @@ def test_bulk_model_build_matches_builder():
                                   len(entities)),
         generation=1)
     lm = LoadMonitor(StaticMetadataSource(metadata), SyntheticLoadSampler())
-    topo_a, assign_a = lm._build_model(metadata, result)     # builder (small)
-    topo_b, assign_b = lm._build_model_bulk(metadata, result)
+    topo_a, assign_a = lm._build_model(             # builder (small path)
+        metadata, result, include_all_topics=include_all_topics)
+    monkeypatch.setattr(LoadMonitor, "BULK_BUILD_THRESHOLD", 1)
+    topo_b, assign_b = lm._build_model(             # bulk, via dispatch
+        metadata, result, include_all_topics=include_all_topics)
 
     for f in _dc.fields(topo_a):
         va, vb = getattr(topo_a, f.name), getattr(topo_b, f.name)
@@ -582,3 +591,99 @@ def test_bulk_model_build_matches_builder():
                                    _np.asarray(assign_b.broker_of))
     _np.testing.assert_array_equal(_np.asarray(assign_a.leader_of),
                                    _np.asarray(assign_b.leader_of))
+
+
+def test_bulk_model_build_all_unmonitored_matches_builder(monkeypatch):
+    """Edge parity: include_all_topics=True with ZERO monitored entities —
+    the builder emits n_windows == 0 (windows fields None); the bulk path
+    must match, not fabricate zero-filled window arrays."""
+    import dataclasses as _dc
+    from cruise_control_tpu.monitor.aggregator import (
+        AggregationResult, Completeness)
+    brokers = [BrokerMetadata(b, rack=f"r{b % 2}", host=f"h{b}", alive=True)
+               for b in range(4)]
+    parts = [PartitionMetadata("T", p, leader=p % 4,
+                               replicas=(p % 4, (p + 1) % 4))
+             for p in range(8)]
+    metadata = ClusterMetadata(brokers=brokers, partitions=parts, generation=1)
+    nW = 2
+    result = AggregationResult(
+        entities=[], values=np.zeros((0, nW, md.NUM_MODEL_METRICS)),
+        window_times=np.arange(nW, dtype=np.int64) * 60_000,
+        extrapolations=np.zeros((0, nW), np.int8),
+        completeness=Completeness(np.ones(nW, np.float32), 1.0, 1, nW, 0),
+        generation=1)
+    lm = LoadMonitor(StaticMetadataSource(metadata), SyntheticLoadSampler())
+    topo_a, assign_a = lm._build_model(metadata, result,
+                                       include_all_topics=True)
+    monkeypatch.setattr(LoadMonitor, "BULK_BUILD_THRESHOLD", 1)
+    topo_b, assign_b = lm._build_model(metadata, result,
+                                       include_all_topics=True)
+    assert topo_a.num_windows == topo_b.num_windows == 0
+    assert topo_b.replica_base_load_windows is None
+    assert topo_b.leader_extra_windows is None
+    import dataclasses
+    for f in dataclasses.fields(topo_a):
+        va, vb = getattr(topo_a, f.name), getattr(topo_b, f.name)
+        if va is None or isinstance(va, tuple):
+            assert va == vb or (va is None and vb is None), f.name
+        else:
+            np.testing.assert_allclose(np.asarray(va, np.float64),
+                                       np.asarray(vb, np.float64),
+                                       err_msg=f.name)
+    np.testing.assert_array_equal(np.asarray(assign_a.broker_of),
+                                  np.asarray(assign_b.broker_of))
+
+
+@pytest.mark.smoke
+@pytest.mark.parametrize("include_all_topics", [False, True])
+def test_build_model_dispatches_bulk_at_real_threshold(include_all_topics):
+    """At >= BULK_BUILD_THRESHOLD partitions the PUBLIC ``_build_model``
+    dispatch must reach the bulk path with the real signature — the exact
+    call the driver bench makes (round 3's bench crashed here on an arity
+    mismatch no test covered). Also checks include_all_topics semantics at
+    scale: unmonitored partitions kept with zero load, or dropped."""
+    rng = np.random.default_rng(5)
+    n_brokers, n_parts = 40, LoadMonitor.BULK_BUILD_THRESHOLD + 500
+    ids = list(range(n_brokers))
+    brokers = [BrokerMetadata(b, rack=f"r{b % 4}", host=f"h{b}", alive=True)
+               for b in ids]
+    parts = []
+    for p in range(n_parts):
+        reps = tuple(int(x) for x in rng.choice(ids, size=3, replace=False))
+        parts.append(PartitionMetadata(f"T{p % 200}", p // 200,
+                                       leader=reps[0], replicas=reps))
+    metadata = ClusterMetadata(brokers=brokers, partitions=parts, generation=1)
+    n_unmonitored = 750
+    from cruise_control_tpu.monitor.aggregator import (
+        AggregationResult, Completeness)
+    entities = [(pm.topic, pm.partition) for pm in parts[:-n_unmonitored]]
+    nW = 2
+    values = rng.exponential(
+        30.0, (len(entities), nW, md.NUM_MODEL_METRICS)).astype(np.float32)
+    result = AggregationResult(
+        entities=entities, values=values,
+        window_times=np.arange(nW, dtype=np.int64) * 60_000,
+        extrapolations=np.zeros((len(entities), nW), np.int8),
+        completeness=Completeness(np.ones(nW, np.float32), 1.0, 1, nW,
+                                  len(entities)),
+        generation=1)
+    lm = LoadMonitor(StaticMetadataSource(metadata), SyntheticLoadSampler())
+    topo, assign = lm._build_model(metadata, result,
+                                   include_all_topics=include_all_topics)
+    expected = n_parts if include_all_topics else n_parts - n_unmonitored
+    assert len(topo.rf_of_partition) == expected
+    if include_all_topics:
+        # unmonitored partitions are structurally present with zero load
+        per_part_load = np.asarray(topo.leader_extra)
+        monitored_ents = set(entities)
+        names = topo.topic_names
+        unmon = [i for i in range(expected)
+                 if (names[int(topo.topic_of_partition[i])],
+                     int(topo.partition_index[i])) not in monitored_ents]
+        assert len(unmon) == n_unmonitored
+        assert float(np.abs(per_part_load[unmon]).max()) == 0.0
+        base = np.asarray(topo.replica_base_load)
+        pid_of_replica = np.asarray(topo.partition_of_replica)
+        unmon_mask = np.isin(pid_of_replica, np.asarray(unmon))
+        assert float(np.abs(base[unmon_mask]).max()) == 0.0
