@@ -310,7 +310,8 @@ def optimize(topo: ClusterTopology, assign: Assignment,
         report_progress("Repairing residual goal violations")
         from cruise_control_tpu.analyzer import repair as REP
         final, _, _ = REP.repair(dt, final, th, weights, opts, num_topics,
-                                 initial_broker_of=init_broker, seed=seed)
+                                 initial_broker_of=init_broker, seed=seed,
+                                 mesh=mesh)
         _mark("repair")
     else:
         raise ValueError(f"unknown engine {engine!r}")

@@ -190,12 +190,13 @@ def _lead_deltas_batch(dt, th, weights, opts, st, src_p, slots):
 
 @partial(jax.jit,
          static_argnames=("use_topic", "check_under", "n_inner", "n_src",
-                          "k_swap"),
+                          "k_swap", "src_sharding", "flag_sharding"),
          donate_argnums=(4,))
 def _fused_targeted(dt, th, w, opts, st, offline, initial_broker_of,
                     movable, movable_pool, key, min_improvement,
                     use_topic: bool, check_under: bool, n_inner: int,
-                    n_src: int, k_swap: int):
+                    n_src: int, k_swap: int,
+                    src_sharding=None, flag_sharding=None):
     """Up to ``n_inner`` repair rounds fused into ONE device program.
 
     The host-driven round loop is tunnel-latency-bound (~0.4-0.8 s per
@@ -220,12 +221,32 @@ def _fused_targeted(dt, th, w, opts, st, offline, initial_broker_of,
       on distinct brokers are exactly additive.
 
     Returns (state, accepted_actions_total, converged).
+
+    ``src_sharding`` / ``flag_sharding`` (static, from ``repair(mesh=…)``)
+    partition the SOURCE axis of the heavy per-round work across a device
+    mesh under GSPMD: the [n_src, B] broadcast delta matrix, the [n_src,
+    k_swap] swap deltas, and the O(R) violation scan each shard on their
+    leading axis; XLA inserts the all-reduce-min collectives the
+    scatter-min claims need and keeps the (small) chain state replicated.
+    All cross-device combines are min/or reductions — order-independent,
+    so sharded == unsharded holds bitwise (asserted by the driver dryrun
+    and test_parallel).
     """
     R = dt.num_replicas
     B = dt.num_brokers
     P = dt.num_partitions
     t_of_r = dt.topic_of_partition[dt.partition_of_replica]
     part_of = dt.partition_of_replica
+
+    def _c(x, s):
+        return x if s is None else jax.lax.with_sharding_constraint(x, s)
+
+    row_sharding = repl_sharding = None
+    if src_sharding is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+        row_sharding = NamedSharding(src_sharding.mesh,
+                                     PartitionSpec(src_sharding.spec[0]))
+        repl_sharding = NamedSharding(src_sharding.mesh, PartitionSpec())
 
     def viol_flag(st):
         bt = G.broker_terms(th, st.broker_load, st.replica_count,
@@ -259,7 +280,8 @@ def _fused_targeted(dt, th, w, opts, st, offline, initial_broker_of,
         on_bad = ((viol_b > 0)[st.broker_of]
                   | (viol_h > 0)[dt.host_of_broker[st.broker_of]])
         unhealed = offline & (st.broker_of == initial_broker_of)
-        return (over | dup_rack | on_bad | unhealed) & movable
+        return _c((over | dup_rack | on_bad | unhealed) & movable,
+                  flag_sharding)
 
     def inner(st, flag, k):
         # rotate the scan origin each round: nonzero picks the lowest
@@ -269,11 +291,11 @@ def _fused_targeted(dt, th, w, opts, st, offline, initial_broker_of,
         rolled = jnp.roll(flag, -start)
         src = jnp.nonzero(rolled, size=n_src, fill_value=-1)[0]
         valid_src = src >= 0
-        srcc = jnp.where(valid_src, (src + start) % R, 0)
+        srcc = _c(jnp.where(valid_src, (src + start) % R, 0), row_sharding)
         # best move per source over every broker
         dmv = _move_rows_impl(dt, th, w, opts, st, initial_broker_of, srcc,
                               use_topic)                         # [n_src, B]
-        dmv = jnp.where(valid_src[:, None], dmv, AN._INF)
+        dmv = _c(jnp.where(valid_src[:, None], dmv, AN._INF), src_sharding)
         # destination spreading: every source's exact argmin is the SAME
         # emptiest broker, and the one-winner-per-destination claim then
         # serializes the whole round to a handful of accepts. Selecting by
@@ -287,15 +309,15 @@ def _fused_targeted(dt, th, w, opts, st, offline, initial_broker_of,
         mv_b = jnp.argmin(dmv_sel, axis=1)
         mv_d = jnp.take_along_axis(dmv, mv_b[:, None], axis=1)[:, 0]
         # best swap per source over sampled partners
-        r2 = movable_pool[jax.random.randint(
-            k, (n_src, k_swap), 0, movable_pool.shape[0])]
+        r2 = _c(movable_pool[jax.random.randint(
+            k, (n_src, k_swap), 0, movable_pool.shape[0])], src_sharding)
         dsw = jax.vmap(jax.vmap(
             lambda a_r, b_r: OBJ.combine(AN._swap_delta(
                 dt, th, w, opts, st, initial_broker_of,
                 "dense" if use_topic else "off",
                 jnp.full((1, 1), -1, jnp.int32), a_r, b_r)),
             in_axes=(None, 0)))(srcc, r2)                        # [n_src, k]
-        dsw = jnp.where(valid_src[:, None], dsw, AN._INF)
+        dsw = _c(jnp.where(valid_src[:, None], dsw, AN._INF), src_sharding)
         sw_j = jnp.argmin(dsw, axis=1)
         sw_d = jnp.take_along_axis(dsw, sw_j[:, None], axis=1)[:, 0]
         partner = jnp.take_along_axis(r2, sw_j[:, None], axis=1)[:, 0]
@@ -335,9 +357,16 @@ def _fused_targeted(dt, th, w, opts, st, offline, initial_broker_of,
         dst1 = jnp.where(mv_sel, b_b,
                          jnp.where(sw_sel, st.broker_of[partner], a_b))
         dst2 = jnp.where(sw_sel, a_b, st.broker_of[partner])
-        all_r = jnp.concatenate([srcc, partner])
-        all_b = jnp.concatenate([dst1, dst2])
+        # the WINNER vectors replicate (all-gather) before the apply: the
+        # state update must run identically on every device — a sharded
+        # scatter-add would reorder f32 accumulation, ULP-shifting the
+        # maintained aggregates and breaking sharded == unsharded parity
+        # (and re-sharding the carried state forces a recompile per outer
+        # round). Only the O(n_src·B) candidate evaluation shards.
+        all_r = _c(jnp.concatenate([srcc, partner]), repl_sharding)
+        all_b = _c(jnp.concatenate([dst1, dst2]), repl_sharding)
         st = AN._apply_moves(dt, st, all_r, all_b, use_topic)
+        st = jax.tree.map(lambda x: _c(x, repl_sharding), st)
         return st, jnp.sum(win.astype(jnp.int32))
 
     def body(carry):
@@ -359,8 +388,8 @@ def _fused_targeted(dt, th, w, opts, st, offline, initial_broker_of,
         return (i < n_inner) & (zeros < 2)
 
     st, _, rounds, zeros, total = jax.lax.while_loop(
-        cond, body, (st, jnp.zeros((R,), bool), jnp.int32(0), jnp.int32(0),
-                     jnp.int32(0)))
+        cond, body, (st, _c(jnp.zeros((R,), bool), flag_sharding),
+                     jnp.int32(0), jnp.int32(0), jnp.int32(0)))
     return st, total, zeros >= 2, rounds
 
 
@@ -390,8 +419,15 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
            weights: OBJ.ObjectiveWeights, opts: G.DeviceOptions,
            num_topics: int, initial_broker_of: Optional[jax.Array] = None,
            config: Optional[RepairConfig] = None,
-           seed: int = 0) -> Tuple[Assignment, int, int]:
-    """Iterative targeted repair; returns (assignment, actions, lead_moves)."""
+           seed: int = 0,
+           mesh: Optional["jax.sharding.Mesh"] = None
+           ) -> Tuple[Assignment, int, int]:
+    """Iterative targeted repair; returns (assignment, actions, lead_moves).
+
+    ``mesh``: partition the per-round source axis (delta matrices, swap
+    deltas, violation scan) across the mesh under GSPMD — the replica-axis
+    scaling of SURVEY §7 applied to the repair engine. The chain state is
+    replicated; results are bitwise-identical to the unsharded pass."""
     cfg = config or RepairConfig()
     _t0 = time.time()
     rng = np.random.default_rng(seed)
@@ -426,6 +462,19 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
     movable_dev = jnp.asarray(movable_np)
     offline_dev = jnp.asarray(offline_np)
     base_key = jax.random.PRNGKey(seed)
+    src_sharding = flag_sharding = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+        from cruise_control_tpu.parallel.sharding import replicate
+        ax = mesh.axis_names[0]
+        src_sharding = NamedSharding(mesh, PartitionSpec(ax, None))
+        flag_sharding = NamedSharding(mesh, PartitionSpec(ax))
+        # replicate the single chain state over the mesh (it is small next
+        # to the [n_src, B] matrices); GSPMD keeps it replicated through
+        # the fused loop while the source/flag axes partition
+        st = replicate(st, mesh)
+        movable_dev = jax.device_put(movable_dev, flag_sharding)
+        offline_dev = jax.device_put(offline_dev, flag_sharding)
     if _DEBUG:
         jax.block_until_ready(st.broker_load)
         print(f"[repair setup] t={time.time()-_t0:.2f}s", flush=True)
@@ -436,7 +485,8 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
             movable_dev, movable_pool_dev, jax.random.fold_in(base_key, outer),
             jnp.float32(cfg.min_improvement),
             topic_on, check_under, cfg.fused_inner, cfg.fused_sources,
-            cfg.swap_partners)
+            cfg.swap_partners, src_sharding=src_sharding,
+            flag_sharding=flag_sharding)
         n_acc = int(jax.device_get(n_acc))
         converged = bool(jax.device_get(converged))
         if _DEBUG:

@@ -703,13 +703,17 @@ class LoadMonitor:
         monitored_mask = rows >= 0
         safe_rows = np.where(monitored_mask, rows, 0)
         W = vals.shape[1]
-        no_entities = vals.shape[0] == 0     # every kept partition unmonitored
+        # every kept partition unmonitored (either no entities at all, or
+        # none overlapping the kept partitions)
+        no_entities = vals.shape[0] == 0 or not monitored_mask.any()
         if no_entities:
-            # builder parity: with zero monitored entities no replica carries
-            # load_windows, so the builder emits n_windows == 0 (windows
-            # fields None); collapse over a zero row and drop windows below
+            # builder parity: with zero monitored partitions no replica
+            # carries load_windows, so the builder emits n_windows == 0
+            # (windows fields None); collapse over a zero row and drop
+            # windows below
             collapsed = np.zeros((1, md.NUM_MODEL_METRICS), np.float32)
             vals = np.zeros((1, W, md.NUM_MODEL_METRICS), np.float32)
+            safe_rows = np.zeros(P, np.int64)
         else:
             avg = vals.mean(axis=1)
             collapsed = avg.copy()
@@ -728,19 +732,24 @@ class LoadMonitor:
         leader_load[~monitored_mask] = 0.0
         leader_extra = leadership_extra_from_leader_load(leader_load)
         follower_load = leader_load - leader_extra       # == leader base load
-        vr = vals[safe_rows]                  # ONE [P, W, M] gather, not four
-        vr[~monitored_mask] = 0.0
-        win_res = np.zeros((P, W, res.NUM_RESOURCES), np.float32)
-        win_res[:, :, res.CPU] = np.nan_to_num(
-            vr[:, :, md.ModelMetric.CPU_USAGE])
-        win_res[:, :, res.DISK] = np.nan_to_num(
-            vr[:, :, md.ModelMetric.DISK_USAGE])
-        win_res[:, :, res.NW_IN] = np.nan_to_num(
-            vr[:, :, md.ModelMetric.LEADER_BYTES_IN])
-        win_res[:, :, res.NW_OUT] = np.nan_to_num(
-            vr[:, :, md.ModelMetric.LEADER_BYTES_OUT])
-        leader_extra_windows = leadership_extra_from_leader_load(win_res)
-        follower_windows = win_res - leader_extra_windows
+        if no_entities:
+            # skip the [P, W, 4] window assembly entirely — the model has
+            # no windows (see above)
+            leader_extra_windows = follower_windows = None
+        else:
+            vr = vals[safe_rows]              # ONE [P, W, M] gather, not four
+            vr[~monitored_mask] = 0.0
+            win_res = np.zeros((P, W, res.NUM_RESOURCES), np.float32)
+            win_res[:, :, res.CPU] = np.nan_to_num(
+                vr[:, :, md.ModelMetric.CPU_USAGE])
+            win_res[:, :, res.DISK] = np.nan_to_num(
+                vr[:, :, md.ModelMetric.DISK_USAGE])
+            win_res[:, :, res.NW_IN] = np.nan_to_num(
+                vr[:, :, md.ModelMetric.LEADER_BYTES_IN])
+            win_res[:, :, res.NW_OUT] = np.nan_to_num(
+                vr[:, :, md.ModelMetric.LEADER_BYTES_OUT])
+            leader_extra_windows = leadership_extra_from_leader_load(win_res)
+            follower_windows = win_res - leader_extra_windows
 
         topo = ClusterTopology(
             rack_of_broker=rack_of_broker,
@@ -764,9 +773,8 @@ class LoadMonitor:
             broker_ids=broker_ids,
             host_names=tuple(host_names),
             rack_names=tuple(rack_names),
-            replica_base_load_windows=(None if no_entities
+            replica_base_load_windows=(None if follower_windows is None
                                        else follower_windows[pid]),
-            leader_extra_windows=(None if no_entities
-                                  else leader_extra_windows),
+            leader_extra_windows=leader_extra_windows,
         )
         return topo, initial_assignment(topo, broker_of)

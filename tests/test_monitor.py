@@ -593,10 +593,15 @@ def test_bulk_model_build_matches_builder(monkeypatch, include_all_topics):
                                    _np.asarray(assign_b.leader_of))
 
 
-def test_bulk_model_build_all_unmonitored_matches_builder(monkeypatch):
-    """Edge parity: include_all_topics=True with ZERO monitored entities —
+@pytest.mark.parametrize("overlap_free_entities", [False, True])
+def test_bulk_model_build_all_unmonitored_matches_builder(
+        monkeypatch, overlap_free_entities):
+    """Edge parity: include_all_topics=True with ZERO monitored partitions —
     the builder emits n_windows == 0 (windows fields None); the bulk path
-    must match, not fabricate zero-filled window arrays."""
+    must match, not fabricate zero-filled window arrays. Covers both an
+    empty entity list and a non-empty one overlapping NO kept partition
+    (e.g. the monitored topics were deleted from metadata between sampling
+    and model build)."""
     import dataclasses as _dc
     from cruise_control_tpu.monitor.aggregator import (
         AggregationResult, Completeness)
@@ -607,11 +612,15 @@ def test_bulk_model_build_all_unmonitored_matches_builder(monkeypatch):
              for p in range(8)]
     metadata = ClusterMetadata(brokers=brokers, partitions=parts, generation=1)
     nW = 2
+    entities = ([("deleted-topic", p) for p in range(3)]
+                if overlap_free_entities else [])
     result = AggregationResult(
-        entities=[], values=np.zeros((0, nW, md.NUM_MODEL_METRICS)),
+        entities=entities,
+        values=np.ones((len(entities), nW, md.NUM_MODEL_METRICS)),
         window_times=np.arange(nW, dtype=np.int64) * 60_000,
-        extrapolations=np.zeros((0, nW), np.int8),
-        completeness=Completeness(np.ones(nW, np.float32), 1.0, 1, nW, 0),
+        extrapolations=np.zeros((len(entities), nW), np.int8),
+        completeness=Completeness(np.ones(nW, np.float32), 1.0, 1, nW,
+                                  len(entities)),
         generation=1)
     lm = LoadMonitor(StaticMetadataSource(metadata), SyntheticLoadSampler())
     topo_a, assign_a = lm._build_model(metadata, result,
