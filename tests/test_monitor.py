@@ -524,6 +524,7 @@ def test_resume_during_training_of_previously_paused_monitor():
     assert lm.state == MonitorState.RUNNING
 
 
+@pytest.mark.smoke
 @pytest.mark.parametrize("include_all_topics", [False, True])
 def test_bulk_model_build_matches_builder(monkeypatch, include_all_topics):
     """_build_model_bulk (the vectorized LinkedIn-scale path) must produce

@@ -38,6 +38,7 @@ def _check_invariants(topo, assign, result):
     assert topo.broker_alive[fb].all()
 
 
+@pytest.mark.smoke
 def test_greedy_unbalanced():
     topo, assign = fixtures.unbalanced()
     r = OPT.optimize(topo, assign)
@@ -74,6 +75,7 @@ def test_greedy_no_hard_regression_on_small():
     _check_invariants(topo, assign, r)
 
 
+@pytest.mark.smoke
 def test_proposals_format():
     topo, assign = fixtures.small_cluster_model()
     # hand-move one replica: T1-0 follower from broker 2 to broker 1
