@@ -218,9 +218,34 @@ def _service_config_def() -> ConfigDef:
     d.define("capacity.config.file", T.STRING, "config/capacity.json",
              I.MEDIUM, "Capacity file path.")
     d.define("sample.store.class", T.CLASS, "FileSampleStore", I.LOW,
-             "Sample store implementation.")
+             "Sample store implementation "
+             "(NoopSampleStore | FileSampleStore | KafkaSampleStore).")
     d.define("sample.store.dir", T.STRING, "", I.LOW,
              "FileSampleStore directory ('' = disabled).")
+    # KafkaSampleStore topic bootstrap (KafkaSampleStore.java:85)
+    d.define("partition.metric.sample.store.topic", T.STRING,
+             "__KafkaCruiseControlPartitionMetricSamples", I.LOW,
+             "KafkaSampleStore partition-sample topic.")
+    d.define("broker.metric.sample.store.topic", T.STRING,
+             "__KafkaCruiseControlModelTrainingSamples", I.LOW,
+             "KafkaSampleStore broker (model-training) sample topic.")
+    d.define("sample.store.topic.replication.factor", T.INT, 2, I.LOW,
+             "Replication factor for the sample store topics.", at_least(1))
+    d.define("partition.sample.store.topic.partition.count", T.INT, 32,
+             I.LOW, "Partition count of the partition-sample topic.",
+             at_least(1))
+    d.define("broker.sample.store.topic.partition.count", T.INT, 32,
+             I.LOW, "Partition count of the broker-sample topic.",
+             at_least(1))
+    d.define("partition.sample.store.topic.retention.time.ms", T.LONG,
+             14 * 24 * 3600 * 1000, I.LOW,
+             "Retention of the sample store topics.", at_least(1))
+    d.define("num.sample.loading.threads", T.INT, 8, I.LOW,
+             "Sample replay deserialization parallelism on startup.",
+             at_least(1))
+    d.define("sample.store.bootstrap.servers", T.STRING, "", I.LOW,
+             "Kafka cluster for the sample store topics "
+             "('' = use bootstrap.servers).")
     d.define("metric.sampler.class", T.CLASS, "SyntheticLoadSampler", I.HIGH,
              "MetricSampler implementation.")
     # analyzer / optimizer engine

@@ -60,16 +60,24 @@ def build_kafka_app(config):
     from cruise_control_tpu import kafka_adapter
     from cruise_control_tpu.app import CruiseControlApp
     from cruise_control_tpu.monitor.capacity import FileCapacityResolver
-    from cruise_control_tpu.monitor.sample_store import FileSampleStore
+    from cruise_control_tpu.monitor.sample_store import (
+        FileSampleStore, KafkaSampleStore)
     source = kafka_adapter.KafkaMetadataSource(config)
     sampler = kafka_adapter.KafkaMetricsTopicSampler(config)
     adapter = kafka_adapter.KafkaClusterAdapter(config)
+    store_cls = config.get("sample.store.class")
     store_dir = config.get("sample.store.dir")
+    if store_cls == "KafkaSampleStore":
+        store = KafkaSampleStore(config)
+    elif store_cls == "FileSampleStore" and store_dir:
+        store = FileSampleStore(store_dir)
+    else:
+        store = None
     return CruiseControlApp(
         config, source, sampler, cluster_adapter=adapter,
         capacity_resolver=FileCapacityResolver(
             config.get("capacity.config.file")),
-        sample_store=FileSampleStore(store_dir) if store_dir else None)
+        sample_store=store)
 
 
 def main(argv=None) -> int:

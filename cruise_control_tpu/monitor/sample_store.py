@@ -44,6 +44,175 @@ class NoopSampleStore(SampleStore):
         return 0
 
 
+class KafkaSampleStore(SampleStore):
+    """Sample persistence in two Kafka topics, replayed on startup — the
+    reference's production store (``KafkaSampleStore.java:85`` topic
+    bootstrap, ``:317`` store, ``:355`` load-on-startup).
+
+    Partition samples and broker (model-training) samples each get their
+    own topic, ensured at startup with the configured partition count and
+    a time-retention policy. Samples are produced keyed by entity (topic-
+    partition / broker id) so one entity's history stays in one topic
+    partition; loading consumes both topics from the beginning, skips
+    corrupt records, and feeds the monitor's ingest callbacks.
+
+    ``producer`` / ``consumer_factory`` / ``admin`` are injectable (tests
+    run against an in-memory fake broker; production binds kafka-python
+    lazily like the other adapters in :mod:`cruise_control_tpu.kafka_adapter`).
+    ``consumer_factory(topic)`` must return an iterable of messages with a
+    ``.value`` (bytes or str) that terminates when the topic is drained.
+    """
+
+    PARTITION_TOPIC = "__KafkaCruiseControlPartitionMetricSamples"
+    BROKER_TOPIC = "__KafkaCruiseControlModelTrainingSamples"
+
+    def __init__(self, config=None, producer=None, consumer_factory=None,
+                 admin=None):
+        def cfg(key, default):
+            # works for plain dicts AND CruiseControlConfig (whose single-
+            # arg get() already resolves defined defaults)
+            try:
+                v = config.get(key) if config is not None else None
+            except Exception:
+                v = None
+            return default if v in (None, "") else v
+
+        self.partition_topic = cfg(
+            "partition.metric.sample.store.topic", self.PARTITION_TOPIC)
+        self.broker_topic = cfg(
+            "broker.metric.sample.store.topic", self.BROKER_TOPIC)
+        self._partition_count = int(cfg(
+            "partition.sample.store.topic.partition.count", 32))
+        self._broker_partition_count = int(cfg(
+            "broker.sample.store.topic.partition.count", 32))
+        self._replication_factor = int(cfg(
+            "sample.store.topic.replication.factor", 2))
+        self._retention_ms = int(cfg(
+            "partition.sample.store.topic.retention.time.ms",
+            14 * 24 * 3600 * 1000))
+        self._loading_threads = int(cfg("num.sample.loading.threads", 8))
+        if producer is None or consumer_factory is None:
+            from cruise_control_tpu.kafka_adapter import _require_kafka
+            kafka = _require_kafka()
+            bootstrap = cfg("sample.store.bootstrap.servers",
+                            cfg("bootstrap.servers", None))
+            if not bootstrap:
+                raise ValueError(
+                    "KafkaSampleStore needs `sample.store.bootstrap.servers` "
+                    "or `bootstrap.servers` configured")
+            if producer is None:
+                producer = kafka.KafkaProducer(
+                    bootstrap_servers=bootstrap,
+                    value_serializer=lambda d: json.dumps(d).encode())
+            if consumer_factory is None:
+                def consumer_factory(topic, _k=kafka, _b=bootstrap):
+                    return _k.KafkaConsumer(
+                        topic, bootstrap_servers=_b,
+                        value_deserializer=lambda b: b,
+                        consumer_timeout_ms=10_000,
+                        auto_offset_reset="earliest",
+                        enable_auto_commit=False)
+            if admin is None:
+                try:
+                    admin = kafka.KafkaAdminClient(bootstrap_servers=bootstrap)
+                except Exception:
+                    admin = None        # topic bootstrap is best-effort
+        self._producer = producer
+        self._consumer_factory = consumer_factory
+        self._ensure_topics(admin)
+
+    def _ensure_topics(self, admin) -> None:
+        """Create the two sample topics if absent (KafkaSampleStore.java:85
+        ensureTopicsCreated): time retention, configured partition counts."""
+        if admin is None:
+            return
+        topic_cfg = {"retention.ms": str(self._retention_ms),
+                     "cleanup.policy": "delete"}
+        for topic, parts in ((self.partition_topic, self._partition_count),
+                             (self.broker_topic,
+                              self._broker_partition_count)):
+            try:
+                new_topic = _new_topic(topic, parts,
+                                       self._replication_factor, topic_cfg)
+                admin.create_topics([new_topic])
+            except Exception:
+                continue                # exists already / racing creator
+
+    def store_samples(self, partition_samples, broker_samples):
+        for s in partition_samples:
+            self._producer.send(self.partition_topic, s.to_json(),
+                                key=f"{s.topic}-{s.partition}".encode())
+        for s in broker_samples:
+            self._producer.send(self.broker_topic, s.to_json(),
+                                key=str(s.broker_id).encode())
+        self._producer.flush()
+
+    @staticmethod
+    def _deserialize(cls, value):
+        """One sample from a raw record value; None for corrupt records
+        (only DESERIALIZATION errors are swallowed — the reference's
+        loadSamples skips unreadable records but does not hide monitor-side
+        ingest failures, and neither do we)."""
+        try:
+            if isinstance(value, (bytes, bytearray)):
+                value = value.decode()
+            if isinstance(value, str):
+                value = json.loads(value)
+            return cls.from_json(value)
+        except Exception:
+            return None
+
+    def load_samples(self, on_partition_sample, on_broker_sample) -> int:
+        from concurrent.futures import ThreadPoolExecutor
+        n = 0
+        for topic, cb, cls in (
+                (self.partition_topic, on_partition_sample,
+                 PartitionMetricSample),
+                (self.broker_topic, on_broker_sample, BrokerMetricSample)):
+            consumer = self._consumer_factory(topic)
+            try:
+                raw = [msg.value for msg in consumer]
+            finally:
+                if hasattr(consumer, "close"):
+                    consumer.close()
+            # deserialization fans out over the loading threads
+            # (num.sample.loading.threads); ingest callbacks stay in the
+            # caller's thread, in record order
+            if self._loading_threads > 1 and len(raw) > 1:
+                with ThreadPoolExecutor(self._loading_threads) as pool:
+                    samples = list(pool.map(
+                        lambda v: self._deserialize(cls, v), raw,
+                        chunksize=max(1, len(raw) // self._loading_threads)))
+            else:
+                samples = [self._deserialize(cls, v) for v in raw]
+            for s in samples:
+                if s is not None:
+                    cb(s)
+                    n += 1
+        return n
+
+    def close(self):
+        try:
+            self._producer.close()
+        except Exception:
+            pass
+
+
+def _new_topic(name: str, num_partitions: int, replication_factor: int,
+               topic_configs: dict):
+    """kafka-python NewTopic when available; a plain namespace for fakes."""
+    try:
+        from kafka.admin import NewTopic
+        return NewTopic(name=name, num_partitions=num_partitions,
+                        replication_factor=replication_factor,
+                        topic_configs=topic_configs)
+    except ImportError:
+        import types
+        return types.SimpleNamespace(name=name, num_partitions=num_partitions,
+                                     replication_factor=replication_factor,
+                                     topic_configs=topic_configs)
+
+
 class FileSampleStore(SampleStore):
     """JSONL append-only shards under a directory (partition + broker files,
     the analogue of the two Kafka sample topics)."""

@@ -249,6 +249,97 @@ def test_sample_store_roundtrip(tmp_path):
     assert got_b[0].broker_id == bs[0].broker_id
 
 
+class _FakeKafkaBroker:
+    """In-memory topic log shared by producer/consumer fakes (the
+    fake-broker pattern of tests/test_kafka_adapter.py)."""
+
+    def __init__(self):
+        self.topics = {}
+        self.created = []
+
+    # admin
+    def create_topics(self, new_topics):
+        for t in new_topics:
+            self.created.append((t.name, t.num_partitions,
+                                 t.replication_factor, dict(t.topic_configs)))
+            self.topics.setdefault(t.name, [])
+
+    # producer
+    def send(self, topic, value, key=None):
+        self.topics.setdefault(topic, []).append((key, value))
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+    # consumer
+    def consumer(self, topic):
+        import types as _types
+        return iter([_types.SimpleNamespace(value=json.dumps(v))
+                     for _, v in self.topics.get(topic, [])])
+
+
+def test_kafka_sample_store_replay_roundtrip():
+    """store → service restart → replay (KafkaSampleStore.java:317,355):
+    a fresh LoadMonitor over a fresh KafkaSampleStore bound to the same
+    (fake) cluster must rebuild the aggregator state and serve a model
+    equal to the pre-restart one."""
+    from cruise_control_tpu.monitor.sample_store import KafkaSampleStore
+    broker = _FakeKafkaBroker()
+
+    def make_store():
+        return KafkaSampleStore(producer=broker,
+                                consumer_factory=broker.consumer,
+                                admin=broker)
+
+    metadata = _metadata(num_brokers=6, num_parts=40, rf=2)
+    store1 = make_store()
+    # topic bootstrap happened with the configured partition counts
+    assert {c[0] for c in broker.created} == {
+        KafkaSampleStore.PARTITION_TOPIC, KafkaSampleStore.BROKER_TOPIC}
+    lm1 = LoadMonitor(StaticMetadataSource(metadata),
+                      SyntheticLoadSampler(seed=5), num_windows=3,
+                      window_ms=W, sample_store=store1)
+    for w in range(4):
+        lm1.sample_once(now_ms=w * W + 30_000)
+    topo1, assign1 = lm1.cluster_model(now_ms=4 * W)
+
+    # "restart": a new monitor + store over the same cluster, replay only
+    store2 = make_store()
+    lm2 = LoadMonitor(StaticMetadataSource(metadata),
+                      SyntheticLoadSampler(seed=99), num_windows=3,
+                      window_ms=W, sample_store=store2)
+    lm2.startup(load_stored_samples=True)
+    lm2.shutdown()
+    topo2, assign2 = lm2.cluster_model(now_ms=4 * W)
+    np.testing.assert_allclose(np.asarray(topo2.replica_base_load),
+                               np.asarray(topo1.replica_base_load),
+                               rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(assign2.broker_of),
+                                  np.asarray(assign1.broker_of))
+
+
+def test_kafka_sample_store_skips_corrupt_records():
+    """Corrupt records must not abort the replay
+    (KafkaSampleStore.java loadSamples swallows deserialization errors)."""
+    from cruise_control_tpu.monitor.sample_store import KafkaSampleStore
+    broker = _FakeKafkaBroker()
+    store = KafkaSampleStore(producer=broker,
+                             consumer_factory=broker.consumer, admin=broker)
+    metadata = _metadata()
+    ps, bs = SyntheticLoadSampler(seed=5).get_samples(metadata, 0, W)
+    store.store_samples(ps, bs)
+    # inject garbage between valid records
+    broker.topics[store.partition_topic].insert(1, (b"x", "not json"))
+    broker.topics[store.broker_topic].insert(0, (b"y", {"no": "fields"}))
+    got_p, got_b = [], []
+    n = store.load_samples(got_p.append, got_b.append)
+    assert n == len(ps) + len(bs)
+    assert len(got_p) == len(ps) and len(got_b) == len(bs)
+
+
 def test_monitor_to_optimizer_end_to_end():
     """Full slice: metadata + synthetic samples -> model -> optimization."""
     from cruise_control_tpu.analyzer import optimizer as OPT
