@@ -98,7 +98,11 @@ class CruiseControlApp:
             min_samples_per_broker_window=config.get(
                 "min.samples.per.broker.metrics.window"),
             max_allowed_extrapolations_per_broker=config.get(
-                "max.allowed.extrapolations.per.broker"))
+                "max.allowed.extrapolations.per.broker"),
+            partition_completeness_cache_size=config.get(
+                "partition.metric.sample.aggregator.completeness.cache.size"),
+            broker_completeness_cache_size=config.get(
+                "broker.metric.sample.aggregator.completeness.cache.size"))
         self._metadata_source = metadata_source
         adapter = cluster_adapter or FakeClusterAdapter({})
         check_ms = config.get("execution.progress.check.interval.ms")
@@ -130,7 +134,11 @@ class CruiseControlApp:
                 removal_history_retention_ms=config.get(
                     "removal.history.retention.time.ms"),
                 demotion_history_retention_ms=config.get(
-                    "demotion.history.retention.time.ms")))
+                    "demotion.history.retention.time.ms"),
+                inter_broker_movement_rate_alerting_threshold=config.get(
+                    "inter.broker.replica.movement.rate.alerting.threshold"),
+                intra_broker_movement_rate_alerting_threshold=config.get(
+                    "intra.broker.replica.movement.rate.alerting.threshold")))
         notifier = SelfHealingNotifier(
             broker_failure_alert_threshold_ms=config.get(
                 "broker.failure.alert.threshold.ms"),
@@ -142,27 +150,43 @@ class CruiseControlApp:
         # (AnomalyDetector.java:167-180): broker failure, goal violation,
         # disk failure (adapter logdir state), metric anomaly and slow-broker
         # (windowed broker metric history from the monitor).
+        from cruise_control_tpu.detector.anomalies import (
+            BrokerFailures, DiskFailures, GoalViolations, MetricAnomaly,
+            resolve_anomaly_class)
         self.anomaly_detector = AnomalyDetectorService(
             notifier, context=self,
             has_ongoing_execution=lambda: self.executor.has_ongoing_execution,
             detectors={
                 "broker_failure": BrokerFailureDetector(
                     metadata_source,
-                    persist_path=config.get("failed.brokers.file.path") or None,
+                    # failed.brokers.zk.path is the reference-compat alias
+                    # for the record location (we persist to a file)
+                    persist_path=(config.get("failed.brokers.zk.path")
+                                  or config.get("failed.brokers.file.path")
+                                  or None),
                     report_backoff_ms=config.get(
                         "broker.failure.detection.backoff.ms"),
+                    anomaly_class=resolve_anomaly_class(
+                        config.get("broker.failures.class"), BrokerFailures),
                 ).detect,
                 "goal_violation": GoalViolationDetector(
                     self.load_monitor,
                     goal_names=tuple(config.get("anomaly.detection.goals")),
                     allow_capacity_estimation=config.get(
                         "anomaly.detection.allow.capacity.estimation"),
+                    anomaly_class=resolve_anomaly_class(
+                        config.get("goal.violations.class"), GoalViolations),
                 ).detect,
                 "disk_failure": DiskFailureDetector(
-                    adapter.describe_logdirs).detect,
+                    adapter.describe_logdirs,
+                    anomaly_class=resolve_anomaly_class(
+                        config.get("disk.failures.class"), DiskFailures),
+                ).detect,
                 "metric_anomaly": MetricAnomalyDetector(
                     self.load_monitor.broker_metric_history,
                     metrics=("cpu",),
+                    anomaly_class=resolve_anomaly_class(
+                        config.get("metric.anomaly.class"), MetricAnomaly),
                     upper_percentile=config.get(
                         "metric.anomaly.percentile.upper.threshold"),
                     lower_percentile=config.get(
@@ -379,12 +403,17 @@ class CruiseControlApp:
 
     def _check_capacity_estimation(self, allow: bool) -> None:
         """allow_capacity_estimation=false refuses to optimize on brokers
-        whose capacity fell back to the default (-1) entry."""
+        whose capacity fell back to the default (-1) entry. The service-wide
+        ``sampling.allow.cpu.capacity.estimation`` switch (SamplingUtils'
+        estimation gate) disallows estimated capacities regardless of the
+        per-request parameter."""
         est = self.load_monitor.capacity_estimated_brokers
+        if not self.config.get("sampling.allow.cpu.capacity.estimation"):
+            allow = False
         if not allow and est:
             raise ValueError(
                 f"Broker capacities were estimated for {sorted(est)} and "
-                "allow_capacity_estimation is false.")
+                "capacity estimation is not allowed.")
 
     def _build_options(self, topo: ClusterTopology,
                        excluded_topics: Sequence[str] = (),

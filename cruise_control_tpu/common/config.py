@@ -248,6 +248,18 @@ def _service_config_def() -> ConfigDef:
              "('' = use bootstrap.servers).")
     d.define("metric.sampler.class", T.CLASS, "SyntheticLoadSampler", I.HIGH,
              "MetricSampler implementation.")
+    d.define("partition.metric.sample.aggregator.completeness.cache.size",
+             T.INT, 5, I.LOW,
+             "Cached completeness computations in the partition aggregator.",
+             at_least(0))
+    d.define("broker.metric.sample.aggregator.completeness.cache.size",
+             T.INT, 5, I.LOW,
+             "Cached completeness computations in the broker aggregator.",
+             at_least(0))
+    d.define("sampling.allow.cpu.capacity.estimation", T.BOOLEAN, True,
+             I.LOW, "Permit estimated broker CPU capacities during "
+             "sampling; when false, model builds fail if any broker "
+             "capacity had to be estimated.")
     # analyzer / optimizer engine
     d.define("proposal.expiration.ms", T.LONG, 900_000, I.MEDIUM,
              "Cached proposal staleness bound.", at_least(0))
@@ -273,6 +285,16 @@ def _service_config_def() -> ConfigDef:
              "Default replication throttle bytes/sec (None = off).")
     d.define("max.num.cluster.movements", T.INT, 1250, I.MEDIUM,
              "Cap on simultaneous movements.", at_least(1))
+    d.define("logdir.response.timeout.ms", T.LONG, 10_000, I.LOW,
+             "DescribeLogDirs request timeout.", at_least(1))
+    d.define("inter.broker.replica.movement.rate.alerting.threshold",
+             T.DOUBLE, 0.1, I.LOW,
+             "Alert when the achieved inter-broker movement rate (MB/s) "
+             "falls below this.", at_least(0.0))
+    d.define("intra.broker.replica.movement.rate.alerting.threshold",
+             T.DOUBLE, 0.2, I.LOW,
+             "Alert when the achieved intra-broker movement rate (MB/s) "
+             "falls below this.", at_least(0.0))
     # anomaly detector
     d.define("anomaly.detection.interval.ms", T.LONG, 300_000, I.MEDIUM,
              "Detector sweep period.", at_least(1))
@@ -286,6 +308,21 @@ def _service_config_def() -> ConfigDef:
              I.MEDIUM, "Broker-failure fix delay.")
     d.define("failed.brokers.file.path", T.STRING, "failed_brokers.json",
              I.LOW, "Persisted failed-broker record.")
+    d.define("failed.brokers.zk.path", T.STRING, "", I.LOW,
+             "Reference-compat alias for the failed-broker record location; "
+             "when set it overrides failed.brokers.file.path (this rebuild "
+             "persists to a file, not ZooKeeper).")
+    # pluggable anomaly classes (AnomalyDetectorConfig *_CLASS_CONFIG):
+    # names resolve through detector.ANOMALY_CLASS_REGISTRY, so a deployment
+    # can register a subclass and select it here
+    d.define("broker.failures.class", T.CLASS, "BrokerFailures", I.LOW,
+             "Broker-failure anomaly payload class.")
+    d.define("goal.violations.class", T.CLASS, "GoalViolations", I.LOW,
+             "Goal-violation anomaly payload class.")
+    d.define("disk.failures.class", T.CLASS, "DiskFailures", I.LOW,
+             "Disk-failure anomaly payload class.")
+    d.define("metric.anomaly.class", T.CLASS, "KafkaMetricAnomaly", I.LOW,
+             "Metric anomaly payload class.")
     d.define("use.linear.regression.model", T.BOOLEAN, False, I.MEDIUM,
              "Use the trained linear-regression CPU model for partition CPU "
              "estimation after TRAIN completes.")
@@ -429,6 +466,18 @@ def _service_config_def() -> ConfigDef:
              "Access-Control-Allow-Methods value.")
     d.define("webserver.http.cors.exposeheaders", T.STRING, "User-Task-ID",
              I.LOW, "Access-Control-Expose-Headers value.")
+    d.define("webserver.accesslog.retention.days", T.INT, 14, I.LOW,
+             "Days of rotated access logs kept on disk.", at_least(1))
+    d.define("webserver.session.path", T.STRING, "/", I.LOW,
+             "Cookie path of the REST session cookie.")
+    d.define("webserver.ui.diskpath", T.STRING, "", I.LOW,
+             "Directory of static UI assets ('' = UI serving disabled).")
+    d.define("webserver.ui.urlprefix", T.STRING, "/*", I.LOW,
+             "URL prefix the static UI is served under.")
+    d.define("zookeeper.security.enabled", T.BOOLEAN, False, I.LOW,
+             "Reference-compat: secure ZK ACLs. This rebuild has no "
+             "ZooKeeper dependency; accepted for config-file parity, "
+             "no effect.")
     # -- pluggable classes --------------------------------------------------
     d.define("executor.notifier.class", T.CLASS, "LoggingExecutorNotifier",
              I.LOW, "ExecutorNotifier implementation.")

@@ -155,6 +155,34 @@ class SlowBrokers(Anomaly):
                 "remove": self.remove_slow_brokers}
 
 
+#: Pluggable anomaly payload classes (AnomalyDetectorConfig's
+#: ``broker.failures.class`` / ``goal.violations.class`` /
+#: ``disk.failures.class`` / ``metric.anomaly.class``): register a subclass
+#: here and select it by name in the config; detectors construct whatever
+#: class the config resolved.
+ANOMALY_CLASS_REGISTRY: Dict[str, type] = {
+    "BrokerFailures": BrokerFailures,
+    "GoalViolations": GoalViolations,
+    "DiskFailures": DiskFailures,
+    "MetricAnomaly": MetricAnomaly,
+    "KafkaMetricAnomaly": MetricAnomaly,    # reference default's name
+    "SlowBrokers": SlowBrokers,
+}
+
+
+def resolve_anomaly_class(name: str, base: type) -> type:
+    """Config class name → registered payload class; must subclass ``base``
+    (the built-in payload it replaces) so detector/notifier plumbing holds."""
+    cls = ANOMALY_CLASS_REGISTRY.get(name)
+    if cls is None:
+        raise ValueError(
+            f"unknown anomaly class {name!r}; register it in "
+            f"ANOMALY_CLASS_REGISTRY (have: {sorted(ANOMALY_CLASS_REGISTRY)})")
+    if not issubclass(cls, base):
+        raise ValueError(f"{name} must subclass {base.__name__}")
+    return cls
+
+
 # ---------------------------------------------------------------------------
 # Notifiers
 # ---------------------------------------------------------------------------

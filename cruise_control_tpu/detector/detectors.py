@@ -50,10 +50,13 @@ class BrokerFailureDetector:
     times so detection survives restarts (ZK record → JSON file)."""
 
     def __init__(self, metadata_source, persist_path: Optional[str] = None,
-                 report_backoff_ms: int = 0, now_fn=_now_ms):
+                 report_backoff_ms: int = 0, now_fn=_now_ms,
+                 anomaly_class: type = BrokerFailures):
         self._metadata_source = metadata_source
         self._path = persist_path
         self._now = now_fn
+        #: broker.failures.class — the payload class this detector emits
+        self._anomaly_class = anomaly_class
         #: broker.failure.detection.backoff.ms — an UNCHANGED failure set is
         #: re-reported at most this often; a change reports immediately
         self._backoff_ms = report_backoff_ms
@@ -86,8 +89,9 @@ class BrokerFailureDetector:
             if not changed and now - self._last_report_ms < self._backoff_ms:
                 return None     # persisting failure inside the backoff window
             self._last_report_ms = now
-            return BrokerFailures(AnomalyType.BROKER_FAILURE, now,
-                                  failed_brokers_by_time=dict(self._failed_by_time))
+            return self._anomaly_class(
+                AnomalyType.BROKER_FAILURE, now,
+                failed_brokers_by_time=dict(self._failed_by_time))
         return None
 
 
@@ -95,13 +99,16 @@ class GoalViolationDetector:
     """Runs the anomaly-detection goal list against a fresh model."""
 
     def __init__(self, load_monitor, goal_names: Optional[Sequence[str]] = None,
-                 allow_capacity_estimation: bool = True, now_fn=_now_ms):
+                 allow_capacity_estimation: bool = True, now_fn=_now_ms,
+                 anomaly_class: type = GoalViolations):
         from cruise_control_tpu.analyzer import goals as G
         self._lm = load_monitor
         self._goals = tuple(goal_names or G.ANOMALY_DETECTION_GOALS)
         #: anomaly.detection.allow.capacity.estimation
         self._allow_estimation = allow_capacity_estimation
         self._now = now_fn
+        #: goal.violations.class
+        self._anomaly_class = anomaly_class
 
     def detect(self) -> Optional[GoalViolations]:
         from cruise_control_tpu.analyzer import goals as G
@@ -130,8 +137,9 @@ class GoalViolationDetector:
         if viol[-1] > 0:           # offline/self-healing term
             violated.append("OfflineReplicas")
         if violated:
-            return GoalViolations(AnomalyType.GOAL_VIOLATION, self._now(),
-                                  fixable_violated_goals=violated)
+            return self._anomaly_class(AnomalyType.GOAL_VIOLATION,
+                                       self._now(),
+                                       fixable_violated_goals=violated)
         return None
 
 
@@ -140,9 +148,11 @@ class DiskFailureDetector:
     {broker_id: {logdir: alive}} (AdminClient describeLogDirs seam)."""
 
     def __init__(self, logdirs_fn: Callable[[], Dict[int, Dict[str, bool]]],
-                 now_fn=_now_ms):
+                 now_fn=_now_ms, anomaly_class: type = DiskFailures):
         self._logdirs_fn = logdirs_fn
         self._now = now_fn
+        #: disk.failures.class
+        self._anomaly_class = anomaly_class
 
     def detect(self) -> Optional[DiskFailures]:
         failed: Dict[int, List[str]] = {}
@@ -151,8 +161,8 @@ class DiskFailureDetector:
             if dead:
                 failed[broker] = dead
         if failed:
-            return DiskFailures(AnomalyType.DISK_FAILURE, self._now(),
-                                failed_disks_by_broker=failed)
+            return self._anomaly_class(AnomalyType.DISK_FAILURE, self._now(),
+                                       failed_disks_by_broker=failed)
         return None
 
 
@@ -182,10 +192,13 @@ class MetricAnomalyDetector:
     (MetricAnomalyDetector.java:29-72 + percentile finder)."""
 
     def __init__(self, broker_history_fn: Callable[[], Dict[int, Dict[str, np.ndarray]]],
-                 metrics: Sequence[str] = ("cpu",), now_fn=_now_ms, **finder_kw):
+                 metrics: Sequence[str] = ("cpu",), now_fn=_now_ms,
+                 anomaly_class: type = MetricAnomaly, **finder_kw):
         self._history_fn = broker_history_fn
         self._metrics = metrics
         self._now = now_fn
+        #: metric.anomaly.class
+        self._anomaly_class = anomaly_class
         self._finder_kw = finder_kw
 
     def detect(self) -> List[MetricAnomaly]:
@@ -198,7 +211,7 @@ class MetricAnomalyDetector:
                 desc = percentile_anomalies(vals[:-1], float(vals[-1]),
                                             **self._finder_kw)
                 if desc:
-                    out.append(MetricAnomaly(
+                    out.append(self._anomaly_class(
                         AnomalyType.METRIC_ANOMALY, self._now(),
                         broker_id=broker, metric=metric, description=desc))
         return out

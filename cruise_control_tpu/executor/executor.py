@@ -287,6 +287,10 @@ class ExecutorConfig:
     #: ({removal,demotion}.history.retention.time.ms)
     removal_history_retention_ms: int = 1_209_600_000
     demotion_history_retention_ms: int = 1_209_600_000
+    #: alert when the achieved movement rate (MB/s) falls below these
+    #: ({inter,intra}.broker.replica.movement.rate.alerting.threshold)
+    inter_broker_movement_rate_alerting_threshold: float = 0.1
+    intra_broker_movement_rate_alerting_threshold: float = 0.2
 
 
 class Executor:
@@ -473,14 +477,27 @@ class Executor:
         finally:
             if helper is not None:
                 helper.clear_throttles()
+            duration_s = time.time() - t0
             summary = {
                 "stopped": self._stop_requested.is_set(),
                 "forcedStop": self._force_stop.is_set(),
                 "timedOut": self._timed_out,
                 "taskCounts": self.tracker.snapshot(),
                 "intraBrokerMoves": intra_moves_applied,
-                "durationSeconds": round(time.time() - t0, 3),
+                "durationSeconds": round(duration_s, 3),
             }
+            # movement-rate alert ({inter,intra}.broker.replica.movement.
+            # rate.alerting.threshold): a healthy execution sustains at
+            # least the configured MB/s of ACTUALLY FINISHED movement (the
+            # tracker's figure — planned data would mis-rate stopped or
+            # timed-out runs); below it, flag the execution so the
+            # notifier/operator can investigate throttles or slow disks
+            data_mb = self.tracker.finished_data_movement_mb
+            if (not crashed and data_mb > 0 and duration_s > 0
+                    and (data_mb / duration_s)
+                    < self.config.inter_broker_movement_rate_alerting_threshold):
+                summary["slowInterBrokerMovementRateMBps"] = round(
+                    data_mb / duration_s, 6)
             self._execution_history.append(summary)
             self._state = ExecutorState.NO_TASK_IN_PROGRESS
             self._planner = None
@@ -505,15 +522,29 @@ class Executor:
             self._state = ExecutorState.INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
         t0 = time.time()
         applied = 0
+        data_mb = 0.0
         try:
             for batch in self._logdir_batches(moves):
                 self.adapter.alter_replica_logdirs(batch)
                 applied += len(batch)
+                # intra rate counts the APPLIED batches' sizes only (a
+                # stopped run must not have its rate inflated by the
+                # unexecuted tail; batches are round-robin, not a prefix
+                # of `moves`)
+                data_mb += sum(float(getattr(m, "size_mb", 0.0))
+                               for m in batch)
                 if self._stop_requested.is_set():
                     break
-            return {"intraBrokerMoves": applied,
-                    "stopped": applied < len(moves),
-                    "durationSeconds": round(time.time() - t0, 3)}
+            dur = time.time() - t0
+            out = {"intraBrokerMoves": applied,
+                   "stopped": applied < len(moves),
+                   "durationSeconds": round(dur, 3)}
+            # intra.broker.replica.movement.rate.alerting.threshold
+            if (data_mb > 0 and dur > 0 and (data_mb / dur)
+                    < self.config.intra_broker_movement_rate_alerting_threshold):
+                out["slowIntraBrokerMovementRateMBps"] = round(
+                    data_mb / dur, 6)
+            return out
         finally:
             self._state = ExecutorState.NO_TASK_IN_PROGRESS
 

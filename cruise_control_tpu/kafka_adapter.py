@@ -223,6 +223,12 @@ class KafkaClusterAdapter:
         self._kafka = _require_kafka()
         self._admin = self._kafka.KafkaAdminClient(
             bootstrap_servers=config.get("bootstrap.servers"))
+        #: logdir.response.timeout.ms — DescribeLogDirs deadline
+        try:
+            self._logdir_timeout_ms = int(
+                config.get("logdir.response.timeout.ms") or 10_000)
+        except Exception:
+            self._logdir_timeout_ms = 10_000
 
     def execute_replica_reassignments(self, tasks):
         assignments = {}
@@ -397,7 +403,12 @@ class KafkaClusterAdapter:
         raises a DiskFailures anomaly. Unknown shapes yield no data (the
         detector simply sees no dirs) rather than crashing the sweep."""
         try:
-            described = self._admin.describe_log_dirs()
+            try:    # forks with a per-request deadline (logdir.response.
+                    # timeout.ms); stock kafka-python has no such kwarg
+                described = self._admin.describe_log_dirs(
+                    timeout_ms=self._logdir_timeout_ms)
+            except TypeError:
+                described = self._admin.describe_log_dirs()
         except Exception:
             return {}
         out: Dict[int, Dict[str, bool]] = {}

@@ -89,7 +89,14 @@ class MetricSampleAggregator:
                  min_samples_per_window: int = 3,
                  max_allowed_extrapolations: int = 5,
                  num_metrics: int = md.NUM_MODEL_METRICS,
-                 strategies: Optional[Sequence[md.Strategy]] = None):
+                 strategies: Optional[Sequence[md.Strategy]] = None,
+                 completeness_cache_size: int = 5):
+        #: *.metric.sample.aggregator.completeness.cache.size — LRU entries
+        #: for completeness() (0 disables)
+        self._completeness_cache_size = completeness_cache_size
+        import collections as _collections
+        self._completeness_cache: "_collections.OrderedDict" = (
+            _collections.OrderedDict())
         self.num_windows = num_windows
         self.window_ms = window_ms
         self.min_samples = min_samples_per_window
@@ -310,6 +317,33 @@ class MetricSampleAggregator:
                 ),
                 generation=self.generation,
             )
+
+    def completeness(self, now_ms: int,
+                     requirements: ModelCompletenessRequirements
+                     = ModelCompletenessRequirements()) -> Completeness:
+        """Cached MetricSampleCompleteness
+        (``*.metric.sample.aggregator.completeness.cache.size``): per-goal
+        readiness checks ask for completeness under several requirement
+        sets within one unchanged sample generation — the cache keys on
+        (generation, ingest count, window, ratio requirement) so any
+        ingestion or roll invalidates, and repeated queries skip the O(E·W)
+        aggregation."""
+        key = (self.generation, self.samples_ingested,
+               int(now_ms) // self.window_ms,
+               requirements.min_monitored_partitions_percentage)
+        with self._lock:
+            c = self._completeness_cache.get(key)
+            if c is not None:
+                self._completeness_cache.move_to_end(key)
+                return c
+        c = self.aggregate(now_ms, requirements).completeness
+        if self._completeness_cache_size > 0:
+            with self._lock:
+                self._completeness_cache[key] = c
+                while (len(self._completeness_cache)
+                       > self._completeness_cache_size):
+                    self._completeness_cache.popitem(last=False)
+        return c
 
     def meets(self, result: AggregationResult,
               req: ModelCompletenessRequirements) -> bool:

@@ -102,6 +102,8 @@ class LoadMonitor:
                  broker_window_ms: Optional[int] = None,
                  min_samples_per_broker_window: Optional[int] = None,
                  max_allowed_extrapolations_per_broker: Optional[int] = None,
+                 partition_completeness_cache_size: int = 5,
+                 broker_completeness_cache_size: int = 5,
                  now_fn: Optional[Callable[[], int]] = None):
         from cruise_control_tpu.monitor.fetcher import MetricFetcherManager
         self._metadata_source = metadata_source
@@ -114,7 +116,8 @@ class LoadMonitor:
         self.partition_aggregator = MetricSampleAggregator(
             num_windows=num_windows, window_ms=window_ms,
             min_samples_per_window=min_samples_per_window,
-            max_allowed_extrapolations=max_allowed_extrapolations)
+            max_allowed_extrapolations=max_allowed_extrapolations,
+            completeness_cache_size=partition_completeness_cache_size)
         # broker aggregator reuses the same engine; metrics:
         # cpu/lbi/lbo/rbi/rbo/log-flush-time-mean + log-flush-time p99.9.
         # The tail column aggregates with MAX: the broker's Yammer histogram
@@ -136,7 +139,8 @@ class LoadMonitor:
                 if max_allowed_extrapolations_per_broker is not None
                 else max_allowed_extrapolations),
             num_metrics=7,
-            strategies=[md.Strategy.AVG] * 6 + [md.Strategy.MAX])
+            strategies=[md.Strategy.AVG] * 6 + [md.Strategy.MAX],
+            completeness_cache_size=broker_completeness_cache_size)
         self.window_ms = window_ms
         self.sampling_interval_ms = sampling_interval_ms
         #: brokers whose capacity came from the default (-1) entry in the
@@ -422,9 +426,10 @@ class LoadMonitor:
         REQUIREMENTS' monitored-partition ratio meets the required window
         count. Used per goal to compute ready goals."""
         now_ms = now_ms or self._now()
-        result = self.partition_aggregator.aggregate(now_ms, requirements)
-        return (result.completeness.num_valid_windows
-                >= requirements.min_required_num_windows)
+        # completeness() serves per-goal readiness checks from the LRU
+        # (partition.metric.sample.aggregator.completeness.cache.size)
+        c = self.partition_aggregator.completeness(now_ms, requirements)
+        return c.num_valid_windows >= requirements.min_required_num_windows
 
     def cluster_model(self, now_ms: Optional[int] = None,
                       requirements: ModelCompletenessRequirements

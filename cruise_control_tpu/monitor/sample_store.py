@@ -162,8 +162,13 @@ class KafkaSampleStore(SampleStore):
         except Exception:
             return None
 
+    #: records deserialized per chunk during replay — bounds the in-memory
+    #: footprint to one chunk regardless of topic size
+    LOAD_CHUNK = 50_000
+
     def load_samples(self, on_partition_sample, on_broker_sample) -> int:
         from concurrent.futures import ThreadPoolExecutor
+        from itertools import islice
         n = 0
         for topic, cb, cls in (
                 (self.partition_topic, on_partition_sample,
@@ -171,24 +176,27 @@ class KafkaSampleStore(SampleStore):
                 (self.broker_topic, on_broker_sample, BrokerMetricSample)):
             consumer = self._consumer_factory(topic)
             try:
-                raw = [msg.value for msg in consumer]
+                it = iter(consumer)
+                # deserialization fans out over the loading threads
+                # (num.sample.loading.threads) one bounded chunk at a time;
+                # ingest callbacks stay in the caller's thread, in record
+                # order — a 14-day topic never sits fully in memory
+                with ThreadPoolExecutor(max(1, self._loading_threads)) as pool:
+                    while True:
+                        raw = [m.value for m in islice(it, self.LOAD_CHUNK)]
+                        if not raw:
+                            break
+                        samples = pool.map(
+                            lambda v: self._deserialize(cls, v), raw,
+                            chunksize=max(1, len(raw)
+                                          // max(1, self._loading_threads)))
+                        for s in samples:
+                            if s is not None:
+                                cb(s)
+                                n += 1
             finally:
                 if hasattr(consumer, "close"):
                     consumer.close()
-            # deserialization fans out over the loading threads
-            # (num.sample.loading.threads); ingest callbacks stay in the
-            # caller's thread, in record order
-            if self._loading_threads > 1 and len(raw) > 1:
-                with ThreadPoolExecutor(self._loading_threads) as pool:
-                    samples = list(pool.map(
-                        lambda v: self._deserialize(cls, v), raw,
-                        chunksize=max(1, len(raw) // self._loading_threads)))
-            else:
-                samples = [self._deserialize(cls, v) for v in raw]
-            for s in samples:
-                if s is not None:
-                    cb(s)
-                    n += 1
         return n
 
     def close(self):

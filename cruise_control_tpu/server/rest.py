@@ -12,6 +12,7 @@ verification through the Purgatory.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -152,6 +153,7 @@ class RestApi:
         self.reason_required = bool(cfg.get("request.reason.required"))
         self._accesslog_lock = threading.Lock()
         self._accesslog_file = None
+        self._accesslog_date = None   # date the open file was started
 
     def close(self):
         if self._accesslog_file:
@@ -160,6 +162,31 @@ class RestApi:
             except OSError:
                 pass
         self.user_tasks.close()
+
+    def _open_accesslog(self, path: str):
+        """Open the access log, rotating a previous day's file to
+        ``path.YYYY-MM-DD`` and deleting rotated logs older than
+        ``webserver.accesslog.retention.days``."""
+        import datetime
+        import glob
+        import time as _time
+        retention_days = int(
+            self.app.config.get("webserver.accesslog.retention.days") or 14)
+        try:
+            st = os.stat(path)
+            mdate = datetime.date.fromtimestamp(st.st_mtime)
+            if mdate != datetime.date.today():
+                os.replace(path, f"{path}.{mdate.isoformat()}")
+        except OSError:
+            pass
+        cutoff = _time.time() - retention_days * 86_400
+        for rotated in glob.glob(path + ".*"):
+            try:
+                if os.path.getmtime(rotated) < cutoff:
+                    os.remove(rotated)
+            except OSError:
+                continue
+        return open(path, "a", buffering=1)
 
     # ------------------------------------------------------------- dispatch
 
@@ -239,8 +266,20 @@ class RestApi:
             if info is None:
                 return 404, {"errorMessage": f"unknown user task {existing}"}
         else:
-            info = self.user_tasks.create_task(
-                endpoint, request_url, client_id, lambda fut: fn())
+            # session → task binding (UserTaskManager.getOrCreateUserTask):
+            # the SAME client repeating the SAME request (endpoint + its
+            # parameters, minus the volatile polling ones) polls its
+            # original task instead of spawning a duplicate operation
+            essence = sorted((k, v) for k, v in params.items()
+                             if k not in ("user_task_id", "json",
+                                          "get_response_timeout_ms"))
+            session_key = f"{client_id} {endpoint} {essence}"
+            bound = self.sessions.task_for(session_key)
+            info = self.user_tasks.get(bound) if bound else None
+            if info is None:
+                info = self.user_tasks.create_task(
+                    endpoint, request_url, client_id, lambda fut: fn())
+                self.sessions.bind(session_key, info.task_id)
         timeout = float(params.get("get_response_timeout_ms", 1_000)) / 1000.0
         try:
             result = info.future.result(timeout=timeout)
@@ -709,6 +748,55 @@ def _to_plaintext(payload, indent: int = 0) -> str:
 class _Handler(BaseHTTPRequestHandler):
     api: RestApi = None     # injected by serve()
 
+    def _serve_ui(self, path: str) -> bool:
+        """Static UI assets (webserver.ui.diskpath under
+        webserver.ui.urlprefix; WebServerConfig's UI serving). Returns True
+        when this request was a UI request (served or 404)."""
+        cfg = self.api.app.config
+        ui_dir = cfg.get("webserver.ui.diskpath")
+        if not ui_dir:
+            return False
+        ui_prefix = (cfg.get("webserver.ui.urlprefix") or "/*").rstrip("*")
+        ui_prefix = "/" + ui_prefix.strip("/")
+        rel = None
+        if ui_prefix == "/":
+            rel = path.lstrip("/")
+        elif path == ui_prefix or path.startswith(ui_prefix + "/"):
+            rel = path[len(ui_prefix):].lstrip("/")
+        if rel is None:
+            return False
+        full = os.path.realpath(os.path.join(ui_dir, rel or "index.html"))
+        root = os.path.realpath(ui_dir)
+        if not (full == root or full.startswith(root + os.sep)) \
+                or not os.path.isfile(full):
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return True
+        import mimetypes
+        ctype = mimetypes.guess_type(full)[0] or "application/octet-stream"
+        with open(full, "rb") as f:
+            data = f.read()
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+        return True
+
+    def _session_id(self):
+        """JSESSIONID from the request cookie, or a fresh one to set
+        (None, new_id). The cookie binds async tasks to the caller's
+        session (SessionManager); its path comes from
+        ``webserver.session.path``."""
+        cookie = self.headers.get("Cookie", "") or ""
+        for part in cookie.split(";"):
+            k, _, v = part.strip().partition("=")
+            if k == "JSESSIONID" and v:
+                return v, None
+        import uuid
+        return None, uuid.uuid4().hex
+
     def _do(self, method: str):
         parsed = urllib.parse.urlparse(self.path)
         params = {k: v[-1] for k, v in
@@ -721,11 +809,20 @@ class _Handler(BaseHTTPRequestHandler):
                                urllib.parse.parse_qs(body).items()})
         path = parsed.path.rstrip("/")
         prefix = self.api.prefix
+        if method == "GET" and not path.startswith(prefix) \
+                and self._serve_ui(parsed.path):
+            return
         endpoint = path[len(prefix):].strip("/") if path.startswith(prefix) \
             else path.strip("/")
+        sid, new_sid = self._session_id()
+        # a session's FIRST request binds to the id the Set-Cookie below
+        # establishes, so follow-ups under the cookie see it; clients that
+        # never echo cookies (curl, cccli) re-enter here with no cookie
+        # each time and still find their tasks via User-Task-ID
         code, payload = self.api.dispatch(
             method, endpoint or "STATE", params,
-            client_id=self.client_address[0], request_url=self.path)
+            client_id=sid or new_sid,
+            request_url=self.path)
         # json=false → text/plain rendering (the reference's default wire
         # format; ParameterUtils JSON_PARAM)
         as_json = str(params.get("json", "true")).strip().lower() != "false"
@@ -738,6 +835,11 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        if new_sid is not None:
+            self.send_header(
+                "Set-Cookie",
+                f"JSESSIONID={new_sid}; "
+                f"Path={self.api.app.config.get('webserver.session.path')}")
         self._cors_headers()
         self.end_headers()
         self.wfile.write(data)
@@ -774,12 +876,22 @@ class _Handler(BaseHTTPRequestHandler):
             # one handle for the server lifetime, opened lazily under a lock
             # (ThreadingHTTPServer logs concurrently); open failures are NOT
             # cached, so file logging resumes once the path is writable
+            import datetime
             with self.api._accesslog_lock:
                 f = self.api._accesslog_file
+                today = datetime.date.today()
+                if f is not None and self.api._accesslog_date != today:
+                    # day rolled over mid-run: close and rotate
+                    try:
+                        f.close()
+                    except OSError:
+                        pass
+                    f = self.api._accesslog_file = None
                 if f is None:
                     try:
-                        f = self.api._accesslog_file = open(
-                            path, "a", buffering=1)
+                        f = self.api._accesslog_file = \
+                            self.api._open_accesslog(path)
+                        self.api._accesslog_date = today
                     except OSError:
                         f = None
                 if f is not None:

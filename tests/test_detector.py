@@ -405,3 +405,30 @@ def test_slow_broker_kafka_raw_type_extras_flow_to_history():
     hist = app.load_monitor.broker_metric_history()
     assert hist[9]["flush_time"][-1] == pytest.approx(12.0)
     assert hist[9]["flush_time_999"][-1] == pytest.approx(220.0)
+
+
+def test_pluggable_anomaly_class_registry():
+    """broker.failures.class etc.: a registered subclass is constructed by
+    the detector in place of the built-in payload; unknown names and
+    non-subclasses are rejected at resolve time."""
+    from cruise_control_tpu.detector.anomalies import (
+        ANOMALY_CLASS_REGISTRY, BrokerFailures, GoalViolations,
+        resolve_anomaly_class)
+
+    class CustomBrokerFailures(BrokerFailures):
+        pass
+
+    ANOMALY_CLASS_REGISTRY["CustomBrokerFailures"] = CustomBrokerFailures
+    try:
+        cls = resolve_anomaly_class("CustomBrokerFailures", BrokerFailures)
+        d = BrokerFailureDetector(StaticMetadataSource(_metadata(dead=(2,))),
+                                  now_fn=FakeTime(1000), anomaly_class=cls)
+        a = d.detect()
+        assert type(a) is CustomBrokerFailures
+        assert a.failed_brokers_by_time == {2: 1000}
+        with pytest.raises(ValueError):
+            resolve_anomaly_class("NoSuchClass", BrokerFailures)
+        with pytest.raises(ValueError):
+            resolve_anomaly_class("CustomBrokerFailures", GoalViolations)
+    finally:
+        ANOMALY_CLASS_REGISTRY.pop("CustomBrokerFailures", None)

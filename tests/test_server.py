@@ -539,3 +539,31 @@ def test_kafka_assigner_mode_on_proposals_and_remove():
                                "dryrun": "true",
                                "get_response_timeout_ms": "60000"})
     assert code == 200, body
+
+
+def test_session_binds_repeated_request_to_same_task():
+    """UserTaskManager.getOrCreateUserTask semantics: the same client
+    repeating the same async request (same endpoint + parameters) polls its
+    ORIGINAL task; different parameters or a different client create a new
+    one."""
+    from cruise_control_tpu.server import rest
+    app = _app()
+    api = rest.RestApi(app)
+    try:
+        p = {"get_response_timeout_ms": "60000"}
+        code1, body1 = api.dispatch("GET", "PROPOSALS", dict(p),
+                                    client_id="session-a")
+        code2, body2 = api.dispatch("GET", "PROPOSALS", dict(p),
+                                    client_id="session-a")
+        assert body1["userTaskId"] == body2["userTaskId"]
+        # different params -> a different task (polling-only params ignored)
+        code3, body3 = api.dispatch(
+            "GET", "PROPOSALS",
+            {**p, "ignore_proposal_cache": "true"}, client_id="session-a")
+        assert body3["userTaskId"] != body1["userTaskId"]
+        # different client -> a different task
+        code4, body4 = api.dispatch("GET", "PROPOSALS", dict(p),
+                                    client_id="session-b")
+        assert body4["userTaskId"] != body1["userTaskId"]
+    finally:
+        api.close()
