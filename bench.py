@@ -10,7 +10,15 @@ divided by our wall-clock (>1 = beating the target).
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "s", "vs_baseline": N, ...extras}
 
-Size selection: env BENCH_SIZE in {linkedin (default), medium, small}.
+Size selection: env BENCH_SIZE picks the BASELINE.md config:
+  linkedin (default) — config 5: 2.6K brokers / 500K replicas, full goals
+  medium             — config 2: RandomCluster 300/10K, HARD goals only
+  small              — config 1: DeterministicCluster.smallClusterModel,
+                       default goals
+  jbod               — config 4: capacityJBOD layout, intra-broker disk
+                       goals at 2.6K brokers x 4 disks / 200K replicas
+  selfheal           — config 3: add_broker + remove_broker proposals on a
+                       RandomCluster (the self-healing path)
 Timed region = threshold precompute + optimization + exact rescore + proposal
 decode (model generation excluded, matching the reference timer's scope).
 """
@@ -50,6 +58,12 @@ def main():
     from cruise_control_tpu.analyzer import optimizer as OPT
     from cruise_control_tpu.models import fixtures
 
+    if size == "jbod":
+        return _bench_jbod(seed)
+    if size == "selfheal":
+        return _bench_selfheal(seed)
+
+    goal_names = G.DEFAULT_GOALS
     if size == "linkedin":
         topo, assign = fixtures.synthetic_cluster(
             num_brokers=2_600, num_replicas=500_000, num_racks=40,
@@ -64,18 +78,22 @@ def main():
                               tries_move=384, tries_lead=64, tries_swap=192)
         engine = "anneal"
     elif size == "medium":
-        topo, assign = fixtures.synthetic_cluster(
-            num_brokers=300, num_replicas=10_000, num_racks=10,
-            num_topics=3_000, seed=seed)
+        # BASELINE config 2: RandomCluster 300 brokers / 10K replicas,
+        # HARD goals only (RandomCluster.java:48 + ClusterProperty.java:7)
+        topo, assign = fixtures.random_cluster(
+            fixtures.ClusterProperties(num_racks=10, num_brokers=300,
+                                       num_replicas=10_000, num_topics=500),
+            seed=3140 + seed)
+        goal_names = tuple(g for g in G.DEFAULT_GOALS if G.is_hard(g))
         cfg = AN.AnnealConfig(num_chains=32, steps=2048, swap_interval=128,
                               tries_move=48, tries_lead=8, tries_swap=24)
         engine = "anneal"
     else:
-        topo, assign = fixtures.synthetic_cluster(
-            num_brokers=40, num_replicas=1_000, num_racks=10,
-            num_topics=100, seed=seed)
+        # BASELINE config 1: DeterministicCluster.smallClusterModel +
+        # default goals (DeterministicCluster.java:300)
+        topo, assign = fixtures.small_cluster_model()
         cfg = AN.AnnealConfig(num_chains=16, steps=1024, swap_interval=64)
-        engine = "anneal"
+        engine = "auto"
 
     # Warm the backend (client creation / first tiny compile) outside the
     # timed region; the proposal-computation graph itself compiles once and
@@ -83,10 +101,12 @@ def main():
     # once to compile, then time the second run.
     jax.jit(lambda x: x + 1)(jnp_ones := np.ones(8, np.float32))
     t_warm = time.time()
-    r = OPT.optimize(topo, assign, engine=engine, anneal_config=cfg, seed=seed)
+    r = OPT.optimize(topo, assign, goal_names=goal_names, engine=engine,
+                     anneal_config=cfg, seed=seed)
     warm_s = time.time() - t_warm
     t0 = time.time()
-    r = OPT.optimize(topo, assign, engine=engine, anneal_config=cfg, seed=seed + 1)
+    r = OPT.optimize(topo, assign, goal_names=goal_names, engine=engine,
+                     anneal_config=cfg, seed=seed + 1)
     elapsed = time.time() - t0
 
     # ---- cluster-model-creation at bench scale (LoadMonitor.java:178
@@ -130,6 +150,144 @@ def main():
     if model_build_s is not None:
         out["model_build_s"] = model_build_s
     print(json.dumps(out))
+
+
+def _bench_jbod(seed: int):
+    """BASELINE config 4: the capacityJBOD.json layout — per-broker logdirs
+    with skewed disk usage — rebalanced by the intra-broker disk goals
+    (IntraBrokerDiskCapacityGoal + IntraBrokerDiskUsageDistributionGoal)
+    at 2.6K brokers x 4 disks / 200K replicas."""
+    import dataclasses
+
+    import jax
+
+    from cruise_control_tpu.analyzer import intra_broker as IB
+    from cruise_control_tpu.models import fixtures
+
+    rng = np.random.default_rng(5 + seed)
+    B, D_PER = 2_600, 4
+    topo, assign = fixtures.synthetic_cluster(
+        num_brokers=B, num_replicas=200_000, num_racks=20,
+        num_topics=2_000, seed=5 + seed)
+    R = topo.num_replicas
+    D = B * D_PER
+    bo = np.asarray(assign.broker_of)
+    first = rng.random(R) < 0.7        # ~70% of replicas on disk 0: skew
+    dof = np.where(first, bo * D_PER,
+                   bo * D_PER + rng.integers(1, D_PER, size=R)).astype(np.int32)
+    topo = dataclasses.replace(
+        topo,
+        disk_of_replica=dof,
+        broker_of_disk=np.repeat(np.arange(B, dtype=np.int32), D_PER),
+        disk_capacity=np.full(D, 4_000.0, np.float32),
+        disk_alive=np.ones(D, bool),
+        disk_names=tuple(f"/d{i % D_PER}" for i in range(D)))
+    # steady state: first call compiles, second measures
+    IB.rebalance_disks(topo, assign, capacity_threshold=0.8)
+    t0 = time.time()
+    moves, new_dof = IB.rebalance_disks(topo, assign, capacity_threshold=0.8)
+    elapsed = time.time() - t0
+    before = IB.disk_penalties(topo, assign, capacity_threshold=0.8)
+    after = IB.disk_penalties(topo, assign, disk_of_replica=new_dof,
+                              capacity_threshold=0.8)
+    target = 30.0
+    print(json.dumps({
+        "metric": "jbod_intra_broker_rebalance_wall_clock",
+        "value": round(elapsed, 3), "unit": "s",
+        "vs_baseline": round(target / elapsed, 3),
+        "brokers": B, "disks": D, "replicas": R,
+        "logdir_moves": int(len(moves)),
+        "capacity_violations_before": float(
+            before["IntraBrokerDiskCapacityGoal"][0]),
+        "capacity_violations_after": float(
+            after["IntraBrokerDiskCapacityGoal"][0]),
+        "usage_cost_before": float(
+            before["IntraBrokerDiskUsageDistributionGoal"][1]),
+        "usage_cost_after": float(
+            after["IntraBrokerDiskUsageDistributionGoal"][1]),
+        "device": str(jax.devices()[0].platform),
+    }))
+
+
+def _bench_selfheal(seed: int):
+    """BASELINE config 3 (RandomSelfHealingTest): add_broker and
+    remove_broker proposal computation on a RandomCluster, using the same
+    topology mutations the app's runnables apply (broker_new mask for ADD;
+    dead broker + offline replicas for REMOVE)."""
+    import dataclasses
+
+    import jax
+
+    from cruise_control_tpu.analyzer import goals as G
+    from cruise_control_tpu.analyzer import optimizer as OPT
+    from cruise_control_tpu.models import fixtures
+    from cruise_control_tpu.models.cluster import Assignment
+
+    topo, assign = fixtures.random_cluster(
+        fixtures.ClusterProperties(num_racks=10, num_brokers=302,
+                                   num_replicas=10_000, num_topics=500),
+        seed=3140 + seed)
+    B = topo.num_brokers
+    rng = np.random.default_rng(seed)
+    new_ids = (B - 2, B - 1)
+    # empty the two "new" brokers (they just joined; nothing lives there
+    # yet), collision-aware so no partition doubles up on a broker
+    bo = np.asarray(jax.device_get(assign.broker_of)).copy()
+    pid = np.asarray(topo.partition_of_replica)
+    for r_i in np.flatnonzero(np.isin(bo, new_ids)):
+        siblings = {int(bo[s]) for s in topo.replicas_of_partition[pid[r_i]]
+                    if s >= 0}
+        choices = [b for b in range(B - 2) if b not in siblings]
+        bo[r_i] = int(rng.choice(choices))
+    assign = Assignment(broker_of=bo, leader_of=assign.leader_of)
+
+    # ADD (AddBrokersRunnable): mark them new, request them as destinations
+    topo_add = dataclasses.replace(
+        topo, broker_new=np.isin(np.arange(B), new_ids))
+    opts_add = G.build_options(
+        topo_add, requested_destination_broker_ids=new_ids)
+    # REMOVE (RemoveBrokersRunnable): broker 0 dead, its replicas offline
+    alive = np.asarray(topo.broker_alive).copy()
+    alive[0] = False
+    topo_rm = dataclasses.replace(
+        topo, broker_alive=alive,
+        replica_offline=np.asarray(topo.replica_offline) | (bo == 0))
+    opts_rm = G.build_options(topo_rm,
+                              excluded_brokers_for_replica_move=(0,),
+                              excluded_brokers_for_leadership=(0,))
+    from cruise_control_tpu.analyzer import annealer as AN
+    cfg = AN.AnnealConfig(num_chains=32, steps=2048, swap_interval=128,
+                          tries_move=48, tries_lead=8, tries_swap=24)
+    results = {}
+    for name, tp, opts in (("add_broker", topo_add, opts_add),
+                           ("remove_broker", topo_rm, opts_rm)):
+        OPT.optimize(tp, assign, options=opts, engine="anneal",
+                     anneal_config=cfg, seed=seed)               # compile
+        t0 = time.time()
+        r = OPT.optimize(tp, assign, options=opts, engine="anneal",
+                         anneal_config=cfg, seed=seed + 1)
+        results[name] = (time.time() - t0, r)
+    (t_add, r_add) = results["add_broker"]
+    (t_rm, r_rm) = results["remove_broker"]
+    bo_rm = np.asarray(jax.device_get(r_rm.final_assignment.broker_of))
+    bo_add = np.asarray(jax.device_get(r_add.final_assignment.broker_of))
+    target = 30.0
+    total = t_add + t_rm
+    print(json.dumps({
+        "metric": "self_healing_add_remove_broker_wall_clock",
+        "value": round(total, 3), "unit": "s",
+        "vs_baseline": round(2 * target / total, 3),
+        "brokers": B, "replicas": topo.num_replicas,
+        "add_broker_s": round(t_add, 3),
+        "remove_broker_s": round(t_rm, 3),
+        "add_moves": r_add.num_replica_movements,
+        "remove_moves": r_rm.num_replica_movements,
+        "new_brokers_populated": int(np.isin(bo_add, new_ids).sum()),
+        "broker0_evacuated": bool((bo_rm != 0).all()),
+        "violated_goals_after_add": len(r_add.violated_goals_after),
+        "violated_goals_after_remove": len(r_rm.violated_goals_after),
+        "device": str(jax.devices()[0].platform),
+    }))
 
 
 def _measure_model_build(topo, assign):

@@ -83,7 +83,8 @@ def combine(two: jax.Array) -> jax.Array:
 def build_weights(goal_names: Sequence[str],
                   hard_weight: float = 2.0 ** 13,
                   soft_base: float = 2.0,
-                  active_prefix: Optional[int] = None) -> ObjectiveWeights:
+                  active_prefix: Optional[int] = None,
+                  hard_only: bool = False) -> ObjectiveWeights:
     """Map a priority-ordered goal list to decomposed two-channel weights.
 
     ``hard_weight`` (cost channel) stays well below ``VIOL_SCALE``: the
@@ -95,12 +96,22 @@ def build_weights(goal_names: Sequence[str],
     reuses one compiled loop across stages because only weight *values*
     change, never shapes. Internal hard terms and self-healing stay active
     in every stage.
+
+    ``hard_only``: zero both channels for every SOFT goal, by value — the
+    hard-violation backstop descends on hard goals alone while keeping the
+    full goal list's array SHAPES, so the jitted repair kernels it re-
+    engages are the already-compiled ones.
     """
     w = G.goal_weights(goal_names, hard_weight, soft_base)       # [G+1]
     wv = G.goal_viol_weights(goal_names)                         # [G+1]
     if active_prefix is not None:
         mask = np.arange(len(w), dtype=np.float32) < active_prefix
         mask[-1] = True                       # appended self-healing term
+        w = w * mask
+        wv = wv * mask
+    if hard_only:
+        mask = np.array([G.is_hard(g) for g in goal_names] + [True],
+                        np.float32)
         w = w * mask
         wv = wv * mask
     by_goal = {g: float(w[i]) for i, g in enumerate(goal_names)}

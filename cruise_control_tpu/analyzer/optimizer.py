@@ -38,8 +38,15 @@ from cruise_control_tpu.models.cluster import Assignment, ClusterTopology
 from cruise_control_tpu.ops.aggregates import compute_aggregates, device_topology
 from cruise_control_tpu.ops.stats import compute_cluster_stats
 
-#: R·B above which greedy's move matrix is considered too large
-GREEDY_LIMIT = 40_000_000
+#: R·B above which the greedy engine stops being the default. The bound is
+#: about ROUNDS, not memory: greedy re-evaluates the full [R, B] move
+#: matrix per accepted action, and a 300-broker / 10K-replica model takes
+#: tens of thousands of actions to converge — tens of minutes on a TPU
+#: (measured round 4), where anneal+repair reaches violations 0 /
+#: balancedness 100 in ~7 s. Greedy remains the explicit-choice engine
+#: (engine="greedy") and the small-model hard-goal polish at any size
+#: under this bound.
+GREEDY_LIMIT = 2_000_000
 
 #: B·T above which the dense [B, T] topic histogram is replaced by the
 #: sort-based sparse topic penalty (matches AnnealConfig.topic_term_limit)
@@ -238,8 +245,11 @@ def optimize(topo: ClusterTopology, assign: Assignment,
              engine: str = "auto",
              anneal_config: Optional["AnnealConfig"] = None,
              seed: int = 0,
-             mesh: Optional["jax.sharding.Mesh"] = None) -> OptimizerResult:
-    """Full optimization pass. ``engine``: auto | greedy | anneal."""
+             mesh: Optional["jax.sharding.Mesh"] = None,
+             repair_config=None) -> OptimizerResult:
+    """Full optimization pass. ``engine``: auto | greedy | anneal.
+    ``repair_config``: RepairConfig override for the MAIN repair pass (the
+    hard-violation backstop always runs with its own defaults)."""
     from cruise_control_tpu.analyzer import annealer as AN  # cycle-free import
 
     from cruise_control_tpu.common.metrics import REGISTRY
@@ -311,7 +321,7 @@ def optimize(topo: ClusterTopology, assign: Assignment,
         from cruise_control_tpu.analyzer import repair as REP
         final, _, _ = REP.repair(dt, final, th, weights, opts, num_topics,
                                  initial_broker_of=init_broker, seed=seed,
-                                 mesh=mesh)
+                                 mesh=mesh, config=repair_config)
         _mark("repair")
     else:
         raise ValueError(f"unknown engine {engine!r}")
@@ -329,20 +339,57 @@ def optimize(topo: ClusterTopology, assign: Assignment,
                                    num_topics, init_broker, agg_after,
                                    sparse_topic=sparse_topic)
     if engine == "anneal":
-        # hard-goal polish: if violations remain after repair and the model
-        # fits the greedy engine, finish with deterministic descent. The
-        # check reuses the post-optimization evaluation (one full eval, not
-        # two) and re-evaluates only when a polish actually ran.
+        # hard-goal backstop: if violations remain after repair, finish
+        # deterministically. Small models get the greedy polish; at scale
+        # (beyond GREEDY_LIMIT) a bad seed must STILL not ship hard
+        # violations, so the repair machinery re-engages in hard-only mode:
+        # soft weights zeroed (hard-neutral soft moves no longer compete
+        # for claims) and a fresh seed per attempt (new scan origins and
+        # swap partners escape the exact local minimum the first pass
+        # converged into). The check reuses the post-optimization
+        # evaluation and re-evaluates only when a backstop actually ran.
         hard_mask = np.array([G.is_hard(g) for g in goal_names] + [True])
-        if (np.asarray(after.penalties.violations)[hard_mask].sum() > 0
-                and topo.num_replicas * topo.num_brokers <= GREEDY_LIMIT):
-            # pass the TRUE original placement: healing accounting must not
-            # re-penalize offline replicas the annealer already relocated
-            gres = GR.optimize_greedy(dt, final, th, weights, opts, num_topics,
-                                      initial_broker_of=init_broker)
-            final = gres.assignment
-            agg_after = compute_aggregates(dt, final,
-                                           1 if sparse_topic else num_topics)
+
+        def _hard_viols(ev) -> float:
+            return float(np.asarray(ev.penalties.violations)[hard_mask].sum())
+
+        if _hard_viols(after) > 0:
+            if topo.num_replicas * topo.num_brokers <= GREEDY_LIMIT:
+                # pass the TRUE original placement: healing accounting must
+                # not re-penalize offline replicas the annealer relocated
+                gres = GR.optimize_greedy(dt, final, th, weights, opts,
+                                          num_topics,
+                                          initial_broker_of=init_broker)
+                final = gres.assignment
+            else:
+                from cruise_control_tpu.analyzer import repair as REP
+                # hard_only zeroes soft weights BY VALUE: array shapes match
+                # the main pass, so the backstop reuses its compiled kernels
+                w_hard = OBJ.build_weights(goal_names, hard_only=True)
+                cur = final
+                for attempt in range(1, 4):
+                    report_progress(
+                        f"Hard-violation backstop attempt {attempt}")
+                    cur, n_acc, _ = REP.repair(
+                        dt, cur, th, w_hard, opts, num_topics,
+                        initial_broker_of=init_broker,
+                        seed=seed + 7919 * attempt, mesh=mesh)
+                    ev = OBJ.evaluate_objective(
+                        dt, cur, th, weights, goal_names, num_topics,
+                        init_broker,
+                        compute_aggregates(dt, cur,
+                                           1 if sparse_topic else num_topics),
+                        sparse_topic=sparse_topic)
+                    if _hard_viols(ev) == 0 or n_acc == 0:
+                        break
+                final = cur
+                _mark("hard backstop")
+            agg_after = (_sharded_broker_aggregates(mesh, dt, final,
+                                                    init_broker, num_topics,
+                                                    sparse_topic)
+                         if mesh is not None else
+                         compute_aggregates(dt, final,
+                                            1 if sparse_topic else num_topics))
             after = OBJ.evaluate_objective(dt, final, th, weights, goal_names,
                                            num_topics, init_broker, agg_after,
                                            sparse_topic=sparse_topic)

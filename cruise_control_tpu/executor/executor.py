@@ -493,7 +493,10 @@ class Executor:
             # timed-out runs); below it, flag the execution so the
             # notifier/operator can investigate throttles or slow disks
             data_mb = self.tracker.finished_data_movement_mb
-            if (not crashed and data_mb > 0 and duration_s > 0
+            # gate on PLANNED movement: a fully-stalled run (0 MB finished)
+            # is the slowest possible and must alert; leadership-only runs
+            # stay exempt
+            if (not crashed and planner.replica_tasks and duration_s > 0
                     and (data_mb / duration_s)
                     < self.config.inter_broker_movement_rate_alerting_threshold):
                 summary["slowInterBrokerMovementRateMBps"] = round(
@@ -531,7 +534,7 @@ class Executor:
                 # stopped run must not have its rate inflated by the
                 # unexecuted tail; batches are round-robin, not a prefix
                 # of `moves`)
-                data_mb += sum(float(getattr(m, "size_mb", 0.0))
+                data_mb += sum(float(getattr(m, "data_size", 0.0))
                                for m in batch)
                 if self._stop_requested.is_set():
                     break

@@ -11,10 +11,15 @@ verification through the Purgatory.
 
 from __future__ import annotations
 
+import contextvars
 import json
 import os
 import threading
 import urllib.parse
+
+#: cookie session identity of the in-flight request (see RestApi.dispatch)
+_SESSION_ID: "contextvars.ContextVar" = contextvars.ContextVar(
+    "cc_session_id", default=None)
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -191,8 +196,24 @@ class RestApi:
     # ------------------------------------------------------------- dispatch
 
     def dispatch(self, method: str, endpoint: str, params: Dict[str, str],
-                 client_id: str = "local", request_url: str = ""
-                 ) -> Tuple[int, dict]:
+                 client_id: str = "local", request_url: str = "",
+                 session_id: Optional[str] = None) -> Tuple[int, dict]:
+        """``client_id`` stays the request origin (peer address — the
+        identity USER_TASKS client_ids filtering and review submitters
+        record); ``session_id`` is the cookie identity the session→task
+        binding keys on (defaults to client_id for cookie-less callers).
+        It rides a contextvar so the ~20 per-endpoint handlers keep their
+        (params, client_id, request_url) signature."""
+        token = _SESSION_ID.set(session_id or client_id)
+        try:
+            return self._dispatch(method, endpoint, params, client_id,
+                                  request_url)
+        finally:
+            _SESSION_ID.reset(token)
+
+    def _dispatch(self, method: str, endpoint: str, params: Dict[str, str],
+                  client_id: str = "local", request_url: str = ""
+                  ) -> Tuple[int, dict]:
         endpoint = endpoint.upper()
         if endpoint not in ALL_ENDPOINTS:
             return 404, {"errorMessage": f"Unknown endpoint {endpoint}",
@@ -267,16 +288,20 @@ class RestApi:
                 return 404, {"errorMessage": f"unknown user task {existing}"}
         else:
             # session → task binding (UserTaskManager.getOrCreateUserTask):
-            # the SAME client repeating the SAME request (endpoint + its
+            # the SAME session repeating the SAME request (endpoint + its
             # parameters, minus the volatile polling ones) polls its
-            # original task instead of spawning a duplicate operation
+            # original IN-FLIGHT task instead of spawning a duplicate
+            # operation. A COMPLETED task unbinds — repeating a finished
+            # non-idempotent request (say a second rebalance) must execute
+            # again, not replay the stale result.
             essence = sorted((k, v) for k, v in params.items()
                              if k not in ("user_task_id", "json",
                                           "get_response_timeout_ms"))
-            session_key = f"{client_id} {endpoint} {essence}"
+            sid = _SESSION_ID.get() or client_id
+            session_key = f"{sid} {endpoint} {essence}"
             bound = self.sessions.task_for(session_key)
             info = self.user_tasks.get(bound) if bound else None
-            if info is None:
+            if info is None or info.future.done():
                 info = self.user_tasks.create_task(
                     endpoint, request_url, client_id, lambda fut: fn())
                 self.sessions.bind(session_key, info.task_id)
@@ -815,14 +840,16 @@ class _Handler(BaseHTTPRequestHandler):
         endpoint = path[len(prefix):].strip("/") if path.startswith(prefix) \
             else path.strip("/")
         sid, new_sid = self._session_id()
-        # a session's FIRST request binds to the id the Set-Cookie below
-        # establishes, so follow-ups under the cookie see it; clients that
-        # never echo cookies (curl, cccli) re-enter here with no cookie
-        # each time and still find their tasks via User-Task-ID
+        # client_id: always the peer address (USER_TASKS client_ids filters
+        # and review submitters are request origins). The cookie identity
+        # only keys the session→task binding; requests without a cookie —
+        # including a cookie-capable client's first — use per-address
+        # binding (cookie-less clients like curl/cccli stay groupable).
         code, payload = self.api.dispatch(
             method, endpoint or "STATE", params,
-            client_id=sid or new_sid,
-            request_url=self.path)
+            client_id=self.client_address[0],
+            request_url=self.path,
+            session_id=sid)
         # json=false → text/plain rendering (the reference's default wire
         # format; ParameterUtils JSON_PARAM)
         as_json = str(params.get("json", "true")).strip().lower() != "false"

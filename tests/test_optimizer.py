@@ -395,3 +395,36 @@ def test_diff_with_stats_matches_per_proposal_properties():
                                      for p in props), rel=1e-6)
     assert r.num_replica_movements == n_moves
     assert r.num_leadership_movements == n_lead
+
+
+def test_hard_violation_backstop_engages_beyond_greedy_limit(monkeypatch):
+    """A bad seed must not ship hard violations at scale: with the greedy
+    polish unavailable (GREEDY_LIMIT forced to 0) and the MAIN repair pass
+    crippled (max_rounds=0, i.e. a repair that converged short), the
+    hard-only repair backstop must engage and clear the remaining hard
+    violations (VERDICT r3 #10)."""
+    from cruise_control_tpu.analyzer import repair as REP
+    topo, assign = fixtures.random_cluster(fixtures.ClusterProperties(
+        num_racks=3, num_brokers=9, num_replicas=200, num_topics=8,
+        num_dead_brokers=1), seed=11)
+    monkeypatch.setattr(OPT, "GREEDY_LIMIT", 0)
+    calls = []
+    real_repair = REP.repair
+
+    def counting_repair(*a, **kw):
+        calls.append(kw.get("config"))
+        return real_repair(*a, **kw)
+
+    monkeypatch.setattr(REP, "repair", counting_repair)
+    crippled = REP.RepairConfig(max_rounds=0)
+    r = OPT.optimize(topo, assign, engine="anneal",
+                     anneal_config=AN.AnnealConfig(num_chains=2, steps=8,
+                                                   swap_interval=8),
+                     seed=0, repair_config=crippled)
+    # the main pass ran crippled, then the backstop engaged with its own
+    # (full) defaults at least once
+    assert calls[0] is crippled
+    assert len(calls) >= 2
+    assert all(c is not crippled for c in calls[1:])
+    hv = _hard_violations_after(r)
+    assert all(v == 0 for v in hv.values()), hv

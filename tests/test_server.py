@@ -542,28 +542,39 @@ def test_kafka_assigner_mode_on_proposals_and_remove():
 
 
 def test_session_binds_repeated_request_to_same_task():
-    """UserTaskManager.getOrCreateUserTask semantics: the same client
-    repeating the same async request (same endpoint + parameters) polls its
-    ORIGINAL task; different parameters or a different client create a new
-    one."""
+    """UserTaskManager.getOrCreateUserTask semantics: the same session
+    repeating the same async request (same endpoint + parameters) while it
+    is IN FLIGHT polls its original task; different parameters, a different
+    session, or a COMPLETED task create a new one (a finished rebalance
+    must not be silently replayed)."""
     from cruise_control_tpu.server import rest
     app = _app()
     api = rest.RestApi(app)
     try:
-        p = {"get_response_timeout_ms": "60000"}
+        # 1ms timeout: the first dispatch returns 202 with the op in flight
+        p = {"get_response_timeout_ms": "1"}
         code1, body1 = api.dispatch("GET", "PROPOSALS", dict(p),
-                                    client_id="session-a")
+                                    client_id="10.0.0.5", session_id="sess-a")
         code2, body2 = api.dispatch("GET", "PROPOSALS", dict(p),
-                                    client_id="session-a")
+                                    client_id="10.0.0.5", session_id="sess-a")
         assert body1["userTaskId"] == body2["userTaskId"]
         # different params -> a different task (polling-only params ignored)
         code3, body3 = api.dispatch(
             "GET", "PROPOSALS",
-            {**p, "ignore_proposal_cache": "true"}, client_id="session-a")
+            {**p, "ignore_proposal_cache": "true"}, client_id="10.0.0.5",
+            session_id="sess-a")
         assert body3["userTaskId"] != body1["userTaskId"]
-        # different client -> a different task
+        # different session -> a different task
         code4, body4 = api.dispatch("GET", "PROPOSALS", dict(p),
-                                    client_id="session-b")
+                                    client_id="10.0.0.5", session_id="sess-b")
         assert body4["userTaskId"] != body1["userTaskId"]
+        # tasks are attributed to the request ORIGIN, not the session
+        assert api.user_tasks.get(body1["userTaskId"]).client_id == "10.0.0.5"
+        # completion unbinds: the same request again runs a NEW task
+        info = api.user_tasks.get(body1["userTaskId"])
+        info.future.result(timeout=120)
+        code5, body5 = api.dispatch("GET", "PROPOSALS", dict(p),
+                                    client_id="10.0.0.5", session_id="sess-a")
+        assert body5["userTaskId"] != body1["userTaskId"]
     finally:
         api.close()
