@@ -370,17 +370,22 @@ def optimize(topo: ClusterTopology, assign: Assignment,
                 for attempt in range(1, 4):
                     report_progress(
                         f"Hard-violation backstop attempt {attempt}")
-                    cur, n_acc, _ = REP.repair(
+                    cur, n_acc, n_lead = REP.repair(
                         dt, cur, th, w_hard, opts, num_topics,
                         initial_broker_of=init_broker,
                         seed=seed + 7919 * attempt, mesh=mesh)
+                    agg_bs = (_sharded_broker_aggregates(
+                                  mesh, dt, cur, init_broker, num_topics,
+                                  sparse_topic)
+                              if mesh is not None else
+                              compute_aggregates(
+                                  dt, cur,
+                                  1 if sparse_topic else num_topics))
                     ev = OBJ.evaluate_objective(
                         dt, cur, th, weights, goal_names, num_topics,
-                        init_broker,
-                        compute_aggregates(dt, cur,
-                                           1 if sparse_topic else num_topics),
-                        sparse_topic=sparse_topic)
-                    if _hard_viols(ev) == 0 or n_acc == 0:
+                        init_broker, agg_bs, sparse_topic=sparse_topic)
+                    # leadership-only progress still counts as progress
+                    if _hard_viols(ev) == 0 or (n_acc + n_lead) == 0:
                         break
                 final = cur
                 _mark("hard backstop")

@@ -495,8 +495,10 @@ class Executor:
             data_mb = self.tracker.finished_data_movement_mb
             # gate on PLANNED movement: a fully-stalled run (0 MB finished)
             # is the slowest possible and must alert; leadership-only runs
-            # stay exempt
-            if (not crashed and planner.replica_tasks and duration_s > 0
+            # and deliberately stopped/timed-out runs stay exempt
+            if (not crashed and planner.replica_tasks
+                    and not self._stop_requested.is_set()
+                    and not self._timed_out and duration_s > 0
                     and (data_mb / duration_s)
                     < self.config.inter_broker_movement_rate_alerting_threshold):
                 summary["slowInterBrokerMovementRateMBps"] = round(

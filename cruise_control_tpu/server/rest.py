@@ -289,11 +289,13 @@ class RestApi:
         else:
             # session → task binding (UserTaskManager.getOrCreateUserTask):
             # the SAME session repeating the SAME request (endpoint + its
-            # parameters, minus the volatile polling ones) polls its
-            # original IN-FLIGHT task instead of spawning a duplicate
-            # operation. A COMPLETED task unbinds — repeating a finished
-            # non-idempotent request (say a second rebalance) must execute
-            # again, not replay the stale result.
+            # parameters, minus the volatile polling ones) gets its
+            # original task — in flight OR completed — instead of spawning
+            # a duplicate operation; repetition is the documented polling
+            # pattern, and a completed task's result must stay deliverable
+            # to the poller. Replay staleness is bounded by the session
+            # expiry (webserver.session.maxExpiryPeriodMs): once the
+            # binding expires, the same request executes anew.
             essence = sorted((k, v) for k, v in params.items()
                              if k not in ("user_task_id", "json",
                                           "get_response_timeout_ms"))
@@ -301,7 +303,7 @@ class RestApi:
             session_key = f"{sid} {endpoint} {essence}"
             bound = self.sessions.task_for(session_key)
             info = self.user_tasks.get(bound) if bound else None
-            if info is None or info.future.done():
+            if info is None:
                 info = self.user_tasks.create_task(
                     endpoint, request_url, client_id, lambda fut: fn())
                 self.sessions.bind(session_key, info.task_id)
@@ -842,14 +844,15 @@ class _Handler(BaseHTTPRequestHandler):
         sid, new_sid = self._session_id()
         # client_id: always the peer address (USER_TASKS client_ids filters
         # and review submitters are request origins). The cookie identity
-        # only keys the session→task binding; requests without a cookie —
-        # including a cookie-capable client's first — use per-address
-        # binding (cookie-less clients like curl/cccli stay groupable).
+        # keys the session→task binding; a session's FIRST request binds
+        # under the id the Set-Cookie below establishes, so the follow-up
+        # carrying the cookie finds it instead of spawning a duplicate.
+        # Cookie-less clients (curl, cccli) poll via User-Task-ID.
         code, payload = self.api.dispatch(
             method, endpoint or "STATE", params,
             client_id=self.client_address[0],
             request_url=self.path,
-            session_id=sid)
+            session_id=sid or new_sid)
         # json=false → text/plain rendering (the reference's default wire
         # format; ParameterUtils JSON_PARAM)
         as_json = str(params.get("json", "true")).strip().lower() != "false"

@@ -428,3 +428,19 @@ def test_hard_violation_backstop_engages_beyond_greedy_limit(monkeypatch):
     assert all(c is not crippled for c in calls[1:])
     hv = _hard_violations_after(r)
     assert all(v == 0 for v in hv.values()), hv
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_hard_zero_is_seed_property(seed):
+    """The 0-hard-violations contract must hold at EVERY seed, not a lucky
+    one (tools/seed_sweep.py pins the same property at LinkedIn scale on
+    the TPU; this is the in-suite small-scale anchor)."""
+    topo, assign = fixtures.random_cluster(fixtures.ClusterProperties(
+        num_racks=4, num_brokers=12, num_replicas=400, num_topics=10),
+        seed=100 + seed)
+    r = OPT.optimize(topo, assign, engine="anneal",
+                     anneal_config=AN.AnnealConfig(num_chains=8, steps=256,
+                                                   swap_interval=64),
+                     seed=seed)
+    hv = _hard_violations_after(r)
+    assert all(v == 0 for v in hv.values()), (seed, hv)

@@ -543,10 +543,11 @@ def test_kafka_assigner_mode_on_proposals_and_remove():
 
 def test_session_binds_repeated_request_to_same_task():
     """UserTaskManager.getOrCreateUserTask semantics: the same session
-    repeating the same async request (same endpoint + parameters) while it
-    is IN FLIGHT polls its original task; different parameters, a different
-    session, or a COMPLETED task create a new one (a finished rebalance
-    must not be silently replayed)."""
+    repeating the same async request (same endpoint + parameters) gets its
+    ORIGINAL task — in flight or completed (repetition is the polling
+    pattern, and the finished result must stay deliverable); different
+    parameters or a different session create a new one. Replay staleness
+    is bounded by the SessionManager expiry."""
     from cruise_control_tpu.server import rest
     app = _app()
     api = rest.RestApi(app)
@@ -570,11 +571,19 @@ def test_session_binds_repeated_request_to_same_task():
         assert body4["userTaskId"] != body1["userTaskId"]
         # tasks are attributed to the request ORIGIN, not the session
         assert api.user_tasks.get(body1["userTaskId"]).client_id == "10.0.0.5"
-        # completion unbinds: the same request again runs a NEW task
+        # after completion, the repeat still delivers the ORIGINAL task's
+        # result (bounded by session expiry) — the poller must not trigger
+        # a silent re-execution between its polls
         info = api.user_tasks.get(body1["userTaskId"])
         info.future.result(timeout=120)
         code5, body5 = api.dispatch("GET", "PROPOSALS", dict(p),
                                     client_id="10.0.0.5", session_id="sess-a")
-        assert body5["userTaskId"] != body1["userTaskId"]
+        assert code5 == 200
+        assert body5["userTaskId"] == body1["userTaskId"]
+        # once the session binding expires, the same request runs anew
+        api.sessions._expiry = 0
+        code6, body6 = api.dispatch("GET", "PROPOSALS", dict(p),
+                                    client_id="10.0.0.5", session_id="sess-a")
+        assert body6["userTaskId"] != body1["userTaskId"]
     finally:
         api.close()

@@ -50,11 +50,15 @@ def main():
         t0 = time.time()
         r = OPT.optimize(topo, assign, engine="anneal", anneal_config=cfg,
                          seed=seed)
+        hard_after = [s.name for s in r.goal_summaries
+                      if s.hard and s.violated_after]
         row = {
             "seed": seed,
             "wall_s": round(time.time() - t0, 3),
             "violations_before": len(r.violated_goals_before),
             "violations_after": len(r.violated_goals_after),
+            "hard_violations_after": len(hard_after),
+            "violated_after": r.violated_goals_after,
             "balancedness_after": round(r.balancedness_after, 2),
             "soft_cost_after": round(sum(s.cost_after
                                          for s in r.goal_summaries
@@ -76,6 +80,8 @@ def main():
         "wall_s_mean": round(sum(steady) / len(steady), 3),
         "first_seed_wall_s": walls[0],
         "all_violations_zero": all(r["violations_after"] == 0 for r in rows),
+        "all_hard_violations_zero": all(r["hard_violations_after"] == 0
+                                        for r in rows),
         "all_balancedness_100": all(r["balancedness_after"] == 100.0
                                     for r in rows),
         "max_soft_cost_after": max(r["soft_cost_after"] for r in rows),
