@@ -51,7 +51,7 @@ def main():
     # across processes on this backend (verified: a second process reloads
     # a TPU executable in <1 s instead of recompiling).
     jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
     from cruise_control_tpu.analyzer import annealer as AN
     from cruise_control_tpu.analyzer import goals as G

@@ -271,15 +271,18 @@ def optimize(topo: ClusterTopology, assign: Assignment,
     dt = device_topology(topo)
     num_topics = topo.num_topics
     sparse_topic = topo.num_brokers * num_topics > TOPIC_DENSE_LIMIT
-    if mesh is not None:
-        # replica-axis sharded production path (SURVEY §7 step 3): the O(R)
-        # exact aggregation runs on replica shards across the mesh
-        agg0 = _sharded_broker_aggregates(
-            mesh, dt, assign, jnp.asarray(assign.broker_of, jnp.int32),
-            num_topics, sparse_topic)
-    else:
-        agg0 = compute_aggregates(dt, assign,
-                                  1 if sparse_topic else num_topics)
+    init_for_agg = jnp.asarray(assign.broker_of, jnp.int32)
+
+    def _agg(a):
+        """Broker aggregates for assignment ``a`` — replica-axis sharded
+        over the mesh when one is given (SURVEY §7 step 3), single-device
+        otherwise. Every aggregation site in optimize() goes through here."""
+        if mesh is not None:
+            return _sharded_broker_aggregates(mesh, dt, a, init_for_agg,
+                                              num_topics, sparse_topic)
+        return compute_aggregates(dt, a, 1 if sparse_topic else num_topics)
+
+    agg0 = _agg(assign)
     from cruise_control_tpu.ops.aggregates import topic_totals
     th = G.compute_thresholds(
         dt, constraint, agg0,
@@ -330,11 +333,7 @@ def optimize(topo: ClusterTopology, assign: Assignment,
     # with both call sites shaped identically they share one compiled
     # program — an eval that computes aggregates internally is a second
     # full trace+compile (~55 s of the cold start for nothing)
-    agg_after = (_sharded_broker_aggregates(mesh, dt, final, init_broker,
-                                            num_topics, sparse_topic)
-                 if mesh is not None else
-                 compute_aggregates(dt, final,
-                                    1 if sparse_topic else num_topics))
+    agg_after = _agg(final)
     after = OBJ.evaluate_objective(dt, final, th, weights, goal_names,
                                    num_topics, init_broker, agg_after,
                                    sparse_topic=sparse_topic)
@@ -374,27 +373,15 @@ def optimize(topo: ClusterTopology, assign: Assignment,
                         dt, cur, th, w_hard, opts, num_topics,
                         initial_broker_of=init_broker,
                         seed=seed + 7919 * attempt, mesh=mesh)
-                    agg_bs = (_sharded_broker_aggregates(
-                                  mesh, dt, cur, init_broker, num_topics,
-                                  sparse_topic)
-                              if mesh is not None else
-                              compute_aggregates(
-                                  dt, cur,
-                                  1 if sparse_topic else num_topics))
                     ev = OBJ.evaluate_objective(
                         dt, cur, th, weights, goal_names, num_topics,
-                        init_broker, agg_bs, sparse_topic=sparse_topic)
+                        init_broker, _agg(cur), sparse_topic=sparse_topic)
                     # leadership-only progress still counts as progress
                     if _hard_viols(ev) == 0 or (n_acc + n_lead) == 0:
                         break
                 final = cur
                 _mark("hard backstop")
-            agg_after = (_sharded_broker_aggregates(mesh, dt, final,
-                                                    init_broker, num_topics,
-                                                    sparse_topic)
-                         if mesh is not None else
-                         compute_aggregates(dt, final,
-                                            1 if sparse_topic else num_topics))
+            agg_after = _agg(final)
             after = OBJ.evaluate_objective(dt, final, th, weights, goal_names,
                                            num_topics, init_broker, agg_after,
                                            sparse_topic=sparse_topic)

@@ -102,7 +102,7 @@ def main(argv=None) -> int:
         "JAX_COMPILATION_CACHE_DIR",
         os.path.join(os.getcwd(), ".jax_cache"))
     jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
     from cruise_control_tpu.common.config import CruiseControlConfig
     from cruise_control_tpu.server import rest

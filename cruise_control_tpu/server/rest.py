@@ -290,12 +290,14 @@ class RestApi:
             # session → task binding (UserTaskManager.getOrCreateUserTask):
             # the SAME session repeating the SAME request (endpoint + its
             # parameters, minus the volatile polling ones) gets its
-            # original task — in flight OR completed — instead of spawning
-            # a duplicate operation; repetition is the documented polling
-            # pattern, and a completed task's result must stay deliverable
-            # to the poller. Replay staleness is bounded by the session
-            # expiry (webserver.session.maxExpiryPeriodMs): once the
-            # binding expires, the same request executes anew.
+            # original task — in flight or successfully completed — instead
+            # of spawning a duplicate operation; repetition is the
+            # documented polling pattern, and a completed task's result
+            # must stay deliverable to the poller. Replay staleness is
+            # bounded by the session expiry
+            # (webserver.session.maxExpiryPeriodMs). A task that FAILED
+            # unbinds: a retry after a transient error must re-execute,
+            # not replay the cached exception for the rest of the session.
             essence = sorted((k, v) for k, v in params.items()
                              if k not in ("user_task_id", "json",
                                           "get_response_timeout_ms"))
@@ -303,6 +305,8 @@ class RestApi:
             session_key = f"{sid} {endpoint} {essence}"
             bound = self.sessions.task_for(session_key)
             info = self.user_tasks.get(bound) if bound else None
+            if info is not None and info.future.exception() is not None:
+                info = None
             if info is None:
                 info = self.user_tasks.create_task(
                     endpoint, request_url, client_id, lambda fut: fn())
@@ -847,7 +851,9 @@ class _Handler(BaseHTTPRequestHandler):
         # keys the session→task binding; a session's FIRST request binds
         # under the id the Set-Cookie below establishes, so the follow-up
         # carrying the cookie finds it instead of spawning a duplicate.
-        # Cookie-less clients (curl, cccli) poll via User-Task-ID.
+        # Cookie-less clients get a fresh session per request — exactly the
+        # reference's Jetty behavior — and poll via User-Task-ID (cccli
+        # does; the response carries the id on 200 AND 202).
         code, payload = self.api.dispatch(
             method, endpoint or "STATE", params,
             client_id=self.client_address[0],

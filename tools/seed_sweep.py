@@ -34,7 +34,7 @@ def main():
 
     import jax
     jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
     from cruise_control_tpu.analyzer import annealer as AN
     from cruise_control_tpu.analyzer import optimizer as OPT
