@@ -130,6 +130,11 @@ def main():
         "engine": r.engine,
         "violated_goals_before": len(r.violated_goals_before),
         "violated_goals_after": len(r.violated_goals_after),
+        # the reference's gate for acting on a proposal: hard goals must
+        # hold; soft goals are best-effort (seed sweep: hard zero at every
+        # seed, docs/PERF.md)
+        "hard_violations_after": sum(1 for s in r.goal_summaries
+                                     if s.hard and s.violated_after),
         "balancedness_before": round(r.balancedness_before, 2),
         "balancedness_after": round(r.balancedness_after, 2),
         "num_replica_movements": r.num_replica_movements,

@@ -271,14 +271,14 @@ def optimize(topo: ClusterTopology, assign: Assignment,
     dt = device_topology(topo)
     num_topics = topo.num_topics
     sparse_topic = topo.num_brokers * num_topics > TOPIC_DENSE_LIMIT
-    init_for_agg = jnp.asarray(assign.broker_of, jnp.int32)
+    init_broker = jnp.asarray(assign.broker_of, jnp.int32)
 
     def _agg(a):
         """Broker aggregates for assignment ``a`` — replica-axis sharded
         over the mesh when one is given (SURVEY §7 step 3), single-device
         otherwise. Every aggregation site in optimize() goes through here."""
         if mesh is not None:
-            return _sharded_broker_aggregates(mesh, dt, a, init_for_agg,
+            return _sharded_broker_aggregates(mesh, dt, a, init_broker,
                                               num_topics, sparse_topic)
         return compute_aggregates(dt, a, 1 if sparse_topic else num_topics)
 
@@ -288,7 +288,6 @@ def optimize(topo: ClusterTopology, assign: Assignment,
         dt, constraint, agg0,
         topic_total=topic_totals(dt, num_topics) if sparse_topic else None)
     weights = OBJ.build_weights(goal_names)
-    init_broker = jnp.asarray(assign.broker_of, jnp.int32)
 
     _mark("setup")
     before = OBJ.evaluate_objective(dt, assign, th, weights, goal_names,

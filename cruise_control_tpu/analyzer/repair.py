@@ -471,10 +471,16 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
         flag_sharding = NamedSharding(mesh, PartitionSpec(ax))
         # replicate the single chain state over the mesh (it is small next
         # to the [n_src, B] matrices); GSPMD keeps it replicated through
-        # the fused loop while the source/flag axes partition
+        # the fused loop while the source/flag axes partition. movable/
+        # offline enter replicated and take the flag sharding INSIDE the
+        # jit: eager device_put demands the axis divide the mesh evenly,
+        # which an arbitrary R (e.g. 49,998 on 8 devices) does not, while
+        # with_sharding_constraint pads under GSPMD.
         st = replicate(st, mesh)
-        movable_dev = jax.device_put(movable_dev, flag_sharding)
-        offline_dev = jax.device_put(offline_dev, flag_sharding)
+        movable_dev = jax.device_put(
+            movable_dev, NamedSharding(mesh, PartitionSpec()))
+        offline_dev = jax.device_put(
+            offline_dev, NamedSharding(mesh, PartitionSpec()))
     if _DEBUG:
         jax.block_until_ready(st.broker_load)
         print(f"[repair setup] t={time.time()-_t0:.2f}s", flush=True)

@@ -223,6 +223,10 @@ class SessionManager:
         with self._lock:
             self._by_session[session_key] = (task_id, self._now())
 
+    def unbind(self, session_key: str):
+        with self._lock:
+            self._by_session.pop(session_key, None)
+
     def task_for(self, session_key: str) -> Optional[str]:
         with self._lock:
             self._sweep()

@@ -306,8 +306,13 @@ class RestApi:
             bound = self.sessions.task_for(session_key)
             info = self.user_tasks.get(bound) if bound else None
             if info is not None and info.future.exception() is not None:
-                info = None
-            if info is None:
+                # deliver the stored failure ONCE (the result path below
+                # re-raises it as the 500 payload), but unbind so the NEXT
+                # repeat re-executes instead of replaying the error — and
+                # so a persistently-failing mutating op is retried at the
+                # client's pace, never in a silent loop
+                self.sessions.unbind(session_key)
+            elif info is None:
                 info = self.user_tasks.create_task(
                     endpoint, request_url, client_id, lambda fut: fn())
                 self.sessions.bind(session_key, info.task_id)
