@@ -324,6 +324,11 @@ class RestApi:
             return 202, {"userTaskId": info.task_id,
                          "progress": info.future.describe()}
         except Exception as e:
+            # a failure observed LIVE (inside the wait) also unbinds, so
+            # the error is delivered exactly once and the next repeat
+            # re-executes (mirrors the pre-wait failed-binding check)
+            if not existing:
+                self.sessions.unbind(session_key)
             return 500, {"userTaskId": info.task_id,
                          "errorMessage": f"{type(e).__name__}: {e}"}
 

@@ -421,11 +421,12 @@ def test_hard_violation_backstop_engages_beyond_greedy_limit(monkeypatch):
                      anneal_config=AN.AnnealConfig(num_chains=2, steps=8,
                                                    swap_interval=8),
                      seed=0, repair_config=crippled)
-    # the main pass ran crippled, then the backstop engaged with its own
-    # (full) defaults at least once
+    # the main pass (and any polish cycles, which share its config) ran
+    # crippled, then the backstop engaged with its own (full) defaults at
+    # least once — backstop calls are the config=None ones
     assert calls[0] is crippled
     assert len(calls) >= 2
-    assert all(c is not crippled for c in calls[1:])
+    assert any(c is None for c in calls[1:])
     hv = _hard_violations_after(r)
     assert all(v == 0 for v in hv.values()), hv
 
