@@ -365,7 +365,11 @@ def optimize(topo: ClusterTopology, assign: Assignment,
                 base_cfg, steps=polish_steps,
                 swap_interval=max(1, min(base_cfg.swap_interval,
                                          polish_steps)))
-            for cycle in range(1, 4):
+            # two cycles: measured at 10 seeds, the second cycle clears most
+            # stragglers; a third spent ~7 s on the one stubborn seed for
+            # cost 0.059 → 0.016 without clearing it — not worth the
+            # wall-clock (27.7 s vs 20.1 s on that seed)
+            for cycle in range(1, 3):
                 report_progress(f"Polish cycle {cycle}")
                 ares2 = AN.optimize_anneal(
                     dt, final, th, weights, opts, num_topics,
