@@ -68,6 +68,18 @@ class RepairConfig:
     max_lead_sources: int = 4096
     #: leadership accepts allowed per broker per round (staleness bound)
     lead_broker_budget: int = 8
+    #: one-step-uphill escapes in the lead phase: when NO single leadership
+    #: move improves but lead-band violations remain (a cross-term local
+    #: optimum — e.g. every count-fixing handoff worsens bytes-in more),
+    #: take the least-bad violation-neutral move off a violating broker,
+    #: redescend, and REVERT the whole excursion unless it ends strictly
+    #: better. OFF by default: measured at LinkedIn scale it clears the
+    #: one stubborn-seed leadership band the polish cycles leave (10/10
+    #: seeds at balancedness 100) but costs ~+20 s of host-driven descent
+    #: rounds on that seed (40.3 s total — over the 30 s budget); enable
+    #: when quality outranks latency. The durable fix is fusing the lead
+    #: descent on-device like the moves phase.
+    lead_uphill_steps: int = 0
     min_improvement: float = 1e-9
 
 
@@ -516,7 +528,62 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
     # host mirrors fetched LAZILY: the common converged case (no leadership
     # violations) must not pay the R/P-sized transfers at all
     bo = lo = reps_np = None
-    for _ in range(cfg.max_rounds):
+    # one-step-uphill escapes (cfg.lead_uphill_steps): before the FIRST
+    # uphill step the full state is snapshotted; at phase end the exact
+    # two-channel energy decides snapshot vs excursion result, so the
+    # guarantee is end-state comparison, not per-move bookkeeping (accepted
+    # batches are intra-round stale, so summed deltas cannot promise
+    # anything). Partitions with an uphill move are excluded from further
+    # moves to prevent ping-pong.
+    uphill_used: set = set()
+    uphill_left = cfg.lead_uphill_steps
+    #: leaves a leadership move can touch — the snapshot copies ONLY these
+    #: (the ~300 MB dense topic histogram and broker_of are lead-invariant;
+    #: they must not be referenced from the snapshot either, because the
+    #: donating applies invalidate the old buffer handles)
+    _LEAD_LEAVES = ("leader_of", "broker_load", "host_load", "leader_count",
+                    "leader_bytes_in", "potential_nw_out")
+    snap = None             # ({lead leaves}, lo copy, total_leads) at snap
+    #: uphill moves must be violation-neutral: the violation channel moves
+    #: in quanta of at least VIOL_SCALE (2^20, the lowest-tier violation
+    #: weight is 1), so only deltas strictly below half a quantum are
+    #: guaranteed pure-cost
+    UPHILL_CAP = 0.5 * float(OBJ.VIOL_SCALE)
+
+    def _lead_energy(leaves):
+        """Exact (violation, cost) of a lead-phase state, from its
+        lead-affected leaves, summed in f64 ON THE HOST — the on-device
+        f32 totals cannot resolve a low-tier violation change under a
+        high-tier ladder term (2^0 vs 2^36). Rack/topic/healing terms are
+        lead-invariant and cancel in the comparison; the PLE term (which
+        leadership DOES move) is included explicitly."""
+        f = OBJ.broker_cost(th, weights, leaves["broker_load"],
+                            leaves["replica_count"],
+                            leaves["leader_count"],
+                            leaves["potential_nw_out"],
+                            leaves["leader_bytes_in"])          # [B, 2]
+        h = OBJ.host_cost(th, weights, leaves["host_load"])     # [H, 2]
+        first = dt.replicas_of_partition[:, 0]
+        ple = jnp.sum((leaves["leader_of"] != first).astype(jnp.float32))
+        fv, hv, ple_n = jax.device_get((f, h, ple))
+        tot = (np.asarray(fv, np.float64).sum(axis=0)
+               + np.asarray(hv, np.float64).sum(axis=0))
+        ple_n = float(ple_n)
+        viol = tot[0] + ple_n * float(
+            jax.device_get(weights.preferred_leader_viol))
+        cost = tot[1] + ple_n * float(
+            jax.device_get(weights.preferred_leader))
+        return (float(viol), float(cost))
+
+    def _leaves_of(state):
+        return {**{k: getattr(state, k) for k in _LEAD_LEAVES},
+                "replica_count": state.replica_count}
+
+    def lead_round(allow_uphill: bool) -> str:
+        """One host-driven leadership round: 'clean' (no lead violations),
+        'accepted' (applied an improving batch), 'uphill' (no improving
+        single; took one violation-neutral uphill step), 'stuck'."""
+        nonlocal st, bo, lo, reps_np, total_leads, snap, uphill_left
         bt = G.broker_terms(th, st.broker_load, st.replica_count,
                             st.leader_count, st.potential_nw_out,
                             st.leader_bytes_in)
@@ -525,7 +592,7 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
             axis=-1)))
         bad = lv > 0
         if not bad.any():
-            break
+            return "clean"
         if bo is None:
             bo = np.array(jax.device_get(st.broker_of))
             lo = np.array(jax.device_get(st.leader_of))
@@ -539,7 +606,7 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
         member_bad = bad[bo[np.maximum(reps_np, 0)]] & (reps_np >= 0)
         cand_p = np.flatnonzero(member_bad.any(axis=1))
         if cand_p.size == 0:
-            break
+            return "clean"
         if cand_p.size > cfg.max_lead_sources:
             cand_p = rng.choice(cand_p, size=cfg.max_lead_sources,
                                 replace=False)
@@ -574,7 +641,8 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
             a_src = int(bo[lo[p]])
             b_dst = int(bo[new_leader])
             if (used_b.get(a_src, 0) >= budget
-                    or used_b.get(b_dst, 0) >= budget or p in used_pp):
+                    or used_b.get(b_dst, 0) >= budget or p in used_pp
+                    or p in uphill_used):
                 continue
             used_b[a_src] = used_b.get(a_src, 0) + 1
             used_b[b_dst] = used_b.get(b_dst, 0) + 1
@@ -584,18 +652,87 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
         if _DEBUG:
             print(f"[repair lead] srcs={Np} improving="
                   f"{int((best_d[:Np] < -cfg.min_improvement).sum())} "
-                  f"accepted={len(acc_p)}", flush=True)
-        if not acc_p:
+                  f"accepted={len(acc_p)} "
+                  f"uphill_used={len(uphill_used)}", flush=True)
+        if acc_p:
+            napp = len(acc_p)
+            pad_a = _bucket(napp, cfg.max_lead_sources)
+            p_arr = np.full(pad_a, acc_p[0], np.int32)
+            l_arr = np.full(pad_a, int(lo[acc_p[0]]), np.int32)  # no-op pad
+            p_arr[:napp] = acc_p
+            l_arr[:napp] = acc_l
+            st = _apply_leads_batch(dt, st, jnp.asarray(p_arr),
+                                    jnp.asarray(l_arr))
+            lo[np.asarray(acc_p)] = acc_l
+            total_leads += napp
+            return "accepted"
+        if allow_uphill and uphill_left > 0:
+            # no improving single move left: take ONE violation-neutral
+            # uphill step off a violating leader broker, then redescend
+            for i in order:
+                d_i = float(best_d[i])
+                if not (d_i < UPHILL_CAP):
+                    break                   # order is sorted: all worse
+                p = int(src_p[i])
+                new_leader = int(reps_np[p, best_s[i]])
+                if (new_leader < 0 or p in uphill_used
+                        or not bad[bo[lo[p]]]):
+                    continue
+                if snap is None:
+                    # copy-on-first-uphill: the end comparison restores
+                    # this if the whole excursion does not pay off (only
+                    # the lead-affected leaves — see _LEAD_LEAVES)
+                    snap = ({k: getattr(st, k) + 0 for k in _LEAD_LEAVES},
+                            lo.copy(), total_leads)
+                pad_a = _bucket(1, cfg.max_lead_sources)
+                p_arr = np.full(pad_a, p, np.int32)
+                l_arr = np.full(pad_a, int(lo[p]), np.int32)
+                l_arr[0] = new_leader
+                st = _apply_leads_batch(dt, st, jnp.asarray(p_arr),
+                                        jnp.asarray(l_arr))
+                uphill_used.add(p)
+                uphill_left -= 1
+                lo[p] = new_leader
+                total_leads += 1
+                if _DEBUG:
+                    print(f"[repair lead] uphill p={p} delta={d_i:.4g}",
+                          flush=True)
+                return "uphill"
+        return "stuck"
+
+    # main descent: EXACTLY the round budget the converged production
+    # profile was validated with — extending it re-exposes batch-staleness
+    # oscillation on fixtures where singles never dry up
+    status = "accepted"
+    for _ in range(cfg.max_rounds):
+        status = lead_round(False)
+        if status in ("clean", "stuck"):
             break
-        napp = len(acc_p)
-        pad_a = _bucket(napp, cfg.max_lead_sources)
-        p_arr = np.full(pad_a, acc_p[0], np.int32)
-        l_arr = np.full(pad_a, int(lo[acc_p[0]]), np.int32)  # no-op padding
-        p_arr[:napp] = acc_p
-        l_arr[:napp] = acc_l
-        st = _apply_leads_batch(dt, st, jnp.asarray(p_arr), jnp.asarray(l_arr))
-        lo[np.asarray(acc_p)] = acc_l
-        total_leads += napp
+    if status == "stuck" and cfg.lead_uphill_steps > 0:
+        # genuinely converged with violations left: guarded uphill
+        # excursions (each uphill step gets a fresh descent; the whole
+        # excursion is snapshot-compared at the end, so it cannot regress)
+        for _ in range(cfg.max_rounds + 2 * cfg.lead_uphill_steps):
+            status = lead_round(True)
+            if status in ("clean", "stuck"):
+                break
+        if snap is not None:
+            # end comparison with the exact evaluator: keep the excursion
+            # only if lexicographically better than the pre-uphill snapshot
+            e_cur = _lead_energy(_leaves_of(st))
+            e_snap = _lead_energy({**snap[0],
+                                   "replica_count": st.replica_count})
+            if e_cur < (e_snap[0], e_snap[1] - cfg.min_improvement):
+                if _DEBUG:
+                    print(f"[repair lead] uphill excursion kept "
+                          f"({e_snap} -> {e_cur})", flush=True)
+            else:
+                st = st._replace(**snap[0])
+                lo = snap[1]
+                total_leads = snap[2]
+                if _DEBUG:
+                    print(f"[repair lead] uphill excursion reverted "
+                          f"({e_snap} vs {e_cur})", flush=True)
 
     if _DEBUG:
         print(f"[repair lead phase] leads={total_leads} "

@@ -445,3 +445,45 @@ def test_hard_zero_is_seed_property(seed):
                      seed=seed)
     hv = _hard_violations_after(r)
     assert all(v == 0 for v in hv.values()), (seed, hv)
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_lead_uphill_never_regresses(seed):
+    """The lead phase's one-step-uphill escapes must never end worse than
+    the plain descent: excursions commit only when their cumulative exact
+    delta is negative and unwind otherwise."""
+    import jax.numpy as jnp
+    from cruise_control_tpu.analyzer import objective as OBJ
+    from cruise_control_tpu.analyzer import repair as REP
+    from cruise_control_tpu.common.resources import BalancingConstraint
+    from cruise_control_tpu.ops.aggregates import compute_aggregates
+    topo, assign = fixtures.random_cluster(fixtures.ClusterProperties(
+        num_racks=3, num_brokers=9, num_replicas=300, num_topics=12),
+        seed=seed)
+    dt = device_topology(topo)
+    agg0 = compute_aggregates(dt, assign, topo.num_topics)
+    th = G.compute_thresholds(dt, BalancingConstraint(), agg0)
+    w = OBJ.build_weights(G.DEFAULT_GOALS)
+    opts = G.default_options(topo)
+    init = jnp.asarray(assign.broker_of, jnp.int32)
+
+    def quality(a):
+        # the WEIGHTED two-channel objective — the uphill excursion may
+        # legitimately trade several low-priority violations for one
+        # higher-priority fix (raw counts can rise while the objective
+        # strictly improves, which is the point of the priority ladder)
+        ev = OBJ.evaluate_objective(
+            dt, a, th, w, G.DEFAULT_GOALS, topo.num_topics, init,
+            compute_aggregates(dt, a, topo.num_topics))
+        v = np.asarray(ev.value, np.float64)
+        return (float(v[0]), float(v[1]))
+
+    base_cfg = REP.RepairConfig(fused_inner=32, fused_sources=64,
+                                swap_partners=4, lead_uphill_steps=0)
+    up_cfg = REP.RepairConfig(fused_inner=32, fused_sources=64,
+                              swap_partners=4, lead_uphill_steps=8)
+    a0, _, _ = REP.repair(dt, assign, th, w, opts, topo.num_topics,
+                          config=base_cfg, seed=seed)
+    a1, _, _ = REP.repair(dt, assign, th, w, opts, topo.num_topics,
+                          config=up_cfg, seed=seed)
+    assert quality(a1) <= quality(a0), (quality(a1), quality(a0))
