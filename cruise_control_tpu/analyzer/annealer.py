@@ -34,6 +34,7 @@ Sharding: chains are embarrassingly parallel — `optimize_anneal` accepts a
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import NamedTuple, Optional, Sequence
 
 import jax
@@ -444,7 +445,7 @@ def make_step_fn(dt: DeviceTopology, th, weights, opts, cfg: AnnealConfig,
     Km, Kl, Ks = cfg.tries_move, cfg.tries_lead, cfg.tries_swap
     m = dt.max_rf
     if topic_reps is None:
-        topic_reps = jnp.full((1, 1), -1, jnp.int32)
+        topic_reps = jax.device_put(np.full((1, 1), -1, np.int32))
     use_topic = topic_mode == "dense"   # maintained-histogram updates
 
     def _pressure(st, brokers):
@@ -574,6 +575,52 @@ def make_step_fn(dt: DeviceTopology, th, weights, opts, cfg: AnnealConfig,
     return step
 
 
+@partial(jax.jit, static_argnames=("use_topic",))
+def _make_base_state(agg, broker_of, leader_of, use_topic: bool):
+    """Single compiled program for the eager glue that builds the seed
+    chain state (the astype/zeros chain was ~8 separate tiny programs —
+    each a remote-compile + persistent-cache load on the TPU tunnel).
+
+    The ``+ 0`` is load-bearing: a pass-through jit output ALIASES its
+    input array, and repair's donating fused applies would then delete the
+    caller's assignment buffers. The add forces a real output buffer."""
+    return ChainState(
+        broker_of=jnp.asarray(broker_of, jnp.int32) + 0,
+        leader_of=jnp.asarray(leader_of, jnp.int32) + 0,
+        broker_load=agg.broker_load,
+        host_load=agg.host_load,
+        replica_count=agg.replica_count.astype(jnp.float32),
+        leader_count=agg.leader_count.astype(jnp.float32),
+        potential_nw_out=agg.potential_nw_out,
+        leader_bytes_in=agg.leader_bytes_in,
+        topic_count=(agg.topic_count.astype(jnp.float32) if use_topic
+                     else jnp.zeros((1, 1), jnp.float32)),
+        energy=jnp.zeros((2,), jnp.float32),
+    )
+
+
+@partial(jax.jit, static_argnames=("num_chains",))
+def _broadcast_chains(base, num_chains: int):
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x, (num_chains,) + x.shape), base)
+
+
+@partial(jax.jit, static_argnames=("out_s",))
+def _take_chain(chains, best, out_s=None):
+    """One program for the winning chain's (broker_of, leader_of) rows.
+
+    ``out_s`` (a replicated NamedSharding when the chains are mesh-sharded)
+    pins the winner REPLICATED: left to GSPMD the slice may come out
+    device-sharded, and every downstream consumer (repair's aggregates,
+    the after-eval) would then reorder its f32 reductions — breaking the
+    sharded == unsharded bitwise contract in a state-dependent way."""
+    bo, lo = chains.broker_of[best], chains.leader_of[best]
+    if out_s is not None:
+        bo = jax.lax.with_sharding_constraint(bo, out_s)
+        lo = jax.lax.with_sharding_constraint(lo, out_s)
+    return bo, lo
+
+
 def optimize_anneal(dt: DeviceTopology, assign: Assignment,
                     th: G.GoalThresholds, weights: OBJ.ObjectiveWeights,
                     opts: G.DeviceOptions, num_topics: int,
@@ -607,7 +654,7 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
     if initial_broker_of is None:
         initial_broker_of = jnp.asarray(assign.broker_of, jnp.int32)
 
-    topic_reps = jnp.full((1, 1), -1, jnp.int32)
+    topic_reps = jax.device_put(np.full((1, 1), -1, np.int32))
     if topic_mode == "sparse":
         # topic CSR: [T, M] replica ids per topic, -1 padded (assignment-
         # invariant, built once on host)
@@ -621,36 +668,26 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
         cols = np.arange(R) - starts[t_of_r[order]]
         csr = np.full((num_topics, M), -1, np.int32)
         csr[t_of_r[order], cols] = order
-        topic_reps = jnp.asarray(csr)
+        topic_reps = jax.device_put(csr)
 
     # Empty candidate pools degrade to a single always-illegal index (the
     # legality masks turn those proposals into +inf deltas) so leadership-only
     # optimization still runs.
     movable_np = np.flatnonzero(np.asarray(jax.device_get(opts.replica_movable)))
     dest_np = np.flatnonzero(np.asarray(jax.device_get(opts.move_dest_ok)))
-    movable_idx = jnp.asarray(movable_np if movable_np.size else np.array([0]), jnp.int32)
-    dest_idx = jnp.asarray(dest_np if dest_np.size else np.array([0]), jnp.int32)
+    movable_idx = jax.device_put(np.asarray(
+        movable_np if movable_np.size else np.array([0]), np.int32))
+    dest_idx = jax.device_put(np.asarray(
+        dest_np if dest_np.size else np.array([0]), np.int32))
 
     # when the topic term is off, skip building the (potentially huge) dense
     # [B, T] histogram — pass a 1-topic axis instead
     agg = compute_aggregates(dt, assign, num_topics if use_topic else 1)
-    base = ChainState(
-        broker_of=jnp.asarray(assign.broker_of, jnp.int32),
-        leader_of=jnp.asarray(assign.leader_of, jnp.int32),
-        broker_load=agg.broker_load,
-        host_load=agg.host_load,
-        replica_count=agg.replica_count.astype(jnp.float32),
-        leader_count=agg.leader_count.astype(jnp.float32),
-        potential_nw_out=agg.potential_nw_out,
-        leader_bytes_in=agg.leader_bytes_in,
-        topic_count=(agg.topic_count.astype(jnp.float32) if use_topic
-                     else jnp.zeros((1, 1), jnp.float32)),
-        energy=jnp.zeros((2,), jnp.float32),
-    )
+    base = _make_base_state(agg, assign.broker_of, assign.leader_of,
+                            use_topic)
     e0 = _chain_energy_jit(dt, th, weights, base, initial_broker_of,
                            topic_mode, num_topics)
-    base = base._replace(energy=e0)
-    chains = jax.tree.map(lambda x: jnp.broadcast_to(x, (C,) + x.shape), base)
+    chains = _broadcast_chains(base._replace(energy=e0), C)
 
     # temperature ladder: a cold block at ~0 (pure descent) + geometric ladder
     n_cold = max(1, int(C * cfg.cold_fraction))
@@ -658,7 +695,7 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
         np.full(n_cold, cfg.t_min, np.float32),
         np.geomspace(cfg.t_min, cfg.t_max, max(C - n_cold, 1)).astype(np.float32)[:C - n_cold],
     ])[:C]
-    temps0 = jnp.asarray(ladder)
+    temps0 = jax.device_put(ladder)
 
     n_rounds = max(1, cfg.steps // cfg.swap_interval)
     keys = jax.random.split(jax.random.PRNGKey(seed), n_rounds)
@@ -692,9 +729,13 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
     e2 = np.asarray(jax.device_get(energies), np.float64)
     comb = e2[:, 0] * OBJ.VIOL_SCALE + e2[:, 1]
     best = int(np.argmin(comb))
+    out_s = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+        out_s = NamedSharding(mesh, PartitionSpec())
+    best_bo, best_lo = _take_chain(chains, best, out_s=out_s)
     return AnnealResult(
-        assignment=Assignment(broker_of=chains.broker_of[best],
-                              leader_of=chains.leader_of[best]),
+        assignment=Assignment(broker_of=best_bo, leader_of=best_lo),
         energy=jnp.float32(comb[best]),
         chain_energies=energies,
     )

@@ -218,6 +218,12 @@ def evaluate_objective(dt: DeviceTopology, assign: Assignment,
     pen = G.full_goal_penalties(dt, assign, th, num_topics, goal_names,
                                 initial_broker_of=initial_broker_of, agg=agg,
                                 sparse_topic=sparse_topic)
-    value = jnp.stack([jnp.sum(pen.violations * weights.per_goal_viol),
-                       jnp.sum(pen.cost * weights.per_goal)])
+    value = _weighted_value(pen, weights)
     return ObjectiveState(value=value, penalties=pen)
+
+
+@jax.jit
+def _weighted_value(pen, weights):
+    """One program for the per-goal weighting (was 5 eager tiny programs)."""
+    return jnp.stack([jnp.sum(pen.violations * weights.per_goal_viol),
+                      jnp.sum(pen.cost * weights.per_goal)])

@@ -246,10 +246,12 @@ def optimize(topo: ClusterTopology, assign: Assignment,
              anneal_config: Optional["AnnealConfig"] = None,
              seed: int = 0,
              mesh: Optional["jax.sharding.Mesh"] = None,
-             repair_config=None) -> OptimizerResult:
+             repair_config=None, polish_cycles: int = 2) -> OptimizerResult:
     """Full optimization pass. ``engine``: auto | greedy | anneal.
     ``repair_config``: RepairConfig override for the MAIN repair pass (the
-    hard-violation backstop always runs with its own defaults)."""
+    hard-violation backstop always runs with its own defaults).
+    ``polish_cycles``: max anneal-restart+repair cycles when violations
+    remain after the main repair (0 disables)."""
     from cruise_control_tpu.analyzer import annealer as AN  # cycle-free import
 
     from cruise_control_tpu.common.metrics import REGISTRY
@@ -271,7 +273,10 @@ def optimize(topo: ClusterTopology, assign: Assignment,
     dt = device_topology(topo)
     num_topics = topo.num_topics
     sparse_topic = topo.num_brokers * num_topics > TOPIC_DENSE_LIMIT
-    init_broker = jnp.asarray(assign.broker_of, jnp.int32)
+    # device_put, not jnp.asarray: a dtype-converting asarray is its own
+    # tiny compiled program (cold-start cache-load tax over the tunnel)
+    init_broker = jax.device_put(
+        np.asarray(jax.device_get(assign.broker_of), np.int32))
 
     def _agg(a):
         """Broker aggregates for assignment ``a`` — replica-axis sharded
@@ -365,11 +370,11 @@ def optimize(topo: ClusterTopology, assign: Assignment,
                 base_cfg, steps=polish_steps,
                 swap_interval=max(1, min(base_cfg.swap_interval,
                                          polish_steps)))
-            # two cycles: measured at 10 seeds, the second cycle clears most
-            # stragglers; a third spent ~7 s on the one stubborn seed for
-            # cost 0.059 → 0.016 without clearing it — not worth the
-            # wall-clock (27.7 s vs 20.1 s on that seed)
-            for cycle in range(1, 3):
+            # two cycles by default: measured at 10 seeds, the second cycle
+            # clears most stragglers; a third spent ~7 s on the one stubborn
+            # seed for cost 0.059 → 0.016 without clearing it — not worth
+            # the wall-clock (27.7 s vs 20.1 s on that seed)
+            for cycle in range(1, polish_cycles + 1):
                 report_progress(f"Polish cycle {cycle}")
                 ares2 = AN.optimize_anneal(
                     dt, final, th, weights, opts, num_topics,

@@ -66,19 +66,22 @@ class RepairConfig:
     swap_partners: int = 12
     #: leadership candidates per round
     max_lead_sources: int = 4096
-    #: leadership accepts allowed per broker per round (staleness bound)
+    #: staleness bound, used two ways: accepts allowed per BROKER per
+    #: host round, and cumulative accepts allowed per PARTITION per fused
+    #: dispatch (the on-device ping-pong guard) — the fused kernel's
+    #: per-round claims are already one per broker
     lead_broker_budget: int = 8
+    #: inner rounds of the fused on-device leadership descent per dispatch
+    lead_inner: int = 256
     #: one-step-uphill escapes in the lead phase: when NO single leadership
     #: move improves but lead-band violations remain (a cross-term local
     #: optimum — e.g. every count-fixing handoff worsens bytes-in more),
     #: take the least-bad violation-neutral move off a violating broker,
     #: redescend, and REVERT the whole excursion unless it ends strictly
-    #: better. OFF by default: measured at LinkedIn scale it clears the
-    #: one stubborn-seed leadership band the polish cycles leave (10/10
-    #: seeds at balancedness 100) but costs ~+20 s of host-driven descent
-    #: rounds on that seed (40.3 s total — over the 30 s budget); enable
-    #: when quality outranks latency. The durable fix is fusing the lead
-    #: descent on-device like the moves phase.
+    #: better. The redescent between uphill steps is the fused ON-DEVICE
+    #: kernel (one dispatch), so an excursion costs ~2 dispatches instead
+    #: of the ~20 host-driven rounds that made this off-by-default in
+    #: round 3.
     lead_uphill_steps: int = 0
     min_improvement: float = 1e-9
 
@@ -193,11 +196,187 @@ _move_deltas_rows = partial(jax.jit, static_argnames=("use_topic",))(
 
 @jax.jit
 def _lead_deltas_batch(dt, th, weights, opts, st, src_p, slots):
-    """f32[N, m, 2] exact deltas for partitions × leadership slots."""
+    """Combined f32[N, m] exact deltas for partitions × leadership slots."""
     def one(p, s):
         return AN._lead_delta(dt, th, weights, opts, st, p, s)
-    return jax.vmap(jax.vmap(one, in_axes=(None, 0)), in_axes=(0, None))(
+    d2 = jax.vmap(jax.vmap(one, in_axes=(None, 0)), in_axes=(0, None))(
         src_p, slots)
+    return OBJ.combine(d2)
+
+
+@partial(jax.jit, static_argnames=("use_topic",))
+def _energy_parts(dt, th, w, st, initial_broker_of, use_topic: bool):
+    """Decomposed exact objective pieces for host-side f64 totals — the
+    full-state analogue of ``_lead_energy_parts`` (replica moves change
+    rack/topic/healing terms, which the lead-only comparison may omit).
+    Per-broker rows come back unsummed so the host can add them in f64:
+    the on-device f32 totals cannot resolve a low-tier change under a
+    2^36-tier ladder term."""
+    f = OBJ.broker_cost(th, w, st.broker_load, st.replica_count,
+                        st.leader_count, st.potential_nw_out,
+                        st.leader_bytes_in)                     # [B, 2]
+    h = OBJ.host_cost(th, w, st.host_load)                      # [H, 2]
+    from cruise_control_tpu.ops.aggregates import partition_rack_excess
+    rack_n = jnp.sum(partition_rack_excess(dt, st.broker_of))
+    if use_topic:
+        alive_f = th.alive.astype(jnp.float32)[:, None]
+        out = (G.band_cost(st.topic_count, th.topic_upper[None, :],
+                           th.topic_lower[None, :]) * alive_f)  # [B, T]
+        topic_v = jnp.sum((out > 0).astype(jnp.float32), axis=1)  # [B]
+        topic_c = jnp.sum(out, axis=1)                            # [B]
+    else:
+        topic_v = topic_c = jnp.zeros((dt.num_brokers,))
+    first = dt.replicas_of_partition[:, 0]
+    ple = jnp.sum((st.leader_of != first).astype(jnp.float32))
+    unhealed = jnp.sum((dt.replica_offline
+                        & (st.broker_of == initial_broker_of)
+                        & dt.broker_alive[st.broker_of]).astype(jnp.float32))
+    return f, h, rack_n, topic_v, topic_c, ple, unhealed
+
+
+@jax.jit
+def _lead_energy_parts(dt, th, weights, leaves):
+    """One program for the device math of the uphill-excursion energy
+    comparison (broker/host cost rows + PLE count)."""
+    f = OBJ.broker_cost(th, weights, leaves["broker_load"],
+                        leaves["replica_count"],
+                        leaves["leader_count"],
+                        leaves["potential_nw_out"],
+                        leaves["leader_bytes_in"])          # [B, 2]
+    h = OBJ.host_cost(th, weights, leaves["host_load"])     # [H, 2]
+    first = dt.replicas_of_partition[:, 0]
+    ple = jnp.sum((leaves["leader_of"] != first).astype(jnp.float32))
+    return f, h, ple
+
+
+def _lead_swap_delta(dt, th, w, opts, st, p, sp, q, sq):
+    """Exact two-channel delta of SIMULTANEOUS leadership handoffs:
+    partition ``p``'s leadership to its slot ``sp`` replica AND partition
+    ``q``'s leadership to its slot ``sq`` replica.
+
+    The pair is the compound escape the single-move lead descent cannot
+    make: a barely-violating leader broker v can rarely shed a partition
+    (every destination would cross ITS band — a ≥ VIOL_SCALE delta), but
+    v shedding a heavy partition to w while taking a light one back from
+    w moves only the NET load onto w. Singles' deltas are not additive
+    when they share brokers, so this evaluates the union of affected
+    brokers/hosts with per-entity total deltas (band costs are
+    nonlinear), mirroring the reference's swap legality+delta walk
+    (``AbstractGoal.java:68-109`` applied to LEADERSHIP_MOVEMENT pairs).
+    """
+    m = dt.max_rf
+    reps_p = dt.replicas_of_partition[p]                     # [m]
+    reps_q = dt.replicas_of_partition[q]
+    c1 = st.leader_of[p]
+    c2 = st.leader_of[q]
+    n1 = reps_p[sp]
+    n2 = reps_q[sq]
+    n1c = jnp.clip(n1, 0)
+    n2c = jnp.clip(n2, 0)
+    a1, b1 = st.broker_of[c1], st.broker_of[n1c]
+    a2, b2 = st.broker_of[c2], st.broker_of[n2c]
+    e1, e2 = dt.leader_extra[p], dt.leader_extra[q]          # [4]
+    l1, l2 = dt.leader_bytes_in[p], dt.leader_bytes_in[q]
+    dpl1 = (dt.replica_base_load[n1c, AN.res.NW_OUT]
+            - dt.replica_base_load[c1, AN.res.NW_OUT])
+    dpl2 = (dt.replica_base_load[n2c, AN.res.NW_OUT]
+            - dt.replica_base_load[c2, AN.res.NW_OUT])
+
+    # contribution slots: 4 leadership endpoints + 2m PNW member rows
+    mb_p = st.broker_of[jnp.clip(reps_p, 0)]
+    mb_q = st.broker_of[jnp.clip(reps_q, 0)]
+    k_b = jnp.concatenate([jnp.stack([a1, b1, a2, b2]), mb_p, mb_q])
+    vmask = jnp.concatenate([jnp.ones(4, bool), reps_p >= 0, reps_q >= 0])
+    zero4 = jnp.zeros((4,))
+    d_load = jnp.concatenate([
+        jnp.stack([-e1, e1, -e2, e2]),
+        jnp.zeros((2 * m, 4))])                              # [K, 4]
+    d_lead = jnp.concatenate([jnp.array([-1.0, 1.0, -1.0, 1.0]),
+                              jnp.zeros(2 * m)])
+    d_lbi = jnp.concatenate([jnp.stack([-l1, l1, -l2, l2]),
+                             jnp.zeros(2 * m)])
+    d_pnw = jnp.concatenate([zero4, jnp.full(m, dpl1), jnp.full(m, dpl2)])
+
+    eq = (k_b[:, None] == k_b[None, :]) & vmask[None, :]     # [K, K]
+    eqf = eq.astype(jnp.float32)
+    tot_load = eqf @ d_load                                  # [K, 4]
+    tot_lead = eqf @ d_lead
+    tot_lbi = eqf @ d_lbi
+    tot_pnw = eqf @ d_pnw
+    K = k_b.shape[0]
+    tri = jnp.tril(jnp.ones((K, K), bool), k=-1)
+    first = vmask & ~jnp.any(eq & tri, axis=1)
+
+    th_k = OBJ.gather_thresholds(th, k_b)
+    f0 = OBJ.broker_cost(th_k, w, st.broker_load[k_b], st.replica_count[k_b],
+                         st.leader_count[k_b], st.potential_nw_out[k_b],
+                         st.leader_bytes_in[k_b])            # [K, 2]
+    f1 = OBJ.broker_cost(
+        th_k, w,
+        st.broker_load[k_b] + tot_load,
+        st.replica_count[k_b],
+        st.leader_count[k_b] + tot_lead,
+        st.potential_nw_out[k_b] + tot_pnw,
+        st.leader_bytes_in[k_b] + tot_lbi)
+    d2 = jnp.sum(jnp.where(first[:, None], f1 - f0, 0.0), axis=0)  # [2]
+
+    # hosts: 4 endpoint contributions, same union treatment
+    h_k = dt.host_of_broker[jnp.stack([a1, b1, a2, b2])]
+    h_d = jnp.stack([-e1, e1, -e2, e2])
+    h_eq = h_k[:, None] == h_k[None, :]
+    h_tot = h_eq.astype(jnp.float32) @ h_d
+    h_first = ~jnp.any(h_eq & jnp.tril(jnp.ones((4, 4), bool), k=-1), axis=1)
+    th_h = OBJ.gather_host_thresholds(th, h_k)
+    h0 = OBJ.host_cost(th_h, w, st.host_load[h_k])
+    h1 = OBJ.host_cost(th_h, w, st.host_load[h_k] + h_tot)
+    d2 = d2 + jnp.sum(jnp.where(h_first[:, None], h1 - h0, 0.0), axis=0)
+
+    d_ple = ((c1 == reps_p[0]).astype(jnp.float32)
+             - (n1 == reps_p[0]).astype(jnp.float32)
+             + (c2 == reps_q[0]).astype(jnp.float32)
+             - (n2 == reps_q[0]).astype(jnp.float32))
+    d2 = d2 + jnp.stack([w.preferred_leader_viol, w.preferred_leader]) * d_ple
+
+    ok = ((n1 >= 0) & (n1 != c1) & (n2 >= 0) & (n2 != c2) & (p != q)
+          & opts.leader_dest_ok[b1] & opts.leadership_movable[n1c]
+          & ~dt.replica_offline[n1c] & dt.broker_alive[b1]
+          & opts.leader_dest_ok[b2] & opts.leadership_movable[n2c]
+          & ~dt.replica_offline[n2c] & dt.broker_alive[b2])
+    return jnp.where(ok, OBJ.combine(d2), _INF)
+
+
+@jax.jit
+def _lead_swap_deltas_batch(dt, th, w, opts, st, p_arr, sp_arr, q_arr,
+                            sq_arr):
+    return jax.vmap(lambda p, sp, q, sq: _lead_swap_delta(
+        dt, th, w, opts, st, p, sp, q, sq))(p_arr, sp_arr, q_arr, sq_arr)
+
+
+@partial(jax.jit, static_argnames=("topic_mode",))
+def _swap_deltas_pairs(dt, th, w, opts, st, initial_broker_of, r1, r2,
+                       topic_mode: str):
+    """Combined f32[N] exact deltas for replica-swap pairs r1[i] ↔ r2[i]."""
+    dummy = jnp.full((1, 1), -1, jnp.int32)
+    return jax.vmap(lambda a, b: OBJ.combine(AN._swap_delta(
+        dt, th, w, opts, st, initial_broker_of, topic_mode, dummy,
+        a, b)))(r1, r2)
+
+
+def _lead_viol_expr(th, w, st, lead_w):
+    """f32[B] weighted leadership-term violations — the convergence
+    contract shared by the fused kernel's candidate flag and the host
+    gate (ONE definition, so the two can never descend on different
+    violation sets)."""
+    bt = G.broker_terms(th, st.broker_load, st.replica_count,
+                        st.leader_count, st.potential_nw_out,
+                        st.leader_bytes_in)
+    return jnp.sum(bt.violations * lead_w * (w.broker_terms_viol > 0),
+                   axis=-1)
+
+
+#: jitted wrapper for host callers (the eager broker_terms chain was ~20
+#: separate tiny programs, each a tunnel round-trip at cold start)
+_lead_viol_vec = jax.jit(_lead_viol_expr)
 
 
 @partial(jax.jit,
@@ -405,26 +584,148 @@ def _fused_targeted(dt, th, w, opts, st, offline, initial_broker_of,
     return st, total, zeros >= 2, rounds
 
 
+@partial(jax.jit,
+         static_argnames=("n_inner", "n_src", "src_sharding",
+                          "flag_sharding"),
+         donate_argnums=(4,))
+def _fused_lead(dt, th, w, opts, st, lead_w, blocked_p, key,
+                min_improvement, per_p_budget, n_inner: int, n_src: int,
+                src_sharding=None, flag_sharding=None):
+    """Up to ``n_inner`` leadership-descent rounds fused into ONE program.
+
+    Round-3's lead phase was host-driven — each round paid ~0.4-0.8 s of
+    tunnel latency for a [n_src, m] delta batch plus a host greedy — which
+    is why the uphill escapes (the only fix for the cross-term leadership
+    local optimum) cost ~20 s on the stubborn seed. This is the moves-phase
+    treatment applied to leadership: each on-device round
+
+    1. recomputes the lead-violating brokers from the maintained broker
+       terms (O(B)) and flags partitions with ANY member on a violating
+       broker (``AbstractGoal.java:68-109``'s candidate walk, vectorized);
+    2. evaluates the exact two-channel delta of every leadership slot for
+       up to ``n_src`` flagged partitions (``_lead_delta`` is O(m));
+    3. claims one accept per source/destination broker and per host via
+       the exact two-pass scatter-min (deltas of same-round winners are
+       additive: a lead move touches only its two brokers' terms, its two
+       hosts' capacity, and its own partition's PLE/PNW rows);
+    4. applies the winner batch and exits after two zero-accept rounds.
+
+    ``blocked_p`` masks partitions an uphill excursion already moved
+    (ping-pong guard). The sharding story matches ``_fused_targeted``:
+    candidate axes shard, winner vectors replicate before the apply, all
+    cross-device combines are min/or reductions, so sharded == unsharded
+    holds bitwise.
+    """
+    P = dt.num_partitions
+    B = dt.num_brokers
+    m = dt.max_rf
+    slots = jnp.arange(m, dtype=jnp.int32)
+
+    def _c(x, s):
+        return x if s is None else jax.lax.with_sharding_constraint(x, s)
+
+    row_sharding = repl_sharding = None
+    if src_sharding is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+        row_sharding = NamedSharding(src_sharding.mesh,
+                                     PartitionSpec(src_sharding.spec[0]))
+        repl_sharding = NamedSharding(src_sharding.mesh, PartitionSpec())
+
+    def cand_flag(st, cnt):
+        bad = _lead_viol_expr(th, w, st, lead_w) > 0                  # [B]
+        reps = dt.replicas_of_partition                               # [P,m]
+        member_bad = bad[st.broker_of[jnp.clip(reps, 0)]] & (reps >= 0)
+        # per-partition accept budget per dispatch: batch deltas are
+        # intra-round stale (winners sharing a member broker), and stale
+        # accepts can ping-pong one partition's leadership forever on
+        # fixtures where improving singles never dry up — the budget turns
+        # that into bounded wander the next exact round walks back
+        return _c(member_bad.any(axis=1) & ~blocked_p
+                  & (cnt < per_p_budget), flag_sharding)
+
+    def inner(st, cnt, k):
+        flag = cand_flag(st, cnt)
+        start = jax.random.randint(jax.random.fold_in(k, 7), (), 0, P)
+        src = jnp.nonzero(jnp.roll(flag, -start), size=n_src,
+                          fill_value=-1)[0]
+        valid_src = src >= 0
+        srcp = _c(jnp.where(valid_src, (src + start) % P, 0), row_sharding)
+        d2 = jax.vmap(jax.vmap(
+            lambda p, s: AN._lead_delta(dt, th, w, opts, st, p, s),
+            in_axes=(None, 0)), in_axes=(0, None))(srcp, slots)  # [n,m,2]
+        d = _c(jnp.where(valid_src[:, None], OBJ.combine(d2), AN._INF),
+               src_sharding)
+        best_s = jnp.argmin(d, axis=1)
+        best_d = jnp.take_along_axis(d, best_s[:, None], axis=1)[:, 0]
+        cur = st.leader_of[srcp]
+        cand = dt.replicas_of_partition[srcp, best_s]
+        cand = jnp.where(cand >= 0, cand, cur)
+        a_b = st.broker_of[cur]
+        b_b = st.broker_of[cand]
+        idx = jnp.arange(n_src, dtype=jnp.int32)
+        big = jnp.int32(n_src + 1)
+
+        def claim(ta, tb, size):
+            m1 = (jnp.full((size,), jnp.inf)
+                  .at[ta].min(best_d).at[tb].min(best_d))
+            tied_a = m1[ta] == best_d
+            tied_b = m1[tb] == best_d
+            m2 = (jnp.full((size,), big)
+                  .at[ta].min(jnp.where(tied_a, idx, big))
+                  .at[tb].min(jnp.where(tied_b, idx, big)))
+            return (m2[ta] == idx) & (m2[tb] == idx)
+
+        # member-broker claims: a lead move scatters potential_nw_out onto
+        # EVERY member broker of its partition (AN._apply_leads), so two
+        # same-round winners sharing a follower broker would not be
+        # additive through the PNW band term — claim the full member set
+        # (which subsumes the two endpoint brokers)
+        reps_c = dt.replicas_of_partition[srcp]                # [n, m]
+        vm = reps_c >= 0
+        mb_c = st.broker_of[jnp.clip(reps_c, 0)]
+        dm = jnp.where(vm, best_d[:, None], jnp.inf)
+        m1m = jnp.full((B,), jnp.inf).at[mb_c].min(dm)
+        tied_m = (m1m[mb_c] == best_d[:, None]) & vm
+        m2m = (jnp.full((B,), big)
+               .at[mb_c].min(jnp.where(tied_m, idx[:, None], big)))
+        claim_members = jnp.all((m2m[mb_c] == idx[:, None]) | ~vm, axis=1)
+        win = (claim_members
+               & claim(dt.host_of_broker[a_b], dt.host_of_broker[b_b],
+                       dt.num_hosts)
+               & (best_d < -min_improvement) & valid_src)
+        new_l = _c(jnp.where(win, cand, cur), repl_sharding)
+        p_vec = _c(srcp, repl_sharding)
+        cnt = cnt.at[p_vec].add(win.astype(jnp.int32))
+        st = AN._apply_leads(dt, st, p_vec, new_l)
+        st = jax.tree.map(lambda x: _c(x, repl_sharding), st)
+        return st, cnt, jnp.sum(win.astype(jnp.int32))
+
+    def body(carry):
+        st, cnt, i, zeros, total = carry
+        st, cnt, acc = inner(st, cnt, jax.random.fold_in(key, i))
+        zeros = jnp.where(acc == 0, zeros + 1, jnp.int32(0))
+        return st, cnt, i + 1, zeros, total + acc
+
+    def cond(carry):
+        _, _, i, zeros, _ = carry
+        return (i < n_inner) & (zeros < 2)
+
+    st, _, rounds, zeros, total = jax.lax.while_loop(
+        cond, body, (st, _c(jnp.zeros((P,), jnp.int32), flag_sharding),
+                     jnp.int32(0), jnp.int32(0), jnp.int32(0)))
+    return st, total, zeros >= 2, rounds
+
+
 def _chain_state(dt, assign, num_topics: int,
                  track_topics: bool) -> AN.ChainState:
     agg = compute_aggregates(dt, assign, num_topics if track_topics else 1)
-    # COPY the assignment arrays: the fused-apply jits donate the chain
-    # state, and jnp.asarray on a device array is a no-copy alias — without
-    # the copy, repair() would delete the CALLER's assign buffers (any reuse
-    # of the input assignment after repair crashes with INVALID_ARGUMENT)
-    return AN.ChainState(
-        broker_of=jnp.asarray(assign.broker_of, jnp.int32) + 0,
-        leader_of=jnp.asarray(assign.leader_of, jnp.int32) + 0,
-        broker_load=agg.broker_load,
-        host_load=agg.host_load,
-        replica_count=agg.replica_count.astype(jnp.float32),
-        leader_count=agg.leader_count.astype(jnp.float32),
-        potential_nw_out=agg.potential_nw_out,
-        leader_bytes_in=agg.leader_bytes_in,
-        topic_count=(agg.topic_count.astype(jnp.float32) if track_topics
-                     else jnp.zeros((1, 1), jnp.float32)),
-        energy=jnp.zeros((2,), jnp.float32),
-    )
+    # _make_base_state runs as ONE jitted program whose outputs are fresh
+    # buffers — the COPY matters: the fused-apply jits donate the chain
+    # state, and an aliased view of the caller's assign arrays would let
+    # repair() delete them (any reuse of the input assignment after repair
+    # then crashes with INVALID_ARGUMENT)
+    return AN._make_base_state(agg, assign.broker_of, assign.leader_of,
+                               track_topics)
 
 
 def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
@@ -470,9 +771,9 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
     movable_pool = np.flatnonzero(movable_np)
     if movable_pool.size == 0:
         return assign, 0, 0
-    movable_pool_dev = jnp.asarray(movable_pool, jnp.int32)
-    movable_dev = jnp.asarray(movable_np)
-    offline_dev = jnp.asarray(offline_np)
+    movable_pool_dev = jax.device_put(np.asarray(movable_pool, np.int32))
+    movable_dev = jax.device_put(movable_np)
+    offline_dev = jax.device_put(offline_np)
     base_key = jax.random.PRNGKey(seed)
     src_sharding = flag_sharding = None
     if mesh is not None:
@@ -496,25 +797,32 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
     if _DEBUG:
         jax.block_until_ready(st.broker_load)
         print(f"[repair setup] t={time.time()-_t0:.2f}s", flush=True)
-    for outer in range(cfg.max_rounds):
-        _t_round = time.time()
-        st, n_acc, converged, rounds = _fused_targeted(
-            dt, th, weights, opts, st, offline_dev, initial_broker_of,
-            movable_dev, movable_pool_dev, jax.random.fold_in(base_key, outer),
-            jnp.float32(cfg.min_improvement),
-            topic_on, check_under, cfg.fused_inner, cfg.fused_sources,
-            cfg.swap_partners, src_sharding=src_sharding,
-            flag_sharding=flag_sharding)
-        n_acc = int(jax.device_get(n_acc))
-        converged = bool(jax.device_get(converged))
-        if _DEBUG:
-            print(f"[repair fused] outer={outer} accepted={n_acc} "
-                  f"rounds={int(jax.device_get(rounds))} "
-                  f"converged={converged} t={time.time()-_t_round:.2f}s",
-                  flush=True)
-        total_moves += n_acc
-        if converged or n_acc == 0:
-            break
+    def moves_descent(key_offset: int = 0):
+        """Fused moves/swaps descent (outer backstop dispatches included).
+        Used for the main pass and as the mop-up after a shed plan."""
+        nonlocal st, total_moves
+        for outer in range(cfg.max_rounds):
+            _t_round = time.time()
+            st, n_acc, converged, rounds = _fused_targeted(
+                dt, th, weights, opts, st, offline_dev, initial_broker_of,
+                movable_dev, movable_pool_dev,
+                jax.random.fold_in(base_key, key_offset + outer),
+                jnp.float32(cfg.min_improvement),
+                topic_on, check_under, cfg.fused_inner, cfg.fused_sources,
+                cfg.swap_partners, src_sharding=src_sharding,
+                flag_sharding=flag_sharding)
+            n_acc = int(jax.device_get(n_acc))
+            converged = bool(jax.device_get(converged))
+            if _DEBUG:
+                print(f"[repair fused] outer={outer} accepted={n_acc} "
+                      f"rounds={int(jax.device_get(rounds))} "
+                      f"converged={converged} t={time.time()-_t_round:.2f}s",
+                      flush=True)
+            total_moves += n_acc
+            if converged or n_acc == 0:
+                break
+
+    moves_descent()
     _t_lead = time.time()
     # ---- leadership repair: partitions led by brokers violating the
     # leadership-sensitive terms (LeaderReplicaDistribution, LeaderBytesIn,
@@ -523,11 +831,12 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
     for g in ("LeaderReplicaDistributionGoal", "LeaderBytesInDistributionGoal",
               "_DemotedLeadership"):
         lead_terms[G.BROKER_TERM_GOALS.index(g)] = 1.0
-    lead_w = jnp.asarray(lead_terms)
-    slots = jnp.arange(m, dtype=jnp.int32)
+    lead_w = jax.device_put(lead_terms)
+    slots = jax.device_put(np.arange(m, dtype=np.int32))
     # host mirrors fetched LAZILY: the common converged case (no leadership
     # violations) must not pay the R/P-sized transfers at all
     bo = lo = reps_np = None
+    P = dt.num_partitions
     # one-step-uphill escapes (cfg.lead_uphill_steps): before the FIRST
     # uphill step the full state is snapshotted; at phase end the exact
     # two-channel energy decides snapshot vs excursion result, so the
@@ -557,15 +866,8 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
         high-tier ladder term (2^0 vs 2^36). Rack/topic/healing terms are
         lead-invariant and cancel in the comparison; the PLE term (which
         leadership DOES move) is included explicitly."""
-        f = OBJ.broker_cost(th, weights, leaves["broker_load"],
-                            leaves["replica_count"],
-                            leaves["leader_count"],
-                            leaves["potential_nw_out"],
-                            leaves["leader_bytes_in"])          # [B, 2]
-        h = OBJ.host_cost(th, weights, leaves["host_load"])     # [H, 2]
-        first = dt.replicas_of_partition[:, 0]
-        ple = jnp.sum((leaves["leader_of"] != first).astype(jnp.float32))
-        fv, hv, ple_n = jax.device_get((f, h, ple))
+        fv, hv, ple_n = jax.device_get(
+            _lead_energy_parts(dt, th, weights, leaves))
         tot = (np.asarray(fv, np.float64).sum(axis=0)
                + np.asarray(hv, np.float64).sum(axis=0))
         ple_n = float(ple_n)
@@ -584,21 +886,20 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
         'accepted' (applied an improving batch), 'uphill' (no improving
         single; took one violation-neutral uphill step), 'stuck'."""
         nonlocal st, bo, lo, reps_np, total_leads, snap, uphill_left
-        bt = G.broker_terms(th, st.broker_load, st.replica_count,
-                            st.leader_count, st.potential_nw_out,
-                            st.leader_bytes_in)
-        lv = np.asarray(jax.device_get(jnp.sum(
-            bt.violations * lead_w * (weights.broker_terms_viol > 0),
-            axis=-1)))
+        lv = np.asarray(jax.device_get(_lead_viol_vec(th, weights, st,
+                                                      lead_w)))
         bad = lv > 0
         if not bad.any():
             return "clean"
         if bo is None:
             bo = np.array(jax.device_get(st.broker_of))
-            lo = np.array(jax.device_get(st.leader_of))
             # static structure fetched once; leadership is tracked
             # incrementally on the host (replica placement is frozen here)
             reps_np = np.asarray(jax.device_get(dt.replicas_of_partition))
+        if lo is None:
+            # the fused descent moves leadership on device; the host
+            # mirror refetches after each dispatch
+            lo = np.array(jax.device_get(st.leader_of))
         # candidate partitions: any member broker violates a leadership term
         # — covers both shedding leadership off over-loaded brokers and
         # handing it to under-loaded ones (the slot enumeration in
@@ -614,9 +915,8 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
         pad = _bucket(Np, cfg.max_lead_sources)
         src_p = np.full(pad, cand_p[0], np.int32)
         src_p[:Np] = cand_p
-        d2 = _lead_deltas_batch(dt, th, weights, opts, st,
-                                jnp.asarray(src_p), slots)
-        d = np.array(jax.device_get(OBJ.combine(d2)))            # [pad, m]
+        d = np.array(jax.device_get(_lead_deltas_batch(
+            dt, th, weights, opts, st, jnp.asarray(src_p), slots)))  # [pad,m]
         d[Np:] = _INF
         best_s = np.argmin(d, axis=1)
         best_d = d[np.arange(pad), best_s]
@@ -650,10 +950,13 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
             acc_p.append(p)
             acc_l.append(new_leader)
         if _DEBUG:
+            fin = best_d[:Np][np.isfinite(best_d[:Np])]
             print(f"[repair lead] srcs={Np} improving="
                   f"{int((best_d[:Np] < -cfg.min_improvement).sum())} "
                   f"accepted={len(acc_p)} "
-                  f"uphill_used={len(uphill_used)}", flush=True)
+                  f"uphill_used={len(uphill_used)} "
+                  f"best_d={np.sort(fin)[:5].tolist() if fin.size else []}",
+                  flush=True)
         if acc_p:
             napp = len(acc_p)
             pad_a = _bucket(napp, cfg.max_lead_sources)
@@ -700,22 +1003,520 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
                 return "uphill"
         return "stuck"
 
-    # main descent: EXACTLY the round budget the converged production
-    # profile was validated with — extending it re-exposes batch-staleness
-    # oscillation on fixtures where singles never dry up
-    status = "accepted"
-    for _ in range(cfg.max_rounds):
+    def _exact_energy() -> Tuple[float, float]:
+        """Exact full-state (violation, cost), f64-summed on host."""
+        f, h, rack_n, tv, tc, ple, unh = jax.device_get(_energy_parts(
+            dt, th, weights, st, initial_broker_of, topic_on))
+        tot = (np.asarray(f, np.float64).sum(axis=0)
+               + np.asarray(h, np.float64).sum(axis=0))
+        wv = {k: float(jax.device_get(getattr(weights, k)))
+              for k in ("rack_viol", "rack", "topic_viol", "topic",
+                        "healing_viol", "healing",
+                        "preferred_leader_viol", "preferred_leader")}
+        viol = (tot[0] + wv["rack_viol"] * float(rack_n)
+                + wv["topic_viol"] * float(np.asarray(tv, np.float64).sum())
+                + wv["healing_viol"] * float(unh)
+                + wv["preferred_leader_viol"] * float(ple))
+        cost = (tot[1] + wv["rack"] * float(rack_n)
+                + wv["topic"] * float(np.asarray(tc, np.float64).sum())
+                + wv["healing"] * float(unh)
+                + wv["preferred_leader"] * float(ple))
+        return float(viol), float(cost)
+
+    def shed_plan() -> bool:
+        """Deterministic plateau traverse for residual LeaderBytesIn band
+        violations: swap the violating broker v's heaviest LEADER
+        replicas against LIGHT-LEADER replicas elsewhere (leader↔leader
+        keeps both brokers' leader counts — which sit at the band top
+        cluster-wide in the stuck states — exactly neutral; leadership
+        travels with the replica, so each pair drains lbi[p] − lbi[q]
+        from v), choosing violation-neutral pairs until the planned
+        cumulative drain covers v's measured band excess. Only a FULL
+        plan is applied — a partial shed pays cost without the
+        violation-clear reward — and the caller wraps it in an exact
+        f64-energy snapshot compare, so it can never regress.
+
+        Known structural limit (LinkedIn-scale seed 8, docs/PERF.md): a
+        state can pin v simultaneously AGAINST its NW-in LOWER band
+        (slack ~0.4) while over its LBI upper band by ~750 — lbi IS
+        leader nw-in, so every draining pair under-runs v's own nw-in
+        band and the plan correctly refuses (cum << need). Escaping that
+        pinch needs ≥3-action bundles whose intermediates cross count
+        bands; the reference's single-action goal walks park strictly
+        earlier on such states."""
+        nonlocal st, bo, lo, reps_np, total_moves
+        lv = np.asarray(jax.device_get(_lead_viol_vec(th, weights, st,
+                                                      lead_w)))
+        bad = lv > 0
+        if not bad.any():
+            return False
+        lbi_b = np.array(jax.device_get(st.leader_bytes_in))
+        lbi_up = np.broadcast_to(
+            np.asarray(jax.device_get(th.lbi_upper)), lbi_b.shape)
+        plbi = np.asarray(jax.device_get(dt.leader_bytes_in))
+        if bo is None:
+            bo = np.array(jax.device_get(st.broker_of))
+            reps_np = np.asarray(jax.device_get(dt.replicas_of_partition))
+        if lo is None:
+            lo = np.array(jax.device_get(st.leader_of))
+        hob = np.asarray(jax.device_get(dt.host_of_broker))
+        led_broker = bo[lo]
+        # effective leader load per partition (base of the leader replica +
+        # the leader extra): a swap exchanges exactly these vectors between
+        # the two brokers, so violation-neutral draining pairs are the
+        # LOAD-MATCHED ones — similar effective load (nothing crosses a
+        # usage band), strictly smaller leader-bytes-in (the drain).
+        # Uniform partner sampling finds none of them in band-tight states.
+        E = np.asarray(jax.device_get(
+            dt.replica_base_load[jnp.asarray(lo), :]
+            + dt.leader_extra))                              # [P, 4]
+        E_scale = np.abs(E).mean(axis=0) + 1e-30
+        En = E / E_scale
+        K = 32
+        sel_r1: List[int] = []
+        sel_r2: List[int] = []
+        used_e: set = set()
+        for v in np.flatnonzero(bad):
+            need = float(lbi_b[v] - lbi_up[v])
+            if need <= 0:
+                continue        # count/demoted bands: not LBI-sheddable
+            P_v = np.flatnonzero(led_broker == v)
+            if P_v.size == 0:
+                continue
+            heavy = P_v[np.argsort(-plbi[P_v], kind="stable")][:128]
+            r1_np = lo[heavy].astype(np.int64)
+            # partners are LEADER replicas (leadership travels with a
+            # moved replica — a follower partner would put +1 leader count
+            # on the counterparty, the band-top blocker) of partitions
+            # with the CLOSEST effective load and smaller lbi
+            pool = np.flatnonzero(led_broker != v)
+            if pool.size > 50_000:
+                pool = rng.choice(pool, size=50_000, replace=False)
+            partners_q = np.zeros((r1_np.size, K), np.int64)
+            for j, p in enumerate(heavy):
+                lighter = pool[plbi[pool] < plbi[p]]
+                if lighter.size == 0:
+                    partners_q[j] = heavy[j]      # self: filtered by kernel
+                    continue
+                diffs = np.abs(En[lighter] - En[p]).sum(axis=1)
+                take = min(K, lighter.size)
+                best = lighter[np.argpartition(diffs, take - 1)[:take]]
+                partners_q[j, :take] = best
+                partners_q[j, take:] = best[0] if take else heavy[j]
+            r2_np = lo[partners_q]
+            off_v = bo[r2_np] != v
+            r1_flat = np.repeat(r1_np, K).astype(np.int32)
+            r2_flat = r2_np.reshape(-1).astype(np.int32)
+            N = r1_flat.size
+            pad = _bucket(N, 16384, floor=4096)
+            r1_pad = np.full(pad, r1_flat[0], np.int32)
+            r2_pad = np.full(pad, r2_flat[0], np.int32)
+            r1_pad[:N] = r1_flat
+            r2_pad[:N] = r2_flat
+            d = np.array(jax.device_get(_swap_deltas_pairs(
+                dt, th, weights, opts, st, initial_broker_of,
+                jnp.asarray(r1_pad), jnp.asarray(r2_pad),
+                "dense" if topic_on else "off")))
+            d[N:] = _INF
+            d[:N][~off_v.reshape(-1)] = _INF
+            D = d[:N].reshape(r1_np.size, K)
+            drains = plbi[heavy][:, None] - plbi[partners_q]  # [n1, K]
+            cum = 0.0
+            planned: List[Tuple[int, int]] = []
+            for j in range(r1_np.size):
+                if cum >= need:
+                    break
+                p = int(heavy[j])
+                if ("p", p) in used_e:
+                    continue
+                row = D[j]
+                # cascade pairs legitimately read as net +1 in the LBI
+                # tier mid-plan (v still over, x newly over, both weight
+                # 1) — allow exactly that one lowest-tier crossing; the
+                # next tier (LeaderReplicaDistribution, weight 16) stays
+                # excluded, and the cascade guard below bounds how much
+                # excess may move
+                ok_k = np.flatnonzero((row < 2.0 * float(OBJ.VIOL_SCALE))
+                                      & (drains[j] > 0))
+                # max drain first (fewest pairs to cover the excess),
+                # exact delta as the tiebreak
+                for k in sorted(ok_k.tolist(),
+                                key=lambda kk: (-drains[j][kk], row[kk])):
+                    q2 = int(partners_q[j, k])
+                    r2 = int(r2_np[j, k])
+                    x = int(bo[r2])
+                    dr = float(drains[j][k])
+                    # the pair delta is NET violation change — clearing v
+                    # while pushing x equally far over ITS cap nets to ~0
+                    # and passes the neutrality filter, which turns
+                    # iterated sheds into whack-a-mole around the ring.
+                    # Controlled cascade instead: x may take on NEW excess
+                    # only well below what v sheds, so cluster-wide excess
+                    # shrinks geometrically and the iterated rounds
+                    # (driver loop) converge — x's residual is a smaller
+                    # problem the next round solves.
+                    removed = min(dr, max(float(lbi_b[v] - lbi_up[v]),
+                                          0.0))
+                    new_x = (max(float(lbi_b[x]) + dr - float(lbi_up[x]),
+                                 0.0)
+                             - max(float(lbi_b[x] - lbi_up[x]), 0.0))
+                    if new_x > 0.7 * removed:
+                        continue
+                    keys = (("p", p), ("p", q2), ("b", x), ("h", hob[x]))
+                    if any(kk in used_e for kk in keys[1:]):
+                        continue
+                    used_e.update(keys)
+                    planned.append((int(lo[p]), r2))
+                    lbi_b[x] += dr
+                    lbi_b[v] -= dr
+                    cum += dr
+                    break
+            if _DEBUG:
+                print(f"[repair shed] v={v} need={need:.4g} "
+                      f"planned={len(planned)} cum={cum:.4g} "
+                      f"drain_max0={float(drains[0].max()):.4g}",
+                      flush=True)
+            # partial plans are accepted: the caller ITERATES shed_plan
+            # (fresh exact deltas + fresh claims each round, so one
+            # counterparty can absorb several small drains across rounds)
+            # and guards the whole sequence with an exact-energy snapshot
+            # compare — partial progress accumulates to the clear, and a
+            # grinding no-hope traverse gets reverted wholesale
+            for r1_i, r2_i in planned:
+                sel_r1.append(r1_i)
+                sel_r2.append(r2_i)
+        if not sel_r1:
+            return False
+        # bound one round's batch under the padded-apply cap (many
+        # violating brokers can each plan up to 128 pairs); the driver
+        # iterates shed rounds, so the overflow simply lands next round
+        max_pairs = cfg.max_lead_sources // 2
+        sel_r1 = sel_r1[:max_pairs]
+        sel_r2 = sel_r2[:max_pairs]
+        n_pairs = len(sel_r1)
+        b1 = bo[np.asarray(sel_r2)]          # r1 -> partner's broker
+        b2 = bo[np.asarray(sel_r1)]          # r2 -> v
+        r_all = np.concatenate([sel_r1, sel_r2]).astype(np.int32)
+        b_all = np.concatenate([b1, b2]).astype(np.int32)
+        napp = r_all.size
+        pad_a = _bucket(napp, cfg.max_lead_sources)
+        r_vec = np.full(pad_a, r_all[0], np.int32)
+        b_vec = np.full(pad_a, int(bo[r_all[0]]), np.int32)  # no-op pad
+        r_vec[:napp] = r_all
+        b_vec[:napp] = b_all
+        st = _apply_batch(dt, st, jnp.asarray(r_vec), jnp.asarray(b_vec),
+                          topic_on)
+        bo[r_all] = b_all
+        total_moves += n_pairs * 2
+        return True
+
+    def fused_descent():
+        """ONE-dispatch on-device leadership descent (plus outer backstop
+        dispatches, mirroring the moves phase). Invalidates the host
+        leader mirror."""
+        nonlocal st, total_leads, lo
+        blocked_np = np.zeros(P, bool)
+        if uphill_used:
+            blocked_np[list(uphill_used)] = True
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            blocked = jax.device_put(
+                blocked_np, NamedSharding(mesh, PartitionSpec()))
+        else:
+            blocked = jax.device_put(blocked_np)
+        for outer in range(cfg.max_rounds):
+            _t = time.time()
+            st, n_acc, converged, rounds = _fused_lead(
+                dt, th, weights, opts, st, lead_w, blocked,
+                jax.random.fold_in(base_key, 1000 + outer),
+                jnp.float32(cfg.min_improvement),
+                jnp.int32(cfg.lead_broker_budget),
+                cfg.lead_inner, cfg.max_lead_sources,
+                src_sharding=src_sharding, flag_sharding=flag_sharding)
+            n_acc = int(jax.device_get(n_acc))
+            converged = bool(jax.device_get(converged))
+            total_leads += n_acc
+            if n_acc:
+                lo = None
+            if _DEBUG:
+                print(f"[repair lead fused] outer={outer} accepted={n_acc} "
+                      f"rounds={int(jax.device_get(rounds))} "
+                      f"converged={converged} t={time.time()-_t:.2f}s",
+                      flush=True)
+            if converged or n_acc == 0:
+                break
+
+    def lead_viol_any() -> bool:
+        return bool(np.any(np.asarray(jax.device_get(
+            _lead_viol_vec(th, weights, st, lead_w))) > 0))
+
+    def lead_swap_round(allow_uphill: bool) -> str:
+        """Compound escape for the single-move leadership optimum: pair a
+        handoff OFF each violating leader broker v with a second handoff
+        that keeps the counterparty NET-neutral — either q returning to v
+        (pure swap: both count- and net-load-neutral on v and w) or q
+        relayed to a third broker u (w sheds to make headroom). Measured
+        on the stubborn LinkedIn seed: leader COUNTS sit at the band top
+        everywhere, so every single handoff AND every relay is +1 count
+        violation somewhere; only v-return pairs are count-neutral, and
+        the best is slightly cost-positive — which is exactly what the
+        ``allow_uphill`` mode accepts (one violation-neutral least-bad
+        pair under the excursion snapshot, like ``lead_round``'s single
+        uphill). Returns 'clean' | 'accepted' | 'uphill' | 'stuck'."""
+        nonlocal st, bo, lo, reps_np, total_leads, snap, uphill_left
+        lv = np.asarray(jax.device_get(_lead_viol_vec(th, weights, st,
+                                                      lead_w)))
+        bad = lv > 0
+        if not bad.any():
+            return "clean"
+        if bo is None:
+            bo = np.array(jax.device_get(st.broker_of))
+            reps_np = np.asarray(jax.device_get(dt.replicas_of_partition))
+        if lo is None:
+            lo = np.array(jax.device_get(st.leader_of))
+        led_broker = bo[lo]                          # [P]
+        mb = bo[np.maximum(reps_np, 0)]              # [P, m]
+        valid = reps_np >= 0
+        p_l: List[int] = []
+        sp_l: List[int] = []
+        q_l: List[int] = []
+        sq_l: List[int] = []
+        led_cache: dict = {}
+
+        def _led_by(w_b: int):
+            if w_b not in led_cache:
+                led_cache[w_b] = np.flatnonzero(led_broker == w_b)
+            return led_cache[w_b]
+
+        for v in np.flatnonzero(bad):
+            P_v = np.flatnonzero(led_broker == v)
+            if P_v.size == 0:
+                continue
+            if P_v.size > 256:
+                P_v = rng.choice(P_v, size=256, replace=False)
+            # partitions led elsewhere holding a replica on v: the
+            # v-return counterparties (count-neutral pairs) — include ALL
+            # of them, they are the only escapes when counts band-top
+            vret = set(np.flatnonzero(((mb == v) & valid).any(axis=1)
+                                      & (led_broker != v)).tolist())
+            vr_cache: dict = {}
+            for p in P_v:
+                for s in range(m):
+                    if not valid[p, s]:
+                        continue
+                    w_b = int(mb[p, s])
+                    if w_b == v:
+                        continue
+                    # counterparties: partitions q led by w — w sheds q's
+                    # leadership (back to v: pure swap; to a third broker
+                    # u: relay) to make headroom for taking p's
+                    qs = _led_by(w_b)
+                    if qs.size == 0:
+                        continue
+                    vr = vr_cache.get(w_b)
+                    if vr is None:
+                        vr = [int(q) for q in qs if int(q) in vret]
+                        vr_cache[w_b] = vr
+                    extra = (qs if qs.size <= 6
+                             else rng.choice(qs, size=6, replace=False))
+                    for q in {*vr, *(int(x) for x in extra)}:
+                        for sq in range(m):
+                            if not valid[q, sq] or int(mb[q, sq]) == w_b:
+                                continue
+                            p_l.append(int(p))
+                            sp_l.append(s)
+                            q_l.append(q)
+                            sq_l.append(sq)
+        if not p_l:
+            return "stuck"
+        N = len(p_l)
+        pad = _bucket(N, 8192, floor=1024)
+        if N > pad:       # candidate explosion: sample down to the cap
+            keep = rng.choice(N, size=pad, replace=False)
+            p_l = [p_l[i] for i in keep]
+            sp_l = [sp_l[i] for i in keep]
+            q_l = [q_l[i] for i in keep]
+            sq_l = [sq_l[i] for i in keep]
+            N = pad
+        pa = np.full(pad, p_l[0], np.int32)
+        spa = np.full(pad, sp_l[0], np.int32)
+        qa = np.full(pad, q_l[0], np.int32)
+        sqa = np.full(pad, sq_l[0], np.int32)
+        pa[:N], spa[:N], qa[:N], sqa[:N] = p_l, sp_l, q_l, sq_l
+        d = np.array(jax.device_get(_lead_swap_deltas_batch(
+            dt, th, weights, opts, st, jnp.asarray(pa), jnp.asarray(spa),
+            jnp.asarray(qa), jnp.asarray(sqa))))
+        d[N:] = _INF
+        order = np.argsort(d, kind="stable")
+        used_b: set = set()
+        used_p: set = set()
+        acc_p: List[int] = []
+        acc_l: List[int] = []
+        for i in order:
+            if not (d[i] < -cfg.min_improvement):
+                break
+            p, s, q, sq = int(pa[i]), int(spa[i]), int(qa[i]), int(sqa[i])
+            n1 = int(reps_np[p, s])
+            n2 = int(reps_np[q, sq])
+            brokers = {int(bo[lo[p]]), int(bo[n1]),
+                       int(bo[lo[q]]), int(bo[n2])}
+            if (p in used_p or q in used_p or p in uphill_used
+                    or q in uphill_used or used_b & brokers):
+                continue
+            used_p.update((p, q))
+            used_b.update(brokers)
+            acc_p.extend((p, q))
+            acc_l.extend((n1, n2))
+        if _DEBUG:
+            print(f"[repair lead swap] pairs={N} "
+                  f"best={float(np.min(d)):.4g} accepted={len(acc_p)//2}",
+                  flush=True)
+        took_uphill = False
+        if not acc_p and allow_uphill and uphill_left > 0:
+            # no improving pair: ONE violation-neutral least-bad pair off
+            # a violating leader broker, under the excursion snapshot
+            for i in order:
+                d_i = float(d[i])
+                if not (d_i < UPHILL_CAP):
+                    break
+                p, s, q, sq = (int(pa[i]), int(spa[i]), int(qa[i]),
+                               int(sqa[i]))
+                if (p in uphill_used or q in uphill_used
+                        or not bad[bo[lo[p]]]):
+                    continue
+                if snap is None:
+                    snap = ({k: getattr(st, k) + 0 for k in _LEAD_LEAVES},
+                            lo.copy(), total_leads)
+                acc_p.extend((p, q))
+                acc_l.extend((int(reps_np[p, s]), int(reps_np[q, sq])))
+                uphill_used.update((p, q))
+                uphill_left -= 1
+                took_uphill = True
+                if _DEBUG:
+                    print(f"[repair lead swap] uphill p={p} q={q} "
+                          f"delta={d_i:.4g}", flush=True)
+                break
+        if not acc_p:
+            return "stuck"
+        napp = len(acc_p)
+        pad_a = _bucket(napp, cfg.max_lead_sources)
+        p_arr = np.full(pad_a, acc_p[0], np.int32)
+        l_arr = np.full(pad_a, int(lo[acc_p[0]]), np.int32)  # no-op pad
+        p_arr[:napp] = acc_p
+        l_arr[:napp] = acc_l
+        st = _apply_leads_batch(dt, st, jnp.asarray(p_arr),
+                                jnp.asarray(l_arr))
+        lo[np.asarray(acc_p)] = acc_l
+        total_leads += napp
+        return "uphill" if took_uphill else "accepted"
+
+    # main descent runs ON DEVICE: one fused dispatch replaces the ~0.5 s/
+    # round host loop; the host round afterwards is the convergence checker
+    # and the uphill stepper. The common converged case (no leadership
+    # violations at all) pays only the [B]-sized gate check. When the
+    # single-move descent parks with violations left, the compound
+    # swap round engages before any uphill wandering.
+    status = "clean"
+    for _ in range(cfg.max_rounds + 4):
+        if not lead_viol_any():
+            status = "clean"
+            break
+        fused_descent()
         status = lead_round(False)
+        if status == "clean":
+            break
+        if status == "stuck":
+            sw = lead_swap_round(False)
+            if sw != "accepted":
+                break
+            status = "swap"      # applied compound pairs; loop redescends
+    # settle to clean/stuck if the loop exhausted mid-progress, so the
+    # shed and uphill gates below stay reachable
+    for _ in range(cfg.max_rounds):
         if status in ("clean", "stuck"):
             break
+        status = lead_round(False)
+    if status == "stuck":
+        # deterministic shed plan (default-on): traverse the plateau in
+        # one planned batch, mop up with both descent engines, keep only
+        # if the EXACT energy says the state ended lexicographically
+        # better (violation channel first) — so this can never regress
+        e_before = _exact_energy()
+        snap_st = jax.tree.map(lambda x: x + 0, st)
+        snap_mirror = (None if bo is None else bo.copy(),
+                       None if lo is None else lo.copy())
+        snap_counts = (total_moves, total_leads)
+        progressed = False
+        # outer passes: the mop-up descent may legitimately trade a
+        # higher-tier residual (left by intra-batch drift of the shed
+        # cascade) back into a +1 LBI — which is simply a smaller shed
+        # problem for the next pass
+        for _pass in range(3):
+            shed_any = False
+            for _i_shed in range(16):
+                if not shed_plan():
+                    break
+                shed_any = progressed = True
+                if not lead_viol_any():
+                    break
+            if not shed_any:
+                break
+            moves_descent(key_offset=100 * (_pass + 1))
+            bo = None            # moves moved replicas: mirror stale
+            fused_descent()
+            if _DEBUG:
+                print(f"[repair shed] pass={_pass} post-mopup "
+                      f"lead_viol={lead_viol_any()}", flush=True)
+            if not lead_viol_any():
+                break
+        if progressed:
+            # settle to clean/stuck: a single host round can return
+            # "accepted" with violations left, which would skip the
+            # opt-in uphill block below
+            for _ in range(cfg.max_rounds):
+                status = lead_round(False)
+                if status in ("clean", "stuck"):
+                    break
+            e_after = _exact_energy()
+            if (e_after[0], e_after[1]) < (e_before[0],
+                                           e_before[1]
+                                           - cfg.min_improvement):
+                if _DEBUG:
+                    print(f"[repair shed] kept ({e_before} -> {e_after})",
+                          flush=True)
+            else:
+                st = snap_st
+                bo, lo = snap_mirror
+                total_moves, total_leads = snap_counts
+                status = "stuck"
+                if _DEBUG:
+                    print(f"[repair shed] reverted "
+                          f"({e_before} vs {e_after})", flush=True)
     if status == "stuck" and cfg.lead_uphill_steps > 0:
         # genuinely converged with violations left: guarded uphill
-        # excursions (each uphill step gets a fresh descent; the whole
-        # excursion is snapshot-compared at the end, so it cannot regress)
+        # excursions — violation-neutral SWAP pairs first (count-neutral
+        # by construction), then single handoffs; each step redescends via
+        # the FUSED kernel (~2 dispatches per step instead of ~20 host
+        # rounds); the whole excursion is snapshot-compared at the end, so
+        # it cannot regress
         for _ in range(cfg.max_rounds + 2 * cfg.lead_uphill_steps):
-            status = lead_round(True)
-            if status in ("clean", "stuck"):
+            status = lead_round(False)
+            if status == "clean":
                 break
+            if status == "accepted":
+                continue
+            sw = lead_swap_round(True)
+            if sw in ("accepted", "uphill"):
+                fused_descent()
+                continue
+            if sw == "clean":
+                status = "clean"
+                break
+            status = lead_round(True)
+            if status == "uphill":
+                fused_descent()
+                continue
+            break
         if snap is not None:
             # end comparison with the exact evaluator: keep the excursion
             # only if lexicographically better than the pre-uphill snapshot

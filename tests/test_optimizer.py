@@ -487,3 +487,141 @@ def test_lead_uphill_never_regresses(seed):
     a1, _, _ = REP.repair(dt, assign, th, w, opts, topo.num_topics,
                           config=up_cfg, seed=seed)
     assert quality(a1) <= quality(a0), (quality(a1), quality(a0))
+
+
+def test_lead_swap_delta_matches_full_eval():
+    """The compound leadership-pair kernel must agree with the full
+    evaluator on the exact two-channel delta of applying BOTH handoffs
+    (pairs share brokers, so singles' deltas are NOT additive — the union
+    evaluation is the point). The state is preconditioned with a repair
+    pass first: on a raw unoptimized state a broker carrying a 2^32-tier
+    violation absorbs a +16-tier crossing inside broker_cost's f32 sum
+    (the SAME precision model every delta kernel shares) — the kernels'
+    operating regime is the post-descent state where high tiers are
+    clear. Channels are compared separately in f64."""
+    import jax
+    import jax.numpy as jnp
+    from cruise_control_tpu.analyzer import objective as OBJ
+    from cruise_control_tpu.analyzer import repair as REP
+    from cruise_control_tpu.common.resources import BalancingConstraint
+    from cruise_control_tpu.models.cluster import Assignment
+    from cruise_control_tpu.ops.aggregates import compute_aggregates
+
+    topo, assign = fixtures.synthetic_cluster(
+        num_brokers=12, num_replicas=300, num_racks=3, num_topics=10,
+        seed=5)
+    dt = device_topology(topo)
+    agg = compute_aggregates(dt, assign, topo.num_topics)
+    th = G.compute_thresholds(dt, BalancingConstraint(), agg)
+    w = OBJ.build_weights(G.DEFAULT_GOALS)
+    opts = G.default_options(topo)
+    init = jnp.asarray(assign.broker_of, jnp.int32)
+    assign, _, _ = REP.repair(dt, assign, th, w, opts, topo.num_topics,
+                              initial_broker_of=init, seed=5)
+    st = REP._chain_state(dt, assign, topo.num_topics, True)
+    reps = np.asarray(jax.device_get(dt.replicas_of_partition))
+    lo = np.asarray(jax.device_get(st.leader_of))
+    # ONE compiled program for all pairs: calling the kernel eagerly
+    # compiles hundreds of tiny programs and pushes the suite over the
+    # XLA CPU backend's cumulative-JIT segfault threshold (conftest)
+    swap_delta = jax.jit(lambda p, sp, q, sq: REP._lead_swap_delta(
+        dt, th, w, opts, st, p, sp, q, sq))
+
+    def channels(leader_of):
+        a2 = Assignment(broker_of=np.asarray(assign.broker_of),
+                        leader_of=np.asarray(leader_of))
+        ev = OBJ.evaluate_objective(dt, a2, th, w, G.DEFAULT_GOALS,
+                                    topo.num_topics, init)
+        v = np.asarray(jax.device_get(ev.penalties.violations), np.float64)
+        c = np.asarray(jax.device_get(ev.penalties.cost), np.float64)
+        wv = np.asarray(jax.device_get(w.per_goal_viol), np.float64)
+        wc = np.asarray(jax.device_get(w.per_goal), np.float64)
+        return float((v * wv).sum()), float((c * wc).sum())
+
+    rng = np.random.default_rng(0)
+    P, m = reps.shape
+    checked = 0
+    for _ in range(500):
+        p, q = rng.integers(0, P, 2)
+        sp, sq = rng.integers(0, m, 2)
+        n1, n2 = reps[p, sp], reps[q, sq]
+        if p == q or n1 < 0 or n2 < 0 or n1 == lo[p] or n2 == lo[q]:
+            continue
+        d = float(jax.device_get(swap_delta(
+            jnp.int32(p), jnp.int32(sp), jnp.int32(q), jnp.int32(sq))))
+        if d >= REP._INF * 0.5:
+            continue
+        lo2 = lo.copy()
+        lo2[p] = n1
+        lo2[q] = n2
+        v0, c0 = channels(lo)
+        v1, c1 = channels(lo2)
+        exact = (v1 - v0) * OBJ.VIOL_SCALE + (c1 - c0)
+        assert np.isclose(d, exact, rtol=1e-3, atol=5e-2), (
+            p, sp, q, sq, d, exact)
+        checked += 1
+        if checked >= 40:
+            break
+    assert checked >= 20  # enough legal pairs actually compared
+    jax.clear_caches()    # bound cumulative JIT code (see conftest)
+
+
+def test_repair_clears_skewed_lbi_without_regression():
+    """The measured stuck shape, small: one broker's leader-bytes-in far
+    over its band (partitions it leads carry inflated LBI) while every
+    other load axis stays balanced. The repair engine — singles, compound
+    lead swaps, or the shed plan, whichever the state admits — must end
+    with the LBI violation cleared and the exact weighted objective never
+    worse than the input."""
+    import dataclasses as dc
+
+    import jax
+    import jax.numpy as jnp
+    from cruise_control_tpu.analyzer import objective as OBJ
+    from cruise_control_tpu.analyzer import repair as REP
+    from cruise_control_tpu.common.resources import BalancingConstraint
+    from cruise_control_tpu.ops.aggregates import compute_aggregates
+
+    topo, assign = fixtures.synthetic_cluster(
+        num_brokers=10, num_replicas=400, num_racks=5, num_topics=8,
+        seed=11)
+    lo = np.asarray(assign.leader_of)
+    bo = np.asarray(assign.broker_of)
+    lbi = np.asarray(topo.leader_bytes_in).copy()
+    led_by_0 = bo[lo] == 0
+    # x2: far enough over the band to violate, while each partition's lbi
+    # stays well inside other brokers' band headroom (x6 made single
+    # partitions bigger than ANY broker's headroom — unclearable by swaps)
+    lbi[led_by_0] *= 2.0
+    topo = dc.replace(topo, leader_bytes_in=lbi)
+    dt = device_topology(topo)
+    agg = compute_aggregates(dt, assign, topo.num_topics)
+    th = G.compute_thresholds(dt, BalancingConstraint(), agg)
+    w = OBJ.build_weights(G.DEFAULT_GOALS)
+    opts = G.default_options(topo)
+    init = jnp.asarray(assign.broker_of, jnp.int32)
+
+    def quality(a):
+        ev = OBJ.evaluate_objective(
+            dt, a, th, w, G.DEFAULT_GOALS, topo.num_topics, init,
+            compute_aggregates(dt, a, topo.num_topics))
+        v = np.asarray(jax.device_get(ev.value), np.float64)
+        return (float(v[0]), float(v[1]))
+
+    def lbi_violations(a):
+        bt_idx = G.BROKER_TERM_GOALS.index("LeaderBytesInDistributionGoal")
+        agg2 = compute_aggregates(dt, a, 1)
+        bt = G.broker_terms(th, agg2.broker_load,
+                            agg2.replica_count.astype(np.float32),
+                            agg2.leader_count.astype(np.float32),
+                            agg2.potential_nw_out, agg2.leader_bytes_in)
+        return float(np.asarray(
+            jax.device_get(bt.violations))[:, bt_idx].sum())
+
+    assert lbi_violations(assign) > 0     # the skew actually violates
+    q0 = quality(assign)
+    out, _, _ = REP.repair(dt, assign, th, w, opts, topo.num_topics,
+                           seed=11)
+    assert lbi_violations(out) == 0
+    assert quality(out) <= q0
+    jax.clear_caches()    # bound cumulative JIT code (see conftest)

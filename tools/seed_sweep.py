@@ -30,6 +30,10 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--seeds", type=int, default=10)
     ap.add_argument("--out", default="")
+    ap.add_argument("--uphill", type=int, default=0,
+                    help="lead_uphill_steps for the repair passes")
+    ap.add_argument("--polish", type=int, default=-1,
+                    help="override polish cycle count (-1 = default)")
     args = ap.parse_args()
 
     import jax
@@ -42,6 +46,13 @@ def main():
 
     cfg = AN.AnnealConfig(num_chains=16, steps=256, swap_interval=64,
                           tries_move=384, tries_lead=64, tries_swap=192)
+    opt_kwargs = {}
+    if args.uphill:
+        from cruise_control_tpu.analyzer.repair import RepairConfig
+        opt_kwargs["repair_config"] = RepairConfig(
+            lead_uphill_steps=args.uphill)
+    if args.polish >= 0:
+        opt_kwargs["polish_cycles"] = args.polish
     rows = []
     for seed in range(args.seeds):
         topo, assign = fixtures.synthetic_cluster(
@@ -49,7 +60,7 @@ def main():
             num_topics=30_000, seed=seed)
         t0 = time.time()
         r = OPT.optimize(topo, assign, engine="anneal", anneal_config=cfg,
-                         seed=seed)
+                         seed=seed, **opt_kwargs)
         hard_after = [s.name for s in r.goal_summaries
                       if s.hard and s.violated_after]
         row = {
