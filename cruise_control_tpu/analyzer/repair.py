@@ -48,6 +48,12 @@ from cruise_control_tpu.ops.aggregates import DeviceTopology, compute_aggregates
 
 _INF = float(np.float32(3.0e38))
 
+#: compound-escape scope: lead swaps / shed plans engage only when at most
+#: this many brokers violate the leadership terms — the machinery exists
+#: for the terminal 1-2-violation plateau, not for broadly-violating
+#: (often structurally-constrained) states
+_ESCAPE_MAX_BAD = 8
+
 
 @dataclasses.dataclass(frozen=True)
 class RepairConfig:
@@ -1050,6 +1056,8 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
         bad = lv > 0
         if not bad.any():
             return False
+        if int(bad.sum()) > _ESCAPE_MAX_BAD:
+            return False    # plateau machinery only (see lead_swap_round)
         lbi_b = np.array(jax.device_get(st.leader_bytes_in))
         lbi_up = np.broadcast_to(
             np.asarray(jax.device_get(th.lbi_upper)), lbi_b.shape)
@@ -1348,23 +1356,34 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
             jnp.asarray(qa), jnp.asarray(sqa))))
         d[N:] = _INF
         order = np.argsort(d, kind="stable")
+        hob_sw = np.asarray(jax.device_get(dt.host_of_broker))
         used_b: set = set()
         used_p: set = set()
         acc_p: List[int] = []
         acc_l: List[int] = []
+
+        def _claim_set(p, q):
+            """All MEMBER brokers of both partitions plus their hosts: a
+            lead handoff scatters potential_nw_out onto every member
+            broker (AN._apply_leads), so two same-batch pairs sharing
+            even a follower broker are not additive through the PNW band
+            term — same rationale as _fused_lead's member claims."""
+            bs = {int(bo[r]) for r in reps_np[p] if r >= 0}
+            bs |= {int(bo[r]) for r in reps_np[q] if r >= 0}
+            return bs | {("h", int(hob_sw[b])) for b in bs}
+
         for i in order:
             if not (d[i] < -cfg.min_improvement):
                 break
             p, s, q, sq = int(pa[i]), int(spa[i]), int(qa[i]), int(sqa[i])
             n1 = int(reps_np[p, s])
             n2 = int(reps_np[q, sq])
-            brokers = {int(bo[lo[p]]), int(bo[n1]),
-                       int(bo[lo[q]]), int(bo[n2])}
+            claims = _claim_set(p, q)
             if (p in used_p or q in used_p or p in uphill_used
-                    or q in uphill_used or used_b & brokers):
+                    or q in uphill_used or used_b & claims):
                 continue
             used_p.update((p, q))
-            used_b.update(brokers)
+            used_b.update(claims)
             acc_p.extend((p, q))
             acc_l.extend((n1, n2))
         if _DEBUG:
@@ -1437,6 +1456,14 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
             break
         status = lead_round(False)
     if status == "stuck":
+        lv_gate = np.asarray(jax.device_get(_lead_viol_vec(
+            th, weights, st, lead_w)))
+        if not (0 < int((lv_gate > 0).sum()) <= _ESCAPE_MAX_BAD):
+            status = "stuck"     # out of plateau scope: skip the shed
+        else:
+            status = "shed"
+    if status == "shed":
+        status = "stuck"
         # deterministic shed plan (default-on): traverse the plateau in
         # one planned batch, mop up with both descent engines, keep only
         # if the EXACT energy says the state ended lexicographically
