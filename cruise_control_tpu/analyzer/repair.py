@@ -48,11 +48,6 @@ from cruise_control_tpu.ops.aggregates import DeviceTopology, compute_aggregates
 
 _INF = float(np.float32(3.0e38))
 
-#: compound-escape scope: lead swaps / shed plans engage only when at most
-#: this many brokers violate the leadership terms — the machinery exists
-#: for the terminal 1-2-violation plateau, not for broadly-violating
-#: (often structurally-constrained) states
-_ESCAPE_MAX_BAD = 8
 
 
 @dataclasses.dataclass(frozen=True)
@@ -79,6 +74,12 @@ class RepairConfig:
     lead_broker_budget: int = 8
     #: inner rounds of the fused on-device leadership descent per dispatch
     lead_inner: int = 256
+    #: compound-escape scope: lead swaps / shed plans engage only when at
+    #: most this many brokers violate the leadership terms — the machinery
+    #: exists for the terminal 1-2-violation plateau, not for broadly-
+    #: violating (often structurally-constrained) states like a
+    #: destination-constrained add_broker request
+    escape_max_bad_brokers: int = 8
     #: one-step-uphill escapes in the lead phase: when NO single leadership
     #: move improves but lead-band violations remain (a cross-term local
     #: optimum — e.g. every count-fixing handoff worsens bytes-in more),
@@ -1056,7 +1057,7 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
         bad = lv > 0
         if not bad.any():
             return False
-        if int(bad.sum()) > _ESCAPE_MAX_BAD:
+        if int(bad.sum()) > cfg.escape_max_bad_brokers:
             return False    # plateau machinery only (see lead_swap_round)
         lbi_b = np.array(jax.device_get(st.leader_bytes_in))
         lbi_up = np.broadcast_to(
@@ -1458,7 +1459,8 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
     if status == "stuck":
         lv_gate = np.asarray(jax.device_get(_lead_viol_vec(
             th, weights, st, lead_w)))
-        if not (0 < int((lv_gate > 0).sum()) <= _ESCAPE_MAX_BAD):
+        if not (0 < int((lv_gate > 0).sum())
+                <= cfg.escape_max_bad_brokers):
             status = "stuck"     # out of plateau scope: skip the shed
         else:
             status = "shed"
