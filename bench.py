@@ -150,7 +150,10 @@ def main():
         "soft_cost_after": round(sum(s.cost_after
                                      for s in r.goal_summaries
                                      if not s.hard), 3),
-        "device": str(jax.devices()[0].platform),
+        # the device the optimization ACTUALLY ran on — tiny models fall
+        # back to the host CPU backend (optimizer.TINY_CPU_LIMIT): every
+        # chunked dispatch otherwise pays remote-TPU tunnel latency
+        "device": r.device,
     }
     if model_build_s is not None:
         out["model_build_s"] = model_build_s
