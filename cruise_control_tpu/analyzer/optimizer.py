@@ -251,6 +251,56 @@ def _balancedness(goal_names, violations) -> float:
 TINY_CPU_LIMIT = 50_000
 
 
+def _routes_to_tiny_cpu(topo, mesh, options) -> bool:
+    """True when optimize() will run this model on the host CPU backend
+    (tiny model, no mesh/custom options, accelerator default backend) —
+    the ONE definition warm_kernels and optimize() share, so the warm can
+    never target a different backend than the run."""
+    return (mesh is None and options is None
+            and topo.num_replicas * topo.num_brokers <= TINY_CPU_LIMIT
+            and jax.default_backend() != "cpu")
+
+
+def warm_kernels(topo: ClusterTopology, assign: Assignment,
+                 goal_names: Optional[Sequence[str]] = None,
+                 constraint: Optional[BalancingConstraint] = None,
+                 options=None, repair_config=None, mesh=None) -> None:
+    """Warm the rarely-engaged escape kernels at this model's shapes.
+
+    ``optimize()`` warms its own common path on the first call, but the
+    topic-band escape and the fused leadership descent only dispatch when a
+    residual violation appears — a state-dependent event — so their first
+    engaged use would otherwise pay a multi-second compile/cache-load
+    mid-request. A service calls this once after its first model build;
+    bench.py calls it between the compile pass and the timed run (the
+    declared steady-state methodology). Pass the SAME ``repair_config`` /
+    ``mesh`` the optimize() calls will use — the escape kernels' static
+    shapes and sharded variants follow them. See
+    repair.warm_escape_kernels."""
+    if _routes_to_tiny_cpu(topo, mesh, options):
+        # optimize() routes this model onto the host CPU backend, where
+        # compiles are local and fast — warming the remote-TPU variants
+        # would cost wall time and leave the CPU path cold anyway. A small
+        # topo with custom options or a mesh runs optimize on the
+        # accelerator path and DOES want the warm.
+        return
+    from cruise_control_tpu.analyzer import repair as REP
+    from cruise_control_tpu.ops.aggregates import topic_totals
+    goal_names = tuple(goal_names or G.DEFAULT_GOALS)
+    constraint = constraint or BalancingConstraint()
+    opts = options if options is not None else G.default_options(topo)
+    dt = device_topology(topo)
+    num_topics = topo.num_topics
+    sparse_topic = topo.num_brokers * num_topics > TOPIC_DENSE_LIMIT
+    agg0 = compute_aggregates(dt, assign, 1 if sparse_topic else num_topics)
+    th = G.compute_thresholds(
+        dt, constraint, agg0,
+        topic_total=topic_totals(dt, num_topics) if sparse_topic else None)
+    weights = OBJ.build_weights(goal_names)
+    REP.warm_escape_kernels(dt, assign, th, weights, opts, num_topics,
+                            config=repair_config, mesh=mesh)
+
+
 def optimize(topo: ClusterTopology, assign: Assignment,
              goal_names: Sequence[str] = G.DEFAULT_GOALS,
              constraint: Optional[BalancingConstraint] = None,
@@ -265,9 +315,7 @@ def optimize(topo: ClusterTopology, assign: Assignment,
     hard-violation backstop always runs with its own defaults).
     ``polish_cycles``: max anneal-restart+repair cycles when violations
     remain after the main repair (0 disables)."""
-    if (mesh is None and options is None
-            and topo.num_replicas * topo.num_brokers <= TINY_CPU_LIMIT
-            and jax.default_backend() != "cpu"):
+    if _routes_to_tiny_cpu(topo, mesh, options):
         try:
             cpu0 = jax.devices("cpu")[0]
         except RuntimeError:
@@ -433,6 +481,36 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
                 if float(np.asarray(after.penalties.violations).sum()) == 0:
                     break
             _mark("polish cycles")
+            if (polish_cycles > 0
+                    and float(np.asarray(
+                        after.penalties.violations).sum()) > 0):
+                # basin restart, the LAST rung: a parked residual can be a
+                # multi-cycle rotation plateau (e.g. a leader-COUNT band
+                # where every receiving broker would cross its own band and
+                # no 2-swap is count-neutral — clearing needs ≥3-cycles).
+                # Polish restarts FROM the parked state stay in that basin;
+                # a full re-anneal from the ORIGINAL assignment with a
+                # shifted seed lands in a different one, and the
+                # lexicographic keep-if-better makes it free of regression
+                # risk. Engages only on the residual-violation tail (the
+                # 10-seed sweep: 1 seed), costing one extra pipeline there.
+                report_progress("Basin restart")
+                ares3 = AN.optimize_anneal(
+                    dt, assign, th, weights, opts, num_topics,
+                    config=anneal_config, seed=seed + 104729,
+                    goal_names=goal_names, initial_broker_of=init_broker,
+                    mesh=mesh)
+                cand, _, _ = REP.repair(
+                    dt, ares3.assignment, th, weights, opts, num_topics,
+                    initial_broker_of=init_broker, seed=seed + 104729,
+                    mesh=mesh, config=repair_config)
+                agg_cand = _agg(cand)
+                cand_after = OBJ.evaluate_objective(
+                    dt, cand, th, weights, goal_names, num_topics,
+                    init_broker, agg_cand, sparse_topic=sparse_topic)
+                if _rank(cand_after) < _rank(after):
+                    final, after, agg_after = cand, cand_after, agg_cand
+                _mark("basin restart")
 
         # hard-goal backstop: if violations remain after repair, finish
         # deterministically. Small models get the greedy polish; at scale

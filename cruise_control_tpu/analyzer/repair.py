@@ -65,6 +65,30 @@ class RepairConfig:
     fused_sources: int = 1024
     #: swap partners sampled per stuck source replica
     swap_partners: int = 12
+    #: claim+apply passes per inner round over the SAME candidate matrices:
+    #: pass k re-argmins with pass <k's claimed brokers/partitions/hosts
+    #: masked, so the round's matching grows while every winner stays
+    #: pairwise disjoint (deltas exactly additive). Rounds-to-converge was
+    #: bounded by the one-accept-per-broker serialization, and the candidate
+    #: matrices are the dominant per-round cost.
+    claim_rounds: int = 4
+    #: sub-rounds only pay off where the one-accept-per-broker bound BINDS:
+    #: at LinkedIn scale (2.6K brokers) they cut rounds 71 → 39, but at a
+    #: few hundred brokers accepts/round is availability-bound (~1) and the
+    #: extra argmin+apply passes are pure per-round cost — below this
+    #: broker count the kernel runs a single claim pass
+    claim_rounds_min_brokers: int = 1024
+    #: targeted topic-band escape (host rounds after the moves descent):
+    #: when the descent converges with a topic band cell still violated,
+    #: every single t-replica move crosses a usage band and the uniform
+    #: random swap partners essentially never land on the load-matched
+    #: counterparty — the deterministic round enumerates exactly those
+    #: pairs and accepts by exact delta (see ``topic_swap_round``)
+    topic_swap_rounds: int = 4
+    #: load-matched partners evaluated per shedding replica
+    topic_swap_partners: int = 32
+    #: shedding replicas considered per violating cell per round
+    topic_swap_sources: int = 8
     #: leadership candidates per round
     max_lead_sources: int = 4096
     #: staleness bound, used two ways: accepts allowed per BROKER per
@@ -100,6 +124,13 @@ def _bucket(n: int, cap: int, floor: int = 512) -> int:
     single cap-sized shape made the (many) small tail rounds pay the full
     big-batch cost every round."""
     return floor if n <= floor else cap
+
+
+#: bucket shapes per batch family, shared by the call sites AND
+#: warm_escape_kernels (which must trace the very same shapes the engaged
+#: rounds dispatch — a drifted literal would warm a program nobody runs)
+_SWAP_PAIRS_FLOOR, _SWAP_PAIRS_CAP = 4096, 16384    # shed / topic pairs
+_LEAD_SWAP_FLOOR, _LEAD_SWAP_CAP = 1024, 8192       # compound lead pairs
 
 
 def _move_rows_impl(dt, th, w, opts, st, initial_broker_of, src_r,
@@ -369,6 +400,80 @@ def _swap_deltas_pairs(dt, th, w, opts, st, initial_broker_of, r1, r2,
         a, b)))(r1, r2)
 
 
+@jax.jit
+def _topic_viol_gate(th, st):
+    """Scalar (n_over, n_under) of the topic bands — pure reductions, no
+    index materialization: the common all-clear case pays one memory-bound
+    pass over [B, T], not a 78M-element nonzero scan."""
+    over = (st.topic_count > th.topic_upper[None, :]) & th.alive[:, None]
+    colmin = jnp.min(jnp.where(th.alive[:, None], st.topic_count,
+                               jnp.int32(2 ** 30)), axis=0)
+    under = (colmin < th.topic_lower) & (th.topic_lower > 0)
+    return jnp.sum(over.astype(jnp.int32)), jnp.sum(under.astype(jnp.int32))
+
+
+@jax.jit
+def _topic_viol_rows(th, st):
+    """Per-BROKER over-cell counts [B] + per-topic alive column minima [T]
+    — reductions only. Materializing the violating (broker, topic) cell
+    ids with a full [B·T] nonzero scan cost ~1.5 s at LinkedIn scale; the
+    row reduction is memory-bound, and the (few) violating brokers' rows
+    are then fetched individually."""
+    over = (st.topic_count > th.topic_upper[None, :]) & th.alive[:, None]
+    colmin = jnp.min(jnp.where(th.alive[:, None], st.topic_count,
+                               jnp.int32(2 ** 30)), axis=0)
+    return jnp.sum(over.astype(jnp.int32), axis=1), colmin
+
+
+@jax.jit
+def _topic_count_row(st, b):
+    return st.topic_count[b]
+
+
+@jax.jit
+def _norm_load(E):
+    """Per-resource normalized replica loads (the load-match metric)."""
+    return E / (jnp.mean(jnp.abs(E), axis=0, keepdims=True) + 1e-30)
+
+
+@jax.jit
+def _brokers_of(st, r):
+    return st.broker_of[r]
+
+
+@partial(jax.jit, static_argnames=("n_src", "k", "mode"))
+def _topic_pair_candidates(dt, th, st, movable, en, t, b,
+                           n_src: int, k: int, mode: str):
+    """Sources + load-matched partners for ONE violating topic-band cell,
+    entirely on device (the host round previously fetched the full [R]
+    broker/topic/load mirrors — ~11 MB over the TPU tunnel per repair).
+
+    ``mode="over"``: shed topic ``t`` off broker ``b`` — sources are t's
+    replicas on b (heaviest first), partners are OTHER-topic replicas on
+    brokers with t-headroom. ``mode="under"``: donate topic ``t`` onto the
+    brokers below t's lower band — sources are t's replicas on brokers
+    above the band, partners are replicas living on the under brokers.
+    Returns (src [n_src], partners [n_src, k], valid [n_src, k])."""
+    t_of_r = dt.topic_of_partition[dt.partition_of_replica]
+    cnt_t = st.topic_count[:, t]
+    bo = st.broker_of
+    if mode == "over":
+        src_mask = (t_of_r == t) & (bo == b) & movable
+        tgt_ok = (th.alive & (cnt_t < th.topic_upper[t])).at[b].set(False)
+    else:
+        src_mask = (t_of_r == t) & movable & (cnt_t[bo] > th.topic_lower[t])
+        tgt_ok = th.alive & (cnt_t < th.topic_lower[t])
+    load = jnp.sum(jnp.abs(en), axis=1)
+    _, src = jax.lax.top_k(jnp.where(src_mask, load, -jnp.inf), n_src)
+    src_valid = src_mask[src]
+    pool_ok = tgt_ok[bo] & (t_of_r != t) & movable
+    dist = jnp.sum(jnp.abs(en[src][:, None, :] - en[None, :, :]), axis=-1)
+    dist = jnp.where(pool_ok[None, :], dist, jnp.inf)
+    neg, partners = jax.lax.top_k(-dist, k)
+    valid = src_valid[:, None] & jnp.isfinite(neg)
+    return src, partners, valid
+
+
 def _lead_viol_expr(th, w, st, lead_w):
     """f32[B] weighted leadership-term violations — the convergence
     contract shared by the fused kernel's candidate flag and the host
@@ -388,12 +493,13 @@ _lead_viol_vec = jax.jit(_lead_viol_expr)
 
 @partial(jax.jit,
          static_argnames=("use_topic", "check_under", "n_inner", "n_src",
-                          "k_swap", "src_sharding", "flag_sharding"),
+                          "k_swap", "n_claim", "src_sharding",
+                          "flag_sharding"),
          donate_argnums=(4,))
 def _fused_targeted(dt, th, w, opts, st, offline, initial_broker_of,
                     movable, movable_pool, key, min_improvement,
                     use_topic: bool, check_under: bool, n_inner: int,
-                    n_src: int, k_swap: int,
+                    n_src: int, k_swap: int, n_claim: int = 4,
                     src_sharding=None, flag_sharding=None):
     """Up to ``n_inner`` repair rounds fused into ONE device program.
 
@@ -504,8 +610,6 @@ def _fused_targeted(dt, th, w, opts, st, offline, initial_broker_of,
         u = jax.random.uniform(jax.random.fold_in(k, 3), dmv.shape,
                                minval=0.0, maxval=0.25)
         dmv_sel = jnp.where(dmv < 0, dmv * (1.0 - u), dmv)
-        mv_b = jnp.argmin(dmv_sel, axis=1)
-        mv_d = jnp.take_along_axis(dmv, mv_b[:, None], axis=1)[:, 0]
         # best swap per source over sampled partners
         r2 = _c(movable_pool[jax.random.randint(
             k, (n_src, k_swap), 0, movable_pool.shape[0])], src_sharding)
@@ -516,25 +620,31 @@ def _fused_targeted(dt, th, w, opts, st, offline, initial_broker_of,
                 jnp.full((1, 1), -1, jnp.int32), a_r, b_r)),
             in_axes=(None, 0)))(srcc, r2)                        # [n_src, k]
         dsw = _c(jnp.where(valid_src[:, None], dsw, AN._INF), src_sharding)
-        sw_j = jnp.argmin(dsw, axis=1)
-        sw_d = jnp.take_along_axis(dsw, sw_j[:, None], axis=1)[:, 0]
-        partner = jnp.take_along_axis(r2, sw_j[:, None], axis=1)[:, 0]
 
-        is_move = mv_d <= sw_d
-        act_d = jnp.minimum(mv_d, sw_d)
-        a_b = st.broker_of[srcc]
-        b_b = jnp.where(is_move, mv_b, st.broker_of[partner])
+        # ---- claim sub-rounds: the expensive candidate matrices (dmv, dsw)
+        # are computed ONCE per round, then up to n_claim claim+apply passes
+        # extend the matching over them. Every pass masks out the brokers/
+        # partitions/hosts already claimed this round, so ALL winners across
+        # the round's passes stay pairwise disjoint — the captured deltas
+        # remain exactly additive (same guarantee as the single pass), the
+        # matching just gets bigger: rounds-to-converge was bounded by the
+        # one-accept-per-broker serialization, not by candidate quality.
+        a_b0 = st.broker_of[srcc]
+        pb_all0 = st.broker_of[r2]          # [n_src, k] partner brokers
+        p_sw_all = part_of[r2]              # [n_src, k] partner partitions
         p_a = part_of[srcc]
-        p_b = jnp.where(is_move, p_a, part_of[partner])
-        # Exact two-pass claims: min delta per resource, then min INDEX among
-        # the delta-tied entries. A float index jitter would be absorbed by
-        # rounding at violation-channel magnitudes (~1e14), letting two tied
-        # actions on the same partition both "win" — whose double
-        # scatter-adds corrupt broker_of.
+        h_of_b = dt.host_of_broker
+        ha0 = h_of_b[a_b0]
         idx = jnp.arange(n_src, dtype=jnp.int32)
         big = jnp.int32(n_src + 1)
+        H = dt.num_hosts
 
-        def claim(targets_a, targets_b, size):
+        def claim(targets_a, targets_b, size, act_d):
+            # Exact two-pass claims: min delta per resource, then min INDEX
+            # among the delta-tied entries. A float index jitter would be
+            # absorbed by rounding at violation-channel magnitudes (~1e14),
+            # letting two tied actions on the same partition both "win" —
+            # whose double scatter-adds corrupt broker_of.
             m1 = (jnp.full((size,), jnp.inf)
                   .at[targets_a].min(act_d).at[targets_b].min(act_d))
             tied_a = m1[targets_a] == act_d
@@ -544,28 +654,76 @@ def _fused_targeted(dt, th, w, opts, st, offline, initial_broker_of,
                   .at[targets_b].min(jnp.where(tied_b, idx, big)))
             return (m2[targets_a] == idx) & (m2[targets_b] == idx)
 
-        ha2 = dt.host_of_broker[a_b]
-        hb2 = dt.host_of_broker[b_b]
-        win = (claim(a_b, b_b, B) & claim(p_a, p_b, P)
-               & claim(ha2, hb2, dt.num_hosts)
-               & (act_d < -min_improvement) & valid_src)
-        # apply: a move is (src -> b_b); a swap is two moves; losers no-op
-        mv_sel = win & is_move
-        sw_sel = win & ~is_move
-        dst1 = jnp.where(mv_sel, b_b,
-                         jnp.where(sw_sel, st.broker_of[partner], a_b))
-        dst2 = jnp.where(sw_sel, a_b, st.broker_of[partner])
-        # the WINNER vectors replicate (all-gather) before the apply: the
-        # state update must run identically on every device — a sharded
-        # scatter-add would reorder f32 accumulation, ULP-shifting the
-        # maintained aggregates and breaking sharded == unsharded parity
-        # (and re-sharding the carried state forces a recompile per outer
-        # round). Only the O(n_src·B) candidate evaluation shards.
-        all_r = _c(jnp.concatenate([srcc, partner]), repl_sharding)
-        all_b = _c(jnp.concatenate([dst1, dst2]), repl_sharding)
-        st = AN._apply_moves(dt, st, all_r, all_b, use_topic)
-        st = jax.tree.map(lambda x: _c(x, repl_sharding), st)
-        return st, jnp.sum(win.astype(jnp.int32))
+        def mark(mask, tgt_a, tgt_b, size, win):
+            # winners' resources become unavailable for later passes; the
+            # sentinel index `size` is out of bounds and therefore DROPPED
+            # by the scatter. set(True) is idempotent/commutative, so a
+            # sharded scatter stays order-independent (bitwise parity).
+            ia = _c(jnp.where(win, tgt_a, size), repl_sharding)
+            ib = _c(jnp.where(win, tgt_b, size), repl_sharding)
+            return _c(mask.at[ia].set(True).at[ib].set(True), repl_sharding)
+
+        def sub(_, carry):
+            st, b_used, p_used, h_used, src_done, tot = carry
+            row_ok = ((~src_done) & valid_src & ~b_used[a_b0]
+                      & ~p_used[p_a] & ~h_used[ha0])
+            col_ok = ~b_used & ~h_used[h_of_b]
+            dmv_m = jnp.where(row_ok[:, None] & col_ok[None, :], dmv_sel,
+                              AN._INF)
+            mv_b = jnp.argmin(dmv_m, axis=1)
+            sel_val = jnp.take_along_axis(dmv_m, mv_b[:, None], axis=1)[:, 0]
+            # selection runs on the jittered copy; the APPLIED delta is the
+            # exact dmv entry of the chosen action (masked picks stay INF)
+            mv_d = jnp.where(sel_val < 0.5 * AN._INF,
+                             jnp.take_along_axis(dmv, mv_b[:, None],
+                                                 axis=1)[:, 0], AN._INF)
+            ent_ok = (row_ok[:, None] & ~b_used[pb_all0] & ~p_used[p_sw_all]
+                      & ~h_used[h_of_b[pb_all0]])
+            dsw_m = jnp.where(ent_ok, dsw, AN._INF)
+            sw_j = jnp.argmin(dsw_m, axis=1)
+            sw_d = jnp.take_along_axis(dsw_m, sw_j[:, None], axis=1)[:, 0]
+            prt = jnp.take_along_axis(r2, sw_j[:, None], axis=1)[:, 0]
+
+            is_move = mv_d <= sw_d
+            act_d = jnp.minimum(mv_d, sw_d)
+            cur_a = st.broker_of[srcc]      # current broker: a no-op dst
+            cur_pb = st.broker_of[prt]      # for losers must not UNDO an
+            b_b = jnp.where(is_move, mv_b, cur_pb)  # earlier pass's move
+            p_b = jnp.where(is_move, p_a, part_of[prt])
+            ha2 = h_of_b[cur_a]
+            hb2 = h_of_b[b_b]
+            win = (claim(cur_a, b_b, B, act_d) & claim(p_a, p_b, P, act_d)
+                   & claim(ha2, hb2, H, act_d)
+                   & (act_d < -min_improvement) & valid_src)
+            # apply: a move is (src -> b_b); a swap is two moves; losers
+            # no-op. The WINNER vectors replicate (all-gather) before the
+            # apply: the state update must run identically on every device —
+            # a sharded scatter-add would reorder f32 accumulation,
+            # ULP-shifting the maintained aggregates and breaking
+            # sharded == unsharded parity (and re-sharding the carried state
+            # forces a recompile per outer round). Only the O(n_src·B)
+            # candidate evaluation shards.
+            mv_sel = win & is_move
+            sw_sel = win & ~is_move
+            dst1 = jnp.where(mv_sel, b_b, jnp.where(sw_sel, cur_pb, cur_a))
+            dst2 = jnp.where(sw_sel, cur_a, cur_pb)
+            all_r = _c(jnp.concatenate([srcc, prt]), repl_sharding)
+            all_b = _c(jnp.concatenate([dst1, dst2]), repl_sharding)
+            st = AN._apply_moves(dt, st, all_r, all_b, use_topic)
+            st = jax.tree.map(lambda x: _c(x, repl_sharding), st)
+            b_used = mark(b_used, cur_a, b_b, B, win)
+            p_used = mark(p_used, p_a, p_b, P, win)
+            h_used = mark(h_used, ha2, hb2, H, win)
+            src_done = src_done | win
+            return (st, b_used, p_used, h_used, src_done,
+                    tot + jnp.sum(win.astype(jnp.int32)))
+
+        init = (st, _c(jnp.zeros((B,), bool), repl_sharding),
+                _c(jnp.zeros((P,), bool), repl_sharding),
+                _c(jnp.zeros((H,), bool), repl_sharding),
+                jnp.zeros((n_src,), bool), jnp.int32(0))
+        st, _, _, _, _, acc = jax.lax.fori_loop(0, n_claim, sub, init)
+        return st, acc
 
     def body(carry):
         st, flag, i, zeros, total = carry
@@ -735,6 +893,104 @@ def _chain_state(dt, assign, num_topics: int,
                                track_topics)
 
 
+def _lead_weights() -> jax.Array:
+    """f32[NUM_BROKER_TERMS] selector of the leadership-sensitive broker
+    terms — the ONE definition both the repair lead phase and the warm
+    path trace with (a drift between them would warm a differently-traced
+    program than the one repair dispatches)."""
+    lead_terms = np.zeros(G.NUM_BROKER_TERMS, np.float32)
+    for g in ("LeaderReplicaDistributionGoal",
+              "LeaderBytesInDistributionGoal", "_DemotedLeadership"):
+        lead_terms[G.BROKER_TERM_GOALS.index(g)] = 1.0
+    return jax.device_put(lead_terms)
+
+
+def warm_escape_kernels(dt, assign, th, weights, opts, num_topics: int,
+                        config: Optional[RepairConfig] = None,
+                        mesh: Optional["jax.sharding.Mesh"] = None) -> None:
+    """Dispatch (compile / persistent-cache-load) the rarely-engaged escape
+    kernels at this model's shapes, so the first request that NEEDS one
+    runs steady-state instead of paying a multi-second load mid-request.
+
+    The common repair path (fused moves + lead gate) warms itself on any
+    first request; the topic-band escape and the fused leadership descent
+    only dispatch when a residual violation appears — a seed-/state-
+    dependent event — so a service warms them explicitly after its first
+    model build (and bench.py calls this between its compile pass and the
+    timed run, matching the declared steady-state methodology). All
+    dispatched states are throwaways; nothing here mutates the caller's
+    assignment."""
+    cfg = config or RepairConfig()
+    topic_on = bool(float(jax.device_get(weights.topic_viol)) > 0
+                    or float(jax.device_get(weights.topic)) > 0)
+    st = _chain_state(dt, assign, num_topics, topic_on)
+    src_sharding = flag_sharding = None
+    if mesh is not None:
+        # mirror repair(mesh=...)'s shardings so the warmed _fused_lead is
+        # the SAME traced variant the engaged sharded call dispatches
+        from jax.sharding import NamedSharding, PartitionSpec
+        from cruise_control_tpu.parallel.sharding import replicate
+        ax = mesh.axis_names[0]
+        src_sharding = NamedSharding(mesh, PartitionSpec(ax, None))
+        flag_sharding = NamedSharding(mesh, PartitionSpec(ax))
+        st = replicate(st, mesh)
+    init = jnp.asarray(assign.broker_of, jnp.int32)
+    lead_w = _lead_weights()
+    outs = [_lead_viol_vec(th, weights, st, lead_w)]
+    if topic_on:
+        outs += list(_topic_viol_gate(th, st))
+        outs += list(_topic_viol_rows(th, st))
+        outs.append(_topic_count_row(st, jnp.int32(0)))
+        en = _norm_load(dt.replica_base_load)
+        for mode in ("over", "under"):
+            outs += list(_topic_pair_candidates(
+                dt, th, st, opts.replica_movable, en, jnp.int32(0),
+                jnp.int32(0), cfg.topic_swap_sources,
+                cfg.topic_swap_partners, mode))
+    # the pairs evaluator serves BOTH the topic escape and the lead shed
+    # plan, and shed dispatches it whether or not topic goals are on — warm
+    # the topic_mode variant those call sites actually trace
+    for pad in (_SWAP_PAIRS_FLOOR, _SWAP_PAIRS_CAP):
+        r0 = jnp.zeros((pad,), jnp.int32)
+        outs.append(_swap_deltas_pairs(dt, th, weights, opts, st, init,
+                                       r0, r0,
+                                       "dense" if topic_on else "off"))
+        outs.append(_brokers_of(st, r0))
+    # lead host-round kernels, at BOTH bucket shapes each call site uses
+    # (floor for tail rounds, cap for bulk ones) — the engaged-seed tail of
+    # the 10-seed sweep was dominated by these loading lazily mid-request
+    slots = jnp.arange(dt.max_rf, dtype=jnp.int32)
+    for pad in (512, cfg.max_lead_sources):
+        outs.append(_lead_deltas_batch(
+            dt, th, weights, opts, st, jnp.zeros((pad,), jnp.int32), slots))
+    for pad in (_LEAD_SWAP_FLOOR, _LEAD_SWAP_CAP):
+        z = jnp.zeros((pad,), jnp.int32)
+        outs.append(_lead_swap_deltas_batch(dt, th, weights, opts, st,
+                                            z, z, z, z))
+    outs.extend(_lead_energy_parts(
+        dt, th, weights,
+        {k: getattr(st, k) for k in
+         ("broker_load", "host_load", "replica_count", "leader_count",
+          "potential_nw_out", "leader_bytes_in", "leader_of")}))
+    # the fused on-device leadership descent: the biggest engaged-path
+    # load (~4 s over the tunnel); runs a real (discarded) descent
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+        blocked = jax.device_put(np.zeros(dt.num_partitions, bool),
+                                 NamedSharding(mesh, PartitionSpec()))
+    else:
+        blocked = jax.device_put(np.zeros(dt.num_partitions, bool))
+    st, _, _, _ = _fused_lead(dt, th, weights, opts, st, lead_w, blocked,
+                              jax.random.PRNGKey(0),
+                              jnp.float32(cfg.min_improvement),
+                              jnp.int32(cfg.lead_broker_budget),
+                              cfg.lead_inner, cfg.max_lead_sources,
+                              src_sharding=src_sharding,
+                              flag_sharding=flag_sharding)
+    outs.append(st.leader_of)
+    jax.block_until_ready(outs)
+
+
 def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
            weights: OBJ.ObjectiveWeights, opts: G.DeviceOptions,
            num_topics: int, initial_broker_of: Optional[jax.Array] = None,
@@ -816,8 +1072,9 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
                 jax.random.fold_in(base_key, key_offset + outer),
                 jnp.float32(cfg.min_improvement),
                 topic_on, check_under, cfg.fused_inner, cfg.fused_sources,
-                cfg.swap_partners, src_sharding=src_sharding,
-                flag_sharding=flag_sharding)
+                cfg.swap_partners,
+                cfg.claim_rounds if B >= cfg.claim_rounds_min_brokers else 1,
+                src_sharding=src_sharding, flag_sharding=flag_sharding)
             n_acc = int(jax.device_get(n_acc))
             converged = bool(jax.device_get(converged))
             if _DEBUG:
@@ -830,15 +1087,165 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
                 break
 
     moves_descent()
+
+    # ---- targeted topic-band escape: the moves descent can converge with
+    # a topic band cell still violated — every single t-replica move off
+    # the cell crosses a usage band at EVERY destination, and the uniform
+    # random swap partners essentially never land on the one load-matched
+    # counterparty. Shed-plan-style deterministic rounds instead: enumerate
+    # exactly the count-fixing, load-matched pairs, evaluate their EXACT
+    # deltas in one batch, and accept only strictly-improving ones under
+    # disjoint claims — improving-by-construction, so no snapshot/revert
+    # machinery is needed. (The polish-cycle backstop that used to absorb
+    # these residuals costs an anneal restart — seconds, vs ~0.1 s here.)
+    _topic_static: dict = {}
+
+    def topic_swap_round() -> bool:
+        nonlocal st, total_moves
+        _tt0 = time.time()
+        n_over, n_under = jax.device_get(_topic_viol_gate(th, st))
+        if not check_under:
+            n_under = 0
+        if _DEBUG:
+            print(f"[repair topic gate] t={time.time()-_tt0:.2f}s "
+                  f"n_over={int(n_over)} n_under={int(n_under)}",
+                  flush=True)
+        if int(n_over) == 0 and int(n_under) == 0:
+            return False
+        over_b, colmin = (np.asarray(x) for x in jax.device_get(
+            _topic_viol_rows(th, st)))
+        if _DEBUG:
+            print(f"[repair topic cells] t={time.time()-_tt0:.2f}s",
+                  flush=True)
+        # plateau scope (same contract as the lead escapes): the machinery
+        # exists for the terminal few-cell residuals, not for broadly-
+        # violating structurally-constrained states (a destination-
+        # constrained add_broker leaves band violations across the whole
+        # cluster that NO swap can clear — grinding targeted rounds there
+        # measurably slowed the self-healing bench)
+        if int((over_b > 0).sum()) > cfg.escape_max_bad_brokers:
+            return False
+        if not _topic_static:
+            _topic_static.update(
+                up=np.asarray(jax.device_get(th.topic_upper)),
+                low=np.asarray(jax.device_get(th.topic_lower)),
+                hob=np.asarray(jax.device_get(dt.host_of_broker)),
+                en=_norm_load(dt.replica_base_load))
+        up = _topic_static["up"]
+        low = _topic_static["low"]
+        hob = _topic_static["hob"]
+        en_dev = _topic_static["en"]
+        K = cfg.topic_swap_partners
+        n_src = cfg.topic_swap_sources
+        cand_r1: List[int] = []
+        cand_r2: List[int] = []
+
+        def pairs_for(t, b, mode):
+            src, partners, valid = (np.asarray(x) for x in jax.device_get(
+                _topic_pair_candidates(dt, th, st, movable_dev, en_dev,
+                                       jnp.int32(t), jnp.int32(b),
+                                       n_src, K, mode)))
+            si, ki = np.nonzero(valid)
+            cand_r1.extend(src[si].tolist())
+            cand_r2.extend(partners[si, ki].tolist())
+
+        budget = 16     # candidate-kernel dispatches per round, total
+        for b in np.flatnonzero(over_b > 0):
+            b = int(b)
+            if budget <= 0:
+                break
+            row = np.asarray(jax.device_get(_topic_count_row(
+                st, jnp.int32(b))))
+            for t in np.flatnonzero(row > up)[:8]:
+                if budget <= 0:
+                    break
+                pairs_for(int(t), b, "over")
+                budget -= 1
+        ut = (np.flatnonzero((colmin < low) & (low > 0)) if check_under
+              else np.empty(0, np.int64))
+        for t in ut[:max(budget, 0)]:
+            pairs_for(int(t), 0, "under")
+        if not cand_r1:
+            return False
+        # bound one round's batch under the padded-eval cap (operator knobs
+        # can push 16 dispatches × sources × partners past it); the driver
+        # iterates rounds, so truncated candidates land next round
+        cand_r1 = cand_r1[:_SWAP_PAIRS_CAP]
+        cand_r2 = cand_r2[:_SWAP_PAIRS_CAP]
+        N = len(cand_r1)
+        pad = _bucket(N, _SWAP_PAIRS_CAP, floor=_SWAP_PAIRS_FLOOR)
+        r1_pad = np.full(pad, cand_r1[0], np.int32)
+        r2_pad = np.full(pad, cand_r2[0], np.int32)
+        r1_pad[:N] = cand_r1
+        r2_pad[:N] = cand_r2
+        if _DEBUG:
+            print(f"[repair topic cand] t={time.time()-_tt0:.2f}s N={N}",
+                  flush=True)
+        r1_dev = jnp.asarray(r1_pad)
+        r2_dev = jnp.asarray(r2_pad)
+        d, b1_all, b2_all = jax.device_get((
+            _swap_deltas_pairs(dt, th, weights, opts, st,
+                               initial_broker_of, r1_dev, r2_dev,
+                               "dense" if topic_on else "off"),
+            _brokers_of(st, r1_dev), _brokers_of(st, r2_dev)))
+        d = np.array(d)
+        d[N:] = _INF
+        order = np.argsort(d, kind="stable")
+        used: set = set()
+        acc_r: List[int] = []
+        acc_b: List[int] = []
+        n_pairs = 0
+        first_cur = 0       # current broker of acc_r[0]: the no-op pad
+        for i in order.tolist():
+            if not (d[i] < -cfg.min_improvement):
+                break
+            r1, r2 = int(r1_pad[i]), int(r2_pad[i])
+            b1, b2 = int(b1_all[i]), int(b2_all[i])
+            p1, p2 = int(part_of_r[r1]), int(part_of_r[r2])
+            keys = (("b", b1), ("b", b2), ("p", p1), ("p", p2),
+                    ("h", hob[b1]), ("h", hob[b2]))
+            if any(kk in used for kk in keys):
+                continue
+            if not acc_r:
+                first_cur = b1
+            used.update(keys)
+            acc_r.extend((r1, r2))
+            acc_b.extend((b2, b1))
+            n_pairs += 1
+        if _DEBUG:
+            print(f"[repair topic swap] over={int(n_over)} "
+                  f"under={ut.size} pairs={N} best={float(d.min()):.4g} "
+                  f"accepted={n_pairs}", flush=True)
+        if not acc_r:
+            return False
+        napp = len(acc_r)
+        pad_a = _bucket(napp, cfg.max_lead_sources)
+        r_vec = np.full(pad_a, acc_r[0], np.int32)
+        # no-op pad: pad entries re-route acc_r[0] to its CURRENT broker —
+        # the delta-add apply turns those into exact zeros
+        b_vec = np.full(pad_a, first_cur, np.int32)
+        r_vec[:napp] = acc_r
+        b_vec[:napp] = acc_b
+        st = _apply_batch(dt, st, jnp.asarray(r_vec), jnp.asarray(b_vec),
+                          topic_on)
+        total_moves += napp
+        return True
+
+    if topic_on:
+        _any_topic = False
+        for _tr in range(cfg.topic_swap_rounds):
+            if not topic_swap_round():
+                break
+            _any_topic = True
+        if _any_topic:
+            # swaps opened headroom: let the cheap converged-case descent
+            # mop up anything newly improving
+            moves_descent(key_offset=500)
     _t_lead = time.time()
     # ---- leadership repair: partitions led by brokers violating the
     # leadership-sensitive terms (LeaderReplicaDistribution, LeaderBytesIn,
     # demoted leadership, PLE handled by its own weight in the delta)
-    lead_terms = np.zeros(G.NUM_BROKER_TERMS, np.float32)
-    for g in ("LeaderReplicaDistributionGoal", "LeaderBytesInDistributionGoal",
-              "_DemotedLeadership"):
-        lead_terms[G.BROKER_TERM_GOALS.index(g)] = 1.0
-    lead_w = jax.device_put(lead_terms)
+    lead_w = _lead_weights()
     slots = jax.device_put(np.arange(m, dtype=np.int32))
     # host mirrors fetched LAZILY: the common converged case (no leadership
     # violations) must not pay the R/P-sized transfers at all
@@ -1117,7 +1524,7 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
             r1_flat = np.repeat(r1_np, K).astype(np.int32)
             r2_flat = r2_np.reshape(-1).astype(np.int32)
             N = r1_flat.size
-            pad = _bucket(N, 16384, floor=4096)
+            pad = _bucket(N, _SWAP_PAIRS_CAP, floor=_SWAP_PAIRS_FLOOR)
             r1_pad = np.full(pad, r1_flat[0], np.int32)
             r2_pad = np.full(pad, r2_flat[0], np.int32)
             r1_pad[:N] = r1_flat
@@ -1339,7 +1746,7 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
         if not p_l:
             return "stuck"
         N = len(p_l)
-        pad = _bucket(N, 8192, floor=1024)
+        pad = _bucket(N, _LEAD_SWAP_CAP, floor=_LEAD_SWAP_FLOOR)
         if N > pad:       # candidate explosion: sample down to the cap
             keep = rng.choice(N, size=pad, replace=False)
             p_l = [p_l[i] for i in keep]
@@ -1437,35 +1844,33 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
     # single-move descent parks with violations left, the compound
     # swap round engages before any uphill wandering.
     status = "clean"
-    for _ in range(cfg.max_rounds + 4):
-        if not lead_viol_any():
-            status = "clean"
-            break
-        fused_descent()
-        status = lead_round(False)
-        if status == "clean":
-            break
-        if status == "stuck":
-            sw = lead_swap_round(False)
-            if sw != "accepted":
+    for _ladder in range(3):
+        for _ in range(cfg.max_rounds + 4):
+            if not lead_viol_any():
+                status = "clean"
                 break
-            status = "swap"      # applied compound pairs; loop redescends
-    # settle to clean/stuck if the loop exhausted mid-progress, so the
-    # shed and uphill gates below stay reachable
-    for _ in range(cfg.max_rounds):
-        if status in ("clean", "stuck"):
+            fused_descent()
+            status = lead_round(False)
+            if status == "clean":
+                break
+            if status == "stuck":
+                sw = lead_swap_round(False)
+                if sw != "accepted":
+                    break
+                status = "swap"      # applied compound pairs; redescends
+        # settle to clean/stuck if the loop exhausted mid-progress, so the
+        # shed and uphill gates below stay reachable
+        for _ in range(cfg.max_rounds):
+            if status in ("clean", "stuck"):
+                break
+            status = lead_round(False)
+        if status != "stuck":
             break
-        status = lead_round(False)
-    if status == "stuck":
         lv_gate = np.asarray(jax.device_get(_lead_viol_vec(
             th, weights, st, lead_w)))
         if not (0 < int((lv_gate > 0).sum())
                 <= cfg.escape_max_bad_brokers):
-            status = "stuck"     # out of plateau scope: skip the shed
-        else:
-            status = "shed"
-    if status == "shed":
-        status = "stuck"
+            break                # out of plateau scope: skip the shed
         # deterministic shed plan (default-on): traverse the plateau in
         # one planned batch, mop up with both descent engines, keep only
         # if the EXACT energy says the state ended lexicographically
@@ -1498,29 +1903,35 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
                       f"lead_viol={lead_viol_any()}", flush=True)
             if not lead_viol_any():
                 break
-        if progressed:
-            # settle to clean/stuck: a single host round can return
-            # "accepted" with violations left, which would skip the
-            # opt-in uphill block below
-            for _ in range(cfg.max_rounds):
-                status = lead_round(False)
-                if status in ("clean", "stuck"):
-                    break
-            e_after = _exact_energy()
-            if (e_after[0], e_after[1]) < (e_before[0],
-                                           e_before[1]
-                                           - cfg.min_improvement):
-                if _DEBUG:
-                    print(f"[repair shed] kept ({e_before} -> {e_after})",
-                          flush=True)
-            else:
-                st = snap_st
-                bo, lo = snap_mirror
-                total_moves, total_leads = snap_counts
-                status = "stuck"
-                if _DEBUG:
-                    print(f"[repair shed] reverted "
-                          f"({e_before} vs {e_after})", flush=True)
+        if not progressed:
+            break
+        # settle to clean/stuck: a single host round can return
+        # "accepted" with violations left, which would skip the
+        # opt-in uphill block below
+        for _ in range(cfg.max_rounds):
+            status = lead_round(False)
+            if status in ("clean", "stuck"):
+                break
+        e_after = _exact_energy()
+        if (e_after[0], e_after[1]) < (e_before[0],
+                                       e_before[1]
+                                       - cfg.min_improvement):
+            if _DEBUG:
+                print(f"[repair shed] kept ({e_before} -> {e_after})",
+                      flush=True)
+            # a KEPT shed changed the landscape: re-enter the FULL
+            # descent + compound-swap ladder — post-shed states routinely
+            # open clearing pairs that single handoffs cannot express
+            # (measured: the settle rounds alone park one step short)
+            continue
+        st = snap_st
+        bo, lo = snap_mirror
+        total_moves, total_leads = snap_counts
+        status = "stuck"
+        if _DEBUG:
+            print(f"[repair shed] reverted "
+                  f"({e_before} vs {e_after})", flush=True)
+        break
     if status == "stuck" and cfg.lead_uphill_steps > 0:
         # genuinely converged with violations left: guarded uphill
         # excursions — violation-neutral SWAP pairs first (count-neutral

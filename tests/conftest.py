@@ -67,3 +67,24 @@ def _release_compiled_programs():
         jax.clear_caches()
     except Exception:
         pass
+
+
+_TESTS_SINCE_CLEAR = {"n": 0}
+
+
+@pytest.fixture(autouse=True)
+def _bound_cumulative_jit_within_module():
+    """Also clear every 10 tests WITHIN a module: the round-4 repair engine
+    (claim sub-rounds, topic-band escape kernels) grew per-test program
+    count enough that test_optimizer alone crossed the cumulative-JIT crash
+    threshold mid-module (segfault in ``backend_compile_and_load`` at test
+    ~53). Ten tests keeps live code far below it while preserving most
+    shared-shape executable reuse."""
+    yield
+    _TESTS_SINCE_CLEAR["n"] += 1
+    if _TESTS_SINCE_CLEAR["n"] % 10 == 0:
+        try:
+            import jax
+            jax.clear_caches()
+        except Exception:
+            pass

@@ -625,3 +625,118 @@ def test_repair_clears_skewed_lbi_without_regression():
     assert lbi_violations(out) == 0
     assert quality(out) <= q0
     jax.clear_caches()    # bound cumulative JIT code (see conftest)
+
+
+def test_claim_subrounds_preserve_quality_contract():
+    """The claim sub-rounds (round-4 third session) extend each fused
+    round's matching over the SAME candidate matrices. Winners across all
+    of a round's passes stay pairwise broker/partition/host-disjoint, so
+    the captured deltas are exactly additive — descent quality must match
+    the single-pass kernel's contract: never trade up the violation
+    ladder, and end with the weighted violation channel no worse."""
+    import jax
+    import jax.numpy as jnp2
+    from cruise_control_tpu.analyzer import objective as OBJ2
+    from cruise_control_tpu.analyzer import repair as REP
+    from cruise_control_tpu.common.resources import BalancingConstraint
+    from cruise_control_tpu.ops.aggregates import (
+        compute_aggregates as agg2, device_topology as devtopo)
+
+    for seed in (0, 3):
+        topo, assign = fixtures.random_cluster(fixtures.ClusterProperties(
+            num_racks=3, num_brokers=12, num_replicas=400, num_topics=20,
+            min_replication=2, max_replication=3), seed=700 + seed)
+        dt = devtopo(topo)
+        th = G.compute_thresholds(dt, BalancingConstraint(),
+                                  agg2(dt, assign, topo.num_topics))
+        w = OBJ2.build_weights(G.DEFAULT_GOALS)
+        opts = G.default_options(topo)
+        init = jnp2.asarray(assign.broker_of)
+        before = OBJ2.evaluate_objective(dt, assign, th, w, G.DEFAULT_GOALS,
+                                         topo.num_topics, init)
+        vb = float(np.asarray(before.value)[0])
+        for min_brokers in (10 ** 9, 1):     # n_claim = 1 vs 4 sub-rounds
+            cfg = REP.RepairConfig(claim_rounds_min_brokers=min_brokers)
+            final, _, _ = REP.repair(dt, assign, th, w, opts,
+                                     topo.num_topics, initial_broker_of=init,
+                                     seed=seed, config=cfg)
+            after = OBJ2.evaluate_objective(dt, final, th, w,
+                                            G.DEFAULT_GOALS,
+                                            topo.num_topics, init)
+            va = float(np.asarray(after.value)[0])
+            assert va <= vb + 1e-3, (seed, min_brokers, vb, va)
+            dchecks = sanity_check(dt, final, topo.num_topics)
+            assert all(dchecks.values()), (seed, min_brokers, dchecks)
+    jax.clear_caches()    # bound cumulative JIT code (see conftest)
+
+
+def test_topic_pair_candidates_respect_masks():
+    """The device-side topic-escape candidate kernel must return sources
+    of the requested topic on the requested broker (over mode) and
+    partners of OTHER topics on brokers with band headroom only."""
+    import jax
+    import jax.numpy as jnp2
+    from cruise_control_tpu.analyzer import objective as OBJ2
+    from cruise_control_tpu.analyzer import repair as REP
+    from cruise_control_tpu.common.resources import BalancingConstraint
+    from cruise_control_tpu.ops.aggregates import (
+        compute_aggregates as agg2, device_topology as devtopo)
+
+    topo, assign = fixtures.random_cluster(fixtures.ClusterProperties(
+        num_racks=3, num_brokers=9, num_replicas=300, num_topics=12,
+        min_replication=2, max_replication=3), seed=42)
+    dt = devtopo(topo)
+    th = G.compute_thresholds(dt, BalancingConstraint(),
+                              agg2(dt, assign, topo.num_topics))
+    w = OBJ2.build_weights(G.DEFAULT_GOALS)
+    st = REP._chain_state(dt, assign, topo.num_topics, True)
+    en = REP._norm_load(dt.replica_base_load)
+    movable = jnp2.ones((topo.num_replicas,), bool)
+    t, b = 3, 2
+    src, partners, valid = (np.asarray(x) for x in jax.device_get(
+        REP._topic_pair_candidates(dt, th, st, movable, en,
+                                   jnp2.int32(t), jnp2.int32(b),
+                                   4, 8, "over")))
+    bo = np.asarray(jax.device_get(st.broker_of))
+    part_of = np.asarray(jax.device_get(dt.partition_of_replica))
+    t_of_r = np.asarray(jax.device_get(dt.topic_of_partition))[part_of]
+    cnt = np.zeros((topo.num_brokers, topo.num_topics), np.int64)
+    np.add.at(cnt, (bo, t_of_r), 1)
+    up = np.asarray(jax.device_get(th.topic_upper))
+    si, ki = np.nonzero(valid)
+    for i, k in zip(si.tolist(), ki.tolist()):
+        r1, r2 = int(src[i]), int(partners[i, k])
+        assert t_of_r[r1] == t and bo[r1] == b          # shed the cell
+        assert t_of_r[r2] != t and bo[r2] != b          # other topic, off b
+        assert cnt[bo[r2], t] < up[t]                   # t-headroom at dest
+    jax.clear_caches()    # bound cumulative JIT code (see conftest)
+
+
+def test_warm_escape_kernels_smoke_and_repair_after():
+    """warm_escape_kernels must dispatch every escape kernel without
+    touching the caller's assignment; a repair afterwards behaves
+    normally (the warm states are throwaways)."""
+    import jax
+    import jax.numpy as jnp2
+    from cruise_control_tpu.analyzer import objective as OBJ2
+    from cruise_control_tpu.analyzer import repair as REP
+    from cruise_control_tpu.common.resources import BalancingConstraint
+    from cruise_control_tpu.ops.aggregates import (
+        compute_aggregates as agg2, device_topology as devtopo)
+
+    topo, assign = fixtures.random_cluster(fixtures.ClusterProperties(
+        num_racks=3, num_brokers=10, num_replicas=300, num_topics=15,
+        min_replication=2, max_replication=3), seed=77)
+    dt = devtopo(topo)
+    th = G.compute_thresholds(dt, BalancingConstraint(),
+                              agg2(dt, assign, topo.num_topics))
+    w = OBJ2.build_weights(G.DEFAULT_GOALS)
+    opts = G.default_options(topo)
+    bo_before = np.asarray(jax.device_get(assign.broker_of)).copy()
+    REP.warm_escape_kernels(dt, assign, th, w, opts, topo.num_topics)
+    assert (np.asarray(jax.device_get(assign.broker_of)) == bo_before).all()
+    final, _, _ = REP.repair(dt, assign, th, w, opts, topo.num_topics,
+                             seed=5)
+    dchecks = sanity_check(dt, final, topo.num_topics)
+    assert all(dchecks.values()), dchecks
+    jax.clear_caches()    # bound cumulative JIT code (see conftest)

@@ -1,0 +1,65 @@
+"""Ad-hoc repair/anneal knob experiments on the LinkedIn-scale model.
+
+Usage: python tools/repair_exp.py [--sources N] [--steps N] [--seeds a,b]
+Prints one JSON line per seed with wall-clock + quality, mirroring the
+bench's steady-state measurement (second run in-process is the one that
+matters; the first run pays compile/cache-load).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), ".jax_cache")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sources", type=int, default=1024)
+    ap.add_argument("--steps", type=int, default=256)
+    ap.add_argument("--swap-partners", type=int, default=12)
+    ap.add_argument("--seeds", default="1,2")
+    args = ap.parse_args()
+
+    import jax
+    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    from cruise_control_tpu.analyzer import annealer as AN
+    from cruise_control_tpu.analyzer import optimizer as OPT
+    from cruise_control_tpu.analyzer import repair as REP
+    from cruise_control_tpu.models import fixtures
+
+    topo, assign = fixtures.synthetic_cluster(
+        num_brokers=2_600, num_replicas=500_000, num_racks=40,
+        num_topics=30_000, seed=0)
+    cfg = AN.AnnealConfig(num_chains=16, steps=args.steps, swap_interval=64,
+                          tries_move=384, tries_lead=64, tries_swap=192)
+    rcfg = REP.RepairConfig(fused_sources=args.sources,
+                            swap_partners=args.swap_partners)
+
+    for i, s in enumerate(int(x) for x in args.seeds.split(",")):
+        t0 = time.time()
+        r = OPT.optimize(topo, assign, engine="anneal", anneal_config=cfg,
+                         seed=s, repair_config=rcfg)
+        dt = time.time() - t0
+        print(json.dumps({
+            "seed": s, "wall_s": round(dt, 2),
+            "sources": args.sources, "steps": args.steps,
+            "viol_after": len(r.violated_goals_after),
+            "hard_after": sum(1 for g in r.goal_summaries
+                              if g.hard and g.violated_after),
+            "balancedness": round(r.balancedness_after, 2),
+            "soft_cost_after": round(sum(g.cost_after for g in r.goal_summaries
+                                         if not g.hard), 3),
+            "moves": r.num_replica_movements,
+            "leads": r.num_leadership_movements,
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

