@@ -70,11 +70,13 @@ def main():
             num_topics=30_000, seed=seed)
         # wide-batch shallow anneal: high candidate tries at few sequential
         # steps (per-step cost is strongly sub-linear in the try count).
-        # 256 steps / swap 64 measured equal-quality to 320/512/1024 (viol
-        # 8→0, balancedness 100.0 at seeds 0 and 7) with the targeted
-        # repair pass absorbing the difference (accepts ~3.5K → ~5.6K) and
-        # FEWER total movements; see docs/PERF.md
-        cfg = AN.AnnealConfig(num_chains=16, steps=256, swap_interval=64,
+        # 192 steps / swap 64: equal 10-seed quality to 256 with the
+        # escape-laddered repair absorbing the difference, ~13% FEWER
+        # replica movements (65–70K vs ~80K), and ~0.6 s less anneal
+        # wall-clock; 128 cut movements further but destabilized the
+        # repair tail (one probed seed paid an 18 s escape walk); see
+        # docs/PERF.md
+        cfg = AN.AnnealConfig(num_chains=16, steps=192, swap_interval=64,
                               tries_move=384, tries_lead=64, tries_swap=192)
         engine = "anneal"
     elif size == "medium":

@@ -251,6 +251,41 @@ def _balancedness(goal_names, violations) -> float:
 TINY_CPU_LIMIT = 50_000
 
 
+def _setup_model(topo, assign, goal_names, constraint, options, mesh):
+    """Model→device setup shared by ``_optimize_impl`` and
+    ``warm_kernels`` — ONE definition, so the warm can never trace
+    differently-shaped (or differently-aggregated) programs than the runs
+    it exists to serve. Returns (constraint, opts, dt, num_topics,
+    sparse_topic, init_broker, agg_fn, agg0, th, weights)."""
+    constraint = constraint or BalancingConstraint()
+    opts = options if options is not None else G.default_options(topo)
+    dt = device_topology(topo)
+    num_topics = topo.num_topics
+    sparse_topic = topo.num_brokers * num_topics > TOPIC_DENSE_LIMIT
+    # device_put, not jnp.asarray: a dtype-converting asarray is its own
+    # tiny compiled program (cold-start cache-load tax over the tunnel)
+    init_broker = jax.device_put(
+        np.asarray(jax.device_get(assign.broker_of), np.int32))
+
+    def _agg(a):
+        """Broker aggregates for assignment ``a`` — replica-axis sharded
+        over the mesh when one is given (SURVEY §7 step 3), single-device
+        otherwise. Every aggregation site in optimize() goes through here."""
+        if mesh is not None:
+            return _sharded_broker_aggregates(mesh, dt, a, init_broker,
+                                              num_topics, sparse_topic)
+        return compute_aggregates(dt, a, 1 if sparse_topic else num_topics)
+
+    agg0 = _agg(assign)
+    from cruise_control_tpu.ops.aggregates import topic_totals
+    th = G.compute_thresholds(
+        dt, constraint, agg0,
+        topic_total=topic_totals(dt, num_topics) if sparse_topic else None)
+    weights = OBJ.build_weights(goal_names)
+    return (constraint, opts, dt, num_topics, sparse_topic, init_broker,
+            _agg, agg0, th, weights)
+
+
 def _routes_to_tiny_cpu(topo, mesh, options) -> bool:
     """True when optimize() will run this model on the host CPU backend
     (tiny model, no mesh/custom options, accelerator default backend) —
@@ -285,18 +320,9 @@ def warm_kernels(topo: ClusterTopology, assign: Assignment,
         # accelerator path and DOES want the warm.
         return
     from cruise_control_tpu.analyzer import repair as REP
-    from cruise_control_tpu.ops.aggregates import topic_totals
     goal_names = tuple(goal_names or G.DEFAULT_GOALS)
-    constraint = constraint or BalancingConstraint()
-    opts = options if options is not None else G.default_options(topo)
-    dt = device_topology(topo)
-    num_topics = topo.num_topics
-    sparse_topic = topo.num_brokers * num_topics > TOPIC_DENSE_LIMIT
-    agg0 = compute_aggregates(dt, assign, 1 if sparse_topic else num_topics)
-    th = G.compute_thresholds(
-        dt, constraint, agg0,
-        topic_total=topic_totals(dt, num_topics) if sparse_topic else None)
-    weights = OBJ.build_weights(goal_names)
+    (_, opts, dt, num_topics, _, _, _, _, th, weights) = _setup_model(
+        topo, assign, goal_names, constraint, options, mesh)
     REP.warm_escape_kernels(dt, assign, th, weights, opts, num_topics,
                             config=repair_config, mesh=mesh)
 
@@ -348,33 +374,10 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
                   flush=True)
             _tp[0] = now
 
-    constraint = constraint or BalancingConstraint()
-    opts = options if options is not None else G.default_options(topo)
     goal_names = tuple(goal_names)
-    dt = device_topology(topo)
-    num_topics = topo.num_topics
-    sparse_topic = topo.num_brokers * num_topics > TOPIC_DENSE_LIMIT
-    # device_put, not jnp.asarray: a dtype-converting asarray is its own
-    # tiny compiled program (cold-start cache-load tax over the tunnel)
-    init_broker = jax.device_put(
-        np.asarray(jax.device_get(assign.broker_of), np.int32))
-
-    def _agg(a):
-        """Broker aggregates for assignment ``a`` — replica-axis sharded
-        over the mesh when one is given (SURVEY §7 step 3), single-device
-        otherwise. Every aggregation site in optimize() goes through here."""
-        if mesh is not None:
-            return _sharded_broker_aggregates(mesh, dt, a, init_broker,
-                                              num_topics, sparse_topic)
-        return compute_aggregates(dt, a, 1 if sparse_topic else num_topics)
-
-    agg0 = _agg(assign)
-    from cruise_control_tpu.ops.aggregates import topic_totals
-    th = G.compute_thresholds(
-        dt, constraint, agg0,
-        topic_total=topic_totals(dt, num_topics) if sparse_topic else None)
-    weights = OBJ.build_weights(goal_names)
-
+    (constraint, opts, dt, num_topics, sparse_topic, init_broker, _agg,
+     agg0, th, weights) = _setup_model(topo, assign, goal_names, constraint,
+                                       options, mesh)
     _mark("setup")
     before = OBJ.evaluate_objective(dt, assign, th, weights, goal_names,
                                     num_topics, init_broker, agg0,

@@ -210,6 +210,9 @@ class CruiseControlApp:
             num_cached_states=config.get("num.cached.recent.anomaly.states"))
         self._proposal_cache: Optional[CachedProposals] = None
         self._cache_lock = threading.Lock()
+        #: one-shot: escape kernels warmed after the first default-goal
+        #: computation (see _compute_and_cache)
+        self._escape_kernels_warmed = False
         self._precompute_thread: Optional[threading.Thread] = None
         self._precompute_shutdown = threading.Event()
         #: serializes the default-goal cacheable computation
@@ -505,6 +508,34 @@ class CruiseControlApp:
         with self._cache_lock:
             self._proposal_cache = CachedProposals(
                 result, gen0, int(time.time() * 1000))
+        if (not self._escape_kernels_warmed
+                and topo.num_replicas * topo.num_brokers
+                > OPT.TINY_CPU_LIMIT):
+            # after the FIRST default-goal computation on a real-size
+            # model: load the rarely-engaged escape kernels (topic-band
+            # swap, fused lead descent) at this model's shapes so the
+            # first request that needs one runs steady-state instead of
+            # paying a multi-second compile/cache-load mid-request
+            # (optimizer.warm_kernels). On a BACKGROUND thread: callers
+            # hold _compute_gate here, and the cache is already filled —
+            # a synchronous warm would stall every queued default-goal
+            # request behind a multi-second load for an already-cached
+            # answer. Tiny models (tests, toy clusters) skip: their
+            # compiles are cheap and lazily-paid anyway.
+            self._escape_kernels_warmed = True
+
+            def _warm():
+                try:
+                    OPT.warm_kernels(topo, assign,
+                                     goal_names=tuple(self.default_goals),
+                                     constraint=self.constraint,
+                                     mesh=self.mesh)
+                except Exception:
+                    logger.warning("escape-kernel warm failed",
+                                   exc_info=True)
+
+            threading.Thread(target=_warm, daemon=True,
+                             name="escape-kernel-warm").start()
         return result
 
     # ----------------------------------------------- operations (runnables)

@@ -40,6 +40,17 @@ try:
     jax.config.update("jax_platforms", "cpu")
     for _plat in ("axon", "tpu"):
         _xb._backend_factories.pop(_plat, None)
+    # persistent compile cache for the CPU test backend (separate dir from
+    # the TPU bench cache): the suite's wall-clock is dominated by XLA CPU
+    # compiles of the large fused programs, and most tests recompile the
+    # same (program, shape) pairs run after run — a warm cache turns a
+    # >20-minute test_optimizer pass into mostly cache loads. Also applies
+    # to the subprocess-spawning mesh tests.
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), ".jax_cache_cpu"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 except Exception:
     pass
 

@@ -44,7 +44,7 @@ def main():
     from cruise_control_tpu.analyzer import optimizer as OPT
     from cruise_control_tpu.models import fixtures
 
-    cfg = AN.AnnealConfig(num_chains=16, steps=256, swap_interval=64,
+    cfg = AN.AnnealConfig(num_chains=16, steps=192, swap_interval=64,
                           tries_move=384, tries_lead=64, tries_swap=192)
     opt_kwargs = {}
     if args.uphill:
