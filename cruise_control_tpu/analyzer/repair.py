@@ -1437,6 +1437,9 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
                 + wv["preferred_leader"] * float(ple))
         return float(viol), float(cost)
 
+    _shed_static: dict = {}
+    _shed_E_cache: dict = {}
+
     def shed_plan() -> bool:
         """Deterministic plateau traverse for residual LeaderBytesIn band
         violations: swap the violating broker v's heaviest LEADER
@@ -1467,15 +1470,22 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
         if int(bad.sum()) > cfg.escape_max_bad_brokers:
             return False    # plateau machinery only (see lead_swap_round)
         lbi_b = np.array(jax.device_get(st.leader_bytes_in))
-        lbi_up = np.broadcast_to(
-            np.asarray(jax.device_get(th.lbi_upper)), lbi_b.shape)
-        plbi = np.asarray(jax.device_get(dt.leader_bytes_in))
+        if not _shed_static:
+            # per-repair constants: fetched once, not per shed round (the
+            # iterated ladder calls shed_plan several times; plbi is a
+            # [P]-sized transfer each time over the tunnel)
+            _shed_static.update(
+                lbi_up=np.asarray(jax.device_get(th.lbi_upper)),
+                plbi=np.asarray(jax.device_get(dt.leader_bytes_in)),
+                hob=np.asarray(jax.device_get(dt.host_of_broker)))
+        lbi_up = np.broadcast_to(_shed_static["lbi_up"], lbi_b.shape)
+        plbi = _shed_static["plbi"]
+        hob = _shed_static["hob"]
         if bo is None:
             bo = np.array(jax.device_get(st.broker_of))
             reps_np = np.asarray(jax.device_get(dt.replicas_of_partition))
         if lo is None:
             lo = np.array(jax.device_get(st.leader_of))
-        hob = np.asarray(jax.device_get(dt.host_of_broker))
         led_broker = bo[lo]
         # effective leader load per partition (base of the leader replica +
         # the leader extra): a swap exchanges exactly these vectors between
@@ -1483,9 +1493,16 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
         # LOAD-MATCHED ones — similar effective load (nothing crosses a
         # usage band), strictly smaller leader-bytes-in (the drain).
         # Uniform partner sampling finds none of them in band-tight states.
-        E = np.asarray(jax.device_get(
-            dt.replica_base_load[jnp.asarray(lo), :]
-            + dt.leader_extra))                              # [P, 4]
+        # E depends ONLY on leader_of: cached across the iterated shed
+        # rounds (a 4 MB [P, 4] tunnel fetch each) and recomputed when the
+        # leader mirror actually changed.
+        lo_key = hash(lo.tobytes())
+        if _shed_E_cache.get("key") != lo_key:
+            _shed_E_cache["key"] = lo_key
+            _shed_E_cache["E"] = np.asarray(jax.device_get(
+                dt.replica_base_load[jnp.asarray(lo), :]
+                + dt.leader_extra))                          # [P, 4]
+        E = _shed_E_cache["E"]
         E_scale = np.abs(E).mean(axis=0) + 1e-30
         En = E / E_scale
         K = 32
