@@ -22,6 +22,7 @@ def main():
     ap.add_argument("--sources", type=int, default=1024)
     ap.add_argument("--steps", type=int, default=256)
     ap.add_argument("--swap-partners", type=int, default=12)
+    ap.add_argument("--claim-rounds", type=int, default=4)
     ap.add_argument("--seeds", default="1,2")
     args = ap.parse_args()
 
@@ -40,7 +41,8 @@ def main():
     cfg = AN.AnnealConfig(num_chains=16, steps=args.steps, swap_interval=64,
                           tries_move=384, tries_lead=64, tries_swap=192)
     rcfg = REP.RepairConfig(fused_sources=args.sources,
-                            swap_partners=args.swap_partners)
+                            swap_partners=args.swap_partners,
+                            claim_rounds=args.claim_rounds)
 
     for i, s in enumerate(int(x) for x in args.seeds.split(",")):
         t0 = time.time()

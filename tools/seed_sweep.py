@@ -93,8 +93,11 @@ def main():
     summary = {
         "summary": True,
         "seeds": args.seeds,
-        "steady_seeds": f"1-{args.seeds - 1} (seed 0 pays process warmup)",
-        "wall_s_min": min(steady), "wall_s_max": max(steady),
+        "steady_seeds": (f"1-{args.seeds - 1} (seed 0 pays process warmup)"
+                         if args.seeds > 1 else "0 (single seed)"),
+        # min/max cover ALL seeds (a cold seed 0 must not hide a budget
+        # breach); only the steady MEAN excludes the warmup seed
+        "wall_s_min": min(walls), "wall_s_max": max(walls),
         "wall_s_mean_steady": round(sum(steady) / len(steady), 3),
         "wall_s_mean_all": round(sum(walls) / len(walls), 3),
         "first_seed_wall_s": walls[0],
