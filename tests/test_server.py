@@ -587,3 +587,47 @@ def test_session_binds_repeated_request_to_same_task():
         assert body6["userTaskId"] != body1["userTaskId"]
     finally:
         api.close()
+
+
+def test_escape_kernel_warm_fires_once_on_real_size_models(monkeypatch):
+    """The first default-goal proposal computation on a model above the
+    tiny-CPU bound must schedule the escape-kernel warm exactly once (on
+    a background thread — the compute gate is held here); tiny models
+    must never schedule it. The SCHEDULING decision is asserted through
+    the synchronous ``_escape_kernels_warmed`` flag (the spy runs on a
+    daemon thread, so bare call-list asserts would race it)."""
+    import threading as _threading
+
+    from cruise_control_tpu.analyzer import optimizer as OPT_mod
+
+    calls = []
+    done = _threading.Event()
+
+    def _spy(topo, assign, **kw):
+        calls.append((topo.num_brokers, topo.num_replicas, sorted(kw)))
+        done.set()
+
+    monkeypatch.setattr(OPT_mod, "warm_kernels", _spy)
+
+    # tiny model (test fixture is far below TINY_CPU_LIMIT): the compute
+    # path runs but never SCHEDULES a warm — asserted via the flag, which
+    # _compute_and_cache sets synchronously before spawning the thread
+    app = _app()
+    app.proposals()
+    assert app._escape_kernels_warmed is False
+    assert not done.is_set()
+
+    # with the bound lowered the fixture counts as real-size: the first
+    # compute schedules the warm; a SECOND pass through the compute path
+    # (cache invalidated, so _compute_and_cache re-runs) must not
+    monkeypatch.setattr(OPT_mod, "TINY_CPU_LIMIT", 1)
+    app2 = _app()
+    app2.proposals()
+    assert app2._escape_kernels_warmed is True
+    assert done.wait(timeout=5), "warm thread never ran"
+    app2._proposal_cache = None       # force the next call to recompute
+    app2.proposals()
+    assert len(calls) == 1            # once per app, not once per compute
+    nb, nr, kws = calls[0]
+    assert (nb, nr) == (6, 60)        # the served model's shape
+    assert "mesh" in kws and "constraint" in kws and "goal_names" in kws
