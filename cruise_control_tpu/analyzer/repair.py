@@ -1496,7 +1496,7 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
         # E depends ONLY on leader_of: cached across the iterated shed
         # rounds (a 4 MB [P, 4] tunnel fetch each) and recomputed when the
         # leader mirror actually changed.
-        lo_key = hash(lo.tobytes())
+        lo_key = lo.tobytes()
         if _shed_E_cache.get("key") != lo_key:
             _shed_E_cache["key"] = lo_key
             _shed_E_cache["E"] = np.asarray(jax.device_get(

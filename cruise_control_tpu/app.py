@@ -508,9 +508,12 @@ class CruiseControlApp:
         with self._cache_lock:
             self._proposal_cache = CachedProposals(
                 result, gen0, int(time.time() * 1000))
+        import jax
         if (not self._escape_kernels_warmed
-                and topo.num_replicas * topo.num_brokers
-                > OPT.TINY_CPU_LIMIT):
+                and not OPT._routes_to_tiny_cpu(topo, self.mesh, options)
+                and (jax.default_backend() != "cpu"
+                     or topo.num_replicas * topo.num_brokers
+                     > OPT.TINY_CPU_LIMIT)):
             # after the FIRST default-goal computation on a real-size
             # model: load the rarely-engaged escape kernels (topic-band
             # swap, fused lead descent) at this model's shapes so the
@@ -520,8 +523,13 @@ class CruiseControlApp:
             # hold _compute_gate here, and the cache is already filled —
             # a synchronous warm would stall every queued default-goal
             # request behind a multi-second load for an already-cached
-            # answer. Tiny models (tests, toy clusters) skip: their
-            # compiles are cheap and lazily-paid anyway.
+            # answer. Models that optimize() routes to the host CPU
+            # backend skip (shared _routes_to_tiny_cpu predicate): their
+            # compiles are local/cheap and lazily-paid anyway, and the
+            # warm must target the same backend the run uses. On a
+            # CPU-only host the predicate is False for every model, so
+            # the size guard additionally keeps toy models (tests) from
+            # spawning background XLA CPU compiles.
             self._escape_kernels_warmed = True
 
             def _warm():
@@ -529,6 +537,7 @@ class CruiseControlApp:
                     OPT.warm_kernels(topo, assign,
                                      goal_names=tuple(self.default_goals),
                                      constraint=self.constraint,
+                                     options=options,
                                      mesh=self.mesh)
                 except Exception:
                     logger.warning("escape-kernel warm failed",
