@@ -1,0 +1,2099 @@
+"""Single-threaded sequential goal optimizer — the measured reference baseline.
+
+This module is a faithful NumPy/Python port of the reference's sequential
+``GoalOptimizer`` inner loop (``analyzer/GoalOptimizer.java:429-453``): goals
+run in priority order, each goal walks brokers one at a time
+(``AbstractGoal.java:68-109``), and every candidate action passes the
+legality → selfSatisfied → prior-goal-veto chain of
+``AbstractGoal.maybeApplyBalancingAction`` (``AbstractGoal.java:181-238``)
+before mutating the shared model one replica at a time.
+
+Purpose (round-5 north-star accounting): the BASELINE.json target is
+"≥20× vs single-threaded GoalOptimizer at equal-or-better violation score".
+There is no JVM in this environment, so this port IS the single-threaded
+baseline: same fixtures, same ``ClusterTopology`` arrays, same thresholds
+family, measured wall-clock against ``optimizer.optimize``. It also supplies
+the per-goal ``ClusterModelStatsComparator`` semantics (``goals/Goal.java:128``
+implementations) as the parity oracle SURVEY §4 tier 3 demands.
+
+Deliberately NOT vectorized over the walk: the per-replica candidate loop with
+per-accept model mutation is the algorithm being measured (the reference's
+O(goals × brokers × replicas × candidates) hot nest). Incremental aggregate
+bookkeeping mirrors what the reference's ``ClusterModel`` mutation ops
+(``ClusterModel.java:347,374``) keep hot — using dicts/sets per broker the way
+the Java model keeps per-broker replica TreeSets.
+
+No JAX imports here: this is the host-only oracle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.common import resources as res
+
+# ---------------------------------------------------------------------------
+# Action / acceptance taxonomy (analyzer/ActionType.java:26-33,
+# ActionAcceptance.java:85)
+# ---------------------------------------------------------------------------
+
+MOVE = "INTER_BROKER_REPLICA_MOVEMENT"
+LEAD = "LEADERSHIP_MOVEMENT"
+SWAP = "INTER_BROKER_REPLICA_SWAP"
+
+ACCEPT = 0
+REPLICA_REJECT = 1
+BROKER_REJECT = 2
+
+#: AnalyzerUtils.EPSILON (AnalyzerUtils.java:42) — count-stat comparators
+EPSILON = 1e-5
+
+#: ResourceDistributionGoal.BALANCE_MARGIN / ReplicaDistributionAbstractGoal /
+#: TopicReplicaDistributionGoal all use 0.9 (churn guard on the thresholds)
+BALANCE_MARGIN = 0.9
+
+#: ResourceDistributionGoal.PER_BROKER_SWAP_TIMEOUT_MS = 1000
+PER_BROKER_SWAP_TIMEOUT_S = 1.0
+
+
+class SeqOptimizationFailure(Exception):
+    """OptimizationFailureException analogue (hard goal unsatisfiable or a
+    goal's post-optimization stats regressed its own comparator)."""
+
+
+def _compare(d1: float, d2: float, eps: float) -> int:
+    """AnalyzerUtils.compare (AnalyzerUtils.java:158): 1 if d1 > d2 beyond
+    eps, -1 if d1 < d2 beyond eps, else 0."""
+    if d2 - d1 > eps:
+        return -1
+    if d1 - d2 > eps:
+        return 1
+    return 0
+
+
+def _resource_compare(d1: float, d2: float, r: int) -> int:
+    """AnalyzerUtils.compare with the per-resource epsilon policy
+    (Resource.java:87-89)."""
+    return _compare(d1, d2, float(res.epsilon(r, d1, d2)))
+
+
+# ---------------------------------------------------------------------------
+# Options (OptimizationOptions.java:14-21, host-side form)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SeqOptions:
+    excluded_topics: frozenset = frozenset()          # topic ids (int)
+    excluded_brokers_for_leadership: frozenset = frozenset()
+    excluded_brokers_for_replica_move: frozenset = frozenset()
+    requested_destination_broker_ids: frozenset = frozenset()
+    only_move_immigrant_replicas: bool = False
+    is_triggered_by_goal_violation: bool = False
+
+
+# ---------------------------------------------------------------------------
+# Mutable single-threaded cluster model mirror
+# ---------------------------------------------------------------------------
+
+
+class SeqModel:
+    """Mutable mirror of the reference ``ClusterModel`` over the repo's
+    ``ClusterTopology`` arrays.
+
+    State parallels model/ClusterModel.java: per-broker replica sets, broker /
+    host load vectors, leadership load, potential leadership NW_OUT
+    (``ClusterModel.java:205``), replica/leader/topic counts — all maintained
+    incrementally by ``relocate_replica`` / ``relocate_leadership``
+    (``ClusterModel.java:347,374``).
+    """
+
+    def __init__(self, topo, broker_of: np.ndarray, leader_of: np.ndarray):
+        self.topo = topo
+        B = topo.num_brokers
+        self.B = B
+        self.R = topo.num_replicas
+        self.P = topo.num_partitions
+        self.T = topo.num_topics
+        self.part_of = np.asarray(topo.partition_of_replica, np.int64)
+        self.topic_of_p = np.asarray(topo.topic_of_partition, np.int64)
+        self.reps_of_p = np.asarray(topo.replicas_of_partition, np.int64)
+        self.rack_of_b = np.asarray(topo.rack_of_broker, np.int64)
+        self.host_of_b = np.asarray(topo.host_of_broker, np.int64)
+        self.H = topo.num_hosts
+        self.brokers_of_host: List[List[int]] = [[] for _ in range(self.H)]
+        for b in range(B):
+            self.brokers_of_host[int(self.host_of_b[b])].append(b)
+        self.cap = np.asarray(topo.capacity, np.float64)          # [B,4]
+        self.host_cap = np.asarray(topo.host_capacity(), np.float64)
+        self.alive = np.asarray(topo.broker_alive, bool).copy()
+        self.new = np.asarray(topo.broker_new, bool)
+        self.has_new = bool(self.new.any())
+        self.base = np.asarray(topo.replica_base_load, np.float64)  # [R,4]
+        self.extra = np.asarray(topo.leader_extra, np.float64)      # [P,4]
+
+        # decision state
+        self.broker_of = np.asarray(broker_of, np.int64).copy()
+        self.leader_of = np.asarray(leader_of, np.int64).copy()    # [P]→r
+        self.orig_broker = self.broker_of.copy()
+        r_idx = np.arange(self.R)
+        self.is_leader = np.zeros(self.R, bool)
+        self.is_leader[self.leader_of] = True
+        # currently-offline flag (Replica.isCurrentOffline): offline at the
+        # ORIGINAL placement and not yet relocated to an alive broker
+        self.offline = np.asarray(topo.replica_offline, bool).copy()
+
+        # per-broker replica sets + (broker, partition) → replica lookup
+        self.replicas_on: List[Set[int]] = [set() for _ in range(B)]
+        self.rep_at: Dict[Tuple[int, int], int] = {}
+        for r in r_idx:
+            b = int(self.broker_of[r])
+            self.replicas_on[b].add(int(r))
+            self.rep_at[(b, int(self.part_of[r]))] = int(r)
+
+        # incremental aggregates (f64, like the Java doubles)
+        eff = self.base + np.where(self.is_leader[:, None],
+                                   self.extra[self.part_of], 0.0)
+        self.broker_load = np.zeros((B, 4))
+        np.add.at(self.broker_load, self.broker_of, eff)
+        self.host_load = np.zeros((self.H, 4))
+        np.add.at(self.host_load, self.host_of_b[self.broker_of], eff)
+        # leadershipLoadForNwResources (Broker.java): leader replicas' load
+        self.lead_load = np.zeros((B, 4))
+        np.add.at(self.lead_load, self.broker_of[self.leader_of],
+                  eff[self.leader_of])
+        # potential leadership NW_OUT (ClusterModel.java:205): every replica
+        # contributes its partition LEADER's NW_OUT
+        leader_nw_out = eff[self.leader_of, res.NW_OUT]           # [P]
+        self.leader_nw_out = leader_nw_out.copy()
+        self.pot_nw_out = np.zeros(B)
+        np.add.at(self.pot_nw_out, self.broker_of, leader_nw_out[self.part_of])
+
+        self.replica_count = np.bincount(self.broker_of, minlength=B)
+        self.leader_count = np.bincount(self.broker_of[self.leader_of],
+                                        minlength=B)
+        # per-broker per-topic replica counts (Broker.numReplicasOfTopicInBroker)
+        self.topic_count: List[Dict[int, int]] = [dict() for _ in range(B)]
+        t_of_r = self.topic_of_p[self.part_of]
+        for r in r_idx:
+            tc = self.topic_count[int(self.broker_of[r])]
+            t = int(t_of_r[r])
+            tc[t] = tc.get(t, 0) + 1
+        # per-topic cluster totals (move-invariant)
+        self.topic_total = np.bincount(t_of_r, minlength=self.T)
+
+        self.num_moves = 0
+        self.num_leads = 0
+
+    # ---- queries ---------------------------------------------------------
+
+    def eff_load(self, r: int) -> np.ndarray:
+        if self.is_leader[r]:
+            return self.base[r] + self.extra[self.part_of[r]]
+        return self.base[r]
+
+    def eff_util(self, r: int, resource: int) -> float:
+        v = self.base[r, resource]
+        if self.is_leader[r]:
+            v += self.extra[self.part_of[r], resource]
+        return float(v)
+
+    def util_pct(self, b: int, resource: int) -> float:
+        """GoalUtils.utilizationPercentage (GoalUtils.java:307-310)."""
+        cap = self.cap[b, resource]
+        return self.broker_load[b, resource] / cap if cap > 0 else -1.0
+
+    def alive_brokers(self) -> List[int]:
+        return [b for b in range(self.B) if self.alive[b]]
+
+    def current_offline_on(self, b: int) -> List[int]:
+        return [r for r in self.replicas_on[b] if self.offline[r]]
+
+    def has_offline(self) -> bool:
+        return bool(self.offline.any())
+
+    def partition_brokers(self, p: int) -> List[int]:
+        return [int(self.broker_of[r]) for r in self.reps_of_p[p]
+                if r >= 0]
+
+    def is_immigrant(self, r: int) -> bool:
+        return self.broker_of[r] != self.orig_broker[r]
+
+    # ---- mutations (ClusterModel.java:347,374) ---------------------------
+
+    def relocate_replica(self, r: int, dst: int) -> None:
+        src = int(self.broker_of[r])
+        p = int(self.part_of[r])
+        t = int(self.topic_of_p[p])
+        eff = self.eff_load(r)
+        self.replicas_on[src].discard(r)
+        self.replicas_on[dst].add(r)
+        del self.rep_at[(src, p)]
+        self.rep_at[(dst, p)] = r
+        self.broker_of[r] = dst
+        self.broker_load[src] -= eff
+        self.broker_load[dst] += eff
+        self.host_load[self.host_of_b[src]] -= eff
+        self.host_load[self.host_of_b[dst]] += eff
+        if self.is_leader[r]:
+            self.lead_load[src] -= eff
+            self.lead_load[dst] += eff
+            self.leader_count[src] -= 1
+            self.leader_count[dst] += 1
+        lno = self.leader_nw_out[p]
+        self.pot_nw_out[src] -= lno
+        self.pot_nw_out[dst] += lno
+        self.replica_count[src] -= 1
+        self.replica_count[dst] += 1
+        tc = self.topic_count[src]
+        tc[t] -= 1
+        if not tc[t]:
+            del tc[t]
+        tc = self.topic_count[dst]
+        tc[t] = tc.get(t, 0) + 1
+        if self.offline[r] and self.alive[dst]:
+            self.offline[r] = False
+        self.num_moves += 1
+
+    def relocate_leadership(self, p: int, r_new: int) -> None:
+        r_old = int(self.leader_of[p])
+        if r_old == r_new:
+            return
+        b_old = int(self.broker_of[r_old])
+        b_new = int(self.broker_of[r_new])
+        ex = self.extra[p]
+        eff_old = self.base[r_old] + ex        # old leader's leader-role load
+        # broker/host loads move by the leader extra only
+        self.broker_load[b_old] -= ex
+        self.broker_load[b_new] += ex
+        self.host_load[self.host_of_b[b_old]] -= ex
+        self.host_load[self.host_of_b[b_new]] += ex
+        self.lead_load[b_old] -= eff_old
+        self.lead_load[b_new] += self.base[r_new] + ex
+        self.leader_count[b_old] -= 1
+        self.leader_count[b_new] += 1
+        self.is_leader[r_old] = False
+        self.is_leader[r_new] = True
+        self.leader_of[p] = r_new
+        # potential NW_OUT: every holder of p now contributes the NEW
+        # leader's NW_OUT
+        new_lno = self.base[r_new, res.NW_OUT] + ex[res.NW_OUT]
+        d = new_lno - self.leader_nw_out[p]
+        if d:
+            for rr in self.reps_of_p[p]:
+                if rr >= 0:
+                    self.pot_nw_out[self.broker_of[rr]] += d
+            self.leader_nw_out[p] = new_lno
+        self.num_leads += 1
+
+    # ---- legality (GoalUtils.java:153-167) -------------------------------
+
+    def legit_move(self, r: int, dst: int, action: str) -> bool:
+        p = int(self.part_of[r])
+        if action == MOVE:
+            return (dst, p) not in self.rep_at
+        if action == LEAD:
+            return bool(self.is_leader[r]) and (dst, p) in self.rep_at
+        return False
+
+
+# ---------------------------------------------------------------------------
+# ClusterModelStats port (model/ClusterModelStats.java:26-46,275-460)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SeqStats:
+    """The comparator-relevant subset of ClusterModelStats."""
+
+    avg_util: np.ndarray        # [4] total utilization / num alive brokers
+    max_util: np.ndarray        # [4] hottest alive broker (absolute)
+    stdev_util: np.ndarray      # [4] sqrt(sum((u - avgPct*cap)^2)/nAlive)
+    num_balanced_by_resource: np.ndarray   # i64[4]
+    num_brokers_under_pot_nw_out: int
+    replica_stdev: float
+    leader_stdev: float
+    topic_stdev: float          # mean over topics of per-topic stdev
+
+
+def compute_seq_stats(m: SeqModel, constraint) -> SeqStats:
+    """ClusterModelStats.populate (ClusterModelStats.java:74-90) over the
+    mutable model — alive-broker populations throughout."""
+    alive = np.flatnonzero(m.alive)
+    n_alive = max(len(alive), 1)
+    bal = np.asarray(constraint.resource_balance_percentage, np.float64)
+    cap_thresh = np.asarray(constraint.capacity_threshold, np.float64)
+
+    avg_util = np.zeros(4)
+    max_util = np.zeros(4)
+    stdev = np.zeros(4)
+    n_balanced = np.zeros(4, np.int64)
+    for r in range(4):
+        host_scope = bool(res.IS_HOST_RESOURCE[r])
+        if host_scope:
+            util = m.host_load[m.host_of_b[alive], r]
+            cap = m.host_cap[m.host_of_b[alive], r]
+        else:
+            util = m.broker_load[alive, r]
+            cap = m.cap[alive, r]
+        total = m.broker_load[alive, r].sum()
+        total_cap = m.cap[alive, r].sum()
+        avg_pct = total / total_cap if total_cap > 0 else 0.0
+        upper = avg_pct * bal[r]
+        lower = avg_pct * max(0.0, 2.0 - bal[r])
+        with np.errstate(divide="ignore", invalid="ignore"):
+            pct = np.where(cap > 0, util / cap, 0.0)
+        n_balanced[r] = int(((pct >= lower) & (pct <= upper)).sum())
+        max_util[r] = util.max(initial=0.0)
+        stdev[r] = float(np.sqrt(
+            np.square(util - avg_pct * cap).sum() / n_alive))
+        avg_util[r] = total / n_alive
+
+    pot = m.pot_nw_out[alive]
+    pot_cap = m.cap[alive, res.NW_OUT]
+    with np.errstate(divide="ignore", invalid="ignore"):
+        pot_pct = np.where(pot_cap > 0, pot / pot_cap, np.inf)
+    n_under = int((pot_pct <= cap_thresh[res.NW_OUT]).sum())
+
+    def _count_stdev(counts: np.ndarray) -> float:
+        avg = counts.sum() / n_alive
+        return float(np.sqrt(np.square(counts[alive] - avg).sum() / n_alive))
+
+    rep_stdev = _count_stdev(m.replica_count.astype(np.float64))
+    lead_stdev = _count_stdev(m.leader_count.astype(np.float64))
+
+    # per-topic stdev over alive brokers, averaged over topics
+    # (ClusterModelStats.java:417-455). Sparse accumulation: sum_b (c-avg)^2
+    # = sum_b c^2 - 2*avg*sum_b c + n_alive*avg^2, walking only the nonzero
+    # per-broker topic counts (a dense [B, T] matrix is 600+ MB at the
+    # LinkedIn 2,600 x 30,000 shape).
+    avg_t = m.topic_total / n_alive                     # [T]
+    sum_c = np.zeros(m.T)
+    sum_c2 = np.zeros(m.T)
+    alive_mask = m.alive
+    for b in range(m.B):
+        if not alive_mask[b]:
+            continue
+        for t, c in m.topic_count[b].items():
+            sum_c[t] += c
+            sum_c2[t] += c * c
+    var_t = np.maximum(
+        (sum_c2 - 2.0 * avg_t * sum_c + n_alive * avg_t * avg_t) / n_alive,
+        0.0)
+    topic_stdev = float(np.sqrt(var_t).sum() / max(m.T, 1))
+
+    return SeqStats(avg_util=avg_util, max_util=max_util, stdev_util=stdev,
+                    num_balanced_by_resource=n_balanced,
+                    num_brokers_under_pot_nw_out=n_under,
+                    replica_stdev=rep_stdev, leader_stdev=lead_stdev,
+                    topic_stdev=topic_stdev)
+
+
+def compare_stats(goal_name: str, s1: SeqStats, s2: SeqStats,
+                  constraint) -> int:
+    """Per-goal ClusterModelStatsComparator.compare(stats1=after,
+    stats2=before) — the exact semantics of each reference comparator:
+
+    - Capacity / RackAware / ReplicaCapacity: always 0 (``CapacityGoal.java:489``,
+      ``RackAwareGoal.java:338``, ``ReplicaCapacityGoal.java:318``)
+    - ReplicaDistribution / LeaderReplicaDistribution / TopicReplicaDistribution:
+      st-dev of the respective count must not increase
+      (``ReplicaDistributionGoal.java:288``, ``LeaderReplicaDistributionGoal.java:338``,
+      ``TopicReplicaDistributionGoal.java:568``)
+    - ResourceDistribution: fewer balanced brokers is only OK if the
+      utilization st-dev improved (``ResourceDistributionGoal.java:960-988``)
+    - LeaderBytesInDistribution: NW_IN max under avg·balance% → better; else
+      st-dev compare with the NW_IN epsilon (``LeaderBytesInDistributionGoal.java:258``)
+    - PotentialNwOut: brokers under potential NW_OUT must not decrease
+      (``PotentialNwOutGoal.java:351``)
+    """
+    if goal_name in ("RackAwareGoal", "ReplicaCapacityGoal",
+                     "DiskCapacityGoal", "NetworkInboundCapacityGoal",
+                     "NetworkOutboundCapacityGoal", "CpuCapacityGoal",
+                     "PreferredLeaderElectionGoal"):
+        return 0
+    if goal_name == "ReplicaDistributionGoal":
+        return _compare(s2.replica_stdev, s1.replica_stdev, EPSILON)
+    if goal_name == "LeaderReplicaDistributionGoal":
+        return _compare(s2.leader_stdev, s1.leader_stdev, EPSILON)
+    if goal_name == "TopicReplicaDistributionGoal":
+        return _compare(s2.topic_stdev, s1.topic_stdev, EPSILON)
+    if goal_name == "LeaderBytesInDistributionGoal":
+        bal = constraint.resource_balance_percentage[res.NW_IN]
+        threshold = s1.avg_util[res.NW_IN] * bal
+        if s1.max_util[res.NW_IN] <= threshold:
+            return 1
+        return _resource_compare(np.sqrt(s2.stdev_util[res.NW_IN]),
+                                 np.sqrt(s1.stdev_util[res.NW_IN]),
+                                 res.NW_IN)
+    if goal_name == "PotentialNwOutGoal":
+        a, b = s1.num_brokers_under_pot_nw_out, s2.num_brokers_under_pot_nw_out
+        return (a > b) - (a < b)
+    # ResourceDistributionGoal family
+    r = _DISTRIBUTION_RESOURCE.get(goal_name)
+    if r is not None:
+        if (s2.num_balanced_by_resource[r] > s1.num_balanced_by_resource[r]
+                and s2.stdev_util[r] < s1.stdev_util[r]):
+            return -1
+        return 1
+    raise ValueError(f"unknown goal {goal_name!r}")
+
+
+_DISTRIBUTION_RESOURCE = {
+    "DiskUsageDistributionGoal": res.DISK,
+    "NetworkInboundUsageDistributionGoal": res.NW_IN,
+    "NetworkOutboundUsageDistributionGoal": res.NW_OUT,
+    "CpuUsageDistributionGoal": res.CPU,
+}
+_CAPACITY_RESOURCE = {
+    "DiskCapacityGoal": res.DISK,
+    "NetworkInboundCapacityGoal": res.NW_IN,
+    "NetworkOutboundCapacityGoal": res.NW_OUT,
+    "CpuCapacityGoal": res.CPU,
+}
+
+
+# ---------------------------------------------------------------------------
+# Goal base — the AbstractGoal walk (AbstractGoal.java:68-238)
+# ---------------------------------------------------------------------------
+
+
+class SeqGoal:
+    name = "SeqGoal"
+    hard = False
+
+    def __init__(self, constraint, options: SeqOptions):
+        self.constraint = constraint
+        self.options = options
+        self.finished = False
+        self.succeeded = True
+
+    # -- SPI hooks (subclasses override) -----------------------------------
+    def init_goal_state(self, m: SeqModel) -> None:
+        pass
+
+    def brokers_to_balance(self, m: SeqModel) -> List[int]:
+        return list(range(m.B))
+
+    def rebalance_for_broker(self, m: SeqModel, b: int,
+                             optimized: List["SeqGoal"]) -> None:
+        raise NotImplementedError
+
+    def update_goal_state(self, m: SeqModel) -> None:
+        self.finished = True
+
+    def self_satisfied(self, m: SeqModel, action) -> bool:
+        return True
+
+    def action_acceptance(self, m: SeqModel, action) -> int:
+        return ACCEPT
+
+    # -- the optimize loop (AbstractGoal.java:68-109) ----------------------
+    def optimize(self, m: SeqModel, optimized: List["SeqGoal"]) -> bool:
+        self.succeeded = True
+        self.finished = False
+        stats_before = compute_seq_stats(m, self.constraint)
+        broken_before = bool((~m.alive).any()) or m.has_offline()
+        self.init_goal_state(m)
+        while not self.finished:
+            for b in self.brokers_to_balance(m):
+                self.rebalance_for_broker(m, b, optimized)
+            self.update_goal_state(m)
+        if not broken_before:
+            stats_after = compute_seq_stats(m, self.constraint)
+            if compare_stats(self.name, stats_after, stats_before,
+                             self.constraint) < 0:
+                raise SeqOptimizationFailure(
+                    f"{self.name}: optimized result worse than before")
+        return self.succeeded
+
+    # -- eligible brokers (GoalUtils.java:121-140) -------------------------
+    def _eligible_brokers(self, m: SeqModel, r: int, candidates,
+                          action: str) -> List[int]:
+        opts = self.options
+        out = []
+        is_lead_action = (action == LEAD
+                          or (action == MOVE and m.is_leader[r]))
+        for b in candidates:
+            if (is_lead_action
+                    and b in opts.excluded_brokers_for_leadership
+                    and not m.offline[r]):
+                continue
+            if (action == MOVE
+                    and b in opts.excluded_brokers_for_replica_move
+                    and not m.offline[r]):
+                continue
+            out.append(b)
+        if opts.requested_destination_broker_ids:
+            # the reference intersects with the requested destinations for
+            # non-leadership actions (GoalUtils.java:100-104) and then
+            # early-returns for EVERY action type, skipping the new-broker
+            # invariant (GoalUtils.java:130-132)
+            if action == MOVE:
+                out = [b for b in out
+                       if b in opts.requested_destination_broker_ids]
+            return out
+        if m.has_new:
+            out = [b for b in out
+                   if m.new[b] or b == int(m.orig_broker[r])]
+        return out
+
+    # -- maybeApplyBalancingAction (AbstractGoal.java:181-223) -------------
+    def maybe_apply(self, m: SeqModel, r: int, candidates, action: str,
+                    optimized: List["SeqGoal"]) -> Optional[int]:
+        for b in self._eligible_brokers(m, r, candidates, action):
+            if not m.legit_move(r, b, action):
+                continue
+            act = (int(m.part_of[r]), int(m.broker_of[r]), b, action, None)
+            if not self.self_satisfied(m, act):
+                continue
+            if any(g.action_acceptance(m, act) != ACCEPT for g in optimized):
+                continue
+            if action == LEAD:
+                m.relocate_leadership(act[0], m.rep_at[(b, act[0])])
+            else:
+                m.relocate_replica(r, b)
+            return b
+        return None
+
+    # -- maybeApplySwapAction (AbstractGoal.java:238-289) ------------------
+    def maybe_apply_swap(self, m: SeqModel, r_src: int,
+                         candidate_replicas: Sequence[int],
+                         optimized: List["SeqGoal"]) -> Optional[int]:
+        if not len(candidate_replicas):
+            return None
+        dst_broker = int(m.broker_of[candidate_replicas[0]])
+        opts = self.options
+        # eligibleReplicasForSwap invariants (GoalUtils.java:200-230)
+        if (dst_broker in opts.excluded_brokers_for_leadership
+                and m.is_leader[r_src] and not m.offline[r_src]):
+            return None
+        if (dst_broker in opts.excluded_brokers_for_replica_move
+                and not m.offline[r_src]):
+            return None
+        src_broker = int(m.broker_of[r_src])
+        for r_dst in candidate_replicas:
+            if not m.legit_move(r_src, dst_broker, MOVE):
+                return None
+            if not m.legit_move(r_dst, src_broker, MOVE):
+                continue
+            act = (int(m.part_of[r_src]), src_broker, dst_broker, SWAP,
+                   int(m.part_of[r_dst]))
+            if not self.self_satisfied(m, act):
+                return None
+            acc = ACCEPT
+            for g in optimized:
+                acc = g.action_acceptance(m, act)
+                if acc != ACCEPT:
+                    break
+            if acc == ACCEPT:
+                m.relocate_replica(r_src, dst_broker)
+                m.relocate_replica(r_dst, src_broker)
+                return r_dst
+            if acc == BROKER_REJECT:
+                return None
+        return None
+
+    # -- shared selection/sort helpers -------------------------------------
+    def _movable(self, m: SeqModel, r: int) -> bool:
+        """Excluded-topic / immigrant-only selection shared by the sort
+        helpers (ReplicaSortFunctionFactory selection funcs)."""
+        t = int(m.topic_of_p[m.part_of[r]])
+        if t in self.options.excluded_topics and not m.offline[r]:
+            return False
+        if (self.options.only_move_immigrant_replicas
+                and not m.is_immigrant(r) and not m.offline[r]):
+            return False
+        return True
+
+
+# ---------------------------------------------------------------------------
+# RackAwareGoal (goals/RackAwareGoal.java:43,161-316)
+# ---------------------------------------------------------------------------
+
+
+class SeqRackAwareGoal(SeqGoal):
+    name = "RackAwareGoal"
+    hard = True
+
+    def action_acceptance(self, m: SeqModel, action) -> int:
+        p, src, dst, kind, p2 = action
+        if kind == LEAD:
+            return ACCEPT
+        if self._move_violates(m, p, src, dst):
+            return BROKER_REJECT
+        if kind == SWAP and self._move_violates(m, p2, dst, src):
+            return REPLICA_REJECT
+        return ACCEPT
+
+    def _move_violates(self, m: SeqModel, p: int, src: int, dst: int) -> bool:
+        dst_rack = m.rack_of_b[dst]
+        for b in m.partition_brokers(p):
+            if b != src and m.rack_of_b[b] == dst_rack:
+                return True
+        return False
+
+    def init_goal_state(self, m: SeqModel) -> None:
+        num_racks_alive = len({int(m.rack_of_b[b]) for b in m.alive_brokers()})
+        max_rf = int(np.max(np.asarray(m.topo.rf_of_partition)))
+        if max_rf > num_racks_alive:
+            raise SeqOptimizationFailure(
+                f"RackAwareGoal: {num_racks_alive} racks < max RF {max_rf}")
+
+    def _satisfied(self, m: SeqModel, r: int) -> bool:
+        p = int(m.part_of[r])
+        my_rack = m.rack_of_b[m.broker_of[r]]
+        my_broker = int(m.broker_of[r])
+        for b in m.partition_brokers(p):
+            if b != my_broker and m.rack_of_b[b] == my_rack:
+                return False
+        return True
+
+    def rebalance_for_broker(self, m, b, optimized):
+        for r in sorted(m.replicas_on[b]):
+            if not self._movable(m, r):
+                continue
+            if (m.alive[b] and not m.offline[r]
+                    and self._satisfied(m, r)):
+                continue
+            # move to a broker in a rack with no other replica of p
+            p = int(m.part_of[r])
+            taken = {int(m.rack_of_b[pb]) for pb in m.partition_brokers(p)
+                     if pb != int(m.broker_of[r])}
+            eligible = [bb for bb in m.alive_brokers()
+                        if int(m.rack_of_b[bb]) not in taken]
+            if self.maybe_apply(m, r, eligible, MOVE, optimized) is None:
+                raise SeqOptimizationFailure(
+                    f"RackAwareGoal: violated for broker {b}")
+
+
+# ---------------------------------------------------------------------------
+# ReplicaCapacityGoal (goals/ReplicaCapacityGoal.java:41-318)
+# ---------------------------------------------------------------------------
+
+
+class SeqReplicaCapacityGoal(SeqGoal):
+    name = "ReplicaCapacityGoal"
+    hard = True
+
+    def __init__(self, constraint, options):
+        super().__init__(constraint, options)
+        self.self_healing_mode = False
+
+    def action_acceptance(self, m, action) -> int:
+        p, src, dst, kind, p2 = action
+        if kind == MOVE:
+            return (ACCEPT if m.replica_count[dst]
+                    < self.constraint.max_replicas_per_broker
+                    else REPLICA_REJECT)
+        return ACCEPT
+
+    def self_satisfied(self, m, action) -> bool:
+        return (m.replica_count[action[2]]
+                < self.constraint.max_replicas_per_broker)
+
+    def init_goal_state(self, m) -> None:
+        self.self_healing_mode = bool((~m.alive).any()) or m.has_offline()
+        limit = self.constraint.max_replicas_per_broker
+        n_alive = len(m.alive_brokers())
+        if int(m.replica_count.sum()) > limit * n_alive:
+            raise SeqOptimizationFailure(
+                "ReplicaCapacityGoal: total replicas exceed cluster limit")
+
+    def update_goal_state(self, m) -> None:
+        if not self.self_healing_mode:
+            limit = self.constraint.max_replicas_per_broker
+            for b in range(m.B):
+                if m.replica_count[b] > limit:
+                    raise SeqOptimizationFailure(
+                        f"ReplicaCapacityGoal: broker {b} over limit")
+            self.finished = True
+        else:
+            self.self_healing_mode = False
+
+    def rebalance_for_broker(self, m, b, optimized):
+        limit = self.constraint.max_replicas_per_broker
+        # offline replicas first (the reference's replica comparator)
+        reps = sorted(m.replicas_on[b],
+                      key=lambda r: (not m.offline[r], r))
+        for r in reps:
+            if not self._movable(m, r):
+                continue
+            if m.replica_count[b] <= limit and not m.offline[r]:
+                break
+            eligible = sorted(
+                (bb for bb in m.alive_brokers()
+                 if bb != b and (self.self_healing_mode
+                                 or m.replica_count[bb] < limit)),
+                key=lambda bb: (m.replica_count[bb], bb))
+            dst = self.maybe_apply(m, r, eligible, MOVE, optimized)
+            if dst is None and (not m.alive[b] or m.offline[r]):
+                raise SeqOptimizationFailure(
+                    f"ReplicaCapacityGoal: cannot move replica {r} off "
+                    f"broker {b}")
+
+
+# ---------------------------------------------------------------------------
+# CapacityGoal family (goals/CapacityGoal.java:38-502)
+# ---------------------------------------------------------------------------
+
+
+class SeqCapacityGoal(SeqGoal):
+    hard = True
+
+    def __init__(self, name, constraint, options):
+        super().__init__(constraint, options)
+        self.name = name
+        self.r = _CAPACITY_RESOURCE[name]
+
+    # capacity check after adding load (CapacityGoal.java:436-466)
+    def _under_limit_after_add(self, m: SeqModel, dst: int,
+                               util: float) -> bool:
+        r = self.r
+        thresh = self.constraint.capacity_threshold[r]
+        if res.IS_HOST_RESOURCE[r]:
+            h = m.host_of_b[dst]
+            if m.host_load[h, r] + util >= m.host_cap[h, r] * thresh:
+                return False
+        if res.IS_BROKER_RESOURCE[r]:
+            return (m.broker_load[dst, r] + util
+                    < m.cap[dst, r] * thresh)
+        return True
+
+    def action_acceptance(self, m, action) -> int:
+        p, src, dst, kind, p2 = action
+        r_src = m.rep_at[(src, p)]
+        if kind == SWAP:
+            r_dst = m.rep_at[(dst, p2)]
+            d = m.eff_util(r_dst, self.r) - m.eff_util(r_src, self.r)
+            ok = (self._under_limit_after_add(m, src, d) if d > 0
+                  else self._under_limit_after_add(m, dst, -d))
+            return ACCEPT if ok else REPLICA_REJECT
+        # NOTE (CapacityGoal.java:74-81): leadership CPU moves are treated
+        # as carrying the FULL leader utilization — intentional reference
+        # behavior we reproduce
+        util = m.eff_util(r_src, self.r)
+        return (ACCEPT if self._under_limit_after_add(m, dst, util)
+                else REPLICA_REJECT)
+
+    def self_satisfied(self, m, action) -> bool:
+        p, src, dst, kind, p2 = action
+        r_src = m.rep_at[(src, p)]
+        return self._under_limit_after_add(m, dst,
+                                           m.eff_util(r_src, self.r))
+
+    def init_goal_state(self, m) -> None:
+        r = self.r
+        alive = np.flatnonzero(m.alive)
+        existing = m.broker_load[alive, r].sum()
+        allowed = (m.cap[alive, r].sum()
+                   * self.constraint.capacity_threshold[r])
+        if allowed < existing:
+            raise SeqOptimizationFailure(
+                f"{self.name}: insufficient healthy capacity")
+
+    def _over_limit(self, m: SeqModel, b: int) -> bool:
+        r = self.r
+        thresh = self.constraint.capacity_threshold[r]
+        if res.IS_HOST_RESOURCE[r]:
+            h = m.host_of_b[b]
+            host_has_reps = any(m.replicas_on[bb]
+                                for bb in m.brokers_of_host[h])
+            if (host_has_reps
+                    and m.host_load[h, r] > m.host_cap[h, r] * thresh):
+                return True
+        if res.IS_BROKER_RESOURCE[r]:
+            return (bool(m.replicas_on[b])
+                    and m.broker_load[b, r] > m.cap[b, r] * thresh)
+        return False
+
+    def update_goal_state(self, m) -> None:
+        for b in range(m.B):
+            if self._over_limit(m, b):
+                raise SeqOptimizationFailure(
+                    f"{self.name}: broker {b} above capacity after balance")
+        if m.has_offline():
+            raise SeqOptimizationFailure(
+                f"{self.name}: offline replicas remain")
+        self.finished = True
+
+    def rebalance_for_broker(self, m, b, optimized):
+        r = self.r
+        if not self._over_limit(m, b) and not m.current_offline_on(b):
+            return
+        # (1) leadership moves for NW_OUT / CPU (CapacityGoal.java:305-330)
+        if r in (res.NW_OUT, res.CPU):
+            leaders = sorted(
+                (rr for rr in m.replicas_on[b]
+                 if m.is_leader[rr] and self._movable(m, rr)),
+                key=lambda rr: -m.eff_util(rr, r))
+            for leader in leaders:
+                p = int(m.part_of[leader])
+                followers = [rr for rr in m.reps_of_p[p]
+                             if rr >= 0 and rr != leader
+                             and not m.offline[rr]]
+                eligible = sorted(
+                    (int(m.broker_of[rr]) for rr in followers),
+                    key=lambda bb: m.util_pct(bb, r))
+                self.maybe_apply(m, leader, eligible, LEAD, optimized)
+                if not self._over_limit(m, b):
+                    break
+        # (2) replica moves (CapacityGoal.java:332-356)
+        if self._over_limit(m, b) or m.current_offline_on(b):
+            thresh = self.constraint.capacity_threshold[r]
+            under = self._sorted_alive_under_threshold(m, thresh)
+            reps = sorted(
+                (rr for rr in m.replicas_on[b] if self._movable(m, rr)),
+                key=lambda rr: (not m.offline[rr],
+                                not m.is_immigrant(rr),
+                                -m.eff_util(rr, r)))
+            for rr in reps:
+                self.maybe_apply(m, rr, under, MOVE, optimized)
+                if (not self._over_limit(m, b)
+                        and not m.current_offline_on(b)):
+                    break
+        if self._over_limit(m, b):
+            raise SeqOptimizationFailure(
+                f"{self.name}: violated capacity for broker {b}")
+        if m.current_offline_on(b):
+            raise SeqOptimizationFailure(
+                f"{self.name}: offline replicas remain on broker {b}")
+
+    def _sorted_alive_under_threshold(self, m: SeqModel,
+                                      thresh: float) -> List[int]:
+        """ClusterModel.sortedAliveBrokersUnderThreshold
+        (ClusterModel.java:984-1031)."""
+        r = self.r
+        out = []
+        for b in m.alive_brokers():
+            if (res.IS_BROKER_RESOURCE[r]
+                    and m.broker_load[b, r] >= m.cap[b, r] * thresh):
+                continue
+            if res.IS_HOST_RESOURCE[r]:
+                h = m.host_of_b[b]
+                if m.host_load[h, r] >= m.host_cap[h, r] * thresh:
+                    continue
+            out.append(b)
+        if res.IS_HOST_RESOURCE[r]:
+            out.sort(key=lambda b: (m.host_load[m.host_of_b[b], r],
+                                    m.broker_load[b, r]))
+        else:
+            out.sort(key=lambda b: m.broker_load[b, r])
+        return out
+
+
+# ---------------------------------------------------------------------------
+# ResourceDistributionGoal family (goals/ResourceDistributionGoal.java:50-999)
+# ---------------------------------------------------------------------------
+
+
+class SeqResourceDistributionGoal(SeqGoal):
+    hard = False
+
+    def __init__(self, name, constraint, options):
+        super().__init__(constraint, options)
+        self.name = name
+        self.r = _DISTRIBUTION_RESOURCE[name]
+        self.fix_offline_only = False
+        self.upper = 0.0   # balance thresholds in utilization PERCENTAGE
+        self.lower = 0.0
+
+    # -- thresholds (ResourceDistributionGoal.java:926-957) ----------------
+    def _balance_pct_with_margin(self) -> float:
+        bal = self.constraint.resource_balance_percentage[self.r]
+        if self.options.is_triggered_by_goal_violation:
+            bal *= self.constraint.goal_violation_distribution_threshold_multiplier
+        return (bal - 1.0) * BALANCE_MARGIN
+
+    def init_goal_state(self, m) -> None:
+        self.fix_offline_only = False
+        r = self.r
+        alive = np.flatnonzero(m.alive)
+        avg_pct = (m.broker_load[alive, r].sum()
+                   / max(m.cap[alive, r].sum(), 1e-30))
+        margin = self._balance_pct_with_margin()
+        self.upper = avg_pct * (1.0 + margin)
+        self.lower = avg_pct * max(0.0, 1.0 - margin)
+
+    # -- band checks (ResourceDistributionGoal.java:757-815) ---------------
+    def _above_lower_after(self, m, b: int, delta: float, add: bool) -> bool:
+        r = self.r
+        d = delta if add else -delta
+        broker_ok = (m.broker_load[b, r] + d
+                     >= m.cap[b, r] * self.lower)
+        if res.IS_HOST_RESOURCE[r]:
+            h = m.host_of_b[b]
+            host_ok = (m.host_load[h, r] + d
+                       >= m.host_cap[h, r] * self.lower)
+            return host_ok or broker_ok
+        return broker_ok
+
+    def _under_upper_after(self, m, b: int, delta: float, add: bool) -> bool:
+        r = self.r
+        d = delta if add else -delta
+        broker_ok = (m.broker_load[b, r] + d
+                     <= m.cap[b, r] * self.upper)
+        if res.IS_HOST_RESOURCE[r]:
+            h = m.host_of_b[b]
+            host_ok = (m.host_load[h, r] + d
+                       <= m.host_cap[h, r] * self.upper)
+            return host_ok or broker_ok
+        return broker_ok
+
+    def _above_lower(self, m, b: int) -> bool:
+        return self._above_lower_after(m, b, 0.0, True)
+
+    def _under_upper(self, m, b: int) -> bool:
+        return self._under_upper_after(m, b, 0.0, False)
+
+    # -- swap limit checks (ResourceDistributionGoal.java:867-925) ---------
+    def _swap_violates_limit(self, m, r_src: int, r_dst: int) -> bool:
+        d = (m.eff_util(r_dst, self.r) - m.eff_util(r_src, self.r))
+        b_src = int(m.broker_of[r_src])
+        b_dst = int(m.broker_of[r_dst])
+
+        def container_violates(load_src, cap_src, load_dst, cap_dst):
+            if d > 0:
+                if load_src + d > cap_src * self.upper:
+                    return True
+            else:
+                if load_dst - d > cap_dst * self.upper:
+                    return True
+            if d < 0:
+                return load_src + d < cap_src * self.lower
+            return load_dst - d < cap_dst * self.lower
+
+        r = self.r
+        broker_bad = container_violates(
+            m.broker_load[b_src, r], m.cap[b_src, r],
+            m.broker_load[b_dst, r], m.cap[b_dst, r])
+        if not broker_bad or not res.IS_HOST_RESOURCE[r]:
+            return broker_bad
+        h_src, h_dst = m.host_of_b[b_src], m.host_of_b[b_dst]
+        return container_violates(
+            m.host_load[h_src, r], m.host_cap[h_src, r],
+            m.host_load[h_dst, r], m.host_cap[h_dst, r])
+
+    def _more_balanced(self, m, b_src: int, b_dst: int, d: float) -> bool:
+        """isGettingMoreBalanced (ResourceDistributionGoal.java:853-865):
+        d is the utilization delta REMOVED from dst and ADDED to src."""
+        r = self.r
+        prev = m.broker_load[b_src, r] - m.broker_load[b_dst, r]
+        nxt = prev + 2 * d
+        return abs(nxt) < abs(prev)
+
+    # -- acceptance / selfSatisfied (ResourceDistributionGoal.java:95-215) -
+    def action_acceptance(self, m, action) -> int:
+        p, src, dst, kind, p2 = action
+        r_src = m.rep_at[(src, p)]
+        if kind == SWAP:
+            r_dst = m.rep_at[(dst, p2)]
+            d = m.eff_util(r_dst, self.r) - m.eff_util(r_src, self.r)
+            if d == 0:
+                return ACCEPT
+            both_within = ((self._above_lower(m, dst)
+                            and self._under_upper(m, src)) if d > 0
+                           else (self._above_lower(m, src)
+                                 and self._under_upper(m, dst)))
+            if both_within:
+                return (REPLICA_REJECT
+                        if self._swap_violates_limit(m, r_src, r_dst)
+                        else ACCEPT)
+            return (ACCEPT if self._more_balanced(m, src, dst, d)
+                    else REPLICA_REJECT)
+        # MOVE / LEAD
+        util = m.eff_util(r_src, self.r)
+        if self._above_lower(m, src) and self._under_upper(m, dst):
+            ok = (self._under_upper_after(m, dst, util, True)
+                  and self._above_lower_after(m, src, util, False))
+            return ACCEPT if ok else REPLICA_REJECT
+        return (ACCEPT if self._more_balanced(m, src, dst, -util)
+                else REPLICA_REJECT)
+
+    def self_satisfied(self, m, action) -> bool:
+        p, src, dst, kind, p2 = action
+        r_src = m.rep_at[(src, p)]
+        if self.fix_offline_only and m.offline[r_src]:
+            return kind == MOVE
+        if kind == SWAP:
+            r_dst = m.rep_at[(dst, p2)]
+            d = m.eff_util(r_dst, self.r) - m.eff_util(r_src, self.r)
+            return d != 0 and not self._swap_violates_limit(m, r_src, r_dst)
+        util = m.eff_util(r_src, self.r)
+        return (self._under_upper_after(m, dst, util, True)
+                and self._above_lower_after(m, src, util, False))
+
+    def brokers_to_balance(self, m) -> List[int]:
+        if m.has_new:
+            return [b for b in range(m.B) if m.new[b]]
+        return list(range(m.B))
+
+    def update_goal_state(self, m) -> None:
+        for b in m.alive_brokers():
+            if not self._under_upper(m, b) or not self._above_lower(m, b):
+                self.succeeded = False
+                break
+        if m.has_offline():
+            if self.fix_offline_only:
+                raise SeqOptimizationFailure(
+                    f"{self.name}: offline replicas remain")
+            self.fix_offline_only = True
+            return
+        self.finished = True
+
+    # -- the move/swap ladder (ResourceDistributionGoal.java:308-686) ------
+    def rebalance_for_broker(self, m, b, optimized):
+        n_offline = len(m.current_offline_on(b))
+        require_less = n_offline > 0 or not self._under_upper(m, b)
+        require_more = not self._above_lower(m, b)
+        move_immigrants_only = False
+        if n_offline == 0:
+            if not require_less and not require_more:
+                return
+            move_immigrants_only = (m.has_offline()
+                                    or self.options.only_move_immigrant_replicas)
+            if (move_immigrants_only and require_less
+                    and not any(m.is_immigrant(r) for r in m.replicas_on[b])):
+                return
+
+        if self.r in (res.NW_OUT, res.CPU) and not (
+                self.fix_offline_only and n_offline):
+            if require_less and not self._move_load_out(m, b, LEAD,
+                                                        optimized):
+                require_less = False
+            if require_more and not self._move_load_in(m, b, LEAD, optimized,
+                                                       False):
+                require_more = False
+
+        unbalanced = False
+        if require_less:
+            if self._move_load_out(m, b, MOVE, optimized):
+                unbalanced = self._swap_load_out(m, b, optimized,
+                                                 move_immigrants_only)
+        if require_more:
+            if self._move_load_in(m, b, MOVE, optimized,
+                                  move_immigrants_only):
+                unbalanced = unbalanced or self._swap_load_in(
+                    m, b, optimized, move_immigrants_only)
+        if unbalanced:
+            self.succeeded = self.succeeded and False
+
+    def _sorted_replicas(self, m, b, leaders_only=False, followers_only=False,
+                         immigrants_only=False, ascending=False,
+                         load_limit=None):
+        """sortedCandidateReplicas (ResourceDistributionGoal.java:449-472):
+        offline first, then by resource load."""
+        r = self.r
+        out = []
+        for rr in m.replicas_on[b]:
+            if not self._movable(m, rr):
+                continue
+            if leaders_only and not m.is_leader[rr]:
+                continue
+            if followers_only and m.is_leader[rr]:
+                continue
+            if immigrants_only and not m.is_immigrant(rr):
+                continue
+            u = m.eff_util(rr, r)
+            if load_limit is not None:
+                if ascending and u >= load_limit:
+                    continue
+                if not ascending and u <= load_limit:
+                    continue
+            out.append(rr)
+        out.sort(key=(lambda rr: (not m.offline[rr], m.eff_util(rr, r)))
+                 if ascending else
+                 (lambda rr: (not m.offline[rr], -m.eff_util(rr, r))))
+        return out
+
+    def _move_load_out(self, m, b, action, optimized) -> bool:
+        """rebalanceByMovingLoadOut (ResourceDistributionGoal.java:686-756).
+        Returns True when still over the upper limit."""
+        r = self.r
+        if self.fix_offline_only:
+            candidates = sorted(m.alive_brokers(),
+                                key=lambda bb: (m.util_pct(bb, r), bb))
+        else:
+            candidates = sorted(
+                (bb for bb in m.alive_brokers()
+                 if m.util_pct(bb, r) < self.upper),
+                key=lambda bb: (m.util_pct(bb, r), bb))
+        healing = m.has_offline()
+        reps = []
+        for rr in m.replicas_on[b]:
+            if not self._movable(m, rr):
+                continue
+            if action == LEAD and not m.is_leader[rr]:
+                continue
+            if (healing and m.alive[b] and not m.is_immigrant(rr)
+                    and not m.offline[rr]):
+                continue
+            reps.append(rr)
+        reps.sort(key=lambda rr: (not m.offline[rr],
+                                  not m.is_immigrant(rr),
+                                  -m.eff_util(rr, r)))
+        for rr in reps:
+            if m.eff_util(rr, r) == 0.0 and not m.offline[rr]:
+                break
+            if action == LEAD:
+                p = int(m.part_of[rr])
+                cand_set = set(candidates)
+                eligible = sorted(
+                    (int(m.broker_of[f]) for f in m.reps_of_p[p]
+                     if f >= 0 and f != rr and not m.offline[f]
+                     and int(m.broker_of[f]) in cand_set),
+                    key=lambda bb: (m.util_pct(bb, r), bb))
+            else:
+                eligible = candidates
+            dst = self.maybe_apply(m, rr, eligible, action, optimized)
+            if dst is not None:
+                if self._under_upper(m, b) and not (
+                        self.fix_offline_only and m.current_offline_on(b)):
+                    return False
+                if action == MOVE:
+                    candidates = [c for c in candidates if c != dst]
+                    if m.util_pct(dst, r) < self.upper:
+                        candidates.append(dst)
+                        candidates.sort(
+                            key=lambda bb: (m.util_pct(bb, r), bb))
+        return bool(m.replicas_on[b])
+
+    def _move_load_in(self, m, b, action, optimized,
+                      move_immigrants_only) -> bool:
+        """rebalanceByMovingLoadIn (ResourceDistributionGoal.java:364-432).
+        Returns True when still under the lower limit."""
+        r = self.r
+        if m.has_new and not m.new[b]:
+            return True
+        follower_only = (b in self.options.excluded_brokers_for_leadership)
+        alive = np.flatnonzero(m.alive)
+        cluster_pct = (m.broker_load[alive, r].sum()
+                       / max(m.cap[alive, r].sum(), 1e-30))
+        pq = sorted((bb for bb in m.alive_brokers()
+                     if m.util_pct(bb, r) > cluster_pct),
+                    key=lambda bb: (-m.util_pct(bb, r), bb))
+        srcs = {bb: self._sorted_replicas(
+                    m, bb, leaders_only=(r == res.NW_OUT),
+                    followers_only=follower_only,
+                    immigrants_only=move_immigrants_only)
+                for bb in pq}
+        while pq and (action == MOVE
+                      or m.leader_count[b] != m.replica_count[b]):
+            cb = pq.pop(0)
+            for rr in list(srcs[cb]):
+                dst = self.maybe_apply(m, rr, [b], action, optimized)
+                if dst is not None:
+                    if self._above_lower(m, b):
+                        return False
+                    if action == MOVE:
+                        srcs[cb].remove(rr)
+                    if pq and m.util_pct(cb, r) < m.util_pct(pq[0], r):
+                        pq.append(cb)
+                        pq.sort(key=lambda bb: (-m.util_pct(bb, r), bb))
+                        break
+        return True
+
+    def _swap_load_out(self, m, b, optimized, move_immigrants_only) -> bool:
+        """rebalanceBySwappingLoadOut (ResourceDistributionGoal.java:502-590).
+        Returns True when still over the limit after swaps."""
+        t0 = time.time()
+        r = self.r
+        if (not m.alive[b]
+                or b in self.options.excluded_brokers_for_replica_move):
+            return True
+        src_reps = self._sorted_replicas(
+            m, b, leaders_only=(r == res.NW_OUT),
+            immigrants_only=move_immigrants_only, ascending=False,
+            load_limit=0.0)
+        if not src_reps:
+            return True
+        max_src_load = max((m.eff_util(rr, r) for rr in src_reps
+                            if not m.offline[rr]),
+                           default=m.eff_util(src_reps[0], r))
+        follower_only = (b in self.options.excluded_brokers_for_leadership)
+        pq = sorted((bb for bb in m.alive_brokers()
+                     if bb != b and m.replicas_on[bb]
+                     and m.util_pct(bb, r) < self.upper),
+                    key=lambda bb: (m.util_pct(bb, r), bb))
+        while pq:
+            if time.time() - t0 > PER_BROKER_SWAP_TIMEOUT_S:
+                break
+            cb = pq.pop(0)
+            cand = self._sorted_replicas(
+                m, cb, followers_only=follower_only,
+                immigrants_only=move_immigrants_only, ascending=True,
+                load_limit=max_src_load)
+            swapped = None
+            for r_src in list(src_reps):
+                swapped = self.maybe_apply_swap(m, r_src, cand, optimized)
+                if swapped is not None:
+                    if self._under_upper(m, b):
+                        return False
+                    break
+                if time.time() - t0 > PER_BROKER_SWAP_TIMEOUT_S:
+                    return True
+            if swapped is not None:
+                src_reps = self._sorted_replicas(
+                    m, b, leaders_only=(r == res.NW_OUT),
+                    immigrants_only=move_immigrants_only, ascending=False,
+                    load_limit=0.0)
+                pq.append(cb)
+                pq.sort(key=lambda bb: (m.util_pct(bb, r), bb))
+        return True
+
+    def _swap_load_in(self, m, b, optimized, move_immigrants_only) -> bool:
+        """rebalanceBySwappingLoadIn (ResourceDistributionGoal.java:599-686)."""
+        t0 = time.time()
+        r = self.r
+        if (not m.alive[b]
+                or b in self.options.excluded_brokers_for_replica_move):
+            return True
+        src_reps = self._sorted_replicas(
+            m, b, immigrants_only=move_immigrants_only, ascending=True)
+        if not src_reps:
+            return True
+        min_src_load = min((m.eff_util(rr, r) for rr in src_reps
+                            if not m.offline[rr]),
+                           default=m.eff_util(src_reps[0], r))
+        follower_only = (b in self.options.excluded_brokers_for_leadership)
+        pq = sorted((bb for bb in m.alive_brokers()
+                     if bb != b and m.util_pct(bb, r) > self.lower),
+                    key=lambda bb: (-m.util_pct(bb, r), bb))
+        while pq:
+            if time.time() - t0 > PER_BROKER_SWAP_TIMEOUT_S:
+                break
+            cb = pq.pop(0)
+            cand = self._sorted_replicas(
+                m, cb, leaders_only=(r == res.NW_OUT),
+                followers_only=follower_only,
+                immigrants_only=move_immigrants_only, ascending=False,
+                load_limit=min_src_load)
+            swapped = None
+            for r_src in list(src_reps):
+                swapped = self.maybe_apply_swap(m, r_src, cand, optimized)
+                if swapped is not None:
+                    if self._above_lower(m, b):
+                        return False
+                    break
+                if time.time() - t0 > PER_BROKER_SWAP_TIMEOUT_S:
+                    return True
+            if swapped is not None:
+                src_reps = self._sorted_replicas(
+                    m, b, immigrants_only=move_immigrants_only,
+                    ascending=True)
+                pq.append(cb)
+                pq.sort(key=lambda bb: (-m.util_pct(bb, r), bb))
+        return True
+
+
+# ---------------------------------------------------------------------------
+# Replica / LeaderReplica count distribution
+# (goals/ReplicaDistributionAbstractGoal.java:23-240,
+#  ReplicaDistributionGoal.java:39-290, LeaderReplicaDistributionGoal.java:38-340)
+# ---------------------------------------------------------------------------
+
+
+class _SeqCountDistributionBase(SeqGoal):
+    hard = False
+
+    def __init__(self, constraint, options):
+        super().__init__(constraint, options)
+        self.fix_offline_only = False
+        self.upper = 0
+        self.lower = 0
+        self._failed_above: Set[int] = set()
+        self._failed_below: Set[int] = set()
+
+    def _balance_percentage(self) -> float:
+        raise NotImplementedError
+
+    def _num_interested(self, m) -> int:
+        raise NotImplementedError
+
+    def init_goal_state(self, m) -> None:
+        self.fix_offline_only = False
+        avg = self._num_interested(m) / max(len(m.alive_brokers()), 1)
+        bal = self._balance_percentage()
+        if self.options.is_triggered_by_goal_violation:
+            bal *= self.constraint.goal_violation_distribution_threshold_multiplier
+        margin = (bal - 1.0) * BALANCE_MARGIN
+        self.upper = int(np.ceil(avg * (1.0 + margin)))
+        self.lower = int(np.floor(avg * max(0.0, 1.0 - margin)))
+
+    def _count_ok_after(self, m, b: int, count: int, add: bool,
+                        check_upper: bool) -> bool:
+        limit_u = self.upper if m.alive[b] else 0
+        limit_l = self.lower if m.alive[b] else 0
+        c = count + (1 if add else -1)
+        return c <= limit_u if check_upper else c >= limit_l
+
+    def update_goal_state(self, m) -> None:
+        if self._failed_above or self._failed_below:
+            self.succeeded = False
+            self._failed_above.clear()
+            self._failed_below.clear()
+        if m.has_offline():
+            if self.fix_offline_only:
+                raise SeqOptimizationFailure(
+                    f"{self.name}: offline replicas remain")
+            self.fix_offline_only = True
+            return
+        self.finished = True
+
+
+class SeqReplicaDistributionGoal(_SeqCountDistributionBase):
+    name = "ReplicaDistributionGoal"
+
+    def _balance_percentage(self) -> float:
+        return self.constraint.replica_balance_percentage
+
+    def _num_interested(self, m) -> int:
+        return m.R
+
+    def action_acceptance(self, m, action) -> int:
+        p, src, dst, kind, p2 = action
+        if kind != MOVE:
+            return ACCEPT
+        ok = (self._count_ok_after(m, dst, int(m.replica_count[dst]),
+                                   True, True)
+              and self._count_ok_after(m, src, int(m.replica_count[src]),
+                                       False, False))
+        return ACCEPT if ok else REPLICA_REJECT
+
+    def self_satisfied(self, m, action) -> bool:
+        p, src, dst, kind, p2 = action
+        r_src = m.rep_at[(src, p)]
+        if self.fix_offline_only and m.offline[r_src]:
+            return True
+        return self.action_acceptance(m, action) == ACCEPT
+
+    def _sorted_reps(self, m, b) -> List[int]:
+        healing = m.has_offline()
+        out = []
+        for rr in m.replicas_on[b]:
+            if not self._movable(m, rr):
+                continue
+            if (healing and m.alive[b] and not m.is_immigrant(rr)
+                    and not m.offline[rr]):
+                continue
+            out.append(rr)
+        # offline first, then immigrants, then ascending disk load
+        out.sort(key=lambda rr: (not m.offline[rr], not m.is_immigrant(rr),
+                                 m.eff_util(rr, res.DISK)))
+        return out
+
+    def rebalance_for_broker(self, m, b, optimized):
+        n = int(m.replica_count[b])
+        n_off = len(m.current_offline_on(b))
+        require_less = n_off > 0 or n > self.upper
+        require_more = m.alive[b] and n - n_off < self.lower
+        if m.alive[b] and not require_more and not require_less:
+            return
+        if m.has_new and not m.new[b] and not require_less:
+            return
+        if (((m.has_offline() and not n_off)
+             or self.options.only_move_immigrant_replicas)
+                and require_less
+                and not any(m.is_immigrant(r) for r in m.replicas_on[b])):
+            return
+        if require_less and self._move_out(m, b, optimized):
+            self._failed_above.add(b)
+        if require_more and self._move_in(m, b, optimized):
+            self._failed_below.add(b)
+
+    def _move_out(self, m, b, optimized) -> bool:
+        if self.fix_offline_only:
+            candidates = sorted(m.alive_brokers(),
+                                key=lambda bb: (m.replica_count[bb], bb))
+        else:
+            candidates = sorted(
+                (bb for bb in m.alive_brokers()
+                 if m.replica_count[bb] < self.upper),
+                key=lambda bb: (m.replica_count[bb], bb))
+        stuck_offline = False
+        for rr in self._sorted_reps(m, b):
+            if (stuck_offline and not m.offline[rr]
+                    and m.replica_count[b] <= self.upper):
+                return False
+            dst = self.maybe_apply(m, rr, candidates, MOVE, optimized)
+            if dst is not None:
+                limit = self.upper if not m.current_offline_on(b) else 0
+                if m.replica_count[b] <= limit:
+                    return False
+                candidates = [c for c in candidates if c != dst]
+                if (m.replica_count[dst] < self.upper
+                        or self.fix_offline_only):
+                    candidates.append(dst)
+                    candidates.sort(key=lambda bb: (m.replica_count[bb], bb))
+            elif m.offline[rr]:
+                stuck_offline = True
+        return bool(m.replicas_on[b])
+
+    def _move_in(self, m, b, optimized) -> bool:
+        if self.fix_offline_only:
+            pq = [bb for bb in range(m.B) if bb != b]
+        else:
+            pq = [bb for bb in range(m.B)
+                  if m.replica_count[bb] > self.lower
+                  or m.current_offline_on(bb)]
+        pq.sort(key=lambda bb: (-len(m.current_offline_on(bb)),
+                                -m.replica_count[bb], bb))
+        while pq:
+            src = pq.pop(0)
+            for rr in self._sorted_reps(m, src):
+                dst = self.maybe_apply(m, rr, [b], MOVE, optimized)
+                if dst is not None:
+                    if m.replica_count[b] >= self.lower:
+                        return False
+                    if pq:
+                        s_off = len(m.current_offline_on(src))
+                        n_off = len(m.current_offline_on(pq[0]))
+                        if (s_off < n_off
+                                or (s_off == n_off
+                                    and m.replica_count[src]
+                                    < m.replica_count[pq[0]])):
+                            pq.append(src)
+                            pq.sort(key=lambda bb: (
+                                -len(m.current_offline_on(bb)),
+                                -m.replica_count[bb], bb))
+                            break
+        return True
+
+
+class SeqLeaderReplicaDistributionGoal(_SeqCountDistributionBase):
+    name = "LeaderReplicaDistributionGoal"
+
+    def _balance_percentage(self) -> float:
+        return self.constraint.leader_replica_balance_percentage
+
+    def _num_interested(self, m) -> int:
+        return m.P
+
+    def _lead_move_ok(self, m, src: int, dst: int) -> int:
+        ok = (self._count_ok_after(m, dst, int(m.leader_count[dst]),
+                                   True, True)
+              and self._count_ok_after(m, src, int(m.leader_count[src]),
+                                       False, False))
+        return ACCEPT if ok else REPLICA_REJECT
+
+    def action_acceptance(self, m, action) -> int:
+        p, src, dst, kind, p2 = action
+        r_src = m.rep_at[(src, p)]
+        if kind == SWAP:
+            r_dst = m.rep_at[(dst, p2)]
+            if m.is_leader[r_src] and not m.is_leader[r_dst]:
+                return self._lead_move_ok(m, src, dst)
+            if not m.is_leader[r_src] and m.is_leader[r_dst]:
+                return self._lead_move_ok(m, dst, src)
+            return ACCEPT
+        if kind == MOVE:
+            if m.is_leader[r_src]:
+                return self._lead_move_ok(m, src, dst)
+            return ACCEPT
+        return self._lead_move_ok(m, src, dst)
+
+    def self_satisfied(self, m, action) -> bool:
+        p, src, dst, kind, p2 = action
+        r_src = m.rep_at[(src, p)]
+        if self.fix_offline_only and m.offline[r_src]:
+            return True
+        return self.action_acceptance(m, action) == ACCEPT
+
+    def rebalance_for_broker(self, m, b, optimized):
+        n_lead = int(m.leader_count[b])
+        require_less_lead = m.alive[b] and n_lead > self.upper
+        require_more_lead = m.alive[b] and n_lead < self.lower
+        require_less_reps = (self.fix_offline_only
+                             and len(m.current_offline_on(b)) > 0)
+        if ((require_less_lead
+             and self._move_leadership_out(m, b, optimized))
+                or require_less_reps):
+            if self._move_replicas_out(m, b, optimized):
+                if not require_less_reps:
+                    self._failed_above.add(b)
+        elif (require_more_lead
+              and self._move_leadership_in(m, b, optimized)
+              and self._move_leader_replicas_in(m, b, optimized)):
+            self._failed_below.add(b)
+
+    def _move_leadership_out(self, m, b, optimized) -> bool:
+        if (~m.alive).any():
+            return True
+        n = int(m.leader_count[b])
+        for rr in sorted(r for r in m.replicas_on[b] if m.is_leader[r]):
+            p = int(m.part_of[rr])
+            candidates = [int(m.broker_of[f]) for f in m.reps_of_p[p]
+                          if f >= 0 and f != rr and not m.offline[f]]
+            if self.maybe_apply(m, rr, candidates, LEAD,
+                                optimized) is not None:
+                n -= 1
+                if n <= self.upper:
+                    return False
+        return True
+
+    def _move_leadership_in(self, m, b, optimized) -> bool:
+        if ((~m.alive).any()
+                or b in self.options.excluded_brokers_for_leadership):
+            return True
+        n = int(m.leader_count[b])
+        for rr in sorted(m.replicas_on[b]):
+            if m.is_leader[rr] or m.offline[rr]:
+                continue
+            leader = int(m.leader_of[m.part_of[rr]])
+            if self.maybe_apply(m, leader, [b], LEAD,
+                                optimized) is not None:
+                n += 1
+                if n >= self.lower:
+                    return False
+        return True
+
+    def _move_replicas_out(self, m, b, optimized) -> bool:
+        if self.fix_offline_only:
+            candidates = sorted(m.alive_brokers(),
+                                key=lambda bb: (m.replica_count[bb], bb))
+        else:
+            candidates = sorted(
+                (bb for bb in m.alive_brokers()
+                 if m.leader_count[bb] < self.upper),
+                key=lambda bb: (m.leader_count[bb], bb))
+        limit = 0 if self.fix_offline_only else self.upper
+        healing = m.has_offline()
+        reps = []
+        for rr in m.replicas_on[b]:
+            if not self._movable(m, rr):
+                continue
+            if self.fix_offline_only:
+                if not m.offline[rr]:
+                    continue
+            else:
+                if not m.is_leader[rr]:
+                    continue
+                if (healing and not m.is_immigrant(rr)
+                        and not m.offline[rr]):
+                    continue
+            reps.append(rr)
+        n = len(reps)
+        for rr in sorted(reps):
+            dst = self.maybe_apply(m, rr, candidates, MOVE, optimized)
+            if dst is not None:
+                n -= 1
+                if n <= limit:
+                    return False
+                candidates = [c for c in candidates if c != dst]
+                if (m.leader_count[dst] < self.upper
+                        or self.fix_offline_only):
+                    candidates.append(dst)
+                    candidates.sort(key=lambda bb: (m.leader_count[bb], bb))
+        return True
+
+    def _move_leader_replicas_in(self, m, b, optimized) -> bool:
+        if b in self.options.excluded_brokers_for_leadership:
+            return True
+        pq = sorted((bb for bb in m.alive_brokers()
+                     if m.leader_count[bb] > self.lower),
+                    key=lambda bb: (-m.leader_count[bb], bb))
+        n = int(m.leader_count[b])
+        broken = bool((~m.alive).any()) or m.has_offline()
+        while pq:
+            src = pq.pop(0)
+            reps = sorted(rr for rr in m.replicas_on[src]
+                          if m.is_leader[rr] and self._movable(m, rr)
+                          and (not broken or m.is_immigrant(rr)))
+            for rr in reps:
+                dst = self.maybe_apply(m, rr, [b], MOVE, optimized)
+                if dst is not None:
+                    n += 1
+                    if n >= self.lower:
+                        return False
+                    if pq and m.leader_count[src] < m.leader_count[pq[0]]:
+                        pq.append(src)
+                        pq.sort(key=lambda bb: (-m.leader_count[bb], bb))
+                        break
+        return True
+
+
+# ---------------------------------------------------------------------------
+# TopicReplicaDistributionGoal (goals/TopicReplicaDistributionGoal.java:45-590)
+# ---------------------------------------------------------------------------
+
+
+class SeqTopicReplicaDistributionGoal(SeqGoal):
+    name = "TopicReplicaDistributionGoal"
+    hard = False
+
+    def __init__(self, constraint, options):
+        super().__init__(constraint, options)
+        self.fix_offline_only = False
+        self.upper_by_topic: Dict[int, int] = {}
+        self.lower_by_topic: Dict[int, int] = {}
+        self.rebalance_topics: Set[int] = set()
+        self._failed = False
+
+    def _margin(self) -> float:
+        bal = self.constraint.topic_replica_balance_percentage
+        if self.options.is_triggered_by_goal_violation:
+            bal *= self.constraint.goal_violation_distribution_threshold_multiplier
+        return (bal - 1.0) * BALANCE_MARGIN
+
+    def init_goal_state(self, m) -> None:
+        self.fix_offline_only = False
+        n_alive = max(len(m.alive_brokers()), 1)
+        margin = self._margin()
+        for t in range(m.T):
+            avg = m.topic_total[t] / n_alive
+            self.upper_by_topic[t] = int(np.ceil(avg * (1.0 + margin)))
+            self.lower_by_topic[t] = int(np.floor(avg * max(0.0, 1.0 - margin)))
+        if m.has_offline():
+            self.rebalance_topics = {
+                int(m.topic_of_p[m.part_of[r]])
+                for r in np.flatnonzero(m.offline)}
+        else:
+            self.rebalance_topics = (set(range(m.T))
+                                     - set(self.options.excluded_topics))
+
+    def _count_ok_after(self, m, t: int, b: int, add: bool,
+                        check_upper: bool) -> bool:
+        n = m.topic_count[b].get(t, 0) + (1 if add else -1)
+        limit_u = self.upper_by_topic[t] if m.alive[b] else 0
+        limit_l = self.lower_by_topic[t] if m.alive[b] else 0
+        return n <= limit_u if check_upper else n >= limit_l
+
+    def action_acceptance(self, m, action) -> int:
+        p, src, dst, kind, p2 = action
+        t = int(m.topic_of_p[p])
+        if kind == LEAD:
+            return ACCEPT
+        if kind == SWAP:
+            t2 = int(m.topic_of_p[p2])
+            if t == t2:
+                return ACCEPT
+            ok = (self._count_ok_after(m, t, dst, True, True)
+                  and self._count_ok_after(m, t, src, False, False)
+                  and self._count_ok_after(m, t2, src, True, True)
+                  and self._count_ok_after(m, t2, dst, False, False))
+            return ACCEPT if ok else REPLICA_REJECT
+        ok = (self._count_ok_after(m, t, dst, True, True)
+              and self._count_ok_after(m, t, src, False, False))
+        return ACCEPT if ok else REPLICA_REJECT
+
+    def self_satisfied(self, m, action) -> bool:
+        p, src, dst, kind, p2 = action
+        r_src = m.rep_at[(src, p)]
+        if self.fix_offline_only and m.offline[r_src]:
+            return kind == MOVE
+        t = int(m.topic_of_p[p])
+        return (self._count_ok_after(m, t, dst, True, True)
+                and self._count_ok_after(m, t, src, False, False))
+
+    def update_goal_state(self, m) -> None:
+        if self._failed:
+            self.succeeded = False
+            self._failed = False
+        if m.has_offline():
+            if self.fix_offline_only:
+                raise SeqOptimizationFailure(
+                    f"{self.name}: offline replicas remain")
+            self.fix_offline_only = True
+            return
+        self.finished = True
+
+    def rebalance_for_broker(self, m, b, optimized):
+        for t in sorted(m.topic_count[b].keys()):
+            if t not in self.rebalance_topics:
+                continue
+            reps = [r for r in m.replicas_on[b]
+                    if int(m.topic_of_p[m.part_of[r]]) == t]
+            n = len(reps)
+            n_off = sum(1 for r in reps if m.offline[r])
+            require_less = n_off > 0 or n > self.upper_by_topic[t]
+            require_more = (m.alive[b]
+                            and n - n_off < self.lower_by_topic[t])
+            has_imm = any(m.is_immigrant(r) for r in reps)
+            # skipBrokerRebalance (TopicReplicaDistributionGoal.java:341-368)
+            if m.alive[b] and not require_more and not require_less:
+                continue
+            if m.has_new and not m.new[b] and not require_less:
+                continue
+            if (m.has_offline() and require_less and n_off == 0
+                    and not has_imm):
+                continue
+            if (self.options.only_move_immigrant_replicas and require_less
+                    and not has_imm):
+                continue
+            if require_less and self._move_out(m, b, t, optimized):
+                self._failed = True
+            if require_more and self._move_in(m, b, t, optimized):
+                self._failed = True
+
+    def _topic_reps(self, m, b, t) -> List[int]:
+        healing = m.has_offline()
+        out = []
+        for rr in m.replicas_on[b]:
+            if int(m.topic_of_p[m.part_of[rr]]) != t:
+                continue
+            if not self._movable(m, rr):
+                continue
+            if (healing and m.alive[b] and not m.is_immigrant(rr)
+                    and not m.offline[rr]):
+                continue
+            out.append(rr)
+        out.sort(key=lambda rr: (not m.offline[rr], rr))
+        return out
+
+    def _move_out(self, m, b, t, optimized) -> bool:
+        if self.fix_offline_only:
+            candidates = sorted(
+                m.alive_brokers(),
+                key=lambda bb: (m.topic_count[bb].get(t, 0), bb))
+        else:
+            candidates = sorted(
+                (bb for bb in m.alive_brokers()
+                 if m.topic_count[bb].get(t, 0) < self.upper_by_topic[t]),
+                key=lambda bb: (m.topic_count[bb].get(t, 0), bb))
+        n = m.topic_count[b].get(t, 0)
+        n_off = sum(1 for r in m.replicas_on[b]
+                    if m.offline[r] and int(m.topic_of_p[m.part_of[r]]) == t)
+        stuck_offline = False
+        for rr in self._topic_reps(m, b, t):
+            if (stuck_offline and not m.offline[rr]
+                    and n <= self.upper_by_topic[t]):
+                return False
+            was_off = bool(m.offline[rr])
+            dst = self.maybe_apply(m, rr, candidates, MOVE, optimized)
+            if dst is not None:
+                if was_off:
+                    n_off -= 1
+                n -= 1
+                if n <= (self.upper_by_topic[t] if n_off == 0 else 0):
+                    return False
+                candidates = [c for c in candidates if c != dst]
+                if (m.topic_count[dst].get(t, 0) < self.upper_by_topic[t]
+                        or self.fix_offline_only):
+                    candidates.append(dst)
+                    candidates.sort(
+                        key=lambda bb: (m.topic_count[bb].get(t, 0), bb))
+            elif m.offline[rr]:
+                stuck_offline = True
+        return n > 0
+
+    def _move_in(self, m, b, t, optimized) -> bool:
+        pq = sorted((bb for bb in range(m.B)
+                     if m.topic_count[bb].get(t, 0) > self.lower_by_topic[t]
+                     or any(m.offline[r] for r in m.replicas_on[bb]
+                            if int(m.topic_of_p[m.part_of[r]]) == t)),
+                    key=lambda bb: (-m.topic_count[bb].get(t, 0), bb))
+        n = m.topic_count[b].get(t, 0)
+        while pq:
+            src = pq.pop(0)
+            for rr in self._topic_reps(m, src, t):
+                dst = self.maybe_apply(m, rr, [b], MOVE, optimized)
+                if dst is not None:
+                    n += 1
+                    if n >= self.lower_by_topic[t]:
+                        return False
+                    if (pq and m.topic_count[src].get(t, 0)
+                            < m.topic_count[pq[0]].get(t, 0)):
+                        pq.append(src)
+                        pq.sort(key=lambda bb: (
+                            -m.topic_count[bb].get(t, 0), bb))
+                        break
+        return True
+
+
+# ---------------------------------------------------------------------------
+# PotentialNwOutGoal (goals/PotentialNwOutGoal.java:37-400)
+# ---------------------------------------------------------------------------
+
+
+class SeqPotentialNwOutGoal(SeqGoal):
+    name = "PotentialNwOutGoal"
+    hard = False
+
+    def __init__(self, constraint, options):
+        super().__init__(constraint, options)
+        self.fix_offline_only = False
+
+    def _limit(self, m, b: int) -> float:
+        return (m.cap[b, res.NW_OUT]
+                * self.constraint.capacity_threshold[res.NW_OUT])
+
+    def self_satisfied(self, m, action) -> bool:
+        p, src, dst, kind, p2 = action
+        r_src = m.rep_at[(src, p)]
+        if self.fix_offline_only and m.offline[r_src]:
+            return kind == MOVE
+        dst_util = m.pot_nw_out[dst]
+        dst_cap = self._limit(m, dst)
+        src_rep_util = m.leader_nw_out[p]
+        if kind != SWAP:
+            return dst_cap >= dst_util + src_rep_util
+        dst_rep_util = m.leader_nw_out[p2]
+        if dst_cap < dst_util + src_rep_util - dst_rep_util:
+            return False
+        src_util = m.pot_nw_out[src]
+        src_cap = self._limit(m, src)
+        return src_cap >= src_util + dst_rep_util - src_rep_util
+
+    def action_acceptance(self, m, action) -> int:
+        p, src, dst, kind, p2 = action
+        if kind == LEAD:
+            return ACCEPT
+        if self.self_satisfied(m, action):
+            return ACCEPT
+        dst_util = m.pot_nw_out[dst]
+        src_util = m.pot_nw_out[src]
+        src_rep_util = m.leader_nw_out[p]
+        max_util = max(dst_util, src_util)
+        if kind == SWAP:
+            dst_rep_util = m.leader_nw_out[p2]
+            if src_util + dst_rep_util - src_rep_util > max_util:
+                return REPLICA_REJECT
+            return (ACCEPT if dst_util + src_rep_util - dst_rep_util
+                    <= max_util else REPLICA_REJECT)
+        return (ACCEPT if dst_util + src_rep_util <= max_util
+                else REPLICA_REJECT)
+
+    def brokers_to_balance(self, m) -> List[int]:
+        broken = [b for b in range(m.B)
+                  if not m.alive[b] or m.current_offline_on(b)]
+        return broken if broken else list(range(m.B))
+
+    def update_goal_state(self, m) -> None:
+        if m.has_offline():
+            if self.fix_offline_only:
+                raise SeqOptimizationFailure(
+                    f"{self.name}: offline replicas remain")
+            self.fix_offline_only = True
+            return
+        self.finished = True
+
+    def rebalance_for_broker(self, m, b, optimized):
+        limit = self._limit(m, b)
+        over = bool(m.replicas_on[b]) and m.pot_nw_out[b] > limit
+        if not over and not (self.fix_offline_only
+                             and m.current_offline_on(b)):
+            return
+        if self.fix_offline_only:
+            candidates = set(m.alive_brokers())
+        else:
+            candidates = {bb for bb in m.alive_brokers()
+                          if m.pot_nw_out[bb] < self._limit(m, bb)}
+        reps = sorted(rr for rr in m.replicas_on[b] if self._movable(m, rr))
+        for rr in reps:
+            p = int(m.part_of[rr])
+            part_brokers = set(m.partition_brokers(p))
+            eligible = sorted(
+                (bb for bb in candidates if bb not in part_brokers),
+                key=lambda bb: (-m.lead_load[bb, res.NW_OUT], bb))
+            dst = self.maybe_apply(m, rr, eligible, MOVE, optimized)
+            if dst is not None:
+                over = (bool(m.replicas_on[b])
+                        and m.pot_nw_out[b] > limit)
+                if not over and not (self.fix_offline_only
+                                     and m.current_offline_on(b)):
+                    break
+                if (not self.fix_offline_only
+                        and m.pot_nw_out[dst] > self._limit(m, dst)):
+                    candidates.discard(dst)
+        if over:
+            self.succeeded = False
+
+
+# ---------------------------------------------------------------------------
+# LeaderBytesInDistributionGoal
+# (goals/LeaderBytesInDistributionGoal.java:39-290)
+# ---------------------------------------------------------------------------
+
+
+class SeqLeaderBytesInDistributionGoal(SeqGoal):
+    name = "LeaderBytesInDistributionGoal"
+    hard = False
+
+    def __init__(self, constraint, options):
+        super().__init__(constraint, options)
+        self.mean_lbi = 0.0
+        self.over_limit: Set[int] = set()
+
+    def _mean(self, m) -> float:
+        if self.mean_lbi == 0.0:
+            alive = m.alive_brokers()
+            self.mean_lbi = (sum(m.lead_load[b, res.NW_IN] for b in alive)
+                             / max(len(alive), 1))
+        return self.mean_lbi
+
+    def _threshold(self, m, b: int) -> float:
+        low = (self.constraint.low_utilization_threshold[res.NW_IN]
+               * m.cap[b, res.NW_IN])
+        return max(self._mean(m)
+                   * self.constraint.resource_balance_percentage[res.NW_IN],
+                   low)
+
+    def action_acceptance(self, m, action) -> int:
+        p, src, dst, kind, p2 = action
+        r_src = m.rep_at[(src, p)]
+        if not m.is_leader[r_src]:
+            if kind == SWAP:
+                r_dst = m.rep_at[(dst, p2)]
+                if not m.is_leader[r_dst]:
+                    return ACCEPT
+            elif kind == MOVE:
+                return ACCEPT
+        src_util = m.eff_util(r_src, res.NW_IN)
+        if kind == SWAP:
+            r_dst = m.rep_at[(dst, p2)]
+            dst_util = m.eff_util(r_dst, res.NW_IN)
+            new_dst = (m.lead_load[dst, res.NW_IN] + src_util - dst_util)
+            new_src = (m.lead_load[src, res.NW_IN] + dst_util - src_util)
+            if new_src > self._threshold(m, src):
+                return REPLICA_REJECT
+        else:
+            new_dst = m.lead_load[dst, res.NW_IN] + src_util
+        return (ACCEPT if new_dst <= self._threshold(m, dst)
+                else REPLICA_REJECT)
+
+    def self_satisfied(self, m, action) -> bool:
+        return self.action_acceptance(m, action) == ACCEPT
+
+    def brokers_to_balance(self, m) -> List[int]:
+        return [b for b in range(m.B)
+                if m.lead_load[b, res.NW_IN] > self._threshold(m, b)]
+
+    def init_goal_state(self, m) -> None:
+        self.mean_lbi = 0.0
+        self.over_limit = set()
+
+    def update_goal_state(self, m) -> None:
+        if self.over_limit:
+            self.succeeded = False
+        self.finished = True
+
+    def rebalance_for_broker(self, m, b, optimized):
+        threshold = self._threshold(m, b)
+        if m.lead_load[b, res.NW_IN] < threshold:
+            return
+        leaders = sorted(
+            (rr for rr in m.replicas_on[b]
+             if m.is_leader[rr] and self._movable(m, rr)),
+            key=lambda rr: -m.eff_util(rr, res.NW_IN))
+        over = True
+        for rr in leaders:
+            if not over:
+                break
+            p = int(m.part_of[rr])
+            followers = [f for f in m.reps_of_p[p]
+                         if f >= 0 and f != rr and not m.offline[f]]
+            eligible = sorted(
+                (int(m.broker_of[f]) for f in followers),
+                key=lambda bb: m.lead_load[bb, res.NW_IN])
+            self.maybe_apply(m, rr, eligible, LEAD, optimized)
+            over = m.lead_load[b, res.NW_IN] > threshold
+        if over:
+            self.over_limit.add(b)
+
+
+# ---------------------------------------------------------------------------
+# Driver: the GoalOptimizer sequential loop (GoalOptimizer.java:429-453)
+# ---------------------------------------------------------------------------
+
+
+def _make_goal(name: str, constraint, options: SeqOptions) -> SeqGoal:
+    if name == "RackAwareGoal":
+        return SeqRackAwareGoal(constraint, options)
+    if name == "ReplicaCapacityGoal":
+        return SeqReplicaCapacityGoal(constraint, options)
+    if name in _CAPACITY_RESOURCE:
+        return SeqCapacityGoal(name, constraint, options)
+    if name in _DISTRIBUTION_RESOURCE:
+        return SeqResourceDistributionGoal(name, constraint, options)
+    if name == "ReplicaDistributionGoal":
+        return SeqReplicaDistributionGoal(constraint, options)
+    if name == "LeaderReplicaDistributionGoal":
+        return SeqLeaderReplicaDistributionGoal(constraint, options)
+    if name == "TopicReplicaDistributionGoal":
+        return SeqTopicReplicaDistributionGoal(constraint, options)
+    if name == "PotentialNwOutGoal":
+        return SeqPotentialNwOutGoal(constraint, options)
+    if name == "LeaderBytesInDistributionGoal":
+        return SeqLeaderBytesInDistributionGoal(constraint, options)
+    raise ValueError(f"sequential engine does not implement {name!r}")
+
+
+@dataclasses.dataclass
+class SeqGoalReport:
+    name: str
+    succeeded: bool
+    comparator_vs_before: int
+    wall_s: float
+
+
+@dataclasses.dataclass
+class SeqResult:
+    """Outcome of the single-threaded sequential optimization."""
+
+    broker_of: np.ndarray            # i64[R] final placement
+    leader_of: np.ndarray            # i64[P] final leader replica index
+    goal_reports: List[SeqGoalReport]
+    num_replica_movements: int
+    num_leadership_movements: int
+    wall_time_s: float
+    stats_before: SeqStats
+    stats_after: SeqStats
+
+    @property
+    def violated_goals_after(self) -> List[str]:
+        return [g.name for g in self.goal_reports if not g.succeeded]
+
+
+def optimize_sequential(topo, broker_of: np.ndarray, leader_of: np.ndarray,
+                        goal_names: Optional[Sequence[str]] = None,
+                        constraint=None,
+                        options: Optional[SeqOptions] = None) -> SeqResult:
+    """Run the reference's sequential per-goal walk end to end.
+
+    Mirrors ``GoalOptimizer.optimizations`` (``GoalOptimizer.java:408-467``):
+    instantiate goals by priority, run each ``goal.optimize(model,
+    optimizedGoals, options)`` over the SHARED mutable model, collecting the
+    optimized set so later goals' candidate actions are vetoed by earlier
+    goals' ``actionAcceptance`` (``AbstractGoal.java:211``).
+
+    ``leader_of`` is the per-partition GLOBAL replica index of the leader
+    (the repo's ``Assignment.leader_of`` convention).
+    """
+    from cruise_control_tpu.common.resources import (
+        DEFAULT_BALANCING_CONSTRAINT)
+    from cruise_control_tpu.analyzer import goals as G
+    constraint = constraint or DEFAULT_BALANCING_CONSTRAINT
+    options = options or SeqOptions()
+    goal_names = tuple(goal_names or G.DEFAULT_GOALS)
+
+    t0 = time.time()
+    m = SeqModel(topo, np.asarray(broker_of), np.asarray(leader_of))
+    stats_before = compute_seq_stats(m, constraint)
+    optimized: List[SeqGoal] = []
+    reports: List[SeqGoalReport] = []
+    for name in goal_names:
+        goal = _make_goal(name, constraint, options)
+        g0 = time.time()
+        sb = compute_seq_stats(m, constraint)
+        succeeded = goal.optimize(m, optimized)
+        sa = compute_seq_stats(m, constraint)
+        reports.append(SeqGoalReport(
+            name=name, succeeded=succeeded,
+            comparator_vs_before=compare_stats(name, sa, sb, constraint),
+            wall_s=time.time() - g0))
+        optimized.append(goal)
+    stats_after = compute_seq_stats(m, constraint)
+    return SeqResult(
+        broker_of=m.broker_of.copy(),
+        leader_of=m.leader_of.copy(),
+        goal_reports=reports,
+        num_replica_movements=m.num_moves,
+        num_leadership_movements=m.num_leads,
+        wall_time_s=time.time() - t0,
+        stats_before=stats_before,
+        stats_after=stats_after,
+    )
