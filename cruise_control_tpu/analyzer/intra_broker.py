@@ -94,15 +94,70 @@ def disk_penalties(topo: ClusterTopology, assign: Assignment,
             "IntraBrokerDiskUsageDistributionGoal": (dist_viol, dist_cost)}
 
 
+def certify_infeasible_capacity_residuals(
+        topo: ClusterTopology, assign: Assignment,
+        disk_of_replica: Optional[np.ndarray] = None,
+        capacity_threshold: float = 0.8) -> Dict[str, int]:
+    """Certify that every remaining IntraBrokerDiskCapacityGoal violation is
+    infeasible by construction: the violating disk's SMALLEST movable
+    replica still overflows every eligible destination disk on the same
+    broker (the capacity-goal acceptance of
+    ``IntraBrokerDiskCapacityGoal.java:36-41`` can accept no single move —
+    and the smallest replica minimizes destination overflow, so if it fits
+    nowhere, nothing does).
+
+    Returns ``{"residual": n_over_limit, "feasible": n_with_single_fix}``;
+    a repair regression shows up as ``feasible > 0`` (bench asserts 0).
+    """
+    assert topo.has_disks, "model has no JBOD disk axis"
+    dof = (disk_of_replica if disk_of_replica is not None
+           else topo.disk_of_replica)
+    D = topo.num_disks
+    p = topo.partition_of_replica
+    is_leader = np.zeros(topo.num_replicas, bool)
+    is_leader[np.asarray(assign.leader_of)] = True
+    load = topo.replica_base_load[:, res.DISK] + np.where(
+        is_leader, topo.leader_extra[p, res.DISK], 0.0)
+    disk_load = np.zeros(D)
+    ok = dof >= 0
+    np.add.at(disk_load, dof[ok], load[ok])
+    alive = np.asarray(topo.disk_alive)
+    limit = np.maximum(topo.disk_capacity, 1e-9) * capacity_threshold
+    # disk_penalties counts BOTH alive over-limit disks and occupied dead
+    # disks as capacity violations — certify both classes, or a broken
+    # dead-disk evacuation could hide behind this gate
+    over = np.flatnonzero(((disk_load > limit) & alive)
+                          | ((disk_load > 0) & ~alive))
+    bod = np.asarray(topo.broker_of_disk)
+    # smallest replica load per disk (vectorized over the replica axis)
+    min_load = np.full(D, np.inf)
+    np.minimum.at(min_load, dof[ok], load[ok])
+    feasible = 0
+    for d in over:
+        b = bod[d]
+        dests = np.flatnonzero((bod == b) & alive
+                               & (np.arange(D) != d))
+        if dests.size and np.isfinite(min_load[d]) and (
+                disk_load[dests] + min_load[d] <= limit[dests]).any():
+            feasible += 1
+    return {"residual": int(over.size), "feasible": feasible}
+
+
 def rebalance_disks(topo: ClusterTopology, assign: Assignment,
                     capacity_threshold: float = 0.8,
                     balance_band: float = 0.10,
-                    max_moves_per_broker: int = 1000
+                    max_moves_per_broker: int = 1000,
+                    goals: Tuple[str, ...] = (
+                        "IntraBrokerDiskCapacityGoal",
+                        "IntraBrokerDiskUsageDistributionGoal")
                     ) -> Tuple[List[LogdirMove], np.ndarray]:
     """Greedy per-broker disk rebalance; returns (moves, new disk vector).
 
     Order of concerns mirrors the reference goal priority: dead-disk
-    evacuation and capacity violations first, then usage spread.
+    evacuation and capacity violations first, then usage spread. ``goals``
+    (the ``intra.broker.goals`` config) selects the phases; dead-disk
+    evacuation always runs (offline replicas must move regardless of which
+    balance goals are enabled).
     """
     assert topo.has_disks
     dof = topo.disk_of_replica.copy()
@@ -130,17 +185,22 @@ def rebalance_disks(topo: ClusterTopology, assign: Assignment,
     all_disk_load = np.zeros(topo.num_disks)
     np.add.at(all_disk_load, dof[placed], load[placed])
 
+    # intra.broker.goals phase selection
+    do_capacity = "IntraBrokerDiskCapacityGoal" in goals
+    do_spread = "IntraBrokerDiskUsageDistributionGoal" in goals
+
     # vectorized pre-screen: only brokers with a dead-occupied disk, a
     # capacity overflow, or an out-of-band disk enter the greedy at all
     B = topo.num_brokers
     bod = topo.broker_of_disk
     flagged = ((~alive & (all_disk_load > 0))
-               | (alive & (all_disk_load > cap * capacity_threshold)))
+               | (do_capacity & alive
+                  & (all_disk_load > cap * capacity_threshold)))
     pct_all = all_disk_load / cap
     n_live = np.bincount(bod[alive], minlength=B)
     sum_pct = np.bincount(bod[alive], weights=pct_all[alive], minlength=B)
     mean_b = np.where(n_live > 0, sum_pct / np.maximum(n_live, 1), 0.0)
-    out_of_band = alive & (n_live[bod] >= 2) & (
+    out_of_band = do_spread & alive & (n_live[bod] >= 2) & (
         pct_all > mean_b[bod] * (1 + balance_band))
     dirty = np.zeros(B, bool)
     np.logical_or.at(dirty, bod[flagged | out_of_band], True)
@@ -169,7 +229,7 @@ def rebalance_disks(topo: ClusterTopology, assign: Assignment,
             for d in disks:
                 over_dead = not alive[d] and disk_load[d] > 0
                 while n_moves < max_moves_per_broker and (
-                        over_dead or (alive[d]
+                        over_dead or (do_capacity and alive[d]
                                       and disk_load[d] > cap[d] * capacity_threshold)):
                     on_d = replicas[dof[replicas] == d]
                     if on_d.size == 0:
@@ -204,7 +264,7 @@ def rebalance_disks(topo: ClusterTopology, assign: Assignment,
                 break
 
         # 2) usage distribution: move replicas hot → cold while out of band
-        for _ in range(max_moves_per_broker - n_moves):
+        for _ in range(max_moves_per_broker - n_moves if do_spread else 0):
             pct = disk_load[live] / cap[live]
             mean = pct.mean()
             hi = mean * (1 + balance_band)

@@ -62,6 +62,36 @@ def test_disk_penalties_and_rebalance():
         assert j["fromLogdir"] != j["toLogdir"]
 
 
+def test_certify_infeasible_capacity_residuals():
+    """The residual-certification oracle (bench's JBOD quality gate):
+    a state with a single-move fix available must be flagged feasible; a
+    genuinely stuck overflow (every destination would also overflow, per
+    IntraBrokerDiskCapacityGoal.java:36-41 acceptance) must not."""
+    topo, assign = _jbod_model()
+    # initial layout: /d1 on each broker holds 1050 > 800 limit, /d2 empty
+    # -> the smallest replica (50) fits on /d2: FEASIBLE violation
+    cert = IB.certify_infeasible_capacity_residuals(topo, assign)
+    assert cert["residual"] >= 1
+    assert cert["feasible"] >= 1
+
+    # after rebalance: no residual at all -> vacuously certified
+    _, new_dof = IB.rebalance_disks(topo, assign)
+    cert2 = IB.certify_infeasible_capacity_residuals(
+        topo, assign, disk_of_replica=new_dof)
+    assert cert2["residual"] == 0 and cert2["feasible"] == 0
+
+    # construct a stuck overflow: shrink every destination's headroom so
+    # even the smallest replica (50) cannot land anywhere
+    import dataclasses
+    small_caps = topo.disk_capacity.copy()
+    small_caps[1] = 10.0        # broker 0's /d2: limit 8 < 50
+    small_caps[3] = 10.0        # broker 1's /d2
+    topo3 = dataclasses.replace(topo, disk_capacity=small_caps)
+    cert3 = IB.certify_infeasible_capacity_residuals(topo3, assign)
+    assert cert3["residual"] >= 1
+    assert cert3["feasible"] == 0
+
+
 def test_dead_disk_evacuated():
     topo, assign = _jbod_model(dead_disk=True)
     moves, new_dof = IB.rebalance_disks(topo, assign)
