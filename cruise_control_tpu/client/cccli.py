@@ -106,6 +106,8 @@ ENDPOINTS: List[Endpoint] = [
                   "Include sample-extrapolation flaws and CPU model state"),)),
     Endpoint("kafka_cluster_state", "GET", "Kafka cluster state", (
         Parameter("populate_disk_info", "populate-disk-info", "bool"),)),
+    Endpoint("metrics", "GET",
+             "Service sensors (timers/meters/gauges snapshot)"),
     Endpoint("load", "GET", "Per-broker load", (
         Parameter("time", "time", "int", "Load as of this epoch ms"),)),
     Endpoint("partition_load", "GET", "Top partition loads", (

@@ -1,4 +1,11 @@
-"""Client CLI tests against a live server (client <-> REST round trips)."""
+"""Client CLI tests against a live server (client <-> REST round trips).
+
+Round 5: every one of the 21 client endpoints round-trips against a live
+``rest.serve`` instance (reference surface:
+``cruisecontrolclient/client/Endpoint.py:158-454``), plus parameter
+validation errors, the async poll loop, the poll-timeout path, and the
+two-step review flow.
+"""
 
 import json
 
@@ -25,14 +32,66 @@ def _run(server, argv, capsys):
     return rc, json.loads(out)
 
 
+def _run_fresh(argv, capsys, overrides=None):
+    """Drive one command against a FRESH app+server (state-mutating
+    endpoints like bootstrap/train would pollute the shared monitor)."""
+    app = _app(overrides=overrides)
+    srv = rest.serve(app, port=0)
+    try:
+        return _run(srv, argv, capsys)
+    finally:
+        srv.shutdown()
+
+
+# --------------------------------------------------------------- GET tier
+
+
 def test_cli_state(server, capsys):
     rc, body = _run(server, ["state"], capsys)
     assert rc == 0 and "MonitorState" in body
 
 
+def test_cli_kafka_cluster_state(server, capsys):
+    rc, body = _run(server, ["kafka_cluster_state"], capsys)
+    assert rc == 0 and body["KafkaPartitionState"]["totalPartitions"] == 30
+
+
 def test_cli_load(server, capsys):
     rc, body = _run(server, ["load"], capsys)
     assert rc == 0 and len(body["brokers"]) == 6
+
+
+def test_cli_partition_load(server, capsys):
+    rc, body = _run(server, ["partition_load", "--entries", "3"], capsys)
+    assert rc == 0 and len(body["records"]) == 3
+
+
+def test_cli_metrics(server, capsys):
+    rc, body = _run(server, ["metrics"], capsys)
+    assert rc == 0 and isinstance(body, dict) and body
+
+
+def test_cli_proposals(server, capsys):
+    rc, body = _run(server, ["proposals", "--timeout-ms", "60000"], capsys)
+    assert rc == 0 and "proposals" in body
+
+
+def test_cli_user_tasks(server, capsys):
+    _run(server, ["proposals", "--timeout-ms", "60000"], capsys)
+    rc, body = _run(server, ["user_tasks"], capsys)
+    assert rc == 0 and len(body["userTasks"]) >= 1
+
+
+def test_cli_bootstrap_and_train(capsys):
+    rc, body = _run_fresh(["bootstrap", "--start", "0",
+                           "--end", "99999999"], capsys)
+    assert rc == 0 and "bootstrap" in body
+    rc, body = _run_fresh(["train", "--start", "0", "--end", "99999999"],
+                          capsys)
+    assert rc == 0 and ("progress" in body or "trained" in body)
+
+
+# -------------------------------------------------------------- POST tier
 
 
 def test_cli_rebalance_dryrun_polls(server, capsys):
@@ -41,10 +100,94 @@ def test_cli_rebalance_dryrun_polls(server, capsys):
     assert rc == 0 and "proposals" in body
 
 
+def test_cli_add_broker(capsys):
+    rc, body = _run_fresh(["add_broker", "--brokers", "5", "--dryrun",
+                           "true", "--timeout-ms", "60000"], capsys)
+    assert rc == 0 and "proposals" in body
+    # ADD semantics: every move lands on the added broker
+    for p in body["proposals"]:
+        added = set(p["newReplicas"]) - set(p["oldReplicas"])
+        assert added <= {5}
+
+
+def test_cli_remove_broker(capsys):
+    rc, body = _run_fresh(["remove_broker", "--brokers", "2", "--dryrun",
+                           "true", "--timeout-ms", "60000"], capsys)
+    assert rc == 0
+    for p in body["proposals"]:
+        assert 2 not in p["newReplicas"]
+
+
+def test_cli_demote_broker(capsys):
+    rc, body = _run_fresh(["demote_broker", "--brokers", "1", "--dryrun",
+                           "true", "--timeout-ms", "60000"], capsys)
+    assert rc == 0
+    for p in body["proposals"]:
+        assert p["newReplicas"][0] != 1
+
+
+def test_cli_fix_offline_replicas(capsys):
+    rc, body = _run_fresh(["fix_offline_replicas", "--dryrun", "true",
+                           "--timeout-ms", "60000"], capsys)
+    assert rc == 0 and "proposals" in body
+
+
+def test_cli_topic_configuration(capsys):
+    rc, body = _run_fresh(["topic_configuration", "--topic", "T",
+                           "--replication-factor", "3", "--dryrun", "true",
+                           "--timeout-ms", "60000"], capsys)
+    assert rc == 0 and body["numPartitionsChanged"] > 0
+    for p in body["proposals"]:
+        assert len(p["newReplicas"]) == 3
+
+
+def test_cli_stop_proposal_execution(server, capsys):
+    rc, body = _run(server, ["stop_proposal_execution"], capsys)
+    assert rc == 0 and "stopRequested" in body
+
+
+def test_cli_pause_resume_sampling(server, capsys):
+    from cruise_control_tpu.monitor.load_monitor import MonitorState
+    server.api.app.load_monitor._state = MonitorState.RUNNING
+    rc, body = _run(server, ["pause_sampling"], capsys)
+    assert rc == 0 and body["paused"]
+    rc, body = _run(server, ["resume_sampling"], capsys)
+    assert rc == 0 and body["resumed"]
+
+
 def test_cli_admin(server, capsys):
     rc, body = _run(server, ["admin", "--enable-self-healing-for", "ALL",
                              "--enable-self-healing", "true"], capsys)
     assert rc == 0 and all(body["selfHealingEnabled"].values())
+
+
+def test_cli_review_flow(capsys):
+    """Two-step verification driven entirely through the client: the
+    gated POST parks in purgatory, review_board lists it, review approves
+    it (Purgatory.java:42,116-166)."""
+    app = _app(overrides={"two.step.verification.enabled": True})
+    srv = rest.serve(app, port=0)
+    try:
+        rc, body = _run(srv, ["rebalance", "--dryrun", "true"], capsys)
+        assert rc == 0 and "reviewResult" in body
+        review_id = body["reviewResult"]["Id"]
+        rc, board = _run(srv, ["review_board"], capsys)
+        assert rc == 0 and f'"Id": {review_id}' in json.dumps(board)
+        rc, approved = _run(srv, ["review", "--approve", str(review_id)],
+                            capsys)
+        assert rc == 0
+        assert "APPROVED" in json.dumps(approved)
+    finally:
+        srv.shutdown()
+
+
+def test_cli_review_unknown_id_is_client_error(capsys):
+    rc, body = _run_fresh(["review", "--approve", "7"], capsys,
+                          overrides={"two.step.verification.enabled": True})
+    assert rc == 1 and "errorMessage" in body
+
+
+# ----------------------------------------------------- validation + polling
 
 
 def test_cli_validation():
@@ -55,12 +198,48 @@ def test_cli_validation():
         cccli._BROKERS.validate("1,x")
 
 
+def test_cli_int_and_csv_int_validation():
+    p_int = next(p for e in cccli.ENDPOINTS for p in e.parameters
+                 if p.type == "int")
+    with pytest.raises(ValueError):
+        p_int.validate("not-a-number")
+    assert p_int.validate("42") == "42"
+
+
 def test_cli_parser_covers_all_endpoints():
     parser = cccli.build_parser()
     names = {e.name for e in cccli.ENDPOINTS}
+    assert len(cccli.ENDPOINTS) == 21
     assert {"rebalance", "proposals", "state", "remove_broker",
             "topic_configuration", "review"} <= names
     # every endpoint subcommand parses
     for e in cccli.ENDPOINTS:
         args = parser.parse_args(["-a", "x:1", e.name])
         assert args.endpoint == e.name
+
+
+def test_responder_poll_timeout_path(monkeypatch):
+    """An async operation that never completes: the poll loop must stop at
+    max_polls and surface the last 202 instead of spinning forever."""
+    responder = cccli.Responder("127.0.0.1:1", poll_interval_s=0.0,
+                                max_polls=3)
+    calls = {"n": 0}
+
+    def fake_request(method, path, params):
+        calls["n"] += 1
+        return 202, {"userTaskId": "t-1", "progress": ["waiting"]}
+
+    monkeypatch.setattr(responder, "_request", fake_request)
+    ep = next(e for e in cccli.ENDPOINTS if e.name == "proposals")
+    code, body = responder.run(ep, {})
+    assert code == 202 and body["userTaskId"] == "t-1"
+    assert calls["n"] == 1 + 3          # initial request + max_polls
+
+
+def test_responder_http_error_body_surfaces(server, capsys):
+    """A 4xx with a JSON body must round-trip to rc=1 + parsed body."""
+    port = server.server_address[1]
+    rc = cccli.main(["-a", f"127.0.0.1:{port}", "review",
+                     "--approve", "99"])
+    out = capsys.readouterr().out
+    assert rc == 1 and "errorMessage" in json.loads(out)

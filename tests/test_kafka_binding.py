@@ -1,0 +1,383 @@
+"""Library-level fake ``kafka`` module driving the kafka-python binding seam.
+
+Every other test injects fakes ABOVE the binding (fake adapters/producers),
+leaving the default construction, serde, batched alter-configs and error
+paths of ``kafka_adapter.py`` and ``monitor/sample_store.py`` unexecuted.
+This module monkeypatches a faithful in-memory ``kafka`` package into
+``sys.modules`` and drives those exact code paths — the JVM-less analogue of
+the reference's embedded-broker tests (``ExecutorTest.java:58``,
+``KafkaSampleStoreTest``).
+"""
+
+import json
+import sys
+import types
+
+import pytest
+
+from cruise_control_tpu.common.config import CruiseControlConfig
+
+
+# ---------------------------------------------------------------------------
+# The fake kafka-python package
+# ---------------------------------------------------------------------------
+
+
+class _FakeBrokerState:
+    """Shared in-memory cluster behind the fake clients."""
+
+    def __init__(self):
+        self.brokers = [
+            {"node_id": 0, "host": "h0", "rack": "r0"},
+            {"node_id": 1, "host": "h1", "rack": "r1"},
+            {"node_id": 2, "host": "h2", "rack": "r0"},
+        ]
+        # topic -> partition -> {"replicas": [...], "leader": int}
+        self.topics = {
+            "T": {0: {"replicas": [0, 1], "leader": 0},
+                  1: {"replicas": [1, 2], "leader": 1}},
+        }
+        self.topic_configs = {}       # (rtype:int, name) -> {k: v} dynamic
+        self.records = {}             # topic -> [(key, value-bytes)]
+        self.in_progress = {}         # (topic, part) -> new replicas
+        self.log_dirs = {0: {"/d0": {"error_code": 0}},
+                         1: {"/d0": {"error_code": 1}}}
+        self.logdir_moves = []
+        self.describe_configs_error = False
+        self.created_topics = {}
+
+
+def make_fake_kafka(state: _FakeBrokerState):
+    kafka = types.ModuleType("kafka")
+    admin_mod = types.ModuleType("kafka.admin")
+
+    class ConfigResourceType:
+        class _V:
+            def __init__(self, v):
+                self.value = v
+        BROKER = _V(4)
+        TOPIC = _V(2)
+
+    class ConfigResource:
+        def __init__(self, resource_type, name, configs=None):
+            self.resource_type = resource_type
+            self.name = name
+            self.configs = configs
+
+    class NewTopic:
+        def __init__(self, name, num_partitions, replication_factor,
+                     topic_configs=None):
+            self.name = name
+            self.num_partitions = num_partitions
+            self.replication_factor = replication_factor
+            self.topic_configs = topic_configs
+
+    class KafkaAdminClient:
+        def __init__(self, bootstrap_servers=None, **_):
+            assert bootstrap_servers, "bootstrap_servers must be threaded"
+            self._s = state
+
+        # -- metadata ---------------------------------------------------
+        def describe_cluster(self):
+            return {"brokers": list(self._s.brokers)}
+
+        def describe_topics(self, topics=None):
+            names = topics if topics is not None else list(self._s.topics)
+            out = []
+            for t in names:
+                parts = self._s.topics.get(t, {})
+                out.append({"topic": t, "partitions": [
+                    {"partition": p, "leader": info["leader"],
+                     "replicas": list(info["replicas"]),
+                     "isr": list(info["replicas"]),
+                     "offline_replicas": []}
+                    for p, info in sorted(parts.items())]})
+            return out
+
+        # -- reassignment / election ------------------------------------
+        def alter_partition_reassignments(self, assignments):
+            for (t, p), reps in assignments.items():
+                if reps is None:                      # KIP-455 cancel
+                    self._s.in_progress.pop((t, p), None)
+                    continue
+                info = self._s.topics.setdefault(t, {}).setdefault(
+                    p, {"replicas": [], "leader": -1})
+                if set(reps) != set(info["replicas"]):
+                    # data actually moves: stays visibly in progress;
+                    # a pure reorder (the PLE pre-step) completes
+                    # immediately, as on a real broker
+                    self._s.in_progress[(t, p)] = list(reps)
+                info["replicas"] = list(reps)
+                if info["leader"] not in reps:
+                    info["leader"] = reps[0]
+
+        def list_partition_reassignments(self):
+            return dict(self._s.in_progress)
+
+        def perform_leader_election(self, election_type, partitions):
+            assert election_type == "PREFERRED"
+            for (t, p) in partitions:
+                info = self._s.topics[t][p]
+                info["leader"] = info["replicas"][0]
+
+        # -- configs ----------------------------------------------------
+        def describe_configs(self, config_resources):
+            if self._s.describe_configs_error:
+                entry = (41, "NOT_CONTROLLER", 4, "0", [])
+                return [types.SimpleNamespace(resources=[entry])]
+            out = []
+            for r in config_resources:
+                rtype = int(r.resource_type.value)
+                cfgs = self._s.topic_configs.get((rtype, str(r.name)), {})
+                entries = [(k, v, False, 1) for k, v in cfgs.items()]
+                # plus a STATIC (source 5) entry that must NOT be merged
+                entries.append(("static.key", "static-value", False, 5))
+                out.append(types.SimpleNamespace(
+                    resources=[(0, None, rtype, str(r.name), entries)]))
+            return out
+
+        def alter_configs(self, resources):
+            for r in resources:                       # REPLACE semantics
+                rtype = int(r.resource_type.value)
+                self._s.topic_configs[(rtype, str(r.name))] = dict(
+                    r.configs or {})
+
+        # -- logdirs ----------------------------------------------------
+        def describe_log_dirs(self, **kwargs):
+            if "timeout_ms" in kwargs:
+                raise TypeError("unexpected keyword 'timeout_ms'")
+            return dict(self._s.log_dirs)
+
+        def alter_replica_log_dirs(self, moves):
+            self._s.logdir_moves.append(dict(moves))
+
+        def create_topics(self, new_topics):
+            for t in new_topics:
+                if t.name in self._s.created_topics:
+                    raise RuntimeError("TopicExistsError")
+                self._s.created_topics[t.name] = t
+
+    class KafkaProducer:
+        def __init__(self, bootstrap_servers=None, value_serializer=None,
+                     **_):
+            assert bootstrap_servers
+            self._ser = value_serializer or (lambda v: v)
+            self.flushed = 0
+
+        def send(self, topic, value, key=None):
+            state.records.setdefault(topic, []).append((key, self._ser(value)))
+
+        def flush(self):
+            self.flushed += 1
+
+        def close(self):
+            pass
+
+    class KafkaConsumer:
+        def __init__(self, topic, bootstrap_servers=None,
+                     value_deserializer=None, **_):
+            assert bootstrap_servers
+            self._msgs = [types.SimpleNamespace(
+                key=k, value=(value_deserializer or (lambda b: b))(v))
+                for k, v in state.records.get(topic, [])]
+            self.closed = False
+
+        def __iter__(self):
+            return iter(self._msgs)
+
+        def close(self):
+            self.closed = True
+
+    admin_mod.ConfigResource = ConfigResource
+    admin_mod.ConfigResourceType = ConfigResourceType
+    admin_mod.NewTopic = NewTopic
+    kafka.admin = admin_mod
+    kafka.KafkaAdminClient = KafkaAdminClient
+    kafka.KafkaProducer = KafkaProducer
+    kafka.KafkaConsumer = KafkaConsumer
+    return kafka, admin_mod
+
+
+@pytest.fixture
+def fake_kafka(monkeypatch):
+    state = _FakeBrokerState()
+    kafka, admin_mod = make_fake_kafka(state)
+    monkeypatch.setitem(sys.modules, "kafka", kafka)
+    monkeypatch.setitem(sys.modules, "kafka.admin", admin_mod)
+    return state
+
+
+def _cfg(extra=None):
+    return CruiseControlConfig({"bootstrap.servers": "fake:9092",
+                                **(extra or {})})
+
+
+# ---------------------------------------------------------------------------
+# KafkaMetadataSource / adapter paths (kafka_adapter.py:58-430)
+# ---------------------------------------------------------------------------
+
+
+def test_metadata_source_via_fake_kafka(fake_kafka):
+    from cruise_control_tpu.kafka_adapter import KafkaMetadataSource
+    src = KafkaMetadataSource(_cfg())
+    md = src.get_metadata()
+    assert {b.broker_id for b in md.brokers} == {0, 1, 2}
+    assert {(p.topic, p.partition) for p in md.partitions} == {
+        ("T", 0), ("T", 1)}
+    assert md.generation == 1
+    assert src.get_metadata().generation == 2
+
+
+def test_adapter_reassign_ple_cancel_and_progress(fake_kafka):
+    from cruise_control_tpu.executor.tasks import ExecutionTask, TaskType
+    from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+    from cruise_control_tpu.kafka_adapter import KafkaClusterAdapter
+
+    ad = KafkaClusterAdapter(_cfg())
+    move = ExecutionTask(1, ExecutionProposal(
+        topic="T", partition=0, old_leader=0,
+        old_replicas=(0, 1), new_replicas=(2, 1), data_size=1.0),
+        task_type=TaskType.INTER_BROKER_REPLICA_ACTION)
+    ad.execute_replica_reassignments([move])
+    assert ad.current_replicas("T-0") == (2, 1)
+    assert ad.in_progress_reassignments() == {"T-0"}
+
+    # leadership-only proposal: the two-step PLE must write the reorder
+    # first, then elect — the new leader is the new list head
+    lead = ExecutionTask(2, ExecutionProposal(
+        topic="T", partition=1, old_leader=1,
+        old_replicas=(1, 2), new_replicas=(2, 1), data_size=1.0),
+        task_type=TaskType.LEADER_ACTION)
+    ad.execute_preferred_leader_elections([lead])
+    assert ad.current_replicas("T-1") == (2, 1)
+    assert ad.current_leader("T-1") == 2
+
+    ad.cancel_reassignments([move])
+    assert ad.in_progress_reassignments() == set()
+
+
+def test_adapter_throttle_merge_preserves_unrelated_dynamic_config(
+        fake_kafka):
+    """kafka-python's legacy AlterConfigs REPLACES a resource's dynamic
+    config — the adapter must merge with current overrides so an unrelated
+    dynamic setting survives a throttle set/clear cycle, and the STATIC
+    source-5 entry must never be promoted to a dynamic override."""
+    from cruise_control_tpu.kafka_adapter import KafkaClusterAdapter
+    ad = KafkaClusterAdapter(_cfg())
+    fake_kafka.topic_configs[(4, "1")] = {"unrelated.setting": "7"}
+
+    ad.set_broker_throttle_rate([1], 1000)
+    cfg = fake_kafka.topic_configs[(4, "1")]
+    assert cfg["leader.replication.throttled.rate"] == "1000"
+    assert cfg["unrelated.setting"] == "7"       # merge, not wipe
+    assert "static.key" not in cfg               # source 5 never merged
+
+    ad.clear_broker_throttle_rate([1])
+    cfg = fake_kafka.topic_configs[(4, "1")]
+    assert "leader.replication.throttled.rate" not in cfg
+    assert cfg["unrelated.setting"] == "7"
+
+
+def test_adapter_topic_throttled_replicas_batch(fake_kafka):
+    from cruise_control_tpu.kafka_adapter import KafkaClusterAdapter
+    ad = KafkaClusterAdapter(_cfg())
+    ad.set_topic_throttled_replicas("T", ["0:0", "1:1"], ["0:2"])
+    cfg = fake_kafka.topic_configs[(2, "T")]
+    assert cfg["leader.replication.throttled.replicas"] == "0:0,1:1"
+    ad.clear_topic_throttled_replicas("T")
+    cfg = fake_kafka.topic_configs[(2, "T")]
+    assert "leader.replication.throttled.replicas" not in cfg
+
+
+def test_adapter_describe_configs_error_aborts_update(fake_kafka):
+    """An unreadable resource must abort (merging with an empty read would
+    silently wipe unrelated dynamic settings)."""
+    from cruise_control_tpu.kafka_adapter import KafkaClusterAdapter
+    ad = KafkaClusterAdapter(_cfg())
+    fake_kafka.describe_configs_error = True
+    with pytest.raises(RuntimeError, match="DescribeConfigs failed"):
+        ad.set_broker_throttle_rate([0], 500)
+
+
+def test_adapter_describe_logdirs_and_moves(fake_kafka):
+    from cruise_control_tpu.kafka_adapter import KafkaClusterAdapter
+    ad = KafkaClusterAdapter(_cfg(
+        {"logdir.response.timeout.ms": 1234}))
+    # fake raises TypeError on timeout_ms: the stock-client fallback path
+    dirs = ad.describe_logdirs()
+    assert dirs == {0: {"/d0": True}, 1: {"/d0": False}}
+
+    from cruise_control_tpu.analyzer.intra_broker import LogdirMove
+    mv = LogdirMove(topic="T", partition=0, broker_id=0,
+                    from_logdir="/d0", to_logdir="/d1", data_size=1.0)
+    ad.alter_replica_logdirs([mv])
+    assert fake_kafka.logdir_moves == [{("T", 0, 0): "/d1"}]
+
+
+# ---------------------------------------------------------------------------
+# Reporter transport + sampler through the fake wire
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_transport_to_sampler_roundtrip(fake_kafka):
+    from cruise_control_tpu.kafka_adapter import (
+        KafkaMetricsTopicSampler, KafkaMetricsTransport, METRICS_TOPIC)
+    from cruise_control_tpu.reporter import CruiseControlMetric
+
+    transport = KafkaMetricsTransport(_cfg())
+    transport.send([
+        CruiseControlMetric("ALL_TOPIC_BYTES_IN", 5_000, 0, 100.0),
+        CruiseControlMetric("TOPIC_BYTES_IN", 5_000, 0, 60.0, topic="T"),
+        CruiseControlMetric("PARTITION_SIZE", 5_000, 0, 42.0,
+                            topic="T", partition=0),
+    ])
+    assert len(fake_kafka.records[METRICS_TOPIC]) == 3
+
+    sampler = KafkaMetricsTopicSampler(_cfg())
+    from cruise_control_tpu.kafka_adapter import KafkaMetadataSource
+    md = KafkaMetadataSource(_cfg()).get_metadata()
+    psamples, bsamples = sampler.get_samples(md, 0, 10_000)
+    assert any(b.broker_id == 0 and b.leader_bytes_in == 100.0
+               for b in bsamples)
+    assert any(p.topic == "T" and p.partition == 0 for p in psamples)
+
+
+# ---------------------------------------------------------------------------
+# KafkaSampleStore DEFAULT construction (monitor/sample_store.py:94-123)
+# ---------------------------------------------------------------------------
+
+
+def test_sample_store_default_construction_roundtrip(fake_kafka):
+    import numpy as np
+    from cruise_control_tpu.monitor.sample_store import KafkaSampleStore
+    from cruise_control_tpu.monitor import metricdef as mdf
+    from cruise_control_tpu.monitor.sampler import (
+        BrokerMetricSample, PartitionMetricSample)
+
+    store = KafkaSampleStore(
+        _cfg({"sample.store.topic.replication.factor": 1}))
+    # topic bootstrap ran with the configured retention
+    assert set(fake_kafka.created_topics) == {
+        store.partition_topic, store.broker_topic}
+    assert "retention.ms" in (
+        fake_kafka.created_topics[store.partition_topic].topic_configs)
+
+    metrics = np.full(mdf.NUM_MODEL_METRICS, np.nan)
+    metrics[mdf.ModelMetric.CPU_USAGE] = 0.5
+    store.store_samples(
+        [PartitionMetricSample(topic="T", partition=0, leader_broker=0,
+                               time_ms=1_000, metrics=metrics)],
+        [BrokerMetricSample(broker_id=0, time_ms=1_000, cpu_util=0.4,
+                            leader_bytes_in=10.0, leader_bytes_out=5.0,
+                            replication_bytes_in=2.0,
+                            replication_bytes_out=1.0)])
+    # a corrupt record must be skipped on replay, not crash it
+    fake_kafka.records[store.partition_topic].append((b"junk", b"{not json"))
+
+    store2 = KafkaSampleStore(
+        _cfg({"sample.store.topic.replication.factor": 1}))
+    got_p, got_b = [], []
+    n = store2.load_samples(got_p.append, got_b.append)
+    assert n == 2
+    assert got_p[0].topic == "T" and got_p[0].leader_broker == 0
+    assert got_b[0].broker_id == 0 and got_b[0].cpu_util == 0.4
