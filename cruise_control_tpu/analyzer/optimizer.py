@@ -52,18 +52,25 @@ GREEDY_LIMIT = 2_000_000
 #: sort-based sparse topic penalty (matches AnnealConfig.topic_term_limit)
 TOPIC_DENSE_LIMIT = 2_000_000
 
-#: balancedness defaults (KafkaCruiseControlConfig goal.balancedness.*)
+#: balancedness defaults (KafkaCruiseControlConfig goal.balancedness.*);
+#: the service threads its configured values through
+#: optimize(balancedness_weights=...) per call — per-config like the
+#: reference (KafkaCruiseControlUtils.java:530), never process state
 PRIORITY_WEIGHT = 1.1
 STRICTNESS_WEIGHT = 1.5
 MAX_BALANCEDNESS_SCORE = 100.0
 
 
 def balancedness_cost_by_goal(goal_names: Sequence[str],
-                              priority_weight: float = PRIORITY_WEIGHT,
-                              strictness_weight: float = STRICTNESS_WEIGHT
+                              priority_weight: Optional[float] = None,
+                              strictness_weight: Optional[float] = None
                               ) -> Dict[str, float]:
     """Per-goal share of the 100-point balancedness budget
     (KafkaCruiseControlUtils.balancednessCostByGoal, :530)."""
+    priority_weight = (PRIORITY_WEIGHT if priority_weight is None
+                       else priority_weight)
+    strictness_weight = (STRICTNESS_WEIGHT if strictness_weight is None
+                         else strictness_weight)
     costs: Dict[str, float] = {}
     weight_sum = 0.0
     prev = 1.0 / priority_weight
@@ -233,8 +240,10 @@ def _sharded_broker_aggregates(mesh, dt, assign, init_broker, num_topics,
         topic_count=topic_count, offline_count=offline_count)
 
 
-def _balancedness(goal_names, violations) -> float:
-    costs = balancedness_cost_by_goal(goal_names)
+def _balancedness(goal_names, violations, weights=None) -> float:
+    pw, sw = weights if weights is not None else (None, None)
+    costs = balancedness_cost_by_goal(goal_names, priority_weight=pw,
+                                      strictness_weight=sw)
     score = MAX_BALANCEDNESS_SCORE
     for g, v in zip(goal_names, violations):
         if v > 0:
@@ -335,12 +344,15 @@ def optimize(topo: ClusterTopology, assign: Assignment,
              anneal_config: Optional["AnnealConfig"] = None,
              seed: int = 0,
              mesh: Optional["jax.sharding.Mesh"] = None,
-             repair_config=None, polish_cycles: int = 2) -> OptimizerResult:
+             repair_config=None, polish_cycles: int = 2,
+             balancedness_weights=None) -> OptimizerResult:
     """Full optimization pass. ``engine``: auto | greedy | anneal.
     ``repair_config``: RepairConfig override for the MAIN repair pass (the
     hard-violation backstop always runs with its own defaults).
     ``polish_cycles``: max anneal-restart+repair cycles when violations
-    remain after the main repair (0 disables)."""
+    remain after the main repair (0 disables).
+    ``balancedness_weights``: (priority, strictness) for the reported
+    balancedness scores (goal.balancedness.* config)."""
     if _routes_to_tiny_cpu(topo, mesh, options):
         try:
             cpu0 = jax.devices("cpu")[0]
@@ -350,15 +362,17 @@ def optimize(topo: ClusterTopology, assign: Assignment,
             with jax.default_device(cpu0):
                 return _optimize_impl(topo, assign, goal_names, constraint,
                                       options, engine, anneal_config, seed,
-                                      mesh, repair_config, polish_cycles)
+                                      mesh, repair_config, polish_cycles,
+                                      balancedness_weights)
     return _optimize_impl(topo, assign, goal_names, constraint, options,
                           engine, anneal_config, seed, mesh, repair_config,
-                          polish_cycles)
+                          polish_cycles, balancedness_weights)
 
 
 def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
                    anneal_config, seed, mesh, repair_config,
-                   polish_cycles) -> OptimizerResult:
+                   polish_cycles, balancedness_weights=None
+                   ) -> OptimizerResult:
     from cruise_control_tpu.analyzer import annealer as AN  # cycle-free import
 
     from cruise_control_tpu.common.metrics import REGISTRY
@@ -598,8 +612,10 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
         goal_summaries=summaries,
         stats_before=stats_before,
         stats_after=stats_after,
-        balancedness_before=_balancedness(goal_names, vb),
-        balancedness_after=_balancedness(goal_names, va),
+        balancedness_before=_balancedness(goal_names, vb,
+                                          balancedness_weights),
+        balancedness_after=_balancedness(goal_names, va,
+                                         balancedness_weights),
         num_replica_movements=n_moves,
         num_leadership_movements=n_lead,
         inter_broker_data_to_move=data_to_move,

@@ -29,6 +29,7 @@ from cruise_control_tpu.analyzer.annealer import AnnealConfig
 from cruise_control_tpu.common.config import CruiseControlConfig
 from cruise_control_tpu.detector.anomalies import AnomalyType, SelfHealingNotifier
 from cruise_control_tpu.detector.detectors import (
+    METRIC_ANOMALY_FINDER_REGISTRY,
     AnomalyDetectorService,
     BrokerFailureDetector,
     DiskFailureDetector,
@@ -64,14 +65,55 @@ class CruiseControlApp:
 
     def __init__(self, config: CruiseControlConfig,
                  metadata_source: MetadataSource,
-                 sampler: MetricSampler,
+                 sampler: Optional[MetricSampler] = None,
                  cluster_adapter: Optional[ClusterAdapter] = None,
                  capacity_resolver=None, sample_store=None,
                  mesh=None):
+        from cruise_control_tpu.common.config import resolve_pluggable
         self.config = config
         self.constraint = config.balancing_constraint()
         self.default_goals = tuple(config.get("default.goals"))
         self.mesh = mesh
+        # goal.balancedness.* weights — per-app config threaded into every
+        # optimize call (KafkaCruiseControlUtils.java:530 semantics; NOT a
+        # module global, so two apps in one process score independently)
+        self._balancedness_weights = (
+            config.get("goal.balancedness.priority.weight"),
+            config.get("goal.balancedness.strictness.weight"))
+        if sampler is None:
+            # metric.sampler.class (MetricSampler SPI): factories take the
+            # service config; dotted paths resolve to a factory/class
+            from cruise_control_tpu.monitor.sampler import SAMPLER_REGISTRY
+            factory = resolve_pluggable(
+                config.get("metric.sampler.class"), SAMPLER_REGISTRY)
+            sampler = factory(config)
+        if capacity_resolver is None:
+            # broker.capacity.config.resolver.class. A config-less boot
+            # (tests, demos) with the DEFAULT file resolver and no capacity
+            # file falls through to the monitor's static default; an
+            # EXPLICITLY configured resolver/file that cannot be read must
+            # fail the boot (the reference does) — silently optimizing
+            # against wrong capacities is the worst outcome.
+            import os as _os
+            from cruise_control_tpu.monitor.capacity import (
+                CAPACITY_RESOLVER_REGISTRY)
+            name = config.get("broker.capacity.config.resolver.class")
+            factory = resolve_pluggable(name, CAPACITY_RESOLVER_REGISTRY)
+            is_file_resolver = name in ("FileCapacityResolver",
+                                        "BrokerCapacityConfigFileResolver")
+            explicit = ("broker.capacity.config.resolver.class"
+                        in config.originals
+                        or "capacity.config.file" in config.originals)
+            file_ok = _os.path.exists(config.get("capacity.config.file"))
+            if factory is not None:
+                if is_file_resolver and not file_ok and explicit:
+                    raise ValueError(
+                        "capacity.config.file "
+                        f"{config.get('capacity.config.file')!r} does not "
+                        "exist but a capacity resolver was explicitly "
+                        "configured")
+                if not is_file_resolver or file_ok:
+                    capacity_resolver = factory(config)
         import re
         _pat = config.get("topics.excluded.from.partition.movement")
         self._excluded_topics_rx = re.compile(_pat) if _pat else None
@@ -92,6 +134,12 @@ class CruiseControlApp:
                 "max.allowed.extrapolations.per.partition"),
             sampling_interval_ms=config.get("metric.sampling.interval.ms"),
             use_lr_model=config.get("use.linear.regression.model"),
+            lr_model_buckets=(
+                config.get("linear.regression.model.cpu.util.bucket.size"),
+                config.get(
+                    "linear.regression.model.min.num.cpu.util.buckets"),
+                config.get(
+                    "linear.regression.model.required.samples.per.bucket")),
             num_metric_fetchers=config.get("num.metric.fetchers"),
             broker_num_windows=config.get("num.broker.metrics.windows"),
             broker_window_ms=config.get("broker.metrics.window.ms"),
@@ -114,10 +162,17 @@ class CruiseControlApp:
             _cls = STRATEGIES.get(_name)
             if _cls is not None:
                 _chain = _cls() if _chain is None else _chain.chain(_cls())
+        from cruise_control_tpu.executor.executor import (
+            EXECUTOR_NOTIFIER_REGISTRY, ExecutorNotifier)
         self.executor = Executor(
             adapter,
             strategy=_chain,
+            notifier=resolve_pluggable(
+                config.get("executor.notifier.class"),
+                EXECUTOR_NOTIFIER_REGISTRY, base=ExecutorNotifier)(),
             config=ExecutorConfig(
+                max_num_cluster_movements=config.get(
+                    "max.num.cluster.movements"),
                 num_concurrent_partition_movements_per_broker=config.get(
                     "num.concurrent.partition.movements.per.broker"),
                 num_concurrent_intra_broker_partition_movements=config.get(
@@ -139,7 +194,12 @@ class CruiseControlApp:
                     "inter.broker.replica.movement.rate.alerting.threshold"),
                 intra_broker_movement_rate_alerting_threshold=config.get(
                     "intra.broker.replica.movement.rate.alerting.threshold")))
-        notifier = SelfHealingNotifier(
+        from cruise_control_tpu.detector.anomalies import (
+            AnomalyNotifier, NOTIFIER_REGISTRY)
+        notifier_cls = resolve_pluggable(
+            config.get("anomaly.notifier.class"), NOTIFIER_REGISTRY,
+            base=AnomalyNotifier)
+        notifier = notifier_cls(
             broker_failure_alert_threshold_ms=config.get(
                 "broker.failure.alert.threshold.ms"),
             self_healing_threshold_ms=config.get(
@@ -185,6 +245,9 @@ class CruiseControlApp:
                 "metric_anomaly": MetricAnomalyDetector(
                     self.load_monitor.broker_metric_history,
                     metrics=("cpu",),
+                    finder=resolve_pluggable(
+                        config.get("metric.anomaly.finder.class"),
+                        METRIC_ANOMALY_FINDER_REGISTRY),
                     anomaly_class=resolve_anomaly_class(
                         config.get("metric.anomaly.class"), MetricAnomaly),
                     upper_percentile=config.get(
@@ -323,6 +386,7 @@ class CruiseControlApp:
             options=options,
             engine=self.config.get("optimizer.engine"),
             anneal_config=self._anneal_config(),
+            balancedness_weights=self._balancedness_weights,
             mesh=self.mesh)
 
     def _model(self, requirements=None, data_from: Optional[str] = None,
@@ -921,7 +985,8 @@ class CruiseControlApp:
         if not topo.has_disks:
             raise ValueError("cluster model has no JBOD disk information")
         before = IB.disk_penalties(topo, assign)
-        moves, new_dof = IB.rebalance_disks(topo, assign)
+        moves, new_dof = IB.rebalance_disks(
+            topo, assign, goals=tuple(self.config.get("intra.broker.goals")))
         after = IB.disk_penalties(topo, assign, disk_of_replica=new_dof)
         summary = {
             "logdirMoves": [m.to_json() for m in moves],

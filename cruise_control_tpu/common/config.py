@@ -135,6 +135,27 @@ class ConfigDef:
         return out
 
 
+def resolve_pluggable(name: str, registry: Dict[str, Any],
+                      base: Optional[type] = None):
+    """Resolve a pluggable-class config value (Pluggable-Components.md
+    parity): a bare name looks up the SPI's registry; a dotted path imports
+    the attribute, so deployments can select ANY class without registering
+    it first. ``base`` (when given) must be a superclass of the result."""
+    if name in registry:
+        out = registry[name]
+    elif "." in name:
+        import importlib
+        mod, _, attr = name.rpartition(".")
+        out = getattr(importlib.import_module(mod), attr)
+    else:
+        raise ValueError(
+            f"unknown pluggable class {name!r}; register it or use a "
+            f"dotted import path (have: {sorted(registry)})")
+    if base is not None and isinstance(out, type) and not issubclass(out, base):
+        raise ValueError(f"{name} must subclass {base.__name__}")
+    return out
+
+
 def load_properties(path: str) -> Dict[str, str]:
     """Minimal Java .properties reader (the boot-file format)."""
     out: Dict[str, str] = {}
@@ -356,7 +377,10 @@ def _service_config_def() -> ConfigDef:
     d.define("bootstrap.servers", T.STRING, "", I.HIGH,
              "Kafka bootstrap servers (Kafka-backed deployments).")
     d.define("zookeeper.connect", T.STRING, "", I.MEDIUM,
-             "ZooKeeper connect string (legacy deployments).")
+             "ZooKeeper connect string (legacy deployments). "
+             "Reference-compat: this rebuild talks to Kafka via the admin "
+             "adapter, not ZooKeeper; accepted for config-file parity, "
+             "no effect.")
     # -- CPU estimation model (ModelParameters.java:21-29) ------------------
     d.define("leader.network.inbound.weight.for.cpu.util", T.DOUBLE, 0.7,
              I.LOW, "Static CPU attribution weight of leader bytes-in.")
@@ -430,10 +454,14 @@ def _service_config_def() -> ConfigDef:
              "Regex of topics never moved by any optimization.")
     d.define("metric.sampler.partition.assignor.class", T.CLASS,
              "DefaultPartitionAssignor", I.LOW,
-             "Partition→fetcher assignor implementation.")
+             "Partition→fetcher assignor implementation. Reference-compat: "
+             "this rebuild assigns partitions round-robin inside "
+             "MetricFetcherManager; accepted for parity, no effect.")
     d.define("topic.config.provider.class", T.CLASS,
              "StaticTopicConfigProvider", I.LOW,
-             "Topic configuration provider implementation.")
+             "Topic configuration provider implementation. Reference-"
+             "compat: topic configs are read through the cluster adapter; "
+             "accepted for parity, no effect.")
     # -- servlet / web ------------------------------------------------------
     d.define("two.step.purgatory.max.requests", T.INT, 25, I.LOW,
              "Max requests pending review in the purgatory.")
@@ -486,7 +514,9 @@ def _service_config_def() -> ConfigDef:
              "MetricAnomalyFinder implementation.")
     d.define("network.client.provider.class", T.CLASS,
              "DefaultNetworkClientProvider", I.LOW,
-             "Network client provider (Kafka adapter seam).")
+             "Network client provider (Kafka adapter seam). Reference-"
+             "compat: kafka-python owns client construction here; accepted "
+             "for parity, no effect.")
     return d
 
 

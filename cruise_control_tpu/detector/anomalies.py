@@ -281,3 +281,11 @@ class SlackSelfHealingNotifier(SelfHealingNotifier):
                 "channel": self.channel,
                 "text": f"[cruise-control-tpu] {anomaly.summary()} "
                         f"autoFix={auto_fix_triggered}"})
+
+
+#: ``anomaly.notifier.class`` registry (AnomalyNotifier SPI); dotted import
+#: paths also resolve via common.config.resolve_pluggable.
+NOTIFIER_REGISTRY = {
+    "SelfHealingNotifier": SelfHealingNotifier,
+    "SlackSelfHealingNotifier": SlackSelfHealingNotifier,
+}

@@ -193,12 +193,16 @@ class MetricAnomalyDetector:
 
     def __init__(self, broker_history_fn: Callable[[], Dict[int, Dict[str, np.ndarray]]],
                  metrics: Sequence[str] = ("cpu",), now_fn=_now_ms,
-                 anomaly_class: type = MetricAnomaly, **finder_kw):
+                 anomaly_class: type = MetricAnomaly, finder=None,
+                 **finder_kw):
         self._history_fn = broker_history_fn
         self._metrics = metrics
         self._now = now_fn
         #: metric.anomaly.class
         self._anomaly_class = anomaly_class
+        #: metric.anomaly.finder.class — the finder callable
+        #: (history, current, **kw) -> description|None
+        self._finder = finder or percentile_anomalies
         self._finder_kw = finder_kw
 
     def detect(self) -> List[MetricAnomaly]:
@@ -208,8 +212,8 @@ class MetricAnomalyDetector:
                 vals = np.asarray(series.get(metric, ()))
                 if vals.size < 4:
                     continue
-                desc = percentile_anomalies(vals[:-1], float(vals[-1]),
-                                            **self._finder_kw)
+                desc = self._finder(vals[:-1], float(vals[-1]),
+                                    **self._finder_kw)
                 if desc:
                     out.append(self._anomaly_class(
                         AnomalyType.METRIC_ANOMALY, self._now(),
@@ -509,3 +513,10 @@ class AnomalyDetectorService:
                 "metrics": dict(self.metrics),
                 "queuedAnomalies": len(self._queue),
             }
+
+
+#: ``metric.anomaly.finder.class`` registry (MetricAnomalyFinder SPI):
+#: callables (history, current, **kw) -> description | None.
+METRIC_ANOMALY_FINDER_REGISTRY = {
+    "PercentileMetricAnomalyFinder": percentile_anomalies,
+}

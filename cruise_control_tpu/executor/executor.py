@@ -268,11 +268,32 @@ class ExecutorNotifier:
         pass
 
 
+class LoggingExecutorNotifier(ExecutorNotifier):
+    """Default notifier: executions land in the operation log (the
+    reference's OPERATION_LOGGER discipline, Executor.java:71)."""
+
+    def on_execution_finished(self, summary: dict):
+        logger.info("execution finished: %s", summary)
+
+    def on_execution_stopped(self, summary: dict):
+        logger.warning("execution stopped: %s", summary)
+
+
+#: ``executor.notifier.class`` registry (ExecutorNotifier SPI).
+EXECUTOR_NOTIFIER_REGISTRY = {
+    "ExecutorNotifier": ExecutorNotifier,
+    "LoggingExecutorNotifier": LoggingExecutorNotifier,
+}
+
+
 @dataclasses.dataclass
 class ExecutorConfig:
     num_concurrent_partition_movements_per_broker: int = 5
     num_concurrent_intra_broker_partition_movements: int = 2
     num_concurrent_leader_movements: int = 1000
+    #: max.num.cluster.movements — hard cap on ongoing movement tasks in
+    #: one execution (None = unlimited)
+    max_num_cluster_movements: Optional[int] = None
     execution_progress_check_interval_ms: int = 10
     max_execution_progress_check_rounds: int = 10_000
     default_replication_throttle: Optional[int] = None
@@ -415,6 +436,15 @@ class Executor:
                                      f"{name!r}; valid: {sorted(STRATEGIES)}")
                 chain = cls() if chain is None else chain.chain(cls())
             strategy = chain
+        # max.num.cluster.movements (Executor sanity cap): refuse an
+        # execution whose total task count exceeds the configured bound —
+        # BEFORE any state transition, like the strategy check above
+        cap = self.config.max_num_cluster_movements
+        total_tasks = len(proposals) + len(logdir_moves)
+        if cap is not None and total_tasks > cap:
+            raise ValueError(
+                f"execution of {total_tasks} movements exceeds "
+                f"max.num.cluster.movements={cap}")
         with self._lock:
             if self.has_ongoing_execution:
                 raise RuntimeError("An execution is already in progress")

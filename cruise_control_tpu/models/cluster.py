@@ -291,9 +291,19 @@ class LinearRegressionCpuModel:
 
     @classmethod
     def fit(cls, leader_bytes_in, leader_bytes_out, follower_bytes_in,
-            cpu_util) -> "LinearRegressionCpuModel":
+            cpu_util, cpu_util_bucket_size: Optional[int] = None,
+            min_num_buckets: Optional[int] = None,
+            samples_per_bucket: Optional[int] = None
+            ) -> "LinearRegressionCpuModel":
         """Least-squares fit; returns an untrained fallback when the sample
-        set is too small or degenerate (singular design matrix)."""
+        set is too small or degenerate (singular design matrix).
+
+        Bucket readiness (LinearRegressionModelParameters.java:40-75,
+        ``linear.regression.model.*`` keys): when given, the CPU-utilization
+        range must cover ``min_num_buckets`` distinct buckets of width
+        ``cpu_util_bucket_size`` percent with ``samples_per_bucket`` samples
+        each before the model counts as trained — a fit from a narrow CPU
+        band extrapolates badly."""
         x = np.stack([np.asarray(leader_bytes_in, np.float64),
                       np.asarray(leader_bytes_out, np.float64),
                       np.asarray(follower_bytes_in, np.float64)], axis=1)
@@ -301,6 +311,14 @@ class LinearRegressionCpuModel:
         n = y.shape[0]
         if n < 3 or np.linalg.matrix_rank(x) < 3:
             return cls()
+        if cpu_util_bucket_size and min_num_buckets:
+            # cpu_util samples are already PERCENT (BrokerMetricSample),
+            # so bucket width divides the raw value
+            buckets = np.floor(y / cpu_util_bucket_size).astype(int)
+            ids, counts = np.unique(buckets, return_counts=True)
+            full = counts >= max(1, samples_per_bucket or 1)
+            if int(full.sum()) < min_num_buckets:
+                return cls()
         coef, *_ = np.linalg.lstsq(x, y, rcond=None)
         coef = np.maximum(coef, 0.0)   # negative CPU-per-byte is noise
         return cls(coef_leader_bytes_in=float(coef[0]),

@@ -100,3 +100,15 @@ class StaticCapacityResolver(BrokerCapacityResolver):
 
     def capacity_for_broker(self, broker_id: int) -> BrokerCapacityInfo:
         return self._info
+
+
+#: ``broker.capacity.config.resolver.class`` registry
+#: (BrokerCapacityConfigResolver SPI): factories taking the service config.
+CAPACITY_RESOLVER_REGISTRY = {
+    "FileCapacityResolver": lambda config: FileCapacityResolver(
+        config.get("capacity.config.file")),
+    # the reference default's class name
+    "BrokerCapacityConfigFileResolver": lambda config: FileCapacityResolver(
+        config.get("capacity.config.file")),
+    "StaticCapacityResolver": None,     # the monitor's built-in default
+}

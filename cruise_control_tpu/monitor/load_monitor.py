@@ -97,6 +97,7 @@ class LoadMonitor:
                  max_allowed_extrapolations: int = 5,
                  sampling_interval_ms: int = 60_000,
                  use_lr_model: bool = False,
+                 lr_model_buckets: Optional[tuple] = None,
                  num_metric_fetchers: int = 1,
                  broker_num_windows: Optional[int] = None,
                  broker_window_ms: Optional[int] = None,
@@ -161,6 +162,9 @@ class LoadMonitor:
         from cruise_control_tpu.models.cluster import LinearRegressionCpuModel
         self.cpu_model = LinearRegressionCpuModel()
         self._use_lr_model = use_lr_model
+        #: linear.regression.model.* readiness knobs:
+        #: (bucket_size_pct, min_num_buckets, samples_per_bucket)
+        self._lr_model_buckets = lr_model_buckets
         # injectable clock: windowed aggregation is time-driven, so tests
         # feeding synthetic timestamps must also control "now"
         self._now = now_fn or (lambda: int(time.time() * 1000))
@@ -363,7 +367,10 @@ class LoadMonitor:
             acc = self._train_acc
             acc[0].extend(lbi); acc[1].extend(lbo)
             acc[2].extend(fbi); acc[3].extend(cpu)
-            self.cpu_model = LinearRegressionCpuModel.fit(*acc)
+            bk = self._lr_model_buckets or (None, None, None)
+            self.cpu_model = LinearRegressionCpuModel.fit(
+                *acc, cpu_util_bucket_size=bk[0], min_num_buckets=bk[1],
+                samples_per_bucket=bk[2])
             if self.cpu_model.trained and self._use_lr_model:
                 self._sampler.set_cpu_model(self.cpu_model)
         finally:

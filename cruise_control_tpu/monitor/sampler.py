@@ -241,3 +241,22 @@ class FileMetricSampler(MetricSampler):
                 else:
                     ps.append(PartitionMetricSample.from_json(d))
         return ps, bs
+
+
+def _kafka_sampler_factory(config):
+    from cruise_control_tpu.kafka_adapter import KafkaMetricsTopicSampler
+    return KafkaMetricsTopicSampler(config)
+
+
+#: ``metric.sampler.class`` registry (MetricSampler.java SPI): factories
+#: taking the service config. The reference's default sampler consumes the
+#: reporter topic; this build's default stays synthetic so a config-less
+#: service boots without a broker.
+SAMPLER_REGISTRY = {
+    "SyntheticLoadSampler": lambda config: SyntheticLoadSampler(),
+    "FileMetricSampler": lambda config: FileMetricSampler(
+        config.get("sample.store.dir") or "samples.jsonl"),
+    "KafkaMetricsTopicSampler": _kafka_sampler_factory,
+    # the reference default's class name, mapped to its analogue here
+    "CruiseControlMetricsReporterSampler": _kafka_sampler_factory,
+}

@@ -389,3 +389,26 @@ def test_reference_config_key_parity():
     # compat-only keys must disclose that they have no effect here
     for key in ("zookeeper.security.enabled",):
         assert "no effect" in ours[key].doc.lower(), key
+
+
+def test_no_silently_unwired_key():
+    """Key→behavior audit invariant (round-5 VERDICT #9): EVERY defined key
+    is either consumed by source code (found by the mechanical audit that
+    also generates docs/configuration.md's table) or explicitly documents
+    that it has no effect. A new key that is parsed but neither wired nor
+    disclosed fails here."""
+    import sys
+    from pathlib import Path
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
+    import gen_docs
+    from cruise_control_tpu.common.config import _service_config_def
+
+    consumers = gen_docs._key_consumers()
+    config_def = _service_config_def()
+    undisclosed = []
+    for name, key in config_def.keys.items():
+        src, _tests, _via = consumers[name]
+        if not src and "no effect" not in (key.doc or "").lower():
+            undisclosed.append(name)
+    assert not undisclosed, (
+        f"keys neither consumed nor marked 'no effect': {undisclosed}")

@@ -420,6 +420,35 @@ def test_train_linear_regression_cpu_model():
     assert cpu_lr != cpu_static
 
 
+def test_lr_model_bucket_readiness_gate():
+    """linear.regression.model.* readiness
+    (LinearRegressionModelParameters.java:40-75): the fit refuses to mark
+    the model trained until the CPU-utilization PERCENT range covers the
+    configured number of full bucket_size-wide buckets."""
+    from cruise_control_tpu.models.cluster import LinearRegressionCpuModel
+    rng = np.random.default_rng(7)
+    n = 500
+    lbi = rng.uniform(1e6, 5e7, n)
+    lbo = rng.uniform(1e6, 5e7, n)
+    fbi = rng.uniform(1e5, 1e7, n)
+    cpu = (2e-8 * lbi + 1e-8 * lbo + 5e-9 * fbi) * 40   # percent, wide
+    assert cpu.max() - cpu.min() > 25.0            # spans >5 5%-buckets
+    m = LinearRegressionCpuModel.fit(lbi, lbo, fbi, cpu,
+                                     cpu_util_bucket_size=5,
+                                     min_num_buckets=5,
+                                     samples_per_bucket=10)
+    assert m.trained, "wide CPU spread must satisfy 5 full 5%-buckets"
+
+    # a narrow CPU band (all samples inside ~1 bucket) must NOT train
+    narrow_scale = 1.0 / (cpu / cpu.mean())
+    cpu_narrow = cpu * narrow_scale * 10.0         # constant 10%
+    m2 = LinearRegressionCpuModel.fit(lbi, lbo, fbi, cpu_narrow,
+                                      cpu_util_bucket_size=5,
+                                      min_num_buckets=5,
+                                      samples_per_bucket=10)
+    assert not m2.trained
+
+
 def test_windowed_loads_in_model():
     """The model carries [W]-windowed per-replica loads (Load.java:84-118):
     the collapsed vector equals the window AVG, and the MAX-window broker
