@@ -110,7 +110,8 @@ def main():
     # when a residual violation appears, so the first-run pass above may
     # not have loaded them; warm explicitly so the timed run below is the
     # steady state a warmed service serves (optimizer.warm_kernels)
-    OPT.warm_kernels(topo, assign, goal_names=goal_names)
+    OPT.warm_kernels(topo, assign, goal_names=goal_names,
+                     anneal_config=cfg)
     t0 = time.time()
     r = OPT.optimize(topo, assign, goal_names=goal_names, engine=engine,
                      anneal_config=cfg, seed=seed + 1)

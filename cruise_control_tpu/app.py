@@ -596,12 +596,25 @@ class CruiseControlApp:
             # spawning background XLA CPU compiles.
             self._escape_kernels_warmed = True
 
+            # polish-shape anneal warm only when this model will actually
+            # run the ANNEAL engine (greedy-routed models never dispatch
+            # polish — warming its program would spend device time and
+            # cache space on a program that can never be used)
+            eng = self.config.get("optimizer.engine")
+            routes_anneal = (eng == "anneal"
+                             or (eng == "auto"
+                                 and topo.num_replicas * topo.num_brokers
+                                 > OPT.GREEDY_LIMIT))
+
             def _warm():
                 try:
                     OPT.warm_kernels(topo, assign,
                                      goal_names=tuple(self.default_goals),
                                      constraint=self.constraint,
                                      options=options,
+                                     anneal_config=(self._anneal_config()
+                                                    if routes_anneal
+                                                    else None),
                                      mesh=self.mesh)
                 except Exception:
                     logger.warning("escape-kernel warm failed",
