@@ -323,6 +323,11 @@ def _bench_selfheal(seed: int):
                            ("remove_broker", topo_rm, opts_rm)):
         OPT.optimize(tp, assign, options=opts, engine="anneal",
                      anneal_config=cfg, seed=seed)               # compile
+        # steady-state methodology (same as linkedin): escape + polish
+        # kernels dispatch lazily on state-dependent events — warm them so
+        # the timed run reflects a warmed service, not a mid-request
+        # program load
+        OPT.warm_kernels(tp, assign, options=opts, anneal_config=cfg)
         t0 = time.time()
         r = OPT.optimize(tp, assign, options=opts, engine="anneal",
                          anneal_config=cfg, seed=seed + 1)

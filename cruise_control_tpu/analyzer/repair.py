@@ -1462,14 +1462,19 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
         bands; the reference's single-action goal walks park strictly
         earlier on such states."""
         nonlocal st, bo, lo, reps_np, total_moves
-        lv = np.asarray(jax.device_get(_lead_viol_vec(th, weights, st,
-                                                      lead_w)))
+        # ONE transfer for the violation vector AND the lbi mirror: the
+        # iterated ladder calls shed_plan dozens of times on engaged seeds
+        # (the remove_broker trace: ~35 calls), and each separate
+        # device_get pays a full tunnel round-trip
+        lv, lbi_b = jax.device_get(
+            (_lead_viol_vec(th, weights, st, lead_w), st.leader_bytes_in))
+        lv = np.asarray(lv)
         bad = lv > 0
         if not bad.any():
             return False
         if int(bad.sum()) > cfg.escape_max_bad_brokers:
             return False    # plateau machinery only (see lead_swap_round)
-        lbi_b = np.array(jax.device_get(st.leader_bytes_in))
+        lbi_b = np.array(lbi_b)
         if not _shed_static:
             # per-repair constants: fetched once, not per shed round (the
             # iterated ladder calls shed_plan several times; plbi is a
