@@ -197,6 +197,18 @@ def main():
         except Exception:
             import traceback
             traceback.print_exc()
+    elif size == "linkedin":
+        # the single-threaded walk at this scale is ~80 minutes, so the
+        # per-round bench reports the RECORDED round-5 measurement
+        # (sequential walk on the same generator at seed 1: 4,832.8 s,
+        # ending with 3 goals still violated / soft cost 275.7 where this
+        # engine ends 0 / 0 — full methodology in docs/PERF.md). The
+        # baseline is a property of the reference walk + fixture family,
+        # not of this engine, so it stays valid as the engine changes;
+        # re-measure live any time with BENCH_SEQ=1.
+        out["sequential_baseline_recorded_s"] = 4832.8
+        out["sequential_baseline_violated_goals"] = 3
+        out["speedup_vs_sequential_recorded"] = round(4832.8 / elapsed, 1)
     print(json.dumps(out))
 
 
