@@ -740,3 +740,49 @@ def test_warm_escape_kernels_smoke_and_repair_after():
     dchecks = sanity_check(dt, final, topo.num_topics)
     assert all(dchecks.values()), dchecks
     jax.clear_caches()    # bound cumulative JIT code (see conftest)
+
+
+def test_basin_restart_skipped_in_healing_context(monkeypatch):
+    """Self-healing / destination-constrained optimizations must never run
+    the basin restart: the parked residual is structural there (the
+    reference's ADD/REMOVE semantics ship such violations) and the full
+    re-anneal from the original — broken — placement re-pays the whole
+    pipeline for a candidate that cannot beat the constraint (measured:
+    7.9 s discarded on the remove_broker bench)."""
+    import dataclasses as dc
+
+    from cruise_control_tpu.analyzer import annealer as AN
+
+    topo, assign = fixtures.random_cluster(fixtures.ClusterProperties(
+        num_racks=3, num_brokers=10, num_replicas=300, num_topics=15,
+        min_replication=2, max_replication=3), seed=21)
+    # dead broker + its replicas offline: the REMOVE self-healing topology
+    alive = np.asarray(topo.broker_alive).copy()
+    alive[0] = False
+    bo = np.asarray(assign.broker_of)
+    topo_rm = dc.replace(
+        topo, broker_alive=alive,
+        replica_offline=np.asarray(topo.replica_offline) | (bo == 0))
+    opts_rm = G.build_options(topo_rm,
+                              excluded_brokers_for_replica_move=(0,),
+                              excluded_brokers_for_leadership=(0,))
+
+    calls = []
+    orig = AN.optimize_anneal
+
+    def spy(*a, **kw):
+        calls.append(kw.get("seed"))
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(AN, "optimize_anneal", spy)
+    cfg = AN.AnnealConfig(num_chains=8, steps=64, swap_interval=32)
+    r = OPT.optimize(topo_rm, assign, options=opts_rm, engine="anneal",
+                     anneal_config=cfg, seed=3)
+    import jax
+    # the basin restart's tell-tale seed offset (seed + 104729) must never
+    # appear in a healing-context run, however many polish cycles ran
+    assert (3 + 104729) not in calls, calls
+    # the healing itself still happened: nothing remains on the dead broker
+    final_bo = np.asarray(jax.device_get(r.final_assignment.broker_of))
+    assert not (final_bo == 0).any()
+    jax.clear_caches()    # bound cumulative JIT code (see conftest)
