@@ -180,8 +180,8 @@ def main():
     # equal-or-better quality" must be a MEASUREMENT, not 30/elapsed.
     # analyzer/sequential.py is the faithful port of the reference's
     # per-goal walk; small/medium run it inline (cheap there), linkedin
-    # only under BENCH_SEQ=1 (the measured walk is ~80 minutes — see
-    # docs/PERF.md for the recorded 4,832.8 s / 3-violations result).
+    # only under BENCH_SEQ=1 (the measured walk is ~38 minutes — see
+    # docs/PERF.md for the recorded 2,258.4 s / 3-violations result).
     if size in ("small", "medium") or os.environ.get("BENCH_SEQ"):
         try:
             from cruise_control_tpu.analyzer import sequential as SEQ
@@ -198,17 +198,17 @@ def main():
             import traceback
             traceback.print_exc()
     elif size == "linkedin":
-        # the single-threaded walk at this scale is ~80 minutes, so the
+        # the single-threaded walk at this scale is ~38 minutes, so the
         # per-round bench reports the RECORDED round-5 measurement
-        # (sequential walk on the same generator at seed 1: 4,832.8 s,
-        # ending with 3 goals still violated / soft cost 275.7 where this
-        # engine ends 0 / 0 — full methodology in docs/PERF.md). The
-        # baseline is a property of the reference walk + fixture family,
-        # not of this engine, so it stays valid as the engine changes;
-        # re-measure live any time with BENCH_SEQ=1.
-        out["sequential_baseline_recorded_s"] = 4832.8
+        # (sequential walk on the same generator at seed 1, measured on an
+        # idle host: 2,258.4 s, ending with 3 goals still violated / soft
+        # cost 275.7 where this engine ends 0 / 0 — full methodology in
+        # docs/PERF.md). The baseline is a property of the reference walk
+        # + fixture family, not of this engine, so it stays valid as the
+        # engine changes; re-measure live any time with BENCH_SEQ=1.
+        out["sequential_baseline_recorded_s"] = 2258.4
         out["sequential_baseline_violated_goals"] = 3
-        out["speedup_vs_sequential_recorded"] = round(4832.8 / elapsed, 1)
+        out["speedup_vs_sequential_recorded"] = round(2258.4 / elapsed, 1)
     print(json.dumps(out))
 
 
