@@ -257,7 +257,7 @@ def _bench_jbod(seed: int):
     cert = IB.certify_infeasible_capacity_residuals(
         topo, assign, disk_of_replica=new_dof, capacity_threshold=0.8)
     assert cert["feasible"] == 0, (
-        f"jbod residual has {cert['feasible']} feasibly-fixable capacity "
+        f"jbod residual has {cert['feasible']} greedy-fixable capacity "
         f"violations (of {cert['residual']}) — either a repair regression "
         f"or the per-broker move budget truncated; rerun with "
         f"REPAIR_DEBUG=1 to tell them apart")
@@ -273,6 +273,7 @@ def _bench_jbod(seed: int):
         "capacity_violations_after": float(
             after["IntraBrokerDiskCapacityGoal"][0]),
         "residual_infeasible_certified": cert["residual"],
+        "residual_improvable": cert["improvable"],
         "usage_cost_before": float(
             before["IntraBrokerDiskUsageDistributionGoal"][1]),
         "usage_cost_after": float(

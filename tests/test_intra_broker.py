@@ -63,13 +63,14 @@ def test_disk_penalties_and_rebalance():
 
 
 def test_certify_infeasible_capacity_residuals():
-    """The residual-certification oracle (bench's JBOD quality gate):
-    a state with a single-move fix available must be flagged feasible; a
-    genuinely stuck overflow (every destination would also overflow, per
-    IntraBrokerDiskCapacityGoal.java:36-41 acceptance) must not."""
+    """The residual-certification oracle (bench's JBOD quality gate),
+    packing-bound form: a state some move SEQUENCE can bring under the
+    limit is feasible; a broker whose excess exceeds its total remaining
+    headroom is not — and if fitting single moves remain there, they are
+    reported as 'improvable' (claimable drain the repair left)."""
     topo, assign = _jbod_model()
     # initial layout: /d1 on each broker holds 1050 > 800 limit, /d2 empty
-    # -> the smallest replica (50) fits on /d2: FEASIBLE violation
+    # -> total 1050 fits under 800+800: FEASIBLE violation
     cert = IB.certify_infeasible_capacity_residuals(topo, assign)
     assert cert["residual"] >= 1
     assert cert["feasible"] >= 1
@@ -80,16 +81,45 @@ def test_certify_infeasible_capacity_residuals():
         topo, assign, disk_of_replica=new_dof)
     assert cert2["residual"] == 0 and cert2["feasible"] == 0
 
-    # construct a stuck overflow: shrink every destination's headroom so
-    # even the smallest replica (50) cannot land anywhere
+    # a stuck overflow: destination capacity so small that the broker's
+    # total exceeds every packing (limit(d)=800, other limit=8, total
+    # 1050 -> must_carry 1042 > 800) and no replica fits the 8 headroom
     import dataclasses
     small_caps = topo.disk_capacity.copy()
-    small_caps[1] = 10.0        # broker 0's /d2: limit 8 < 50
+    small_caps[1] = 10.0        # broker 0's /d2: limit 8 < smallest (50)
     small_caps[3] = 10.0        # broker 1's /d2
     topo3 = dataclasses.replace(topo, disk_capacity=small_caps)
     cert3 = IB.certify_infeasible_capacity_residuals(topo3, assign)
     assert cert3["residual"] >= 1
     assert cert3["feasible"] == 0
+    assert cert3["improvable"] == 0
+
+    # unfixable-but-improvable on broker 0: move its 100-load replica to
+    # /d2 inflated to 750 -> /d1 at 950 over the 800 limit, /d2 at 750
+    # with headroom 50 that fits the smallest remaining replica (50); but
+    # broker total 1700 > 800 + 800, so no packing fixes /d1. Broker 1
+    # keeps the original (fixable) pile-up, so feasible counts exactly it.
+    topo4, assign4 = _jbod_model()
+    dof4 = topo4.disk_of_replica.copy()
+    load4 = topo4.replica_base_load.copy()
+    r_idx = [i for i in range(topo4.num_replicas) if dof4[i] == 0]
+    r_move = next(i for i in r_idx
+                  if abs(load4[i, res.DISK] - 100.0) < 1e-6)
+    dof4[r_move] = 1
+    load4[r_move, res.DISK] = 750.0
+    topo4 = dataclasses.replace(topo4, disk_of_replica=dof4,
+                                replica_base_load=load4)
+    cert4 = IB.certify_infeasible_capacity_residuals(topo4, assign4)
+    assert cert4["feasible"] == 1, cert4      # broker 1's original state
+    assert cert4["improvable"] >= 1, cert4    # 50 fits the 50 headroom
+
+    # ...and the repair's best-effort drain claims exactly those moves:
+    # after rebalance_disks nothing improvable (or fixable) may remain
+    _, new_dof4 = IB.rebalance_disks(topo4, assign4)
+    cert5 = IB.certify_infeasible_capacity_residuals(
+        topo4, assign4, disk_of_replica=new_dof4)
+    assert cert5["improvable"] == 0, cert5
+    assert cert5["feasible"] == 0, cert5
 
 
 def test_dead_disk_evacuated():
