@@ -112,10 +112,19 @@ def main():
     # steady state a warmed service serves (optimizer.warm_kernels)
     OPT.warm_kernels(topo, assign, goal_names=goal_names,
                      anneal_config=cfg)
+    # steady-state sentinels (common/sentinels.py): the timed run below is
+    # the request a warmed service serves — it must perform ZERO retraces
+    # (every retrace is a multi-second compile inside a request) and the
+    # annealer's device loop runs under jax.transfer_guard("disallow").
+    # Violations are REPORTED in the JSON (a crash here would zero the
+    # round's contract number); GRAFT_STRICT_SENTINELS=1 makes them fatal.
+    from cruise_control_tpu.common import sentinels as SENT
     t0 = time.time()
-    r = OPT.optimize(topo, assign, goal_names=goal_names, engine=engine,
-                     anneal_config=cfg, seed=seed + 1)
+    with SENT.retrace_sentinel() as retrace_log:
+        r = OPT.optimize(topo, assign, goal_names=goal_names, engine=engine,
+                         anneal_config=cfg, seed=seed + 1)
     elapsed = time.time() - t0
+    steady_uncovered = SENT.check_steady_state(retrace_log)
 
     # ---- cluster-model-creation at bench scale (LoadMonitor.java:178
     # cluster-model-creation-timer): windowed aggregation result + cluster
@@ -171,7 +180,15 @@ def main():
         # back to the host CPU backend (optimizer.TINY_CPU_LIMIT): every
         # chunked dispatch otherwise pays remote-TPU tunnel latency
         "device": r.device,
+        # runtime sentinels: retraces observed during the timed steady-state
+        # run that the runtime baseline does not cover (contract: 0), and
+        # the functions that retraced, for file-level attribution
+        "steady_state_retraces": len(steady_uncovered),
     }
+    if steady_uncovered:
+        out["steady_state_retraced_functions"] = sorted(set(steady_uncovered))
+        print(f"bench: WARNING steady state retraced: "
+              f"{retrace_log.summary()}", file=sys.stderr)
     if model_build_s is not None:
         out["model_build_s"] = model_build_s
 
@@ -200,15 +217,38 @@ def main():
     elif size == "linkedin":
         # the single-threaded walk at this scale is ~38 minutes, so the
         # per-round bench reports the RECORDED round-5 measurement
-        # (sequential walk on the same generator at seed 1, measured on an
-        # idle host: 2,258.4 s, ending with 3 goals still violated / soft
-        # cost 275.7 where this engine ends 0 / 0 — full methodology in
+        # (sequential walk on the same generator, measured on an idle
+        # host: 2,258.4 s, ending with 3 goals still violated / soft cost
+        # 275.7 where this engine ends 0 / 0 — full methodology in
         # docs/PERF.md). The baseline is a property of the reference walk
-        # + fixture family, not of this engine, so it stays valid as the
-        # engine changes; re-measure live any time with BENCH_SEQ=1.
-        out["sequential_baseline_recorded_s"] = 2258.4
-        out["sequential_baseline_violated_goals"] = 3
-        out["speedup_vs_sequential_recorded"] = round(2258.4 / elapsed, 1)
+        # + the EXACT fixture it walked, so the recorded number is stamped
+        # with that fixture's seed and content digest
+        # (fixtures.fixture_digest); the ratio is only emitted when the
+        # live fixture matches — a generator change or a different
+        # BENCH_SEED can't silently ratio against a stale number.
+        # Re-measure live any time with BENCH_SEQ=1.
+        recorded = {
+            "seconds": 2258.4,
+            "violated_goals": 3,
+            "bench_seed": 0,
+            "fixture_digest": "c501849f5e6c967f0dd0f569bf04404125"
+                              "fa9658623b827df60ad94234374fc3",
+        }
+        out["sequential_baseline_recorded_s"] = recorded["seconds"]
+        out["sequential_baseline_violated_goals"] = recorded["violated_goals"]
+        live_digest = fixtures.fixture_digest(topo, assign)
+        if (seed == recorded["bench_seed"]
+                and live_digest == recorded["fixture_digest"]):
+            out["speedup_vs_sequential_recorded"] = round(
+                recorded["seconds"] / elapsed, 1)
+        else:
+            out["sequential_baseline_stale"] = True
+            print("bench: WARNING recorded sequential baseline was measured "
+                  f"against fixture seed {recorded['bench_seed']} digest "
+                  f"{recorded['fixture_digest'][:12]}…, but this run uses "
+                  f"seed {seed} digest {live_digest[:12]}… — omitting "
+                  "speedup_vs_sequential_recorded (re-measure with "
+                  "BENCH_SEQ=1)", file=sys.stderr)
     print(json.dumps(out))
 
 
@@ -250,10 +290,16 @@ def _bench_jbod(seed: int):
     before = IB.disk_penalties(topo, assign, capacity_threshold=0.8)
     after = IB.disk_penalties(topo, assign, disk_of_replica=new_dof,
                               capacity_threshold=0.8)
-    # certify the residual: any remaining capacity violation must be
-    # infeasible by construction (its smallest movable replica overflows
-    # EVERY destination disk on the broker) — a repair regression cannot
-    # hide inside "infeasible" (round-5 VERDICT weak #4)
+    # certify the residual: every remaining capacity violation must be
+    # PROVEN stuck, two ways (intra_broker.certify_...): (a) a packing
+    # bound — no subset of the disk's movable replicas both clears the
+    # overflow and fits the free space on the broker's other disks — and
+    # (b) where the bound alone can't rule a fix out, the repair's own
+    # greedy drain re-runs on a simulated copy as a constructive witness:
+    # only a residual the simulation actually brings under the limit
+    # counts "feasible" (reported separately from merely-"improvable"
+    # divisibility artifacts) and fires the assert below — so a repair
+    # regression cannot hide inside "infeasible" (round-5 VERDICT weak #4)
     cert = IB.certify_infeasible_capacity_residuals(
         topo, assign, disk_of_replica=new_dof, capacity_threshold=0.8)
     assert cert["feasible"] == 0, (

@@ -44,6 +44,7 @@ import numpy as np
 from cruise_control_tpu.analyzer import goals as G
 from cruise_control_tpu.analyzer import objective as OBJ
 from cruise_control_tpu.common import resources as res
+from cruise_control_tpu.common import sentinels as SENT
 from cruise_control_tpu.models.cluster import Assignment
 from cruise_control_tpu.ops.aggregates import DeviceTopology, compute_aggregates
 
@@ -665,7 +666,7 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
         order = np.argsort(t_of_r, kind="stable")
         starts = np.zeros(num_topics + 1, np.int64)
         starts[1:] = np.cumsum(counts)
-        cols = np.arange(R) - starts[t_of_r[order]]
+        cols = np.arange(R, dtype=np.int64) - starts[t_of_r[order]]
         csr = np.full((num_topics, M), -1, np.int32)
         csr[t_of_r[order], cols] = order
         topic_reps = jax.device_put(csr)
@@ -708,9 +709,13 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
         chains = shard_chains(chains, mesh)
         temps0 = shard_chains(temps0, mesh)
 
-    chains, temps = _run_pt(chains, temps0, keys, dt, th, weights, opts,
-                            movable_idx, dest_idx, initial_broker_of,
-                            topic_reps, cfg, topic_mode, n_rounds)
+    # steady-state dispatch: every argument is a device array (or hashed
+    # static), so any implicit transfer inside this call is a hazard the
+    # sentinel should catch, not tolerate (see common/sentinels.py)
+    with SENT.no_implicit_transfers():
+        chains, temps = _run_pt(chains, temps0, keys, dt, th, weights, opts,
+                                movable_idx, dest_idx, initial_broker_of,
+                                topic_reps, cfg, topic_mode, n_rounds)
     if mesh is not None and topic_mode in ("dense", "off"):
         # replica-sharded exact rescore (parallel/sharding.py): the per-chain
         # O(R) gathers and segment-sums run on replica shards with one psum,

@@ -54,7 +54,7 @@ def disk_penalties(topo: ClusterTopology, assign: Assignment,
     dof = (disk_of_replica if disk_of_replica is not None
            else topo.disk_of_replica)
     D = topo.num_disks
-    disk_load = np.zeros(D)
+    disk_load = np.zeros(D, np.float64)
     p = topo.partition_of_replica
     is_leader = np.zeros(topo.num_replicas, bool)
     is_leader[np.asarray(assign.leader_of)] = True
@@ -132,7 +132,7 @@ def certify_infeasible_capacity_residuals(
     is_leader[np.asarray(assign.leader_of)] = True
     load = topo.replica_base_load[:, res.DISK] + np.where(
         is_leader, topo.leader_extra[p, res.DISK], 0.0)
-    disk_load = np.zeros(D)
+    disk_load = np.zeros(D, np.float64)
     ok = dof >= 0
     np.add.at(disk_load, dof[ok], load[ok])
     alive = np.asarray(topo.disk_alive)
@@ -148,7 +148,7 @@ def certify_infeasible_capacity_residuals(
     for d in over:
         b = bod[d]
         dests = np.flatnonzero((bod == b) & alive
-                               & (np.arange(D) != d))
+                               & (np.arange(D, dtype=np.int64) != d))
         broker_disks = np.flatnonzero(bod == b)
         total = disk_load[broker_disks].sum()
         # dead disks must end EMPTY, so their target limit is 0
@@ -234,12 +234,14 @@ def rebalance_disks(topo: ClusterTopology, assign: Assignment,
     # and disks out of sorted index arrays is O(R log R) total.
     placed = np.flatnonzero(dof >= 0)
     r_order = placed[np.argsort(bo[placed], kind="stable")]
-    r_starts = np.searchsorted(bo[r_order], np.arange(topo.num_brokers + 1))
+    r_starts = np.searchsorted(bo[r_order],
+                               np.arange(topo.num_brokers + 1, dtype=np.int64))
     d_order = np.argsort(topo.broker_of_disk, kind="stable")
     d_starts = np.searchsorted(topo.broker_of_disk[d_order],
-                               np.arange(topo.num_brokers + 1))
+                               np.arange(topo.num_brokers + 1,
+                                         dtype=np.int64))
     # the global disk-load vector accumulates once, not per broker
-    all_disk_load = np.zeros(topo.num_disks)
+    all_disk_load = np.zeros(topo.num_disks, np.float64)
     np.add.at(all_disk_load, dof[placed], load[placed])
 
     # intra.broker.goals phase selection
@@ -457,7 +459,7 @@ def kafka_assigner_disk_usage_distribution(topo: ClusterTopology,
     bo = np.asarray(assign.broker_of).copy()
     cap = np.maximum(topo.capacity[:, res.DISK], 1e-9)
     alive = np.asarray(topo.broker_alive)
-    broker_load = np.zeros(topo.num_brokers)
+    broker_load = np.zeros(topo.num_brokers, np.float64)
     np.add.at(broker_load, bo, load)
 
     def partition_on(b):

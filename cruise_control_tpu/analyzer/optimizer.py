@@ -48,6 +48,22 @@ from cruise_control_tpu.ops.stats import compute_cluster_stats
 #: under this bound.
 GREEDY_LIMIT = 2_000_000
 
+
+def routes_to_anneal(topo, engine: str = "auto") -> bool:
+    """Single source of truth for engine routing: does this (topology,
+    engine setting) dispatch the ANNEAL engine?
+
+    Both :func:`optimize` and the app's warm-shape path call this, so the
+    routing rule cannot silently diverge between "which engine runs" and
+    "which kernels get warmed" (a divergence puts a cold compile inside a
+    request, or warms a program that can never run).
+    """
+    if engine == "anneal":
+        return True
+    return (engine == "auto"
+            and topo.num_replicas * topo.num_brokers > GREEDY_LIMIT)
+
+
 #: B·T above which the dense [B, T] topic histogram is replaced by the
 #: sort-based sparse topic penalty (matches AnnealConfig.topic_term_limit)
 TOPIC_DENSE_LIMIT = 2_000_000
@@ -432,8 +448,7 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
 
     _mark("eval+stats before")
     if engine == "auto":
-        engine = ("greedy" if topo.num_replicas * topo.num_brokers <= GREEDY_LIMIT
-                  else "anneal")
+        engine = "anneal" if routes_to_anneal(topo, engine) else "greedy"
     report_progress(f"Optimizing goals with the {engine} engine")
 
     if engine == "greedy":
@@ -480,7 +495,8 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
         # — measured on seed 1: 2 soft violations / cost 1.03 → 0 / 0 in
         # one cycle. Candidates are kept only when lexicographically
         # better (violations, then cost), so a bad cycle cannot regress.
-        hard_mask_p = np.array([G.is_hard(g) for g in goal_names] + [True])
+        hard_mask_p = np.array([G.is_hard(g) for g in goal_names] + [True],
+                               dtype=bool)
 
         def _rank(ev):
             """Lexicographic quality: hard violations dominate (a polish
@@ -521,7 +537,8 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
                     init_broker, agg_cand, sparse_topic=sparse_topic)
                 if _rank(cand_after) < _rank(after):
                     final, after, agg_after = cand, cand_after, agg_cand
-                if float(np.asarray(after.penalties.violations).sum()) == 0:
+                if float(jax.device_get(
+                        after.penalties.violations).sum()) == 0:
                     break
             _mark("polish cycles")
             # self-healing / destination-constrained contexts skip the
@@ -578,7 +595,8 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
         # swap partners escape the exact local minimum the first pass
         # converged into). The check reuses the post-optimization
         # evaluation and re-evaluates only when a backstop actually ran.
-        hard_mask = np.array([G.is_hard(g) for g in goal_names] + [True])
+        hard_mask = np.array([G.is_hard(g) for g in goal_names] + [True],
+                             dtype=bool)
 
         def _hard_viols(ev) -> float:
             return float(np.asarray(ev.penalties.violations)[hard_mask].sum())

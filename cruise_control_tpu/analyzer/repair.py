@@ -1333,7 +1333,7 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
             dt, th, weights, opts, st, jnp.asarray(src_p), slots)))  # [pad,m]
         d[Np:] = _INF
         best_s = np.argmin(d, axis=1)
-        best_d = d[np.arange(pad), best_s]
+        best_d = d[np.arange(pad, dtype=np.int64), best_s]
         order = np.argsort(best_d)
         # per-broker budget instead of one action per broker per round: the
         # per-partition lead deltas are small relative to the band widths,

@@ -529,27 +529,30 @@ class SeqGoal:
     def _eligible_brokers(self, m: SeqModel, r: int, candidates,
                           action: str) -> List[int]:
         opts = self.options
+        if opts.requested_destination_broker_ids and action != LEAD:
+            # requested destinations REPLACE the exclusion filters for
+            # non-leadership actions (GoalUtils.java:100-104): the caller
+            # explicitly picked the destinations, so the excluded-broker
+            # sets don't apply; the early return also skips the new-broker
+            # invariant (GoalUtils.java:130-132)
+            return [b for b in candidates
+                    if b in opts.requested_destination_broker_ids]
         out = []
         is_lead_action = (action == LEAD
                           or (action == MOVE and m.is_leader[r]))
+        # NO offline-replica carve-out here: the reference exempts offline
+        # replicas from the exclusion filters only in
+        # eligibleReplicasForSwap (GoalUtils.java:207-212), not in the
+        # per-action eligible-brokers path
         for b in candidates:
-            if (is_lead_action
-                    and b in opts.excluded_brokers_for_leadership
-                    and not m.offline[r]):
+            if is_lead_action and b in opts.excluded_brokers_for_leadership:
                 continue
-            if (action == MOVE
-                    and b in opts.excluded_brokers_for_replica_move
-                    and not m.offline[r]):
+            if action == MOVE and b in opts.excluded_brokers_for_replica_move:
                 continue
             out.append(b)
         if opts.requested_destination_broker_ids:
-            # the reference intersects with the requested destinations for
-            # non-leadership actions (GoalUtils.java:100-104) and then
-            # early-returns for EVERY action type, skipping the new-broker
-            # invariant (GoalUtils.java:130-132)
-            if action == MOVE:
-                out = [b for b in out
-                       if b in opts.requested_destination_broker_ids]
+            # LEAD with requested destinations: filters applied above, and
+            # the early return still skips the new-broker invariant
             return out
         if m.has_new:
             out = [b for b in out

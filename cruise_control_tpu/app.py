@@ -601,10 +601,7 @@ class CruiseControlApp:
             # polish — warming its program would spend device time and
             # cache space on a program that can never be used)
             eng = self.config.get("optimizer.engine")
-            routes_anneal = (eng == "anneal"
-                             or (eng == "auto"
-                                 and topo.num_replicas * topo.num_brokers
-                                 > OPT.GREEDY_LIMIT))
+            routes_anneal = OPT.routes_to_anneal(topo, eng)
 
             def _warm():
                 try:

@@ -1,0 +1,134 @@
+"""Runtime hazard sentinels: retrace counting + implicit-transfer guards.
+
+graftlint's static rules (tools/graftlint) catch hazard *patterns*; these
+sentinels catch the hazards themselves at runtime:
+
+- :func:`no_implicit_transfers` — a ``jax.transfer_guard("disallow")``
+  scope.  Inside it, any device transfer JAX inserts *implicitly* (a numpy
+  array silently uploaded into a jit call, an eager op against a Python
+  scalar, a device array silently pulled to host) raises immediately.
+  Explicit transfers — ``jax.device_put``, ``jax.device_get``,
+  ``jnp.asarray(np_array)`` — remain allowed, so fully-explicit
+  host-sequencing passes untouched.  The annealer wraps its steady-state
+  parallel-tempering dispatch in this scope.
+
+- :func:`retrace_sentinel` — counts jit traces/compiles inside the scope
+  (via ``jax_log_compiles`` log capture, which names the traced function),
+  so a test or bench can assert that a *warmed* steady-state run performs
+  zero retraces.
+
+- :func:`check_steady_state` — compares a :class:`RetraceLog` against the
+  checked-in runtime baseline (``tools/graftlint/runtime_baseline.json``):
+  every steady-state retrace must either not happen or be listed there
+  with a justification.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+from typing import Iterator, List, Optional
+
+import jax
+
+RUNTIME_BASELINE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))),
+    "tools", "graftlint", "runtime_baseline.json")
+
+
+def no_implicit_transfers():
+    """``with no_implicit_transfers(): ...`` — implicit transfers raise.
+
+    Wrap steady-state device dispatch (all-device-array arguments, statics
+    hashed) in this scope.  Keep host glue — Python-scalar arithmetic,
+    ``jnp.array([...])`` literals, numpy args to jit calls — outside, or
+    make its transfers explicit via device_put/device_get.
+    """
+    return jax.transfer_guard("disallow")
+
+
+class RetraceLog:
+    """Trace/compile events captured inside a :func:`retrace_sentinel`."""
+
+    def __init__(self) -> None:
+        self.traces: List[str] = []    # "Finished tracing + transforming X"
+        self.compiles: List[str] = []  # "Compiling X with global shapes..."
+
+    @property
+    def count(self) -> int:
+        """Number of traces observed (each cache miss traces once)."""
+        return len(self.traces)
+
+    def summary(self) -> str:
+        if not self.traces and not self.compiles:
+            return "0 retraces"
+        names = self.traces or self.compiles
+        return f"{len(names)} retrace(s): {', '.join(sorted(set(names)))}"
+
+
+class _CaptureHandler(logging.Handler):
+    def __init__(self, log: RetraceLog) -> None:
+        super().__init__(level=logging.DEBUG)
+        self._log = log
+
+    def emit(self, record: logging.LogRecord) -> None:
+        msg = record.getMessage()
+        if msg.startswith("Finished tracing + transforming"):
+            self._log.traces.append(msg.split()[4])
+        elif msg.startswith("Compiling") and "with global shapes" in msg:
+            self._log.compiles.append(msg.split()[1])
+
+
+@contextlib.contextmanager
+def retrace_sentinel() -> Iterator[RetraceLog]:
+    """Count jit traces/compiles inside the scope.
+
+    A warmed steady-state region must report ``log.count == 0``; anything
+    else is a retrace storm (shape/dtype drift, a fresh jit wrapper, or a
+    high-cardinality static) and ``log.summary()`` names the functions.
+    """
+    log = RetraceLog()
+    handler = _CaptureHandler(log)
+    logger = logging.getLogger("jax")
+    prev = bool(jax.config.jax_log_compiles)
+    jax.config.update("jax_log_compiles", True)
+    logger.addHandler(handler)
+    try:
+        yield log
+    finally:
+        logger.removeHandler(handler)
+        jax.config.update("jax_log_compiles", prev)
+
+
+def load_runtime_baseline(path: str = RUNTIME_BASELINE) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh).get("allowed", [])
+
+
+def check_steady_state(log: RetraceLog, path: str = RUNTIME_BASELINE,
+                       strict: Optional[bool] = None) -> List[str]:
+    """Return steady-state retraces NOT covered by the runtime baseline.
+
+    Each baseline entry allows one trace of ``function`` (with a
+    file:line + justification for the reader).  With ``strict`` (default:
+    the GRAFT_STRICT_SENTINELS env var), uncovered retraces raise.
+    """
+    allowed: List[str] = []
+    for entry in load_runtime_baseline(path):
+        allowed.append(entry.get("function", ""))
+    uncovered = list(log.traces)
+    for fn in allowed:
+        if fn in uncovered:
+            uncovered.remove(fn)
+    if strict is None:
+        strict = bool(os.environ.get("GRAFT_STRICT_SENTINELS"))
+    if uncovered and strict:
+        raise AssertionError(
+            f"steady state retraced {len(uncovered)} function(s) not in "
+            f"runtime baseline: {sorted(set(uncovered))}")
+    return uncovered

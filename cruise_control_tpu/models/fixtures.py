@@ -408,3 +408,36 @@ def random_cluster(props: ClusterProperties = None, seed: int = 3140,
                            lead_load, leader_bytes_in=float(lead_load[NW_IN]),
                            offline=offline)
     return b.build()
+
+
+def fixture_digest(topo, assign=None) -> str:
+    """Content hash of a fixture: sha256 over every array field (values +
+    shape + dtype) of the topology, plus the assignment when given.
+
+    bench.py stamps recorded baselines (e.g. the 2,258.4 s sequential
+    LinkedIn walk) with the digest of the fixture they were measured
+    against, so a generator change or a different BENCH_SEED can never be
+    silently ratioed against a stale number.
+    """
+    import hashlib
+
+    import jax
+
+    h = hashlib.sha256()
+
+    def feed(name, value):
+        arr = np.asarray(jax.device_get(value))
+        h.update(name.encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+
+    for f in sorted(dataclasses.fields(topo), key=lambda f: f.name):
+        value = getattr(topo, f.name)
+        if isinstance(value, (np.ndarray,)) or hasattr(value, "__jax_array__") \
+                or type(value).__name__ == "ArrayImpl":
+            feed(f.name, value)
+    if assign is not None:
+        feed("broker_of", assign.broker_of)
+        feed("leader_of", assign.leader_of)
+    return h.hexdigest()

@@ -1,0 +1,436 @@
+"""graftlint: per-rule trigger/clean fixtures, the whole-package gate, and
+the runtime steady-state sentinels.
+
+Every rule G001-G008 gets (a) a fixture snippet that TRIGGERS it and (b) a
+clean-idiom snippet that must pass — so a rule that silently stops firing
+(or starts over-firing) breaks here, not in a downstream repo sweep.  The
+gate test is the CI tentpole: the whole ``cruise_control_tpu`` package plus
+``bench.py`` must lint clean against the checked-in baseline.
+"""
+
+import textwrap
+
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from tools.graftlint import engine as LE  # noqa: E402
+from tools.graftlint.engine import apply_baseline, lint, lint_source, \
+    load_baseline  # noqa: E402
+
+pytestmark = pytest.mark.lint
+
+#: a hot-path module location (G002/G005 scope off the pretended path)
+HOT = "cruise_control_tpu/analyzer/annealer.py"
+
+
+def _codes(src, path="cruise_control_tpu/models/somefile.py", select=None):
+    return [f.code for f in lint_source(textwrap.dedent(src), path=path,
+                                        select=select)]
+
+
+# -- G001: traced-value Python control flow inside jit ---------------------
+
+def test_g001_triggers_on_traced_if():
+    src = """
+    import jax, jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        if x > 0:
+            return x
+        return -x
+    """
+    assert "G001" in _codes(src)
+
+
+def test_g001_triggers_on_partial_jit_while():
+    src = """
+    import jax, jax.numpy as jnp
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("n",))
+    def f(x, n):
+        while jnp.sum(x) > 0:
+            x = x - 1
+        return x
+    """
+    assert "G001" in _codes(src)
+
+
+def test_g001_clean_on_static_and_shape_tests():
+    src = """
+    import jax, jax.numpy as jnp
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("mode",))
+    def f(x, mode, y=None):
+        if mode == "fast":        # static arg: fine
+            x = x * 2
+        if y is None:             # structural test: fine
+            y = x
+        if x.ndim == 2:           # shape metadata: fine
+            x = x.sum(axis=0)
+        return jnp.where(x > 0, x, -x)   # device branch: the clean idiom
+    """
+    assert "G001" not in _codes(src)
+
+
+# -- G002: implicit host sync in hot loops ---------------------------------
+
+def test_g002_triggers_on_item_in_hot_loop():
+    src = """
+    import jax.numpy as jnp
+
+    def step(xs):
+        total = 0.0
+        for x in xs:
+            total += x.item()
+        return total
+    """
+    assert "G002" in _codes(src, path=HOT)
+
+
+def test_g002_triggers_on_float_coercion_of_device_value():
+    src = """
+    import jax.numpy as jnp
+
+    def step(xs):
+        out = []
+        for x in xs:
+            out.append(float(jnp.sum(x)))
+        return out
+    """
+    assert "G002" in _codes(src, path=HOT)
+
+
+def test_g002_triggers_on_bare_asarray_in_hot_loop():
+    src = """
+    import numpy as np
+
+    def step(batches):
+        outs = []
+        for b in batches:
+            outs.append(np.asarray(b))
+        return outs
+    """
+    assert "G002" in _codes(src, path=HOT)
+
+
+def test_g002_clean_on_explicit_device_get():
+    src = """
+    import jax
+    import numpy as np
+
+    def step(batches):
+        outs = []
+        for b in batches:
+            outs.append(np.asarray(jax.device_get(b)))
+        return outs
+    """
+    assert "G002" not in _codes(src, path=HOT)
+
+
+def test_g002_clean_on_host_list_and_outside_hot_modules():
+    src = """
+    import numpy as np
+
+    def step(n):
+        sim = list(range(n))
+        out = []
+        for i in range(3):
+            out.append(np.asarray(sim, np.int64))
+        return out
+    """
+    assert "G002" not in _codes(src, path=HOT)
+    # same .item() code OUTSIDE the hot-module list: not G002's business
+    cold = """
+    def step(xs):
+        return [x.item() for x in xs]
+    """
+    assert "G002" not in _codes(cold, path="cruise_control_tpu/app.py")
+
+
+# -- G003: device allocation inside a Python loop --------------------------
+
+def test_g003_triggers_on_alloc_in_loop():
+    src = """
+    import jax, jax.numpy as jnp
+
+    def f(n):
+        outs = []
+        for i in range(n):
+            outs.append(jnp.zeros((8,), jnp.float32))
+            jax.device_put(i)
+        return outs
+    """
+    codes = _codes(src)
+    assert codes.count("G003") == 2
+
+
+def test_g003_clean_on_hoisted_alloc_and_inline_disable():
+    src = """
+    import jax.numpy as jnp
+
+    def f(n):
+        z = jnp.zeros((8,), jnp.float32)     # hoisted: fine
+        outs = []
+        for i in range(n):
+            outs.append(z + i)
+            w = jnp.zeros((4,), jnp.int32)  # graftlint: disable=G003
+        return outs
+    """
+    assert "G003" not in _codes(src)
+
+
+def test_g003_not_confused_by_defs_inside_loops():
+    # a def inside a loop DEFINES code per iteration; the allocation in its
+    # body does not run per loop iteration
+    src = """
+    import jax.numpy as jnp
+
+    def f(n):
+        fns = []
+        for i in range(n):
+            def g():
+                return jnp.zeros((4,), jnp.float32)
+            fns.append(g)
+        return fns
+    """
+    assert "G003" not in _codes(src)
+
+
+# -- G004: non-static Python state captured by jit -------------------------
+
+def test_g004_triggers_on_mutable_default_and_global_read():
+    src = """
+    import jax
+
+    _CACHE = {}
+
+    @jax.jit
+    def f(x, opts=[]):
+        return x + len(_CACHE)
+    """
+    codes = _codes(src)
+    assert codes.count("G004") == 2
+
+
+def test_g004_clean_on_passed_state():
+    src = """
+    import jax
+
+    @jax.jit
+    def f(x, scale):
+        return x * scale
+    """
+    assert "G004" not in _codes(src)
+
+
+# -- G005: dtype-promotion hazards -----------------------------------------
+
+def test_g005_triggers_on_dtypeless_np_alloc_in_jit():
+    src = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def f(x):
+        return x + np.zeros(4)
+    """
+    assert "G005" in _codes(src)
+
+
+def test_g005_triggers_on_literal_array_in_hot_module():
+    src = """
+    import numpy as np
+
+    def f():
+        return np.array([1, 2, 3])
+    """
+    assert "G005" in _codes(src, path=HOT)
+
+
+def test_g005_clean_on_explicit_dtype_and_preserving_conversions():
+    src = """
+    import jax
+    import numpy as np
+
+    def f(x, host_arr):
+        a = np.zeros(4, np.float32)              # explicit dtype
+        b = np.asarray(host_arr)                 # dtype-preserving
+        c = np.asarray(np.array([1, 2]), np.int32)  # converted right above
+        d = np.asarray(jax.device_get(x))        # device pull, keeps dtype
+        return a, b, c, d
+    """
+    assert "G005" not in _codes(src, path=HOT)
+
+
+# -- G006: retrace storms --------------------------------------------------
+
+def test_g006_triggers_on_jit_inside_function_body():
+    src = """
+    import jax
+
+    def make_step(scale):
+        return jax.jit(lambda x: x * scale)
+    """
+    assert "G006" in _codes(src)
+
+
+def test_g006_triggers_on_high_cardinality_static():
+    src = """
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("seed",))
+    def f(x, seed):
+        return x + seed
+    """
+    assert "G006" in _codes(src)
+
+
+def test_g006_clean_on_module_level_jit_with_shape_statics():
+    src = """
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("topic_mode",))
+    def f(x, topic_mode):
+        return x
+
+    f_jit = jax.jit(f, static_argnames=("topic_mode",))
+    """
+    assert "G006" not in _codes(src)
+
+
+# -- G008: forbidden impurity inside jit -----------------------------------
+
+def test_g008_triggers_on_host_rng_time_and_print():
+    src = """
+    import jax
+    import numpy as np
+    import time
+
+    @jax.jit
+    def f(x):
+        print(x)
+        t = time.time()
+        return x + np.random.rand() + t
+    """
+    codes = _codes(src)
+    assert codes.count("G008") == 3
+
+
+def test_g008_clean_on_jax_rng_and_debug_print():
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x, key):
+        jax.debug.print("x={x}", x=x)
+        return x + jax.random.normal(key, x.shape)
+    """
+    assert "G008" not in _codes(src)
+
+
+# -- G007: unwired config keys (project rule, real package) ----------------
+
+def test_g007_whole_package_has_no_unwired_keys():
+    """Generalizes test_no_silently_unwired_key into the lint framework:
+    the project rule must run AND report nothing on the real package."""
+    findings = lint(["cruise_control_tpu"], select=["G007"],
+                    with_project_rules=True)
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+# -- baseline mechanics ----------------------------------------------------
+
+def test_baseline_suppresses_exact_count_and_flags_growth(tmp_path):
+    src = textwrap.dedent("""
+    import jax.numpy as jnp
+
+    def f(n):
+        out = []
+        for i in range(n):
+            out.append(jnp.zeros(4))
+            out.append(jnp.ones(4))
+        return out
+    """)
+    findings = lint_source(src, path="cruise_control_tpu/x.py")
+    g3 = [f for f in findings if f.code == "G003"]
+    assert len(g3) == 2
+    baseline = {g3[0].fingerprint: {"fingerprint": g3[0].fingerprint,
+                                    "count": 1, "justification": "test"}}
+    new, suppressed, stale = apply_baseline(g3, baseline)
+    # zeros suppressed, ones is new
+    assert len(suppressed) == 1 and len(new) == 1
+    assert stale == []
+    # fingerprints are line-free: shifting the file must not churn them
+    shifted = lint_source("\n\n\n" + src, path="cruise_control_tpu/x.py")
+    assert ([f.fingerprint for f in findings]
+            == [f.fingerprint for f in shifted])
+
+
+# -- the tentpole gate -----------------------------------------------------
+
+def test_package_lints_clean_against_baseline():
+    """`python -m tools.graftlint cruise_control_tpu bench.py` is clean:
+    every finding in the repo is either fixed or baselined with a
+    justification.  New hazards fail HERE."""
+    findings = lint(["cruise_control_tpu", "bench.py"], root=LE.REPO_ROOT,
+                    with_project_rules=True)
+    baseline = load_baseline()
+    new, _suppressed, _stale = apply_baseline(findings, baseline)
+    assert new == [], "new graftlint findings:\n" + "\n".join(
+        f.format() for f in new)
+    for entry in baseline.values():
+        assert entry.get("justification", "").strip() not in (
+            "", "TODO: justify or fix"), (
+            f"baseline entry lacks a real justification: "
+            f"{entry['fingerprint']}")
+
+
+# -- runtime sentinels -----------------------------------------------------
+
+def test_transfer_guard_semantics():
+    """The guard underlying the annealer's steady-state scope: explicit
+    transfers pass, implicit ones raise."""
+    from cruise_control_tpu.common import sentinels as SENT
+    x = jnp.arange(4, dtype=jnp.float32)
+    host = np.ones(4, np.float32)
+    with SENT.no_implicit_transfers():
+        jax.device_get(x)            # explicit pull: allowed
+        jnp.asarray(host)            # explicit upload: allowed
+        with pytest.raises(Exception):
+            _ = x + 1.0              # implicit scalar upload: blocked
+
+
+def test_steady_state_anneal_zero_retraces_under_guard():
+    """The acceptance-criteria sentinel, CPU-tier: a warmed second
+    optimize (anneal engine, so the `_run_pt` transfer_guard scope is
+    exercised) performs ZERO retraces not covered by the runtime
+    baseline.  Must warm and measure inside ONE test (conftest clears jax
+    caches between tests)."""
+    from cruise_control_tpu.analyzer import annealer as AN
+    from cruise_control_tpu.analyzer import optimizer as OPT
+    from cruise_control_tpu.common import sentinels as SENT
+    from cruise_control_tpu.models import fixtures
+
+    topo, assign = fixtures.synthetic_cluster(
+        num_brokers=12, num_replicas=400, num_racks=3, rf=3,
+        num_topics=20, seed=0)
+    cfg = AN.AnnealConfig(num_chains=4, steps=128, swap_interval=64,
+                          tries_move=16, tries_lead=4, tries_swap=8)
+    kw = dict(engine="anneal", anneal_config=cfg, seed=0)
+    OPT.optimize(topo, assign, **kw)                 # compile + warm
+    with SENT.retrace_sentinel() as log:
+        OPT.optimize(topo, assign, **kw)             # steady state
+    uncovered = SENT.check_steady_state(log, strict=False)
+    assert uncovered == [], (
+        f"warmed steady-state optimize retraced: {log.summary()} — either "
+        f"fix the retrace or add it to tools/graftlint/"
+        f"runtime_baseline.json with a justification")

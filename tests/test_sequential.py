@@ -299,3 +299,63 @@ def test_sequential_vs_tpu_engine_quality_small():
                        jnp.asarray(seq.leader_of, jnp.int32))
     r_tpu = OPT.optimize(topo, assign, seed=3)
     assert viols(r_tpu.final_assignment) <= viols(a_seq)
+
+
+# -- GoalUtils.eligibleBrokers parity (ADVICE round-5 drift fix) -----------
+
+def _seq_model(fix):
+    topo, assign = fix()
+    return topo, SEQ.SeqModel(topo, _host(assign.broker_of),
+                              _host(assign.leader_of))
+
+
+def test_eligible_brokers_requested_destinations_replace_exclusions():
+    """GoalUtils.java:100-104: when destination brokers are REQUESTED and
+    the action is not leadership movement, the requested-set intersection
+    REPLACES the exclusion filters (the caller explicitly picked the
+    destinations), and the early return also skips the new-broker
+    invariant (GoalUtils.java:130-132)."""
+    _, m = _seq_model(fixtures.small_cluster_model)
+    r = 0
+    goal = SEQ.SeqGoal(None, SEQ.SeqOptions(
+        excluded_brokers_for_replica_move=frozenset({1}),
+        excluded_brokers_for_leadership=frozenset({1}),
+        requested_destination_broker_ids=frozenset({1, 2})))
+    # broker 1 is excluded-for-move AND requested: requested wins for MOVE
+    assert goal._eligible_brokers(m, r, [0, 1, 2], SEQ.MOVE) == [1, 2]
+    # LEAD keeps the leadership-exclusion filter (requested destinations
+    # apply to replica placement, not leadership)
+    assert goal._eligible_brokers(m, r, [0, 1, 2], SEQ.LEAD) == [0, 2]
+
+
+def test_eligible_brokers_exclusion_applies_to_offline_replicas():
+    """The reference exempts offline replicas from the exclusion filters
+    only in eligibleReplicasForSwap (GoalUtils.java:207-212); the
+    per-action eligible-brokers path applies them unconditionally — an
+    offline replica must NOT slip onto an excluded broker."""
+    _, m = _seq_model(fixtures.dead_broker)
+    off = [r for r in range(m.R) if m.offline[r]]
+    assert off, "dead_broker fixture must produce offline replicas"
+    r = off[0]
+    goal = SEQ.SeqGoal(None, SEQ.SeqOptions(
+        excluded_brokers_for_replica_move=frozenset({3})))
+    out = goal._eligible_brokers(m, r, [1, 2, 3, 4], SEQ.MOVE)
+    assert 3 not in out
+    assert out == [1, 2, 4]
+
+
+def test_eligible_brokers_new_broker_invariant_without_requests():
+    """Without requested destinations the new-broker invariant holds: on a
+    cluster with NEW brokers, eligible MOVE destinations shrink to the new
+    brokers plus the replica's original broker (GoalUtils.java:130-140)."""
+    import dataclasses as _dc
+    topo, assign = fixtures.small_cluster_model()
+    new = np.zeros(topo.num_brokers, bool)
+    new[2] = True
+    topo2 = _dc.replace(topo, broker_new=new)
+    m = SEQ.SeqModel(topo2, _host(assign.broker_of), _host(assign.leader_of))
+    goal = SEQ.SeqGoal(None, SEQ.SeqOptions())
+    r = 0
+    orig = int(m.orig_broker[r])
+    out = goal._eligible_brokers(m, r, list(range(m.B)), SEQ.MOVE)
+    assert set(out) <= {2, orig}

@@ -1,0 +1,3 @@
+from tools.graftlint.engine import main
+
+raise SystemExit(main())

@@ -1,0 +1,287 @@
+"""graftlint engine: file walking, rule dispatch, baseline bookkeeping.
+
+The analyzer is a findbugs-style gate for JAX/XLA hazards (the reference
+project runs findbugs + a config-key audit in CI; this is the JAX-native
+equivalent).  Rules are AST passes producing :class:`Finding`s; a checked-in
+baseline file suppresses *known* findings (each with a one-line
+justification), so only NEW violations fail the gate.
+
+Baseline entries are keyed by a line-number-free fingerprint —
+``code|relpath|stripped-source-line`` — with an occurrence count, so edits
+elsewhere in a file never churn the baseline.  A finding fails the gate when
+its fingerprint's occurrence count exceeds the baselined count.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import sys
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: repo root (graftlint lives at <root>/tools/graftlint)
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    code: str        # rule id, e.g. "G002"
+    path: str        # repo-relative posix path
+    line: int        # 1-based
+    col: int         # 0-based
+    message: str
+    snippet: str     # stripped source line the finding sits on
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.code}|{self.path}|{self.snippet}"
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col + 1}: "
+                f"{self.code} {self.message}")
+
+
+class ModuleContext:
+    """Parsed module handed to every per-file rule."""
+
+    def __init__(self, path: str, source: str, root: str = REPO_ROOT):
+        self.abspath = os.path.abspath(path)
+        self.path = os.path.relpath(self.abspath, root).replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # parent links (ast has none); rules use them for context checks
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self._jit_cache = None
+
+    def snippet_at(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, code: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(code=code, path=self.path, line=line, col=col,
+                       message=message, snippet=self.snippet_at(line))
+
+    @property
+    def jit_functions(self):
+        """Jitted functions in this module (lazily computed once)."""
+        if self._jit_cache is None:
+            from tools.graftlint import rules
+            self._jit_cache = rules.find_jit_functions(self.tree)
+        return self._jit_cache
+
+
+# --------------------------------------------------------------------------
+# Rule registry
+# --------------------------------------------------------------------------
+
+#: per-file rules: fn(ModuleContext) -> Iterable[Finding]
+FILE_RULES: Dict[str, Tuple[str, callable]] = {}
+#: project rules: fn(root, paths) -> Iterable[Finding]; run once per lint
+PROJECT_RULES: Dict[str, Tuple[str, callable]] = {}
+
+
+def file_rule(code: str, name: str):
+    def deco(fn):
+        FILE_RULES[code] = (name, fn)
+        return fn
+    return deco
+
+
+def project_rule(code: str, name: str):
+    def deco(fn):
+        PROJECT_RULES[code] = (name, fn)
+        return fn
+    return deco
+
+
+def _ensure_rules_loaded():
+    from tools.graftlint import rules  # noqa: F401  (registers on import)
+
+
+# --------------------------------------------------------------------------
+# Lint drivers
+# --------------------------------------------------------------------------
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, names in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                out.extend(os.path.join(dirpath, n)
+                           for n in sorted(names) if n.endswith(".py"))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def lint_source(source: str, path: str = "fixture.py",
+                select: Optional[Sequence[str]] = None,
+                root: str = REPO_ROOT) -> List[Finding]:
+    """Lint a source string (unit-test entry point). ``path`` is the
+    pretended repo location — rules scoped to hot-path modules key off it."""
+    _ensure_rules_loaded()
+    ctx = ModuleContext(os.path.join(root, path), source, root=root)
+    findings: List[Finding] = []
+    for code, (_, fn) in sorted(FILE_RULES.items()):
+        if select and code not in select:
+            continue
+        findings.extend(fn(ctx))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.code))
+
+
+def lint(paths: Sequence[str], select: Optional[Sequence[str]] = None,
+         root: str = REPO_ROOT, with_project_rules: bool = True
+         ) -> List[Finding]:
+    """Lint files/directories; returns ALL findings (baseline not applied)."""
+    _ensure_rules_loaded()
+    findings: List[Finding] = []
+    for f in iter_py_files(paths):
+        with open(f, encoding="utf-8") as fh:
+            source = fh.read()
+        try:
+            ctx = ModuleContext(f, source, root=root)
+        except SyntaxError as e:
+            findings.append(Finding(
+                code="G000", path=os.path.relpath(f, root).replace(os.sep, "/"),
+                line=e.lineno or 1, col=0,
+                message=f"syntax error: {e.msg}", snippet=""))
+            continue
+        for code, (_, rule_fn) in sorted(FILE_RULES.items()):
+            if select and code not in select:
+                continue
+            findings.extend(rule_fn(ctx))
+    if with_project_rules:
+        for code, (_, rule_fn) in sorted(PROJECT_RULES.items()):
+            if select and code not in select:
+                continue
+            findings.extend(rule_fn(root, paths))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.code))
+
+
+# --------------------------------------------------------------------------
+# Baseline
+# --------------------------------------------------------------------------
+
+def load_baseline(path: str = DEFAULT_BASELINE) -> Dict[str, dict]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    return {e["fingerprint"]: e for e in data.get("suppressions", [])}
+
+
+def save_baseline(findings: Iterable[Finding], path: str = DEFAULT_BASELINE,
+                  old: Optional[Dict[str, dict]] = None) -> None:
+    """Write a baseline covering ``findings``, preserving the justifications
+    of entries already present in ``old``."""
+    old = old if old is not None else load_baseline(path)
+    counts: Dict[str, int] = {}
+    lines: Dict[str, int] = {}
+    for f in findings:
+        counts[f.fingerprint] = counts.get(f.fingerprint, 0) + 1
+        lines.setdefault(f.fingerprint, f.line)
+    entries = []
+    for fp in sorted(counts):
+        prev = old.get(fp, {})
+        entries.append({
+            "fingerprint": fp,
+            "count": counts[fp],
+            # line is informational only (fingerprints are line-free); it
+            # points a reader at one current occurrence
+            "line": lines[fp],
+            "justification": prev.get("justification",
+                                      "TODO: justify or fix"),
+        })
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "suppressions": entries}, fh, indent=1)
+        fh.write("\n")
+
+
+def apply_baseline(findings: Sequence[Finding],
+                   baseline: Dict[str, dict]
+                   ) -> Tuple[List[Finding], List[Finding], List[str]]:
+    """Split findings into (new, suppressed) and report stale fingerprints.
+
+    Per fingerprint, the first ``count`` occurrences are suppressed; any
+    beyond that are new.  Baseline entries matching nothing are stale —
+    reported so the baseline can shrink as hazards get fixed, but stale
+    entries do not fail the gate (they'd make every fix a two-step dance).
+    """
+    seen: Dict[str, int] = {}
+    new: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in findings:
+        seen[f.fingerprint] = seen.get(f.fingerprint, 0) + 1
+        allowed = baseline.get(f.fingerprint, {}).get("count", 0)
+        (suppressed if seen[f.fingerprint] <= allowed else new).append(f)
+    stale = [fp for fp in baseline if fp not in seen]
+    return new, suppressed, stale
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="JAX/XLA hazard static analyzer (rules G001-G008)")
+    parser.add_argument("paths", nargs="*",
+                        default=["cruise_control_tpu", "bench.py"],
+                        help="files/directories to lint "
+                             "(default: cruise_control_tpu bench.py)")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="baseline suppression file")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding (ignore the baseline)")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="rewrite the baseline to cover current findings "
+                             "(keeps existing justifications)")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated rule codes to run (e.g. "
+                             "G001,G002)")
+    parser.add_argument("--no-project-rules", action="store_true",
+                        help="skip whole-project rules (G007); they import "
+                             "the package")
+    args = parser.parse_args(argv)
+
+    select = args.select.split(",") if args.select else None
+    os.chdir(REPO_ROOT)
+    findings = lint(args.paths, select=select,
+                    with_project_rules=not args.no_project_rules)
+
+    if args.write_baseline:
+        save_baseline(findings, path=args.baseline)
+        print(f"graftlint: wrote {len(findings)} suppression(s) to "
+              f"{os.path.relpath(args.baseline, REPO_ROOT)}")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new, suppressed, stale = apply_baseline(findings, baseline)
+    for f in new:
+        print(f.format())
+    if stale:
+        print(f"graftlint: note: {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} (fixed findings — run "
+              f"--write-baseline to prune):", file=sys.stderr)
+        for fp in stale:
+            print(f"  {fp}", file=sys.stderr)
+    print(f"graftlint: {len(new)} new finding(s), "
+          f"{len(suppressed)} baselined, {len(stale)} stale")
+    return 1 if new else 0

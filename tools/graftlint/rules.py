@@ -1,0 +1,755 @@
+"""graftlint rules G001-G008: JAX/XLA hazard AST passes.
+
+Each rule is registered with the engine and yields :class:`engine.Finding`s.
+The rules are deliberately heuristic — a static pass cannot prove an array is
+on-device — but every heuristic errs toward catching the hazard class and
+relies on the baseline (plus ``# graftlint: disable=Gnnn`` inline markers)
+for the handful of deliberate exceptions.
+
+Rule catalog (docs/linting.md has the long-form rationale):
+
+G001  Python ``if``/``while``/``assert`` on a traced value inside a jitted
+      function — trace-time branching silently bakes one path per trace or
+      raises ConcretizationTypeError.
+G002  Implicit host sync inside a hot-path loop — ``.item()``,
+      ``float()``/``int()``/``bool()`` coercion or ``np.asarray`` on device
+      values; each one is a blocking device round-trip mid-loop.
+G003  Device allocation (``jnp.*`` constructors, ``jax.device_put``) inside
+      a Python-level loop body — hoistable uploads that serialize dispatch.
+G004  Non-static Python state captured by a jitted function — mutable
+      default args, reads of mutable module globals, ``global`` statements.
+G005  dtype-promotion hazard: host ``np.*`` array constructors without an
+      explicit dtype in device-adjacent code (numpy defaults are
+      float64/int64; x64-disabled JAX silently downcasts, x64-enabled JAX
+      silently upcasts the whole expression).
+G006  Retrace storms: ``jax.jit`` wrapping created inside a function body
+      (fresh callable per call defeats the jit cache), and
+      ``static_argnums``/``static_argnames`` on high-cardinality values
+      (every distinct value is a full retrace).
+G007  Config keys defined but never consumed by source (the reference's
+      config-key audit, as a lint rule).
+G008  Forbidden impurity inside a jitted function — ``np.random``/
+      ``random``/``time``/``open``/``os.environ``/``print`` execute at
+      trace time only and silently freeze into the compiled program.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, FrozenSet, Iterator, List, NamedTuple, Optional
+
+from tools.graftlint.engine import (
+    Finding, ModuleContext, file_rule, project_rule)
+
+# --------------------------------------------------------------------------
+# Shared helpers
+# --------------------------------------------------------------------------
+
+#: modules whose loops are the measured wall-clock (G002/G005 scope)
+HOT_PATH_MODULES = frozenset({
+    "cruise_control_tpu/analyzer/annealer.py",
+    "cruise_control_tpu/analyzer/repair.py",
+    "cruise_control_tpu/analyzer/optimizer.py",
+    "cruise_control_tpu/analyzer/greedy.py",
+    "cruise_control_tpu/analyzer/objective.py",
+    "cruise_control_tpu/analyzer/intra_broker.py",
+    "cruise_control_tpu/ops/aggregates.py",
+    "cruise_control_tpu/ops/stats.py",
+    "cruise_control_tpu/parallel/sharding.py",
+})
+
+#: attribute reads of a traced value that are trace-safe (static metadata)
+SAFE_TRACED_ATTRS = frozenset({"shape", "dtype", "ndim", "size", "aval",
+                               "sharding", "weak_type"})
+
+#: static_argnames/static_argnums entries that are almost certainly
+#: high-cardinality (one retrace per distinct value)
+SUSPECT_STATIC_NAMES = frozenset({"seed", "key", "rng", "rng_key", "prng_key",
+                                  "index", "idx", "step", "offset", "start",
+                                  "stop", "value", "threshold"})
+
+_NP_ROOTS = frozenset({"np", "numpy"})
+_JNP_ROOTS = frozenset({"jnp"})
+
+
+class JitInfo(NamedTuple):
+    node: ast.AST            # FunctionDef / AsyncFunctionDef / Lambda
+    static_names: FrozenSet[str]
+
+
+def _attr_root(node: ast.AST) -> Optional[str]:
+    """Root Name of a dotted chain: ``jax.numpy.zeros`` -> ``jax``."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    """``jit`` / ``jax.jit`` / ``<anything>.jit`` reference."""
+    if isinstance(node, ast.Name):
+        return node.id == "jit"
+    return isinstance(node, ast.Attribute) and node.attr == "jit"
+
+
+def _is_partial_ref(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id in ("partial", "_partial")
+    return isinstance(node, ast.Attribute) and node.attr == "partial"
+
+
+def _jit_call_statics(call: ast.Call, fn: Optional[ast.AST] = None
+                      ) -> FrozenSet[str]:
+    """static argument NAMES of a jit()/partial(jit, ...) call; positional
+    static_argnums resolve through ``fn``'s signature when given."""
+    names: List[str] = []
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            names.extend(_str_elems(kw.value))
+        elif kw.arg == "static_argnums" and fn is not None:
+            params = _param_names(fn)
+            for i in _int_elems(kw.value):
+                if 0 <= i < len(params):
+                    names.append(params[i])
+    return frozenset(names)
+
+
+def _str_elems(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+def _int_elems(node: ast.AST) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+    return []
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    return [p.arg for p in a.posonlyargs + a.args]
+
+
+def find_jit_functions(tree: ast.Module) -> List[JitInfo]:
+    """Functions that run under ``jax.jit``: decorated directly, decorated
+    via ``partial(jax.jit, ...)``, or wrapped by a module-level
+    ``name = jax.jit(fn, ...)`` assignment."""
+    by_name: Dict[str, ast.AST] = {}
+    out: List[JitInfo] = []
+    seen = set()
+
+    def add(fn, statics):
+        if id(fn) not in seen:
+            seen.add(id(fn))
+            out.append(JitInfo(fn, statics))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, node)
+            for dec in node.decorator_list:
+                if _is_jit_ref(dec):
+                    add(node, frozenset())
+                elif isinstance(dec, ast.Call):
+                    if _is_jit_ref(dec.func):           # @jax.jit(...)
+                        add(node, _jit_call_statics(dec, node))
+                    elif (_is_partial_ref(dec.func) and dec.args
+                          and _is_jit_ref(dec.args[0])):  # @partial(jax.jit,)
+                        add(node, _jit_call_statics(dec, node))
+    # module-level  f_jit = jax.jit(f, static_argnames=...)
+    for stmt in tree.body:
+        if (isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call)
+                and _is_jit_ref(stmt.value.func) and stmt.value.args
+                and isinstance(stmt.value.args[0], ast.Name)):
+            fn = by_name.get(stmt.value.args[0].id)
+            if fn is not None:
+                add(fn, _jit_call_statics(stmt.value, fn))
+    return out
+
+
+def _enclosing_function(ctx: ModuleContext, node: ast.AST) -> Optional[ast.AST]:
+    """Innermost function whose BODY executes ``node``.  Decorators and
+    default-argument expressions run in the surrounding scope at def time,
+    so a def entered via its decorator_list/signature does not count."""
+    prev: ast.AST = node
+    cur = ctx.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            via_signature = (prev in cur.decorator_list
+                             or isinstance(prev, ast.arguments)
+                             or prev is cur.returns)
+            if not via_signature:
+                return cur
+        elif isinstance(cur, ast.Lambda):
+            if not isinstance(prev, ast.arguments):
+                return cur
+        prev, cur = cur, ctx.parents.get(cur)
+    return None
+
+
+def _jit_scope_nodes(ctx: ModuleContext) -> Dict[int, JitInfo]:
+    """Map id(function node) -> JitInfo for every jitted function."""
+    return {id(ji.node): ji for ji in ctx.jit_functions}
+
+
+def _in_jit_scope(ctx: ModuleContext, node: ast.AST) -> Optional[JitInfo]:
+    """Innermost-to-outermost: is ``node`` inside a jitted function?  A
+    nested def inside a jitted function traces with it, so ancestors count."""
+    jit_nodes = _jit_scope_nodes(ctx)
+    cur = ctx.parents.get(node)
+    while cur is not None:
+        if id(cur) in jit_nodes:
+            return jit_nodes[id(cur)]
+        cur = ctx.parents.get(cur)
+    return None
+
+
+def _suppressed(ctx: ModuleContext, node: ast.AST, code: str) -> bool:
+    """Inline escape hatch: ``# graftlint: disable=G003`` on the line."""
+    line = ctx.snippet_at(getattr(node, "lineno", 0))
+    marker = "graftlint: disable"
+    if marker not in line:
+        return False
+    tail = line.split(marker, 1)[1]
+    return "=" not in tail or code in tail
+
+
+def _loop_body_nodes(fn_or_mod: ast.AST) -> Iterator[ast.AST]:
+    """Nodes lexically inside a For/While BODY, with function boundaries
+    resetting the loop context (a def inside a loop defines code, it does
+    not run it per iteration)."""
+    emitted = set()
+
+    def walk(node, in_loop):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                walk(child, False)
+                continue
+            if isinstance(child, (ast.For, ast.AsyncFor, ast.While)):
+                # iter/test run per-iteration too — count them as in-loop
+                walk(child, True)
+                continue
+            if in_loop and id(child) not in emitted:
+                emitted.add(id(child))
+                yield_nodes.append(child)
+            walk(child, in_loop)
+
+    yield_nodes: List[ast.AST] = []
+    walk(fn_or_mod, False)
+    return iter(yield_nodes)
+
+
+def _mentions_root(node: ast.AST, roots: FrozenSet[str]) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id in roots:
+            return True
+    return False
+
+
+def _contains_device_get(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                and n.func.attr in ("device_get", "block_until_ready")):
+            return True
+    return False
+
+
+def _device_tainted(node: ast.AST) -> bool:
+    """Heuristic: the expression touches device values and does not go
+    through an explicit jax.device_get."""
+    return ((_mentions_root(node, _JNP_ROOTS)
+             or any(isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "device_put"
+                    for n in ast.walk(node)))
+            and not _contains_device_get(node))
+
+
+def _assignments_in(fn: ast.AST) -> Dict[str, List[ast.AST]]:
+    """name -> value expressions assigned to it anywhere in the function."""
+    out: Dict[str, List[ast.AST]] = {}
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign):
+            for tgt in n.targets:
+                for name_node in ast.walk(tgt):
+                    if isinstance(name_node, ast.Name):
+                        out.setdefault(name_node.id, []).append(n.value)
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)) and n.value:
+            if isinstance(n.target, ast.Name):
+                out.setdefault(n.target.id, []).append(n.value)
+    return out
+
+
+_HOST_BUILTINS = frozenset({"list", "tuple", "dict", "set", "sorted", "range",
+                            "len", "enumerate", "min", "max", "sum", "int",
+                            "float", "str"})
+
+
+def _is_host_expr(v: ast.AST) -> bool:
+    return (isinstance(v, (ast.List, ast.Tuple, ast.Dict, ast.Set,
+                           ast.ListComp, ast.Constant))
+            or _contains_device_get(v)
+            or (_mentions_root(v, _NP_ROOTS)
+                and not _mentions_root(v, _JNP_ROOTS))
+            or (isinstance(v, ast.Call) and isinstance(v.func, ast.Name)
+                and v.func.id in _HOST_BUILTINS))
+
+
+def _host_assigned_name(ctx: ModuleContext, node: ast.AST) -> bool:
+    """Bare name whose every assignment in the enclosing function is
+    clearly host-side (list/np/device_get expressions)."""
+    if not isinstance(node, ast.Name):
+        return False
+    fn = _enclosing_function(ctx, node)
+    if fn is None:
+        return False
+    vals = _assignments_in(fn).get(node.id)
+    return bool(vals) and all(_is_host_expr(v) for v in vals)
+
+
+# --------------------------------------------------------------------------
+# G001 — traced-value Python control flow inside jit
+# --------------------------------------------------------------------------
+
+@file_rule("G001", "traced-branch")
+def check_traced_branch(ctx: ModuleContext) -> Iterator[Finding]:
+    for ji in ctx.jit_functions:
+        if isinstance(ji.node, ast.Lambda):
+            continue
+        traced = (frozenset(_param_names(ji.node))
+                  | frozenset(p.arg for p in ji.node.args.kwonlyargs)
+                  ) - ji.static_names
+        for node in ast.walk(ji.node):
+            if not isinstance(node, (ast.If, ast.While, ast.Assert)):
+                continue
+            test = node.test
+            if _is_static_shape_test(test, traced):
+                continue
+            if _references_traced(test, traced, ctx) \
+                    or _calls_jnp(test):
+                if _suppressed(ctx, node, "G001"):
+                    continue
+                kind = type(node).__name__.lower()
+                yield ctx.finding(
+                    "G001", node,
+                    f"Python `{kind}` on a traced value inside a jitted "
+                    f"function — branch is baked at trace time (or raises "
+                    f"ConcretizationTypeError); use lax.cond/jnp.where")
+
+
+def _is_static_shape_test(test: ast.AST, traced: FrozenSet[str]) -> bool:
+    """``x is None`` / ``x.shape == ...`` style tests are trace-safe."""
+    if isinstance(test, ast.Compare) and any(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+        return True
+    return False
+
+
+def _references_traced(test: ast.AST, traced: FrozenSet[str],
+                       ctx: ModuleContext) -> bool:
+    for n in ast.walk(test):
+        if isinstance(n, ast.Name) and n.id in traced:
+            par = ctx.parents.get(n)
+            if (isinstance(par, ast.Attribute) and par.value is n
+                    and par.attr in SAFE_TRACED_ATTRS):
+                continue
+            # len(x) on a traced array is static (shape-derived)
+            if (isinstance(par, ast.Call) and isinstance(par.func, ast.Name)
+                    and par.func.id in ("len", "isinstance")):
+                continue
+            return True
+    return False
+
+
+def _calls_jnp(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if (isinstance(n, ast.Call)
+                and _attr_root(n.func) in _JNP_ROOTS):
+            return True
+    return False
+
+
+# --------------------------------------------------------------------------
+# G002 — implicit host sync in hot-path loops
+# --------------------------------------------------------------------------
+
+@file_rule("G002", "host-sync-in-loop")
+def check_host_sync(ctx: ModuleContext) -> Iterator[Finding]:
+    if ctx.path not in HOT_PATH_MODULES:
+        return
+    assigns_by_fn: Dict[int, Dict[str, List[ast.AST]]] = {}
+
+    def name_tainted(node: ast.AST) -> bool:
+        root = node
+        while isinstance(root, (ast.Attribute, ast.Subscript)):
+            root = root.value
+        if not isinstance(root, ast.Name):
+            return False
+        fn = _enclosing_function(ctx, node)
+        if fn is None:
+            return False
+        if id(fn) not in assigns_by_fn:
+            assigns_by_fn[id(fn)] = _assignments_in(fn)
+        return any(_device_tainted(v)
+                   for v in assigns_by_fn[id(fn)].get(root.id, ()))
+
+    for node in _loop_body_nodes(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _suppressed(ctx, node, "G002"):
+            continue
+        # .item()
+        if (isinstance(node.func, ast.Attribute) and node.func.attr == "item"
+                and not node.args):
+            yield ctx.finding(
+                "G002", node,
+                "`.item()` inside a hot-path loop — blocking device->host "
+                "sync per iteration; batch with jax.device_get outside "
+                "the loop")
+            continue
+        # float()/int()/bool() coercion of device values
+        if (isinstance(node.func, ast.Name)
+                and node.func.id in ("float", "int", "bool")
+                and len(node.args) == 1):
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant):
+                continue
+            if _device_tainted(arg) or name_tainted(arg):
+                yield ctx.finding(
+                    "G002", node,
+                    f"`{node.func.id}()` on a device value inside a "
+                    f"hot-path loop — implicit sync; hoist one "
+                    f"jax.device_get out of the loop")
+            continue
+        # np.asarray / np.array in a hot loop: on a device value this is an
+        # implicit device->host transfer.  Static analysis can't prove
+        # residency, so in HOT loops the burden flips: anything not
+        # explicitly host-side (device_get'd, np-rooted, or a literal)
+        # is flagged — write the transfer explicitly or it blocks the loop.
+        if (isinstance(node.func, ast.Attribute)
+                and _attr_root(node.func) in _NP_ROOTS
+                and node.func.attr in ("asarray", "array") and node.args):
+            arg = node.args[0]
+            explicitly_host = (
+                _contains_device_get(arg)
+                or isinstance(arg, (ast.Constant, ast.List, ast.Tuple,
+                                    ast.ListComp))
+                or _mentions_root(arg, _NP_ROOTS)
+                or _host_assigned_name(ctx, arg))
+            if not explicitly_host:
+                yield ctx.finding(
+                    "G002", node,
+                    "`np.asarray` on a possibly-device value inside a "
+                    "hot-path loop — implicit device->host transfer; "
+                    "route it through jax.device_get explicitly (and "
+                    "batch it outside the loop)")
+
+
+# --------------------------------------------------------------------------
+# G003 — device allocation / upload inside a Python loop
+# --------------------------------------------------------------------------
+
+_JNP_ALLOCS = frozenset({
+    "zeros", "ones", "full", "empty", "arange", "asarray", "array", "eye",
+    "linspace", "geomspace", "zeros_like", "ones_like", "full_like"})
+
+
+@file_rule("G003", "alloc-in-loop")
+def check_alloc_in_loop(ctx: ModuleContext) -> Iterator[Finding]:
+    for node in _loop_body_nodes(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        is_alloc = (isinstance(func, ast.Attribute)
+                    and ((_attr_root(func) in _JNP_ROOTS
+                          and func.attr in _JNP_ALLOCS)
+                         or func.attr == "device_put"))
+        if not is_alloc or _suppressed(ctx, node, "G003"):
+            continue
+        what = (func.attr if func.attr == "device_put"
+                else f"jnp.{func.attr}")
+        yield ctx.finding(
+            "G003", node,
+            f"`{what}` inside a Python loop body — a device "
+            f"allocation/upload per iteration; hoist it (or fold the loop "
+            f"into the jitted computation)")
+
+
+# --------------------------------------------------------------------------
+# G004 — non-static Python state captured by a jitted function
+# --------------------------------------------------------------------------
+
+@file_rule("G004", "nonstatic-capture")
+def check_nonstatic_capture(ctx: ModuleContext) -> Iterator[Finding]:
+    # module-level names bound to mutable displays
+    mutable_globals = set()
+    for stmt in ctx.tree.body:
+        if isinstance(stmt, ast.Assign):
+            if isinstance(stmt.value, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(stmt.value, ast.Call)
+                    and isinstance(stmt.value.func, ast.Name)
+                    and stmt.value.func.id in ("list", "dict", "set")):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        mutable_globals.add(tgt.id)
+    for ji in ctx.jit_functions:
+        fn = ji.node
+        if isinstance(fn, ast.Lambda):
+            continue
+        # (a) mutable default arguments
+        for default in fn.args.defaults + [d for d in fn.args.kw_defaults
+                                           if d is not None]:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                if not _suppressed(ctx, default, "G004"):
+                    yield ctx.finding(
+                        "G004", default,
+                        f"mutable default argument on jitted `{fn.name}` — "
+                        f"captured state is baked at first trace and never "
+                        f"re-read")
+        local = set(_param_names(fn)) | {p.arg for p in fn.args.kwonlyargs}
+        local |= set(_assignments_in(fn))
+        for n in ast.walk(fn):
+            # (b) reads of mutable module globals
+            if (isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+                    and n.id in mutable_globals and n.id not in local
+                    and not _suppressed(ctx, n, "G004")):
+                yield ctx.finding(
+                    "G004", n,
+                    f"jitted `{fn.name}` reads mutable module global "
+                    f"`{n.id}` — its value at trace time is frozen into "
+                    f"the compiled program; pass it as an argument")
+            # (c) global statements
+            if isinstance(n, ast.Global) and not _suppressed(ctx, n, "G004"):
+                yield ctx.finding(
+                    "G004", n,
+                    f"`global` inside jitted `{fn.name}` — writes happen "
+                    f"at trace time only, not per call")
+
+
+# --------------------------------------------------------------------------
+# G005 — dtype-promotion hazards (dtype-less host numpy allocations)
+# --------------------------------------------------------------------------
+
+#: np constructor -> positional index of its dtype parameter
+_NP_DTYPE_SLOT = {"zeros": 1, "ones": 1, "empty": 1, "full": 2, "array": 1,
+                  "asarray": 1, "arange": 3}
+
+
+@file_rule("G005", "dtype-promotion")
+def check_dtype_promotion(ctx: ModuleContext) -> Iterator[Finding]:
+    hot = ctx.path in HOT_PATH_MODULES
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and _attr_root(node.func) in _NP_ROOTS
+                and node.func.attr in _NP_DTYPE_SLOT):
+            continue
+        if not (hot or _in_jit_scope(ctx, node)):
+            continue
+        if any(kw.arg == "dtype" for kw in node.keywords):
+            continue
+        if len(node.args) > _NP_DTYPE_SLOT[node.func.attr]:
+            continue
+        # array/asarray of an existing array is dtype-PRESERVING — the
+        # promotion hazard is only dtype INFERENCE from Python literals
+        # (lists/tuples/scalar arithmetic -> float64/int64)
+        if (node.func.attr in ("array", "asarray") and node.args
+                and not _infers_dtype_from_literals(node.args[0])):
+            continue
+        # wrapped in an explicitly-dtyped converter right above? then the
+        # inner constructor's default dtype never escapes
+        if _dtype_converted_ancestor(ctx, node):
+            continue
+        if _suppressed(ctx, node, "G005"):
+            continue
+        yield ctx.finding(
+            "G005", node,
+            f"`np.{node.func.attr}` without an explicit dtype in "
+            f"device-adjacent code — numpy defaults to float64/int64 and "
+            f"the promotion (or silent x64 downcast) follows the array "
+            f"into jnp arithmetic; pass dtype= explicitly")
+
+
+def _infers_dtype_from_literals(arg: ast.AST) -> bool:
+    """True when numpy has to GUESS the dtype from Python values: container
+    displays, comprehensions, numeric constants, or arithmetic over them.
+    Bare names / calls / attributes are assumed to already carry a dtype."""
+    if isinstance(arg, (ast.List, ast.Tuple, ast.Set, ast.ListComp,
+                        ast.GeneratorExp)):
+        return True
+    if isinstance(arg, ast.Constant):
+        return isinstance(arg.value, (int, float, bool, complex))
+    if isinstance(arg, ast.BinOp):  # array*2 keeps the array dtype
+        return (_infers_dtype_from_literals(arg.left)
+                and _infers_dtype_from_literals(arg.right))
+    if isinstance(arg, ast.UnaryOp):
+        return _infers_dtype_from_literals(arg.operand)
+    if isinstance(arg, ast.IfExp):  # either literal branch can leak
+        return (_infers_dtype_from_literals(arg.body)
+                or _infers_dtype_from_literals(arg.orelse))
+    return False
+
+
+def _dtype_converted_ancestor(ctx: ModuleContext, node: ast.AST) -> bool:
+    cur = ctx.parents.get(node)
+    hops = 0
+    while cur is not None and hops < 3:
+        if isinstance(cur, ast.Call):
+            func = cur.func
+            if (isinstance(func, ast.Attribute)
+                    and func.attr in ("asarray", "array", "astype",
+                                      "device_put")):
+                has_dtype = (any(kw.arg == "dtype" for kw in cur.keywords)
+                             or (func.attr in ("asarray", "array", "astype")
+                                 and len(cur.args) >= 2)
+                             or func.attr == "astype" and cur.args)
+                if has_dtype:
+                    return True
+            return False
+        if not isinstance(cur, (ast.IfExp, ast.BoolOp)):
+            return False
+        cur = ctx.parents.get(cur)
+        hops += 1
+    return False
+
+
+# --------------------------------------------------------------------------
+# G006 — retrace storms
+# --------------------------------------------------------------------------
+
+@file_rule("G006", "retrace-storm")
+def check_retrace_storm(ctx: ModuleContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        is_jit_call = _is_jit_ref(node.func)
+        is_partial_jit = (_is_partial_ref(node.func) and node.args
+                          and _is_jit_ref(node.args[0]))
+        if not (is_jit_call or is_partial_jit):
+            continue
+        if _suppressed(ctx, node, "G006"):
+            continue
+        # (a) jit wrapper built inside a function body: fresh function
+        # object per call -> zero cache hits, one retrace per invocation
+        if _enclosing_function(ctx, node) is not None:
+            par = ctx.parents.get(node)
+            is_decorator = (isinstance(
+                par, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node in par.decorator_list)
+            # decorating a NESTED def is the same hazard (new def object
+            # per enclosing call), so no exemption for decorators
+            del is_decorator, par
+            yield ctx.finding(
+                "G006", node,
+                "`jax.jit` wrapper created inside a function body — a "
+                "fresh callable per call never hits the jit cache "
+                "(one full retrace per invocation); hoist to module level")
+        # (b) high-cardinality statics
+        statics = _jit_call_statics(node)
+        suspects = sorted(statics & SUSPECT_STATIC_NAMES)
+        if suspects:
+            yield ctx.finding(
+                "G006", node,
+                f"static_argnames includes {suspects} — each distinct "
+                f"value is a separate trace+compile (retrace storm); pass "
+                f"it as a traced argument or hash a coarser key")
+
+
+# --------------------------------------------------------------------------
+# G007 — config keys defined but never consumed (project rule)
+# --------------------------------------------------------------------------
+
+@project_rule("G007", "unwired-config-key")
+def check_unwired_config_keys(root: str, paths) -> Iterator[Finding]:
+    """The reference's config-key audit as a lint rule: every key the
+    ConfigDef defines must be consumed by source code or documented as
+    having no effect.  Reuses the mechanical audit behind
+    docs/configuration.md (tools/gen_docs.py)."""
+    config_rel = "cruise_control_tpu/common/config.py"
+    if not os.path.exists(os.path.join(root, config_rel)):
+        return
+    # the audited package must be importable from the repo root
+    for p in (root, os.path.join(root, "tools")):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    try:
+        import gen_docs
+        from cruise_control_tpu.common.config import _service_config_def
+    except Exception as e:  # package not importable in this env
+        yield Finding("G007", config_rel, 1, 0,
+                      f"config-key audit could not run: {e}", snippet="")
+        return
+    consumers = gen_docs._key_consumers()
+    config_def = _service_config_def()
+    with open(os.path.join(root, config_rel), encoding="utf-8") as fh:
+        lines = fh.read().splitlines()
+    for name, key in sorted(config_def.keys.items()):
+        src, _tests, _via = consumers.get(name, ((), (), None))
+        if src or "no effect" in (key.doc or "").lower():
+            continue
+        line = next((i + 1 for i, text in enumerate(lines)
+                     if f'"{name}"' in text), 1)
+        yield Finding(
+            "G007", config_rel, line, 0,
+            f"config key `{name}` is defined but never consumed by source "
+            f"— wire it or document it as having no effect",
+            snippet=name)
+
+
+# --------------------------------------------------------------------------
+# G008 — forbidden impurity inside jit
+# --------------------------------------------------------------------------
+
+def _impurity(node: ast.Call) -> Optional[str]:
+    func = node.func
+    if isinstance(func, ast.Name):
+        if func.id in ("open", "input"):
+            return f"`{func.id}()`"
+        if func.id == "print":
+            return "`print()` (runs at trace time only; use jax.debug.print)"
+        return None
+    if not isinstance(func, ast.Attribute):
+        return None
+    root = _attr_root(func)
+    dotted = []
+    cur = func
+    while isinstance(cur, ast.Attribute):
+        dotted.append(cur.attr)
+        cur = cur.value
+    dotted = ".".join(reversed(dotted))
+    if root in _NP_ROOTS and "random" in dotted.split("."):
+        return f"`np.{dotted}` (host RNG; use jax.random with a threaded key)"
+    if root == "random":
+        return f"`random.{dotted}` (host RNG; use jax.random)"
+    if root == "time" and func.attr in ("time", "perf_counter", "monotonic",
+                                        "time_ns"):
+        return f"`time.{func.attr}()`"
+    if root == "os" and func.attr in ("getenv", "system", "popen"):
+        return f"`os.{func.attr}()`"
+    return None
+
+
+@file_rule("G008", "impure-jit")
+def check_impure_jit(ctx: ModuleContext) -> Iterator[Finding]:
+    for ji in ctx.jit_functions:
+        for node in ast.walk(ji.node):
+            is_environ = (isinstance(node, ast.Attribute)
+                          and node.attr == "environ"
+                          and _attr_root(node) == "os")
+            what = _impurity(node) if isinstance(node, ast.Call) else None
+            if is_environ:
+                what = "`os.environ`"
+            if what is None or _suppressed(ctx, node, "G008"):
+                continue
+            yield ctx.finding(
+                "G008", node,
+                f"{what} inside a jitted function — executes at trace time "
+                f"only and its result is frozen into the compiled program")
