@@ -27,6 +27,7 @@ from cruise_control_tpu.analyzer import goals as G
 from cruise_control_tpu.analyzer import optimizer as OPT
 from cruise_control_tpu.analyzer.annealer import AnnealConfig
 from cruise_control_tpu.common.config import CruiseControlConfig
+from cruise_control_tpu.common.metrics import REGISTRY
 from cruise_control_tpu.detector.anomalies import AnomalyType, SelfHealingNotifier
 from cruise_control_tpu.detector.detectors import (
     METRIC_ANOMALY_FINDER_REGISTRY,
@@ -193,7 +194,14 @@ class CruiseControlApp:
                 inter_broker_movement_rate_alerting_threshold=config.get(
                     "inter.broker.replica.movement.rate.alerting.threshold"),
                 intra_broker_movement_rate_alerting_threshold=config.get(
-                    "intra.broker.replica.movement.rate.alerting.threshold")))
+                    "intra.broker.replica.movement.rate.alerting.threshold"),
+                adapter_retries=config.get("executor.adapter.retries"),
+                adapter_retry_backoff_ms=config.get(
+                    "executor.adapter.retry.backoff.ms"),
+                adapter_retry_backoff_max_ms=config.get(
+                    "executor.adapter.retry.backoff.max.ms"),
+                task_stuck_deadline_ms=config.get(
+                    "executor.task.stuck.deadline.ms")))
         from cruise_control_tpu.detector.anomalies import (
             AnomalyNotifier, NOTIFIER_REGISTRY)
         notifier_cls = resolve_pluggable(
@@ -287,6 +295,11 @@ class CruiseControlApp:
         #: (cache key, goals) for _ready_goals — readiness is stable within
         #: one (aggregator generation, window)
         self._ready_goals_cache: Optional[tuple] = None
+        #: degraded-mode record of the most recent optimize() that fell back
+        #: to a lower engine (surfaced in /state AnalyzerState)
+        self._last_fallback: Optional[dict] = None
+        #: consecutive precompute_tick failures (warning rate is capped)
+        self._precompute_failures = 0
 
     # ----------------------------------------------------------------- boot
 
@@ -347,11 +360,20 @@ class CruiseControlApp:
             if self._cache_is_fresh():
                 return False
             self._compute_and_cache()
+            self._precompute_failures = 0
             return True
         except NotEnoughValidWindowsError:
             return False         # monitor not ready yet: expected at startup
         except Exception:
-            logger.warning("proposal precompute failed", exc_info=True)
+            # a permanently-broken precompute loop must stay visible without
+            # flooding the log: warn on the first few consecutive failures,
+            # then only every 10th, and count every one in the registry
+            self._precompute_failures += 1
+            REGISTRY.counter("proposal.precompute.failures")
+            n = self._precompute_failures
+            if n <= 3 or n % 10 == 0:
+                logger.warning("proposal precompute failed (%d consecutive)",
+                               n, exc_info=True)
             return False
         finally:
             self._compute_gate.release()
@@ -379,7 +401,7 @@ class CruiseControlApp:
                   goal_names: Optional[Sequence[str]] = None,
                   options: Optional[G.DeviceOptions] = None,
                   ) -> OPT.OptimizerResult:
-        return OPT.optimize(
+        res = OPT.optimize(
             topo, assign,
             goal_names=tuple(goal_names or self.default_goals),
             constraint=self.constraint,
@@ -388,6 +410,13 @@ class CruiseControlApp:
             anneal_config=self._anneal_config(),
             balancedness_weights=self._balancedness_weights,
             mesh=self.mesh)
+        if res.fallback_reason:
+            # degraded mode: remember the most recent fallback for /state
+            self._last_fallback = {
+                "engine": res.engine,
+                "reason": res.fallback_reason,
+                "atMs": int(time.time() * 1000)}
+        return res
 
     def _model(self, requirements=None, data_from: Optional[str] = None,
                now_ms: Optional[int] = None,
@@ -1167,6 +1196,8 @@ class CruiseControlApp:
             "AnalyzerState": {
                 "isProposalReady": self._proposal_cache is not None,
                 "readyGoals": list(self._ready_goals()),
+                "lastOptimizationFallback": self._last_fallback,
+                "precomputeFailures": self._precompute_failures,
             },
             "AnomalyDetectorState": self.anomaly_detector.state_snapshot(),
         }

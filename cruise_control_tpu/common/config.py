@@ -306,6 +306,17 @@ def _service_config_def() -> ConfigDef:
              "Default replication throttle bytes/sec (None = off).")
     d.define("max.num.cluster.movements", T.INT, 1250, I.MEDIUM,
              "Cap on simultaneous movements.", at_least(1))
+    d.define("executor.adapter.retries", T.INT, 3, I.MEDIUM,
+             "Retries per adapter call before the affected task is marked "
+             "DEAD (0 = fail fast).", at_least(0))
+    d.define("executor.adapter.retry.backoff.ms", T.LONG, 100, I.LOW,
+             "Initial adapter-retry backoff; doubles per attempt with "
+             "jitter.", at_least(1))
+    d.define("executor.adapter.retry.backoff.max.ms", T.LONG, 10_000, I.LOW,
+             "Upper bound on the adapter-retry backoff.", at_least(1))
+    d.define("executor.task.stuck.deadline.ms", T.LONG, 300_000, I.MEDIUM,
+             "Abort an in-flight task whose cluster-observed progress has "
+             "not changed for this long.", at_least(1))
     d.define("logdir.response.timeout.ms", T.LONG, 10_000, I.LOW,
              "DescribeLogDirs request timeout.", at_least(1))
     d.define("inter.broker.replica.movement.rate.alerting.threshold",

@@ -1,0 +1,259 @@
+"""Deterministic fault injection: chaos hooks + a faulty ClusterAdapter.
+
+The reference earns its keep by surviving a misbehaving cluster: Executor.java
+retries transient admin failures, detects stuck tasks, and contains failures
+to the affected tasks. Those paths are untestable without a way to *produce*
+the failures on demand, so this module provides two seams:
+
+1. **Chaos hooks** — named injection points (``install_chaos_hook``) that
+   production code threads values through via :func:`chaos`. A hook can
+   mutate the value (e.g. poison a penalty total with NaN) or raise (e.g.
+   simulate a device failure inside an engine). With no hook installed the
+   call is an identity pass-through — zero behavior change.
+
+2. **FaultyClusterAdapter** — a wrapper around any ``ClusterAdapter`` that
+   injects faults according to a seeded :class:`FaultPlan`: transient
+   ``AdapterTransientError``s, call latency, partial-batch submissions,
+   reassignments that never converge (stuck tasks), permanently-failing
+   partitions, and mid-execution broker/disk death. Every draw comes from
+   one ``random.Random(seed)`` stream, so a failing chaos test reproduces
+   exactly from its seed.
+
+The wrapper is duck-typed rather than subclassing ``ClusterAdapter`` so this
+module stays import-light (common/ must not depend on executor/).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Callable, Dict, Optional, Set, Tuple
+
+# ---------------------------------------------------------------------------
+# Chaos hooks (analyzer/detector injection points)
+# ---------------------------------------------------------------------------
+
+_CHAOS_HOOKS: Dict[str, Callable] = {}
+
+
+def install_chaos_hook(site: str, fn: Callable) -> None:
+    """Install ``fn`` at ``site``. The hook receives the value passed to
+    :func:`chaos` and its return value replaces it; raising from the hook
+    simulates a failure at that site."""
+    _CHAOS_HOOKS[site] = fn
+
+
+def remove_chaos_hook(site: str) -> None:
+    _CHAOS_HOOKS.pop(site, None)
+
+
+def clear_chaos_hooks() -> None:
+    _CHAOS_HOOKS.clear()
+
+
+def chaos(site: str, value=None):
+    """Thread ``value`` through the hook installed at ``site`` (identity
+    when none is installed — the production fast path)."""
+    fn = _CHAOS_HOOKS.get(site)
+    return value if fn is None else fn(value)
+
+
+# ---------------------------------------------------------------------------
+# Adapter fault injection
+# ---------------------------------------------------------------------------
+
+
+class AdapterTransientError(RuntimeError):
+    """A retriable cluster-side failure (the admin-API timeout /
+    NOT_CONTROLLER / disconnect class the reference retries)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Seeded schedule of fault events for :class:`FaultyClusterAdapter`.
+
+    Rates are per guarded adapter call and drawn from one seeded RNG stream,
+    so a given (plan, call sequence) always injects the same faults.
+    """
+
+    seed: int = 0
+    #: probability a guarded call raises AdapterTransientError
+    transient_error_rate: float = 0.0
+    #: cap on back-to-back transient failures of one method — keeps a
+    #: retrying caller convergent (set >= executor retries to starve it)
+    max_consecutive_transients: int = 2
+    #: probability a guarded call sleeps ``latency_s`` first
+    latency_rate: float = 0.0
+    latency_s: float = 0.0
+    #: probability a reassignment batch is submitted only partially
+    #: (prefix applied, then AdapterTransientError raised)
+    partial_batch_rate: float = 0.0
+    #: topic-partitions whose reassignments are accepted but never converge
+    #: in current_replicas (the reference's stuck-task condition)
+    stuck_partitions: Tuple[str, ...] = ()
+    #: topic-partitions whose current_replicas ALWAYS raises (a permanently
+    #: unreachable partition — exercises retry exhaustion / containment)
+    poisoned_partitions: Tuple[str, ...] = ()
+    #: kill this broker once the guarded-call counter passes the threshold
+    #: (mid-execution broker death)
+    kill_broker_id: Optional[int] = None
+    kill_broker_after_calls: Optional[int] = None
+    #: fail this (broker, logdir) once the counter passes the threshold
+    fail_disk_broker_id: Optional[int] = None
+    fail_disk_logdir: str = "/data/d0"
+    fail_disk_after_calls: Optional[int] = None
+
+
+class FaultyClusterAdapter:
+    """Wraps any ClusterAdapter and injects the faults a :class:`FaultPlan`
+    schedules. Unlisted attributes delegate to the inner adapter, so fake
+    helpers (``kill_broker``, ``replicas``, ...) remain reachable."""
+
+    def __init__(self, inner, plan: FaultPlan, sleep=time.sleep):
+        self.inner = inner
+        self.plan = plan
+        self._rng = random.Random(plan.seed)
+        self._sleep = sleep
+        self.calls = 0
+        #: per-kind injection tally (test observability)
+        self.injected: Dict[str, int] = {
+            "transient": 0, "latency": 0, "partial": 0,
+            "broker_death": 0, "disk_death": 0}
+        self._consecutive: Dict[str, int] = {}
+        self._stuck_submitted: Set[str] = set()
+        self._forced_dead: Set[int] = set()
+        self._forced_bad_disks: Dict[int, Dict[str, bool]] = {}
+
+    # -- fault machinery --
+    def _guard(self, method: str) -> None:
+        plan = self.plan
+        self.calls += 1
+        if (plan.kill_broker_after_calls is not None
+                and plan.kill_broker_id is not None
+                and self.calls >= plan.kill_broker_after_calls
+                and plan.kill_broker_id not in self._forced_dead):
+            self._forced_dead.add(plan.kill_broker_id)
+            self.injected["broker_death"] += 1
+            if hasattr(self.inner, "kill_broker"):
+                self.inner.kill_broker(plan.kill_broker_id)
+        if (plan.fail_disk_after_calls is not None
+                and plan.fail_disk_broker_id is not None
+                and self.calls >= plan.fail_disk_after_calls
+                and plan.fail_disk_broker_id not in self._forced_bad_disks):
+            self._forced_bad_disks[plan.fail_disk_broker_id] = {
+                plan.fail_disk_logdir: False}
+            self.injected["disk_death"] += 1
+            if hasattr(self.inner, "fail_disk"):
+                self.inner.fail_disk(plan.fail_disk_broker_id,
+                                     plan.fail_disk_logdir)
+        if plan.latency_rate and self._rng.random() < plan.latency_rate:
+            self.injected["latency"] += 1
+            self._sleep(plan.latency_s)
+        if (plan.transient_error_rate
+                and self._rng.random() < plan.transient_error_rate):
+            if self._bump(method):
+                self.injected["transient"] += 1
+                raise AdapterTransientError(
+                    f"injected transient failure in {method} "
+                    f"(call {self.calls}, seed {plan.seed})")
+        self._consecutive[method] = 0
+
+    def _bump(self, key: str) -> bool:
+        """True when another consecutive failure of ``key`` is allowed."""
+        c = self._consecutive.get(key, 0)
+        if c >= self.plan.max_consecutive_transients:
+            return False
+        self._consecutive[key] = c + 1
+        return True
+
+    # -- adapter API --
+    def execute_replica_reassignments(self, tasks):
+        self._guard("execute_replica_reassignments")
+        stuck = set(self.plan.stuck_partitions)
+        forward = []
+        for t in tasks:
+            tp = t.proposal.topic_partition
+            if tp in stuck:
+                # accepted but never applied: looks in-progress forever
+                self._stuck_submitted.add(tp)
+            else:
+                forward.append(t)
+        if (forward and len(forward) > 1 and self.plan.partial_batch_rate
+                and self._rng.random() < self.plan.partial_batch_rate
+                and self._bump("partial_batch")):
+            half = max(1, len(forward) // 2)
+            self.inner.execute_replica_reassignments(forward[:half])
+            self.injected["partial"] += 1
+            raise AdapterTransientError(
+                f"injected partial-batch failure: submitted {half} of "
+                f"{len(forward)} reassignments (seed {self.plan.seed})")
+        self._consecutive["partial_batch"] = 0
+        if forward:
+            self.inner.execute_replica_reassignments(forward)
+
+    def execute_preferred_leader_elections(self, tasks):
+        self._guard("execute_preferred_leader_elections")
+        self.inner.execute_preferred_leader_elections(tasks)
+
+    def current_replicas(self, tp):
+        if tp in self.plan.poisoned_partitions:
+            self.calls += 1
+            self.injected["transient"] += 1
+            raise AdapterTransientError(
+                f"injected permanent failure: current_replicas({tp!r})")
+        self._guard("current_replicas")
+        return self.inner.current_replicas(tp)
+
+    def current_leader(self, tp):
+        self._guard("current_leader")
+        return self.inner.current_leader(tp)
+
+    def in_progress_reassignments(self):
+        self._guard("in_progress_reassignments")
+        return set(self.inner.in_progress_reassignments()) | set(
+            self._stuck_submitted)
+
+    def cancel_reassignments(self, tasks):
+        self._guard("cancel_reassignments")
+        for t in tasks:
+            self._stuck_submitted.discard(t.proposal.topic_partition)
+        self.inner.cancel_reassignments(tasks)
+
+    def set_broker_throttle_rate(self, broker_ids, rate):
+        self._guard("set_broker_throttle_rate")
+        self.inner.set_broker_throttle_rate(broker_ids, rate)
+
+    def clear_broker_throttle_rate(self, broker_ids):
+        self._guard("clear_broker_throttle_rate")
+        self.inner.clear_broker_throttle_rate(broker_ids)
+
+    def set_topic_throttled_replicas(self, topic, leader_entries,
+                                     follower_entries):
+        self._guard("set_topic_throttled_replicas")
+        self.inner.set_topic_throttled_replicas(topic, leader_entries,
+                                                follower_entries)
+
+    def clear_topic_throttled_replicas(self, topic):
+        self._guard("clear_topic_throttled_replicas")
+        self.inner.clear_topic_throttled_replicas(topic)
+
+    def dead_brokers(self):
+        self._guard("dead_brokers")
+        return set(self.inner.dead_brokers()) | set(self._forced_dead)
+
+    def describe_logdirs(self):
+        self._guard("describe_logdirs")
+        out = {b: dict(d) for b, d in self.inner.describe_logdirs().items()}
+        for b, dirs in self._forced_bad_disks.items():
+            out.setdefault(b, {}).update(dirs)
+        return out
+
+    def alter_replica_logdirs(self, moves):
+        self._guard("alter_replica_logdirs")
+        self.inner.alter_replica_logdirs(moves)
+
+    def __getattr__(self, name):
+        # fake-adapter helpers (kill_broker, replicas, leaders, ...) and any
+        # future adapter surface pass through un-faulted
+        return getattr(self.inner, name)

@@ -22,12 +22,15 @@ from __future__ import annotations
 import dataclasses
 import heapq
 import json
+import logging
 import os
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 from cruise_control_tpu.detector.anomalies import (
     Anomaly,
@@ -370,7 +373,11 @@ class AnomalyDetectorService:
         self._now = now_fn
         self.history: List[dict] = []
         self.metrics = {"anomalies_detected": 0, "fixes_triggered": 0,
-                        "fixes_failed": 0, "ignored": 0, "checks": 0}
+                        "fixes_failed": 0, "ignored": 0, "checks": 0,
+                        "detector_failures": 0}
+        #: per-detector failure tally (one misbehaving detector must be
+        #: visible in /state, not just a log line)
+        self.detector_failures: Dict[str, int] = {}
 
     # -- queue --
     @staticmethod
@@ -422,6 +429,17 @@ class AnomalyDetectorService:
             try:
                 found = det()
             except Exception:
+                # one raising detector must not stop the sweep: the others
+                # still run (AnomalyDetector.java keeps its scheduled tasks
+                # independent), and the failure is logged + counted
+                logger.warning("anomaly detector %r raised; continuing the "
+                               "sweep", name, exc_info=True)
+                with self._lock:
+                    self.metrics["detector_failures"] += 1
+                    self.detector_failures[name] = (
+                        self.detector_failures.get(name, 0) + 1)
+                from cruise_control_tpu.common.metrics import REGISTRY
+                REGISTRY.counter("anomaly-detector-error-rate")
                 continue
             if found is None:
                 continue
@@ -467,6 +485,8 @@ class AnomalyDetectorService:
                     from cruise_control_tpu.common.metrics import REGISTRY
                     REGISTRY.counter("self-healing-fix-rate")
                 except Exception as e:   # fix failures must not kill the loop
+                    logger.warning("self-healing fix for %s failed",
+                                   a.anomaly_type.value, exc_info=True)
                     record["fixError"] = str(e)
                     self.metrics["fixes_failed"] += 1
             elif result.action == AnomalyAction.IGNORE:
@@ -512,6 +532,7 @@ class AnomalyDetectorService:
                 "recentAnomalies": self.history[-self.num_cached_states:],
                 "metrics": dict(self.metrics),
                 "queuedAnomalies": len(self._queue),
+                "detectorFailures": dict(self.detector_failures),
             }
 
 

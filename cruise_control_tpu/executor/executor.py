@@ -15,6 +15,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import logging
+import random
 import threading
 import time
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
@@ -212,6 +213,73 @@ class FakeClusterAdapter(ClusterAdapter):
                 self._pending_ple[tp] = (n - 1, new_order)
 
 
+#: adapter API methods the executor wraps in retry-with-backoff — the full
+#: cluster-facing surface of :class:`ClusterAdapter`
+_ADAPTER_RETRY_METHODS = frozenset({
+    "execute_replica_reassignments", "execute_preferred_leader_elections",
+    "current_replicas", "current_leader", "in_progress_reassignments",
+    "cancel_reassignments", "set_broker_throttle_rate",
+    "clear_broker_throttle_rate", "set_topic_throttled_replicas",
+    "clear_topic_throttled_replicas", "dead_brokers", "describe_logdirs",
+    "alter_replica_logdirs",
+})
+
+
+class RetryingClusterAdapter:
+    """Retry-with-exponential-backoff+jitter shim over a ClusterAdapter.
+
+    The reference retries transient admin failures (timeouts, controller
+    handoffs, disconnects) before giving up on a task; this wrapper gives
+    every adapter call that discipline, governed by ``executor.adapter.
+    retries`` / ``executor.adapter.retry.backoff.ms`` / ``executor.adapter.
+    retry.backoff.max.ms``. ``NotImplementedError`` passes straight through —
+    it is a capability signal (e.g. an adapter that cannot cancel), not a
+    failure. Config is read per call so per-instance tuning after
+    construction takes effect.
+    """
+
+    def __init__(self, inner: ClusterAdapter, config: "ExecutorConfig",
+                 on_retry: Optional[Callable[[str], None]] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 rng: Optional[random.Random] = None):
+        self._inner = inner
+        self._config = config
+        self._on_retry = on_retry
+        self._sleep = sleep
+        self._rng = rng or random.Random()
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name not in _ADAPTER_RETRY_METHODS or not callable(attr):
+            return attr
+
+        def call(*args, **kwargs):
+            retries = max(0, self._config.adapter_retries)
+            backoff_s = max(self._config.adapter_retry_backoff_ms, 1) / 1000.0
+            cap_s = max(self._config.adapter_retry_backoff_max_ms, 1) / 1000.0
+            for attempt in range(retries + 1):
+                try:
+                    return attr(*args, **kwargs)
+                except NotImplementedError:
+                    raise
+                except Exception:
+                    if attempt >= retries:
+                        raise
+                    delay = min(cap_s, backoff_s * (2 ** attempt))
+                    # full-jitter lower half: [0.5, 1.0) of the nominal delay
+                    delay *= 0.5 + self._rng.random() * 0.5
+                    logger.warning(
+                        "adapter call %s failed (attempt %d/%d); retrying "
+                        "in %.3f s", name, attempt + 1, retries + 1, delay,
+                        exc_info=True)
+                    if self._on_retry is not None:
+                        self._on_retry(name)
+                    self._sleep(delay)
+
+        call.__name__ = name
+        return call
+
+
 class ReplicationThrottleHelper:
     """Sets/clears leader+follower throttled rates and per-topic throttled
     replica lists around an execution (ReplicationThrottleHelper.java:29-79):
@@ -296,6 +364,15 @@ class ExecutorConfig:
     max_num_cluster_movements: Optional[int] = None
     execution_progress_check_interval_ms: int = 10
     max_execution_progress_check_rounds: int = 10_000
+    #: executor.adapter.retries / executor.adapter.retry.backoff{,.max}.ms —
+    #: per-adapter-call retry budget with exponential backoff + jitter
+    adapter_retries: int = 3
+    adapter_retry_backoff_ms: int = 100
+    adapter_retry_backoff_max_ms: int = 10_000
+    #: executor.task.stuck.deadline.ms — abort an in-flight task whose
+    #: adapter-observed progress has not changed for this long (the
+    #: reference's task-stuck condition; None disables the check)
+    task_stuck_deadline_ms: Optional[int] = 300_000
     default_replication_throttle: Optional[int] = None
     #: leader.movement.timeout.ms — wall-clock bound on one leadership batch;
     #: the round budget is derived from the EFFECTIVE check interval at
@@ -337,6 +414,22 @@ class Executor:
         self._removal_history: Dict[int, float] = {}   # broker → record ts (s)
         self._demotion_history: Dict[int, float] = {}
         self._execution_history: List[dict] = []
+        # per-execution fault-tolerance tallies (reset in execute_proposals)
+        self._exec_retries = 0
+        self._exec_task_failures = 0
+        self._exec_stuck = 0
+
+    @property
+    def _adapter(self) -> RetryingClusterAdapter:
+        """The retrying view of ``self.adapter`` — built per access so a
+        swapped-in adapter (tests) is always the one retried."""
+        return RetryingClusterAdapter(self.adapter, self.config,
+                                      on_retry=self._note_retry)
+
+    def _note_retry(self, method: str) -> None:
+        self._exec_retries += 1
+        from cruise_control_tpu.common.metrics import REGISTRY
+        REGISTRY.counter("adapter-call-retry-rate")
 
     # -- removal/demotion history (Executor.java:123-127 with the
     # {removal,demotion}.history.retention.time.ms windows). Readers prune
@@ -455,6 +548,9 @@ class Executor:
             self._stop_requested.clear()
             self._force_stop.clear()
             self._timed_out = False
+            self._exec_retries = 0
+            self._exec_task_failures = 0
+            self._exec_stuck = 0
             t0 = time.time()
             self._interval_override_ms = progress_check_interval_ms
             planner = ExecutionTaskPlanner(strategy)
@@ -468,7 +564,7 @@ class Executor:
             throttle = (replication_throttle
                         if replication_throttle is not None
                         else self.config.default_replication_throttle)
-            helper = (ReplicationThrottleHelper(self.adapter, throttle)
+            helper = (ReplicationThrottleHelper(self._adapter, throttle)
                       if throttle is not None else None)
         except BaseException:
             with self._lock:        # match the acquisition path's discipline
@@ -494,7 +590,7 @@ class Executor:
                 report_progress(f"Executing {len(logdir_moves)} intra-broker "
                                 f"logdir movements")
                 for lb in self._logdir_batches(logdir_moves):
-                    self.adapter.alter_replica_logdirs(lb)
+                    self._adapter.alter_replica_logdirs(lb)
                     intra_moves_applied += len(lb)
                     if self._stop_requested.is_set():
                         break
@@ -505,8 +601,17 @@ class Executor:
             self._move_leadership(planner, leader_concurrency)
             crashed = False
         finally:
+            from cruise_control_tpu.common.metrics import REGISTRY
             if helper is not None:
-                helper.clear_throttles()
+                try:
+                    helper.clear_throttles()
+                except Exception:
+                    # the summary/state release below must still run; the
+                    # leaked throttle is the operator's signal to clean up
+                    logger.exception(
+                        "failed to clear replication throttles after "
+                        "execution (adapter retries exhausted)")
+                    REGISTRY.counter("throttle-clear-failed-rate")
             duration_s = time.time() - t0
             summary = {
                 "stopped": self._stop_requested.is_set(),
@@ -516,6 +621,14 @@ class Executor:
                 "intraBrokerMoves": intra_moves_applied,
                 "durationSeconds": round(duration_s, 3),
             }
+            # fault-tolerance tallies are reported only when nonzero so a
+            # fault-free execution's summary is unchanged from older builds
+            if self._exec_retries:
+                summary["adapterRetries"] = self._exec_retries
+            if self._exec_task_failures:
+                summary["tasksDeadOnAdapterFailure"] = self._exec_task_failures
+            if self._exec_stuck:
+                summary["stuckTasksAborted"] = self._exec_stuck
             # movement-rate alert ({inter,intra}.broker.replica.movement.
             # rate.alerting.threshold): a healthy execution sustains at
             # least the configured MB/s of ACTUALLY FINISHED movement (the
@@ -536,7 +649,6 @@ class Executor:
             self._execution_history.append(summary)
             self._state = ExecutorState.NO_TASK_IN_PROGRESS
             self._planner = None
-            from cruise_control_tpu.common.metrics import REGISTRY
             if crashed:
                 REGISTRY.counter("execution-failed-rate")
                 self.notifier.on_execution_stopped(summary)
@@ -560,7 +672,7 @@ class Executor:
         data_mb = 0.0
         try:
             for batch in self._logdir_batches(moves):
-                self.adapter.alter_replica_logdirs(batch)
+                self._adapter.alter_replica_logdirs(batch)
                 applied += len(batch)
                 # intra rate counts the APPLIED batches' sizes only (a
                 # stopped run must not have its rate inflated by the
@@ -616,8 +728,10 @@ class Executor:
             for t in batch:
                 t.transition(TaskState.IN_PROGRESS, now)
                 self.tracker.mark(t, TaskState.PENDING)
-            self.adapter.execute_replica_reassignments(batch)
-            self._wait_for(batch, self._replica_task_done)
+            batch = self._submit_contained(
+                batch, self._adapter.execute_replica_reassignments)
+            if batch:
+                self._wait_for(batch, self._replica_task_status)
 
     def _move_leadership(self, planner: ExecutionTaskPlanner,
                          concurrency: Optional[int] = None):
@@ -633,9 +747,12 @@ class Executor:
             for t in batch:
                 t.transition(TaskState.IN_PROGRESS, now)
                 self.tracker.mark(t, TaskState.PENDING)
-            self.adapter.execute_preferred_leader_elections(batch)
-            self._wait_for(batch, self._leader_task_done,
-                           max_rounds=self._leadership_round_budget())
+            batch = self._submit_contained(
+                batch, self._adapter.execute_preferred_leader_elections)
+            if batch:
+                self._wait_for(batch, self._leader_task_status,
+                               max_rounds=self._leadership_round_budget(),
+                               cancelable=False)
 
     def _effective_check_interval_ms(self) -> int:
         return (self._interval_override_ms
@@ -650,27 +767,73 @@ class Executor:
         return max(1, int(self.config.leader_movement_timeout_ms
                           // max(self._effective_check_interval_ms(), 1)))
 
-    def _replica_task_done(self, task: ExecutionTask) -> Optional[TaskState]:
-        tp = task.proposal.topic_partition
-        current = self.adapter.current_replicas(tp)
-        if task.proposal.is_completed(current):
-            return TaskState.COMPLETED
-        dead = self.adapter.dead_brokers()
-        if dead & set(task.proposal.new_replicas):
-            return TaskState.DEAD
-        return None
+    def _submit_contained(self, batch: List[ExecutionTask],
+                          submit: Callable[[Sequence[ExecutionTask]], None]
+                          ) -> List[ExecutionTask]:
+        """Submit a batch through the retrying adapter; on retry exhaustion
+        fall back to per-task submission and mark only the tasks that STILL
+        fail DEAD — the rest of the execution continues (the reference
+        contains admin failures to the affected tasks, it does not abort
+        whole rebalances). Returns the tasks that were actually submitted."""
+        try:
+            submit(batch)
+            return list(batch)
+        except NotImplementedError:
+            raise
+        except Exception:
+            logger.exception(
+                "batch submission of %d tasks failed after retries; "
+                "retrying tasks individually", len(batch))
+        survivors: List[ExecutionTask] = []
+        for t in batch:
+            try:
+                submit([t])
+                survivors.append(t)
+            except Exception:
+                logger.exception(
+                    "task %s failed to submit after retries; marking it DEAD",
+                    t.proposal.topic_partition)
+                self._fail_task(t, int(time.time() * 1000))
+        return survivors
 
-    def _leader_task_done(self, task: ExecutionTask) -> Optional[TaskState]:
+    def _fail_task(self, task: ExecutionTask, now_ms: int) -> None:
+        """Adapter-failure containment: this task dies, the run survives."""
+        prev = task.state
+        task.transition(TaskState.DEAD, now_ms)
+        self.tracker.mark(task, prev)
+        self._exec_task_failures += 1
+        from cruise_control_tpu.common.metrics import REGISTRY
+        REGISTRY.counter("task-dead-on-adapter-failure-rate")
+
+    def _replica_task_status(
+            self, task: ExecutionTask) -> Tuple[Optional[TaskState], object]:
+        """One progress probe; returns (outcome, observed replica set). The
+        probe value feeds stuck detection: no change within the deadline
+        means the reassignment is wedged cluster-side."""
         tp = task.proposal.topic_partition
-        if self.adapter.current_leader(tp) == task.proposal.new_replicas[0]:
-            return TaskState.COMPLETED
-        if self.adapter.current_leader(tp) in self.adapter.dead_brokers():
-            return TaskState.DEAD
-        return None
+        current = self._adapter.current_replicas(tp)
+        if task.proposal.is_completed(current):
+            return TaskState.COMPLETED, current
+        dead = self._adapter.dead_brokers()
+        if dead & set(task.proposal.new_replicas):
+            return TaskState.DEAD, current
+        return None, current
+
+    def _leader_task_status(
+            self, task: ExecutionTask) -> Tuple[Optional[TaskState], object]:
+        tp = task.proposal.topic_partition
+        leader = self._adapter.current_leader(tp)
+        if leader == task.proposal.new_replicas[0]:
+            return TaskState.COMPLETED, leader
+        if leader in self._adapter.dead_brokers():
+            return TaskState.DEAD, leader
+        return None, leader
 
     def _wait_for(self, batch: List[ExecutionTask],
-                  done_fn: Callable[[ExecutionTask], Optional[TaskState]],
-                  max_rounds: Optional[int] = None):
+                  status_fn: Callable[[ExecutionTask],
+                                      Tuple[Optional[TaskState], object]],
+                  max_rounds: Optional[int] = None,
+                  cancelable: bool = True):
         """Progress polling (Executor.java waitForExecutionTaskToFinish).
 
         Graceful stop aborts what can be aborted and drains the rest; forced
@@ -678,6 +841,12 @@ class Executor:
         Exhausting the round budget also marks the stragglers DEAD — leaving
         them IN_PROGRESS would corrupt per-broker concurrency accounting for
         the next batch — and surfaces ``timedOut`` in the summary.
+
+        Per-task failure containment (the reference's task-stuck semantics):
+        a status probe that still fails after adapter retries kills only that
+        task; a task whose adapter-observed progress has not changed within
+        ``task_stuck_deadline_ms`` is individually cancelled and ABORTED
+        (``cancelable=False`` phases — leadership — mark it DEAD instead).
         """
         rounds = 0
         budget = (max_rounds if max_rounds is not None
@@ -685,6 +854,10 @@ class Executor:
         open_tasks = list(batch)
         batch_t0 = time.time()
         alerted = False
+        deadline_ms = self.config.task_stuck_deadline_ms
+        # per-task (last probe, wall time it last changed)
+        progress: Dict[int, Tuple[object, float]] = {
+            id(t): (None, batch_t0) for t in open_tasks}
         while open_tasks and rounds < budget:
             if (not alerted and (time.time() - batch_t0) * 1000
                     > self.config.task_execution_alerting_threshold_ms):
@@ -697,18 +870,40 @@ class Executor:
                     self.config.task_execution_alerting_threshold_ms / 1000.0)
             rounds += 1
             now = int(time.time() * 1000)
+            wall = time.time()
             still = []
             aborting: List[ExecutionTask] = []
+            stuck: List[ExecutionTask] = []
             stopping = self._stop_requested.is_set()
             forced = self._force_stop.is_set()
             for t in open_tasks:
-                outcome = done_fn(t)
+                try:
+                    outcome, probe = status_fn(t)
+                except NotImplementedError:
+                    raise
+                except Exception:
+                    # the probe itself is failing past the retry budget:
+                    # contain the failure to this task and keep polling
+                    logger.exception(
+                        "progress check for %s failed after retries; "
+                        "marking the task DEAD",
+                        t.proposal.topic_partition)
+                    self._fail_task(t, now)
+                    continue
+                prev_probe, since = progress[id(t)]
+                if probe != prev_probe:
+                    progress[id(t)] = (probe, wall)
+                elif (outcome is None and not stopping
+                        and deadline_ms is not None
+                        and (wall - since) * 1000.0 > deadline_ms):
+                    stuck.append(t)
+                    continue
                 if outcome is None and forced:
                     outcome = TaskState.DEAD
                 elif outcome is None and stopping:
                     # graceful stop: abort what can be aborted
                     if t.proposal.can_be_aborted(
-                            self.adapter.current_replicas(
+                            self._adapter.current_replicas(
                                 t.proposal.topic_partition)):
                         t.transition(TaskState.ABORTING, now)
                         self.tracker.mark(t, TaskState.IN_PROGRESS)
@@ -720,13 +915,34 @@ class Executor:
                     prev = t.state
                     t.transition(outcome, now)
                     self.tracker.mark(t, prev)
+            if stuck:
+                from cruise_control_tpu.common.metrics import REGISTRY
+                for t in stuck:
+                    logger.warning(
+                        "task %s made no progress for %.0f ms (deadline "
+                        "%d ms); %s it individually",
+                        t.proposal.topic_partition,
+                        (wall - progress[id(t)][1]) * 1000.0, deadline_ms,
+                        "aborting" if cancelable else "killing")
+                    self._exec_stuck += 1
+                    REGISTRY.counter("task-stuck-rate")
+                if cancelable:
+                    aborting.extend(stuck)
+                    for t in stuck:
+                        t.transition(TaskState.ABORTING, now)
+                        self.tracker.mark(t, TaskState.IN_PROGRESS)
+                else:
+                    for t in stuck:
+                        prev = t.state
+                        t.transition(TaskState.DEAD, now)
+                        self.tracker.mark(t, prev)
             if aborting:
                 # adapter-side cancel BEFORE marking ABORTED: a graceful
                 # abort rewrites the in-flight reassignment to a safe
                 # target, it does not merely stop the bookkeeping (forced
                 # stop is the drop-without-cancel path)
                 try:
-                    self.adapter.cancel_reassignments(aborting)
+                    self._adapter.cancel_reassignments(aborting)
                 except NotImplementedError:
                     logger.warning(
                         "%s cannot cancel reassignments; aborting %d tasks "
@@ -739,7 +955,7 @@ class Executor:
                     # sees the failure in the log
                     logger.exception(
                         "cancel_reassignments failed for %d tasks during "
-                        "graceful stop; marking them ABORTED anyway",
+                        "abort; marking them ABORTED anyway",
                         len(aborting))
                 for t in aborting:
                     t.transition(TaskState.ABORTED, now)

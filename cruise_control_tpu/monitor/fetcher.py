@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 from concurrent.futures import ThreadPoolExecutor, as_completed
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Dict, List, Optional, Tuple
 
 from cruise_control_tpu.monitor.sampler import (
@@ -89,7 +90,10 @@ class MetricFetcherManager:
                 psamples.extend(ps)
                 for b in bs:        # broker metrics dedupe across fetchers
                     broker_samples.setdefault(b.broker_id, b)
-        except TimeoutError:
+        except (TimeoutError, FuturesTimeoutError):
+            # concurrent.futures.TimeoutError is NOT the builtin on
+            # Python < 3.11 — as_completed's deadline raises the
+            # futures one, which would otherwise crash the fetch loop
             # unfinished fetchers forfeit their slices. Python threads can't
             # be killed, so a truly hung sampler still occupies its pool
             # worker — cancel() at least stops queued-but-unstarted ones.

@@ -11,11 +11,15 @@ verification through the Purgatory.
 
 from __future__ import annotations
 
+import concurrent.futures
 import contextvars
 import json
+import logging
 import os
 import threading
 import urllib.parse
+
+logger = logging.getLogger(__name__)
 
 #: cookie session identity of the in-flight request (see RestApi.dispatch)
 _SESSION_ID: "contextvars.ContextVar" = contextvars.ContextVar(
@@ -267,6 +271,9 @@ class RestApi:
             handler = getattr(self, f"_{endpoint.lower()}")
             code, payload = handler(params, client_id, request_url)
         except Exception as e:     # surface as the reference's error JSON
+            # the client gets the error payload; the server log keeps the
+            # traceback (the payload's one-liner is not enough to debug)
+            logger.warning("%s request failed", endpoint, exc_info=True)
             code, payload = 500, {"errorMessage": f"{type(e).__name__}: {e}"}
         if consumed_review is not None and code >= 500:
             # the reviewed action never ran: re-open the approval so a
@@ -320,7 +327,11 @@ class RestApi:
         try:
             result = info.future.result(timeout=timeout)
             return 200, {"userTaskId": info.task_id, **result}
-        except TimeoutError:
+        except (TimeoutError, concurrent.futures.TimeoutError):
+            # concurrent.futures.TimeoutError is NOT the builtin on
+            # Python < 3.11; catching only the builtin turned every
+            # still-in-flight wait into a 500 (and unbound the session,
+            # breaking the repeat-request → same-task polling contract)
             return 202, {"userTaskId": info.task_id,
                          "progress": info.future.describe()}
         except Exception as e:

@@ -1,0 +1,397 @@
+"""Chaos suite: seeded fault injection against the executor and the
+analyzer fallback chain (docs/operations.md "Failure modes and degraded
+operation").
+
+Every random draw comes from one ``FaultPlan(seed=...)`` stream, so a
+failure reproduces exactly with ``CHAOS_SEED=<seed> pytest -m chaos``.
+The invariants asserted here are seed-independent (they hold for any
+draw sequence); the seed is printed in every assertion message anyway so
+an escape is a one-command repro.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import annealer as AN
+from cruise_control_tpu.analyzer import optimizer as OPT
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.common import faults
+from cruise_control_tpu.common.faults import (
+    AdapterTransientError,
+    FaultPlan,
+    FaultyClusterAdapter,
+)
+from cruise_control_tpu.executor.executor import (
+    Executor,
+    ExecutorConfig,
+    ExecutorState,
+    FakeClusterAdapter,
+    RetryingClusterAdapter,
+)
+from cruise_control_tpu.models import fixtures
+
+pytestmark = pytest.mark.chaos
+
+SEED = int(os.environ.get("CHAOS_SEED", "1337"))
+S = f"(seed {SEED})"
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos_hooks():
+    yield
+    faults.clear_chaos_hooks()
+
+
+# --------------------------------------------------------------------------
+# executor fault tolerance
+# --------------------------------------------------------------------------
+
+
+def _proposal(topic, part, old, new, size=10.0):
+    return ExecutionProposal(topic=topic, partition=part, old_leader=old[0],
+                             old_replicas=tuple(old), new_replicas=tuple(new),
+                             data_size=size)
+
+
+def _fake(proposals, latency=1):
+    return FakeClusterAdapter(
+        {p.topic_partition: p.old_replicas for p in proposals},
+        latency_polls=latency)
+
+
+def _config(**kw):
+    kw.setdefault("execution_progress_check_interval_ms", 1)
+    kw.setdefault("adapter_retries", 3)
+    kw.setdefault("adapter_retry_backoff_ms", 1)
+    kw.setdefault("adapter_retry_backoff_max_ms", 4)
+    return ExecutorConfig(**kw)
+
+
+def _terminal_counts(summary, task_type="INTER_BROKER_REPLICA_ACTION"):
+    return summary["taskCounts"].get(task_type, {})
+
+
+def test_retrying_adapter_unit():
+    """The retry shim: transient failures are retried with backoff, the
+    retry callback fires, and exhaustion re-raises the last error."""
+
+    class Flaky:
+        def __init__(self, failures):
+            self.failures = failures
+            self.calls = 0
+
+        def current_replicas(self, tp):
+            self.calls += 1
+            if self.calls <= self.failures:
+                raise AdapterTransientError("injected")
+            return (0, 1)
+
+        def cancel_reassignments(self, tasks):
+            raise NotImplementedError
+
+    retried, slept = [], []
+    cfg = _config()
+    ad = RetryingClusterAdapter(Flaky(2), cfg, on_retry=retried.append,
+                                sleep=slept.append)
+    assert ad.current_replicas("t-0") == (0, 1), S
+    assert retried == ["current_replicas", "current_replicas"], S
+    assert len(slept) == 2 and all(s > 0 for s in slept), S
+    # NotImplementedError is a capability signal, never retried
+    with pytest.raises(NotImplementedError):
+        ad.cancel_reassignments([])
+    # exhaustion: retries+1 attempts, then the failure propagates
+    flaky = Flaky(10)
+    ad = RetryingClusterAdapter(flaky, cfg, sleep=lambda s: None)
+    with pytest.raises(AdapterTransientError):
+        ad.current_replicas("t-0")
+    assert flaky.calls == cfg.adapter_retries + 1, S
+
+
+def test_transient_errors_retried_to_completion():
+    """Transients below the retry budget: every task completes, retries are
+    visible in the summary, throttles are cleared."""
+    props = [_proposal("t", i, [i, 10 + i], [i, 20 + i]) for i in range(3)]
+    fake = _fake(props, latency=2)
+    plan = FaultPlan(seed=SEED, transient_error_rate=0.5,
+                     max_consecutive_transients=2)
+    faulty = FaultyClusterAdapter(fake, plan, sleep=lambda s: None)
+    ex = Executor(faulty, _config())
+    summary = ex.execute_proposals(props, replication_throttle=10_000_000)
+    counts = _terminal_counts(summary)
+    assert counts.get("COMPLETED") == 3, (summary, S)
+    for p in props:
+        assert fake.replicas[p.topic_partition] == p.new_replicas, S
+    assert faulty.injected["transient"] > 0, S
+    assert summary.get("adapterRetries", 0) == faulty.injected["transient"], \
+        (summary, faulty.injected, S)
+    assert fake.broker_throttle_rates == {}, S
+    assert fake.topic_throttled_replicas == {}, S
+    assert ex.state == ExecutorState.NO_TASK_IN_PROGRESS, S
+
+
+def test_poisoned_partition_contained_to_its_task():
+    """A partition whose status probe fails past the retry budget: only its
+    task dies; the rest of the batch completes."""
+    props = [_proposal("t", i, [i, 10 + i], [i, 20 + i]) for i in range(3)]
+    fake = _fake(props, latency=1)
+    plan = FaultPlan(seed=SEED, poisoned_partitions=("t-1",))
+    faulty = FaultyClusterAdapter(fake, plan, sleep=lambda s: None)
+    ex = Executor(faulty, _config())
+    summary = ex.execute_proposals(props)
+    counts = _terminal_counts(summary)
+    assert counts.get("COMPLETED") == 2, (summary, S)
+    assert counts.get("DEAD") == 1, (summary, S)
+    assert summary.get("tasksDeadOnAdapterFailure") == 1, (summary, S)
+    # the poisoned probe burned the full retry budget before containment
+    assert summary.get("adapterRetries", 0) >= ex.config.adapter_retries, S
+    assert ex.state == ExecutorState.NO_TASK_IN_PROGRESS, S
+
+
+def test_stuck_task_individually_aborted():
+    """A reassignment the cluster accepts but never converges: the stuck
+    task is aborted at the no-progress deadline; others complete; the run
+    does NOT time out."""
+    props = [_proposal("t", 0, [0, 10], [0, 20]),
+             _proposal("t", 1, [1, 11], [1, 21])]
+    fake = _fake(props, latency=1)
+    plan = FaultPlan(seed=SEED, stuck_partitions=("t-1",))
+    faulty = FaultyClusterAdapter(fake, plan, sleep=lambda s: None)
+    ex = Executor(faulty, _config(task_stuck_deadline_ms=50))
+    summary = ex.execute_proposals(props, replication_throttle=10_000_000)
+    counts = _terminal_counts(summary)
+    assert counts.get("COMPLETED") == 1, (summary, S)
+    assert counts.get("ABORTED") == 1, (summary, S)
+    assert summary.get("stuckTasksAborted") == 1, (summary, S)
+    assert not summary["timedOut"], (summary, S)
+    # the abort cancelled the in-flight reassignment adapter-side
+    assert "t-1" not in faulty.in_progress_reassignments(), S
+    assert fake.broker_throttle_rates == {}, S
+    assert fake.topic_throttled_replicas == {}, S
+    assert ex.state == ExecutorState.NO_TASK_IN_PROGRESS, S
+
+
+def test_partial_batch_failure_recovered_per_task():
+    """A batch submission that lands only a prefix then fails: with retries
+    exhausted the executor falls back to per-task submission and every task
+    still completes — nothing is lost, nothing crashes."""
+    props = [_proposal("t", i, [i, 10 + i], [i, 20 + i]) for i in range(4)]
+    fake = _fake(props, latency=1)
+    plan = FaultPlan(seed=SEED, partial_batch_rate=1.0,
+                     max_consecutive_transients=10)
+    faulty = FaultyClusterAdapter(fake, plan, sleep=lambda s: None)
+    ex = Executor(faulty, _config(adapter_retries=0))
+    summary = ex.execute_proposals(props)
+    counts = _terminal_counts(summary)
+    assert counts.get("COMPLETED") == 4, (summary, S)
+    assert faulty.injected["partial"] >= 1, (faulty.injected, S)
+    for p in props:
+        assert fake.replicas[p.topic_partition] == p.new_replicas, S
+    assert ex.state == ExecutorState.NO_TASK_IN_PROGRESS, S
+
+
+def test_mid_run_broker_death_kills_only_affected_tasks():
+    """A destination broker dies mid-execution: the task moving onto it
+    dies; every other task completes."""
+    props = [_proposal("t", i, [i, 10 + i], [i, 20 + i]) for i in range(3)]
+    props.append(_proposal("t", 3, [3, 13], [3, 9]))     # doomed: broker 9
+    fake = _fake(props, latency=5)
+    plan = FaultPlan(seed=SEED, kill_broker_id=9, kill_broker_after_calls=10)
+    faulty = FaultyClusterAdapter(fake, plan, sleep=lambda s: None)
+    ex = Executor(faulty, _config())
+    summary = ex.execute_proposals(props)
+    counts = _terminal_counts(summary)
+    assert counts.get("COMPLETED") == 3, (summary, S)
+    assert counts.get("DEAD") == 1, (summary, S)
+    assert faulty.injected["broker_death"] == 1, S
+    # the healthy moves landed; the doomed one never converged
+    for p in props[:3]:
+        assert fake.replicas[p.topic_partition] == p.new_replicas, S
+    assert fake.replicas["t-3"] == (3, 13), (fake.replicas["t-3"], S)
+    assert ex.state == ExecutorState.NO_TASK_IN_PROGRESS, S
+
+
+def test_combined_chaos_acceptance():
+    """The acceptance scenario: transients + latency + one stuck task + one
+    mid-run broker death in a single execution. Only the affected tasks end
+    DEAD/ABORTED, no task is lost, throttles are cleared, the executor
+    returns to NO_TASK_IN_PROGRESS, and the summary carries the tallies."""
+    props = [_proposal("t", i, [i, 10 + i], [i, 20 + i]) for i in range(4)]
+    props.append(_proposal("t", 4, [4, 14], [4, 24]))    # stuck
+    props.append(_proposal("t", 5, [5, 15], [5, 9]))     # doomed: broker 9
+    fake = _fake(props, latency=3)
+    plan = FaultPlan(seed=SEED,
+                     transient_error_rate=0.2, max_consecutive_transients=2,
+                     latency_rate=0.1, latency_s=0.001,
+                     stuck_partitions=("t-4",),
+                     kill_broker_id=9, kill_broker_after_calls=20)
+    faulty = FaultyClusterAdapter(fake, plan)
+    ex = Executor(faulty, _config(task_stuck_deadline_ms=80,
+                                  num_concurrent_partition_movements_per_broker=10))
+    summary = ex.execute_proposals(props, replication_throttle=10_000_000)
+
+    counts = _terminal_counts(summary)
+    assert counts.get("COMPLETED") == 4, (summary, S)
+    assert counts.get("ABORTED") == 1, (summary, S)      # the stuck task
+    assert counts.get("DEAD") == 1, (summary, S)         # the doomed task
+    # no task lost: every planned task is in a terminal state
+    assert sum(counts.values()) == len(props), (summary, S)
+    for st in ("PENDING", "IN_PROGRESS", "ABORTING"):
+        assert counts.get(st, 0) == 0, (summary, S)
+    # the tallies are visible
+    assert summary.get("stuckTasksAborted") == 1, (summary, S)
+    if faulty.injected["transient"]:
+        assert summary.get("adapterRetries", 0) > 0, (summary, S)
+    assert not summary["timedOut"], (summary, S)
+    # throttles always cleared, even on a degraded run
+    assert fake.broker_throttle_rates == {}, S
+    assert fake.topic_throttled_replicas == {}, S
+    assert ex.state == ExecutorState.NO_TASK_IN_PROGRESS, S
+    # the healthy moves actually landed
+    for p in props[:4]:
+        assert fake.replicas[p.topic_partition] == p.new_replicas, S
+
+
+def test_no_fault_summary_shape_unchanged():
+    """With fault injection disabled the summary is byte-identical to the
+    pre-chaos builds: no retry/stuck/dead keys appear."""
+    props = [_proposal("t", 0, [0, 10], [0, 20]),
+             _proposal("t", 1, [1, 11], [1, 21])]
+    ex = Executor(_fake(props, latency=1), _config())
+    summary = ex.execute_proposals(props, replication_throttle=10_000_000)
+    assert set(summary) == {"stopped", "forcedStop", "timedOut", "taskCounts",
+                            "intraBrokerMoves", "durationSeconds"}, summary
+    assert _terminal_counts(summary).get("COMPLETED") == 2, summary
+
+
+# --------------------------------------------------------------------------
+# analyzer fallback chain
+# --------------------------------------------------------------------------
+
+
+def _valid_result(topo, r):
+    fb = np.asarray(r.final_assignment.broker_of)
+    for p in range(topo.num_partitions):
+        slots = topo.replicas_of_partition[p]
+        slots = slots[slots >= 0]
+        brokers = fb[slots]
+        assert len(set(brokers.tolist())) == len(brokers), \
+            f"dup brokers p={p} {S}"
+    assert topo.broker_alive[fb].all(), S
+
+
+def test_nonfinite_anneal_penalty_falls_back_to_greedy():
+    """The acceptance scenario: poisoning the anneal penalty total via the
+    chaos hook degrades to greedy, which produces valid proposals, and the
+    reason is visible on the result and in its JSON form."""
+    topo, assign = fixtures.unbalanced()
+    faults.install_chaos_hook("analyzer.anneal.penalty_total",
+                              lambda total: float("nan"))
+    r = OPT.optimize(topo, assign, engine="anneal",
+                     anneal_config=AN.AnnealConfig(num_chains=2, steps=16,
+                                                   swap_interval=8))
+    assert r.engine == "greedy", (r.engine, S)
+    assert r.fallback_reason and "non-finite" in r.fallback_reason, \
+        (r.fallback_reason, S)
+    assert "anneal" in r.fallback_reason, (r.fallback_reason, S)
+    assert r.to_json()["fallbackReason"] == r.fallback_reason, S
+    _valid_result(topo, r)
+
+
+def test_engine_failure_falls_back_to_greedy():
+    """A RuntimeError inside the anneal rung (the device-loss class) falls
+    back to greedy without surfacing to the caller."""
+    topo, assign = fixtures.unbalanced()
+
+    def boom(_):
+        raise RuntimeError("injected device failure in anneal")
+
+    faults.install_chaos_hook("analyzer.anneal.engine", boom)
+    r = OPT.optimize(topo, assign, engine="anneal")
+    assert r.engine == "greedy", (r.engine, S)
+    assert "injected device failure" in (r.fallback_reason or ""), \
+        (r.fallback_reason, S)
+    _valid_result(topo, r)
+
+
+def test_double_failure_falls_back_to_sequential():
+    """Both accelerator engines failing degrades to the host-side
+    sequential oracle — the last rung still yields valid proposals."""
+    topo, assign = fixtures.unbalanced()
+
+    def boom(_):
+        raise RuntimeError("injected engine failure")
+
+    faults.install_chaos_hook("analyzer.anneal.engine", boom)
+    faults.install_chaos_hook("analyzer.greedy.engine", boom)
+    r = OPT.optimize(topo, assign, engine="anneal")
+    assert r.engine == "sequential", (r.engine, S)
+    assert "anneal" in r.fallback_reason and "greedy" in r.fallback_reason, \
+        (r.fallback_reason, S)
+    _valid_result(topo, r)
+
+
+def test_all_rungs_failing_raises():
+    """When even the last rung fails the error propagates — degraded mode
+    never fabricates a result."""
+    topo, assign = fixtures.unbalanced()
+
+    def boom(_):
+        raise RuntimeError("injected engine failure")
+
+    for site in ("analyzer.anneal.engine", "analyzer.greedy.engine",
+                 "analyzer.sequential.engine"):
+        faults.install_chaos_hook(site, boom)
+    with pytest.raises(RuntimeError, match="injected engine failure"):
+        OPT.optimize(topo, assign, engine="anneal")
+
+
+def test_fallback_surfaces_in_service_state():
+    """App-level: a degraded proposal computation lands in
+    /state AnalyzerState.lastOptimizationFallback."""
+    from cruise_control_tpu.app import CruiseControlApp
+    from cruise_control_tpu.common.config import CruiseControlConfig
+    from cruise_control_tpu.executor.executor import FakeClusterAdapter as FCA
+    from cruise_control_tpu.monitor.load_monitor import StaticMetadataSource
+    from cruise_control_tpu.monitor.sampler import (
+        BrokerMetadata,
+        ClusterMetadata,
+        PartitionMetadata,
+        SyntheticLoadSampler,
+    )
+
+    W = 60_000
+    brokers = [BrokerMetadata(i, rack=f"r{i % 2}", host=f"h{i}", alive=True)
+               for i in range(4)]
+    parts = [PartitionMetadata("T", p, leader=p % 4,
+                               replicas=(p % 4, (p + 1) % 4))
+             for p in range(8)]
+    md = ClusterMetadata(brokers=brokers, partitions=parts, generation=1)
+    cfg = CruiseControlConfig({
+        "optimizer.engine": "greedy",
+        "partition.metrics.window.ms": W,
+        "num.partition.metrics.windows": 3,
+        "min.valid.partition.ratio": 0.0,
+        "execution.progress.check.interval.ms": 1,
+        "failed.brokers.file.path": ""})
+    adapter = FCA({f"{p.topic}-{p.partition}": tuple(p.replicas)
+                   for p in parts}, latency_polls=1)
+    app = CruiseControlApp(cfg, StaticMetadataSource(md),
+                           SyntheticLoadSampler(seed=7),
+                           cluster_adapter=adapter)
+    app.load_monitor._now = lambda: 4 * W
+    for w in range(4):
+        app.load_monitor.sample_once(now_ms=w * W + 30_000)
+
+    def boom(_):
+        raise RuntimeError("injected greedy failure")
+
+    faults.install_chaos_hook("analyzer.greedy.engine", boom)
+    assert app.precompute_tick() is True, S
+    st = app.state()["AnalyzerState"]
+    fb = st["lastOptimizationFallback"]
+    assert fb is not None, (st, S)
+    assert fb["engine"] == "sequential", (fb, S)
+    assert "greedy" in fb["reason"], (fb, S)
+    assert "injected greedy failure" in fb["reason"], (fb, S)

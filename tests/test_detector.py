@@ -269,6 +269,40 @@ def test_enqueue_dedupes_persistent_condition():
     assert svc._queue[0].anomaly.detection_time_ms == 4
 
 
+def test_raising_detector_does_not_stop_sweep():
+    """One broken detector must not stop the sweep: the healthy detectors
+    still run and enqueue, and the failure is counted and visible in the
+    state snapshot (not just a log line)."""
+    clock = FakeTime(1_000_000)
+    notifier = SelfHealingNotifier(now_fn=clock)
+    calls = {"working": 0}
+
+    def broken():
+        raise RuntimeError("injected detector failure")
+
+    def working():
+        calls["working"] += 1
+        return GoalViolations(AnomalyType.GOAL_VIOLATION, clock(),
+                              fixable_violated_goals=["RackAwareGoal"])
+
+    # "broken" iterates first, proving the sweep continues past it
+    svc = AnomalyDetectorService(
+        notifier, detectors={"broken": broken, "working": working},
+        now_fn=clock)
+    assert svc.sweep() == 1
+    assert calls["working"] == 1
+    clock.t += svc.interval_ms + 1
+    assert svc.sweep() == 1            # still sweeping on later rounds
+    assert calls["working"] == 2
+    assert svc.metrics["detector_failures"] == 2
+    assert svc.detector_failures == {"broken": 2}
+    snap = svc.state_snapshot()
+    assert snap["detectorFailures"] == {"broken": 2}
+    # the healthy detector's anomalies actually made it into the queue
+    kinds = {q.anomaly.anomaly_type for q in svc._queue}
+    assert AnomalyType.GOAL_VIOLATION in kinds
+
+
 def _service_app(overrides=None):
     """Full app with self-healing on; returns (app, adapter)."""
     from cruise_control_tpu.app import CruiseControlApp

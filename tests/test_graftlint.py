@@ -1,7 +1,7 @@
 """graftlint: per-rule trigger/clean fixtures, the whole-package gate, and
 the runtime steady-state sentinels.
 
-Every rule G001-G008 gets (a) a fixture snippet that TRIGGERS it and (b) a
+Every rule G001-G009 gets (a) a fixture snippet that TRIGGERS it and (b) a
 clean-idiom snippet that must pass — so a rule that silently stops firing
 (or starts over-firing) breaks here, not in a downstream repo sweep.  The
 gate test is the CI tentpole: the whole ``cruise_control_tpu`` package plus
@@ -335,6 +335,76 @@ def test_g008_clean_on_jax_rng_and_debug_print():
         return x + jax.random.normal(key, x.shape)
     """
     assert "G008" not in _codes(src)
+
+
+# -- G009: silent broad except ---------------------------------------------
+
+def test_g009_triggers_on_bare_except_pass():
+    src = """
+    def f():
+        try:
+            risky()
+        except:
+            pass
+    """
+    assert "G009" in _codes(src)
+
+
+def test_g009_triggers_on_swallowed_exception():
+    src = """
+    def f():
+        out = []
+        try:
+            out.append(compute())
+        except Exception:
+            out = None
+        return out
+    """
+    assert "G009" in _codes(src)
+
+
+def test_g009_triggers_inside_tuple_handler():
+    src = """
+    def f():
+        try:
+            risky()
+        except (ValueError, Exception):
+            return None
+    """
+    assert "G009" in _codes(src)
+
+
+def test_g009_clean_on_logging_reraise_and_narrow():
+    src = """
+    import logging
+    logger = logging.getLogger(__name__)
+
+    def f():
+        try:
+            risky()
+        except Exception:
+            logger.warning("risky failed", exc_info=True)
+        try:
+            risky()
+        except Exception:
+            raise RuntimeError("wrapped")
+        try:
+            risky()
+        except ValueError:
+            return None
+    """
+    assert "G009" not in _codes(src)
+
+
+def test_g009_clean_with_inline_disable():
+    src = """
+    def close(producer):
+        try:
+            producer.close()
+        except Exception:  # graftlint: disable=G009
+            pass
+    """
+    assert "G009" not in _codes(src)
 
 
 # -- G007: unwired config keys (project rule, real package) ----------------

@@ -31,6 +31,10 @@ G007  Config keys defined but never consumed by source (the reference's
 G008  Forbidden impurity inside a jitted function — ``np.random``/
       ``random``/``time``/``open``/``os.environ``/``print`` execute at
       trace time only and silently freeze into the compiled program.
+G009  Silent broad exception swallow — an ``except Exception:`` /
+      ``except BaseException:`` / bare ``except:`` block that neither
+      logs, re-raises, nor carries a ``# graftlint: disable=G009``
+      justification turns a permanently-failing path invisible.
 """
 
 from __future__ import annotations
@@ -735,6 +739,81 @@ def _impurity(node: ast.Call) -> Optional[str]:
     if root == "os" and func.attr in ("getenv", "system", "popen"):
         return f"`os.{func.attr}()`"
     return None
+
+
+# --------------------------------------------------------------------------
+# G009 — silent broad exception swallows
+# --------------------------------------------------------------------------
+
+#: call attrs that count as "the error was surfaced"
+_LOG_METHODS = frozenset({"debug", "info", "warning", "warn", "error",
+                          "exception", "critical", "log", "print_exc"})
+#: names anywhere in the dotted chain that mark the call as a logging call
+_LOGGERISH = frozenset({"logger", "logging", "log", "_logger", "_log",
+                        "warnings", "traceback"})
+
+
+def _broad_handler_label(handler: ast.ExceptHandler) -> Optional[str]:
+    """"Exception"/"BaseException"/"bare except" when the handler catches
+    (at least) every Exception; None for narrower handlers."""
+    t = handler.type
+
+    def name_of(n: ast.AST) -> Optional[str]:
+        if isinstance(n, ast.Name) and n.id in ("Exception", "BaseException"):
+            return n.id
+        if (isinstance(n, ast.Attribute)
+                and n.attr in ("Exception", "BaseException")):
+            return n.attr
+        return None
+
+    if t is None:
+        return "except:"
+    if isinstance(t, ast.Tuple):
+        for e in t.elts:
+            nm = name_of(e)
+            if nm:
+                return f"except {nm}:"
+        return None
+    nm = name_of(t)
+    return f"except {nm}:" if nm else None
+
+
+def _handler_surfaces(handler: ast.ExceptHandler) -> bool:
+    """Does the handler body re-raise or log (logger.*/logging.*/
+    warnings.warn/traceback.print_exc)?"""
+    for n in ast.walk(handler):
+        if isinstance(n, ast.Raise):
+            return True
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+            if n.func.attr not in _LOG_METHODS:
+                continue
+            parts = set()
+            cur: ast.AST = n.func
+            while isinstance(cur, ast.Attribute):
+                parts.add(cur.attr)
+                cur = cur.value
+            if isinstance(cur, ast.Name):
+                parts.add(cur.id)
+            if parts & _LOGGERISH:
+                return True
+    return False
+
+
+@file_rule("G009", "silent-broad-except")
+def check_silent_broad_except(ctx: ModuleContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        label = _broad_handler_label(node)
+        if label is None:
+            continue
+        if _handler_surfaces(node) or _suppressed(ctx, node, "G009"):
+            continue
+        yield ctx.finding(
+            "G009", node,
+            f"broad `{label}` swallows the error without logging or "
+            f"re-raising — a permanently-failing path becomes invisible; "
+            f"log it, re-raise, or justify with `# graftlint: disable=G009`")
 
 
 @file_rule("G008", "impure-jit")
