@@ -305,6 +305,15 @@ class CruiseControlApp:
 
     def startup(self):
         """KafkaCruiseControl.startUp (KafkaCruiseControl.java:156-165)."""
+        # opt-in TSan-style lock tracing (GRAFT_TSAN=1): instrument every
+        # lock-owning component before any background thread starts; the
+        # report dumps at shutdown. Zero effect when the variable is unset.
+        from cruise_control_tpu.common import sanitizer as _sanitizer
+        if _sanitizer.tsan_enabled():
+            self._lock_sanitizer = _sanitizer.install_tracing(
+                self, self.executor, self.load_monitor,
+                self.anomaly_detector, self.load_monitor.partition_aggregator,
+                self.load_monitor.broker_aggregator)
         self.load_monitor.startup(
             load_stored_samples=not self.config.get("skip.loading.samples"))
         self.anomaly_detector.start()
@@ -330,6 +339,9 @@ class CruiseControlApp:
             self._precompute_thread.join(timeout=5)
         self.anomaly_detector.shutdown()
         self.load_monitor.shutdown()
+        san = getattr(self, "_lock_sanitizer", None)
+        if san is not None:
+            logger.info("GRAFT_TSAN report: %s", san.dump())
 
     def _cached_result_if_fresh(self) -> Optional[OPT.OptimizerResult]:
         """THE freshness rule (shared by the request path, the precompute
@@ -412,10 +424,12 @@ class CruiseControlApp:
             mesh=self.mesh)
         if res.fallback_reason:
             # degraded mode: remember the most recent fallback for /state
-            self._last_fallback = {
-                "engine": res.engine,
-                "reason": res.fallback_reason,
-                "atMs": int(time.time() * 1000)}
+            # (read by the REST thread, so it shares the cache lock)
+            with self._cache_lock:
+                self._last_fallback = {
+                    "engine": res.engine,
+                    "reason": res.fallback_reason,
+                    "atMs": int(time.time() * 1000)}
         return res
 
     def _model(self, requirements=None, data_from: Optional[str] = None,
@@ -1190,13 +1204,16 @@ class CruiseControlApp:
         """CruiseControlState for the STATE endpoint. ``super_verbose``
         (CruiseControlState.writeSuperVerbose): adds the extrapolated
         metric-sample flaws and the linear-regression model state."""
+        with self._cache_lock:
+            proposal_ready = self._proposal_cache is not None
+            last_fallback = self._last_fallback
         out = {
             "MonitorState": self.load_monitor.state_snapshot(),
             "ExecutorState": self.executor.state_snapshot(),
             "AnalyzerState": {
-                "isProposalReady": self._proposal_cache is not None,
+                "isProposalReady": proposal_ready,
                 "readyGoals": list(self._ready_goals()),
-                "lastOptimizationFallback": self._last_fallback,
+                "lastOptimizationFallback": last_fallback,
                 "precomputeFailures": self._precompute_failures,
             },
             "AnomalyDetectorState": self.anomaly_detector.state_snapshot(),

@@ -44,7 +44,8 @@ class Timer:
 
     @property
     def mean_s(self) -> float:
-        return self.total_s / self.count if self.count else 0.0
+        with self._lock:
+            return self.total_s / self.count if self.count else 0.0
 
 
 class MetricsRegistry:

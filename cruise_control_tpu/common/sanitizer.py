@@ -1,0 +1,247 @@
+"""Runtime lock sanitizer: TSan-style acquisition-order + hold-time tracing.
+
+graftlint's static concurrency family (G101-G105, ``tools/graftlint``)
+proves lock *discipline* from the AST; this module observes the locks
+*running*.  A :class:`TracedLock` wraps a ``threading.Lock``/``RLock`` and
+reports every acquire/release to a :class:`LockSanitizer`, which maintains:
+
+- the **acquisition-order graph** — a directed edge ``A -> B`` the first
+  time any thread acquires B while holding A.  Acquiring B while holding A
+  when the reverse edge ``B -> A`` was ever observed is a **lock-order
+  inversion** (the runtime twin of static rule G102): two threads
+  interleaving those paths can deadlock.
+- per-lock **hold times** — a release after more than ``hold_threshold_s``
+  is recorded as a long hold (the runtime twin of G105: something slow ran
+  inside a critical section).
+- per-lock **acquire counts** — lets a regression test assert that a
+  method actually takes the lock it is documented to take.
+
+Opt-in only: production code paths change ONLY when ``GRAFT_TSAN=1`` is in
+the environment (:func:`tsan_enabled` — the app instruments its own locks
+at startup and dumps a report at shutdown) or when a test wraps objects in
+:func:`instrument_locks`.  With the variable unset nothing in this module
+is imported by a hot path.
+
+Reports are reproducible: sites are ``file:line`` of the acquiring frame,
+and the report dict is JSON-serializable via :meth:`LockSanitizer.dump`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+#: concrete lock types instrument_locks() looks for on objects
+_LOCK_TYPES = (type(threading.Lock()), type(threading.RLock()))
+
+#: default long-hold threshold (seconds) — generous enough that CI noise
+#: never trips it, small enough to catch a blocking RPC under a lock
+DEFAULT_HOLD_THRESHOLD_S = 0.25
+
+def tsan_enabled() -> bool:
+    """True when GRAFT_TSAN=1: the app instruments its locks at startup."""
+    return os.environ.get("GRAFT_TSAN") == "1"
+
+
+def default_report_path() -> str:
+    """Report path for the GRAFT_TSAN=1 app wiring (env-overridable)."""
+    return os.environ.get("GRAFT_TSAN_REPORT", "graft_tsan_report.json")
+
+
+def _call_site() -> str:
+    """``file:line`` of the nearest frame outside this module."""
+    frame = sys._getframe(1)
+    while frame is not None and frame.f_code.co_filename == __file__:
+        frame = frame.f_back
+    if frame is None:
+        return "<unknown>"
+    fname = os.path.basename(frame.f_code.co_filename)
+    return f"{fname}:{frame.f_lineno}"
+
+
+class _ThreadHeld(threading.local):
+    """Per-thread acquisition state: ordered held list + reentrancy depth."""
+
+    def __init__(self) -> None:
+        self.order: List[str] = []
+        self.depth: Dict[str, int] = {}
+
+
+class LockSanitizer:
+    """Collects acquisition edges, inversions, hold times, acquire counts.
+
+    Thread-safe; its own bookkeeping lock is a plain ``threading.Lock``
+    that is never held while user code runs, so the sanitizer cannot
+    introduce ordering of its own.
+    """
+
+    def __init__(self, hold_threshold_s: float = DEFAULT_HOLD_THRESHOLD_S):
+        self.hold_threshold_s = hold_threshold_s
+        self._internal = threading.Lock()
+        self._held = _ThreadHeld()
+        #: (held, acquired) -> "file:line" of the first site observing it
+        self.edges: Dict[Tuple[str, str], str] = {}
+        self.inversions: List[dict] = []
+        self.long_holds: List[dict] = []
+        self.acquire_counts: Dict[str, int] = {}
+
+    # -- TracedLock callbacks --
+
+    def note_acquired(self, name: str) -> None:
+        held = self._held
+        if held.depth.get(name, 0):          # reentrant RLock acquire
+            held.depth[name] += 1
+            return
+        site = _call_site()
+        with self._internal:
+            self.acquire_counts[name] = self.acquire_counts.get(name, 0) + 1
+            for h in held.order:
+                if (name, h) in self.edges:
+                    self.inversions.append({
+                        "held": h, "acquiring": name,
+                        "firstOrderSite": self.edges[(name, h)],
+                        "site": site,
+                        "thread": threading.current_thread().name,
+                    })
+                self.edges.setdefault((h, name), site)
+        held.order.append(name)
+        held.depth[name] = 1
+
+    def note_released(self, name: str, held_for_s: float) -> None:
+        held = self._held
+        d = held.depth.get(name, 0)
+        if d > 1:
+            held.depth[name] = d - 1
+            return
+        held.depth.pop(name, None)
+        if name in held.order:
+            # remove the most recent occurrence (release order may not be
+            # strict LIFO)
+            for i in range(len(held.order) - 1, -1, -1):
+                if held.order[i] == name:
+                    del held.order[i]
+                    break
+        if held_for_s > self.hold_threshold_s:
+            with self._internal:
+                self.long_holds.append({
+                    "lock": name, "heldForS": round(held_for_s, 6),
+                    "site": _call_site(),
+                    "thread": threading.current_thread().name,
+                })
+
+    # -- reporting --
+
+    def report(self) -> dict:
+        with self._internal:
+            return {
+                "inversions": list(self.inversions),
+                "longHolds": list(self.long_holds),
+                "acquireCounts": dict(self.acquire_counts),
+                "edges": [{"held": a, "acquired": b, "site": s}
+                          for (a, b), s in sorted(self.edges.items())],
+            }
+
+    def dump(self, path: Optional[str] = None) -> str:
+        path = path or default_report_path()
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(self.report(), fh, indent=1)
+            fh.write("\n")
+        return path
+
+    def check(self) -> None:
+        """Raise if any lock-order inversion was observed."""
+        with self._internal:
+            inversions = list(self.inversions)
+        if inversions:
+            lines = [f"  {i['held']} -> {i['acquiring']} at {i['site']} "
+                     f"(opposite order first seen at {i['firstOrderSite']})"
+                     for i in inversions]
+            raise AssertionError(
+                "lock-order inversion(s) observed:\n" + "\n".join(lines))
+
+
+class TracedLock:
+    """Wraps a Lock/RLock, reporting acquire/release to a LockSanitizer.
+
+    Drop-in: supports the context-manager protocol, ``acquire`` with
+    ``blocking``/``timeout``, ``release``, and proxies anything else
+    (``locked``, RLock internals) to the wrapped lock.
+    """
+
+    def __init__(self, lock, name: str, sanitizer: LockSanitizer):
+        self._lock = lock
+        self._name = name
+        self._sanitizer = sanitizer
+        self._acquired_at = threading.local()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            self._sanitizer.note_acquired(self._name)
+            self._acquired_at.t = time.monotonic()
+        return ok
+
+    def release(self) -> None:
+        held_for = time.monotonic() - getattr(self._acquired_at, "t",
+                                              time.monotonic())
+        self._lock.release()
+        self._sanitizer.note_released(self._name, held_for)
+
+    def __enter__(self) -> "TracedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __getattr__(self, attr):
+        return getattr(self._lock, attr)
+
+
+def _instrument_object(obj, sanitizer: LockSanitizer) -> List[Tuple[str, object]]:
+    """Replace every Lock/RLock attribute of ``obj`` with a TracedLock.
+    Returns the (attr, original) pairs for restoration."""
+    replaced: List[Tuple[str, object]] = []
+    for attr, value in list(vars(obj).items()):
+        if isinstance(value, _LOCK_TYPES):
+            name = f"{type(obj).__name__}.{attr}"
+            setattr(obj, attr, TracedLock(value, name, sanitizer))
+            replaced.append((attr, value))
+    return replaced
+
+
+def install_tracing(*objects, sanitizer: Optional[LockSanitizer] = None,
+                    hold_threshold_s: float = DEFAULT_HOLD_THRESHOLD_S
+                    ) -> LockSanitizer:
+    """Permanently instrument ``objects``' lock attributes (GRAFT_TSAN=1
+    app wiring — restoration is pointless when the process is exiting
+    anyway).  Tests should prefer :func:`instrument_locks`."""
+    san = sanitizer or LockSanitizer(hold_threshold_s=hold_threshold_s)
+    for obj in objects:
+        _instrument_object(obj, san)
+    return san
+
+
+@contextlib.contextmanager
+def instrument_locks(*objects, sanitizer: Optional[LockSanitizer] = None,
+                     hold_threshold_s: float = DEFAULT_HOLD_THRESHOLD_S
+                     ) -> Iterator[LockSanitizer]:
+    """``with instrument_locks(app, app.executor) as san:`` — every
+    Lock/RLock attribute of the given objects is traced inside the scope
+    and restored on exit.  Restoring while another thread still holds a
+    TracedLock is safe: that thread releases through its own reference."""
+    san = sanitizer or LockSanitizer(hold_threshold_s=hold_threshold_s)
+    restore: List[Tuple[object, str, object]] = []
+    for obj in objects:
+        for attr, original in _instrument_object(obj, san):
+            restore.append((obj, attr, original))
+    try:
+        yield san
+    finally:
+        for obj, attr, original in restore:
+            setattr(obj, attr, original)

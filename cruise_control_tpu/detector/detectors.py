@@ -469,34 +469,43 @@ class AnomalyDetectorService:
                 deferred.append(item)     # not due yet — hold for re-push
                 continue
             if self._has_exec():
-                self.metrics["checks"] += 1
-                self.history.append({"anomaly": a.summary(),
-                                     "action": "DELAYED_ONGOING_EXECUTION"})
+                with self._lock:
+                    self.metrics["checks"] += 1
+                    self.history.append({"anomaly": a.summary(),
+                                         "action": "DELAYED_ONGOING_EXECUTION"})
                 deferred.append(dataclasses.replace(
                     item, ready_at_ms=now + self.recheck_delay_ms))
                 continue
+            # the notifier callback and the fix itself run OUTSIDE the lock
+            # (they hit the adapter); only the tally/history mutations — which
+            # /state readers race against — take it
             result = self.notifier.on_anomaly(a)
             record = {"anomaly": a.summary(), "action": result.action.value}
             if result.action == AnomalyAction.FIX and self.context is not None:
                 try:
                     fix_result = a.fix(self.context)
                     record["fixResult"] = bool(fix_result)
-                    self.metrics["fixes_triggered"] += 1
+                    with self._lock:
+                        self.metrics["fixes_triggered"] += 1
                     from cruise_control_tpu.common.metrics import REGISTRY
                     REGISTRY.counter("self-healing-fix-rate")
                 except Exception as e:   # fix failures must not kill the loop
                     logger.warning("self-healing fix for %s failed",
                                    a.anomaly_type.value, exc_info=True)
                     record["fixError"] = str(e)
-                    self.metrics["fixes_failed"] += 1
+                    with self._lock:
+                        self.metrics["fixes_failed"] += 1
             elif result.action == AnomalyAction.IGNORE:
-                self.metrics["ignored"] += 1
+                with self._lock:
+                    self.metrics["ignored"] += 1
             else:
-                self.metrics["checks"] += 1
+                with self._lock:
+                    self.metrics["checks"] += 1
                 if result.delay_ms > 0:   # CHECK with delay → re-check later
                     deferred.append(dataclasses.replace(
                         item, ready_at_ms=now + result.delay_ms))
-            self.history.append(record)
+            with self._lock:
+                self.history.append(record)
             handled += 1
         with self._lock:
             for item in deferred:

@@ -406,7 +406,9 @@ class Executor:
         self._stop_requested = threading.Event()
         self._force_stop = threading.Event()
         self._timed_out = False
-        self._lock = threading.Lock()
+        # RLock: state transitions inside execute_proposals happen while the
+        # acquisition check (which also takes it) may sit on the same stack
+        self._lock = threading.RLock()
         self.tracker = ExecutionTaskTracker()
         self._interval_override_ms: Optional[int] = None
         self._planner: Optional[ExecutionTaskPlanner] = None
@@ -445,13 +447,17 @@ class Executor:
 
     @property
     def recently_removed_brokers(self) -> Set[int]:
-        return self._pruned_history(self._removal_history,
-                                    self.config.removal_history_retention_ms)
+        # the dict reference is created once in __init__ and never rebound;
+        # _pruned_history takes the history lock before touching its contents
+        return self._pruned_history(
+            self._removal_history,  # graftlint: disable=G101
+            self.config.removal_history_retention_ms)
 
     @property
     def recently_demoted_brokers(self) -> Set[int]:
-        return self._pruned_history(self._demotion_history,
-                                    self.config.demotion_history_retention_ms)
+        return self._pruned_history(
+            self._demotion_history,  # graftlint: disable=G101
+            self.config.demotion_history_retention_ms)
 
     def record_history(self, removed_brokers=(), demoted_brokers=()):
         now = time.time()
@@ -472,15 +478,17 @@ class Executor:
     # -- state --
     @property
     def state(self) -> ExecutorState:
-        return self._state
+        with self._lock:
+            return self._state
 
     @property
     def has_ongoing_execution(self) -> bool:
-        return self._state != ExecutorState.NO_TASK_IN_PROGRESS
+        with self._lock:
+            return self._state != ExecutorState.NO_TASK_IN_PROGRESS
 
     def state_snapshot(self) -> dict:
         return {
-            "state": self._state.value,
+            "state": self.state.value,
             "taskCounts": self.tracker.snapshot(),
             "finishedDataMovementMB": self.tracker.finished_data_movement_mb,
             "recentlyRemovedBrokers": sorted(self.recently_removed_brokers),
@@ -494,8 +502,12 @@ class Executor:
         if forced:
             self._force_stop.set()
         self._stop_requested.set()
-        if self.has_ongoing_execution:
-            self._state = ExecutorState.STOPPING_EXECUTION
+        # check-then-act under the lock: an execution finishing between the
+        # check and the write would otherwise wedge the executor in
+        # STOPPING_EXECUTION with no task to ever clear it
+        with self._lock:
+            if self._state != ExecutorState.NO_TASK_IN_PROGRESS:
+                self._state = ExecutorState.STOPPING_EXECUTION
 
     # -- execution --
     def execute_proposals(self, proposals: Sequence[ExecutionProposal],
@@ -555,7 +567,8 @@ class Executor:
             self._interval_override_ms = progress_check_interval_ms
             planner = ExecutionTaskPlanner(strategy)
             planner.add_proposals(proposals)
-            self._planner = planner
+            with self._lock:
+                self._planner = planner
             self.tracker = ExecutionTaskTracker()
             self.tracker.register(planner.replica_tasks)
             self.tracker.register(planner.leadership_tasks)
@@ -579,14 +592,17 @@ class Executor:
             from cruise_control_tpu.server.async_ops import report_progress
             if helper is not None:
                 helper.set_throttles([t.proposal for t in planner.replica_tasks])
-            self._state = ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
+            with self._lock:
+                self._state = \
+                    ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
             report_progress(
                 f"Executing {len(planner.replica_tasks)} inter-broker "
                 f"replica movements")
             self._move_replicas(planner, concurrency)
             if logdir_moves and not self._stop_requested.is_set():
-                self._state = \
-                    ExecutorState.INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
+                with self._lock:
+                    self._state = ExecutorState.\
+                        INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
                 report_progress(f"Executing {len(logdir_moves)} intra-broker "
                                 f"logdir movements")
                 for lb in self._logdir_batches(logdir_moves):
@@ -594,7 +610,8 @@ class Executor:
                     intra_moves_applied += len(lb)
                     if self._stop_requested.is_set():
                         break
-            self._state = ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS
+            with self._lock:
+                self._state = ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS
             report_progress(
                 f"Executing {len(planner.leadership_tasks)} leadership "
                 f"movements")
@@ -647,8 +664,9 @@ class Executor:
                 summary["slowInterBrokerMovementRateMBps"] = round(
                     data_mb / duration_s, 6)
             self._execution_history.append(summary)
-            self._state = ExecutorState.NO_TASK_IN_PROGRESS
-            self._planner = None
+            with self._lock:
+                self._state = ExecutorState.NO_TASK_IN_PROGRESS
+                self._planner = None
             if crashed:
                 REGISTRY.counter("execution-failed-rate")
                 self.notifier.on_execution_stopped(summary)
@@ -693,7 +711,8 @@ class Executor:
                     data_mb / dur, 6)
             return out
         finally:
-            self._state = ExecutorState.NO_TASK_IN_PROGRESS
+            with self._lock:
+                self._state = ExecutorState.NO_TASK_IN_PROGRESS
 
     def _logdir_batches(self, moves) -> Iterable[list]:
         """Round-robin batches with at most N in-flight logdir moves per

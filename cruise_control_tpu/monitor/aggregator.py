@@ -328,10 +328,10 @@ class MetricSampleAggregator:
         (generation, ingest count, window, ratio requirement) so any
         ingestion or roll invalidates, and repeated queries skip the O(E·W)
         aggregation."""
-        key = (self.generation, self.samples_ingested,
-               int(now_ms) // self.window_ms,
-               requirements.min_monitored_partitions_percentage)
         with self._lock:
+            key = (self.generation, self.samples_ingested,
+                   int(now_ms) // self.window_ms,
+                   requirements.min_monitored_partitions_percentage)
             c = self._completeness_cache.get(key)
             if c is not None:
                 self._completeness_cache.move_to_end(key)
@@ -353,4 +353,5 @@ class MetricSampleAggregator:
 
     @property
     def num_entities(self) -> int:
-        return len(self._entities)
+        with self._lock:
+            return len(self._entities)

@@ -173,22 +173,29 @@ class LoadMonitor:
 
     @property
     def state(self) -> MonitorState:
-        return self._state
+        with self._lock:
+            return self._state
 
     def state_snapshot(self, now_ms: Optional[int] = None) -> dict:
         """LoadMonitorState for the STATE endpoint (LoadMonitor.java:223)."""
         now_ms = now_ms or self._now()
+        # snapshot the guarded fields first; the aggregation below is slow
+        # and must not run under the monitor lock
+        with self._lock:
+            state = self._state.value
+            pause_reason = self._pause_reason
+            bootstrap_progress = self._bootstrap_progress
         result = self.partition_aggregator.aggregate(now_ms)
         c = result.completeness
         return {
-            "state": self._state.value,
-            "reasonOfPauseOrResume": self._pause_reason,
+            "state": state,
+            "reasonOfPauseOrResume": pause_reason,
             "trained": self.cpu_model.trained,
             "numValidWindows": c.num_valid_windows,
             "monitoredWindows": result.window_times.tolist(),
             "numMonitoredPartitions": c.num_valid_entities,
             "monitoringCoveragePct": round(100.0 * c.valid_entity_ratio, 3),
-            "bootstrapProgressPct": self._bootstrap_progress,
+            "bootstrapProgressPct": bootstrap_progress,
             "generation": self.model_generation().__dict__,
         }
 
@@ -202,10 +209,12 @@ class LoadMonitor:
     def startup(self, load_stored_samples: bool = True):
         """LoadMonitor.startUp: replay the sample store, start sampling."""
         if load_stored_samples:
-            self._state = MonitorState.LOADING
+            with self._lock:
+                self._state = MonitorState.LOADING
             self._store.load_samples(self._ingest_partition_sample,
                                      self._ingest_broker_sample)
-        self._state = MonitorState.RUNNING
+        with self._lock:
+            self._state = MonitorState.RUNNING
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="load-monitor-sampler")
         self._thread.start()
@@ -243,7 +252,7 @@ class LoadMonitor:
 
     def _run(self):
         while not self._shutdown.wait(self.sampling_interval_ms / 1000.0):
-            if self._state == MonitorState.PAUSED:
+            if self.state == MonitorState.PAUSED:
                 continue
             try:
                 self.sample_once()
@@ -302,8 +311,9 @@ class LoadMonitor:
     def sample_once(self, now_ms: Optional[int] = None) -> int:
         """One sampling pass (SamplingTask body); returns samples ingested."""
         now_ms = now_ms or self._now()
-        prev = self._state
-        self._state = MonitorState.SAMPLING
+        with self._lock:
+            prev = self._state
+            self._state = MonitorState.SAMPLING
         try:
             metadata = self._metadata_source.get_metadata()
             ps, bs = self._fetchers.fetch(
@@ -315,7 +325,12 @@ class LoadMonitor:
             self._store.store_samples(ps, bs)
             return len(ps) + len(bs)
         finally:
-            self._state = prev
+            with self._lock:
+                # restore only if nothing intervened: a pause()/resume()
+                # issued mid-sample must win over the restore, not be
+                # silently clobbered back to the pre-sample state
+                if self._state == MonitorState.SAMPLING:
+                    self._state = prev
 
     def train(self, start_ms: int, end_ms: int,
               clear_metrics: bool = True) -> dict:
@@ -384,7 +399,8 @@ class LoadMonitor:
 
     def bootstrap(self, start_ms: int, end_ms: int):
         """BootstrapTask: replay a historical range window by window."""
-        self._state = MonitorState.BOOTSTRAPPING
+        with self._lock:
+            self._state = MonitorState.BOOTSTRAPPING
         try:
             t = start_ms
             total = max(end_ms - start_ms, 1)
@@ -397,10 +413,12 @@ class LoadMonitor:
                 for s in bs:
                     self._ingest_broker_sample(s)
                 t = step_end
-                self._bootstrap_progress = round(
-                    100.0 * (t - start_ms) / total, 2)
+                with self._lock:
+                    self._bootstrap_progress = round(
+                        100.0 * (t - start_ms) / total, 2)
         finally:
-            self._state = MonitorState.RUNNING
+            with self._lock:
+                self._state = MonitorState.RUNNING
 
     # ------------------------------------------------------------ model build
 

@@ -12,6 +12,8 @@ from __future__ import annotations
 import json
 import os
 import threading
+from concurrent.futures import ThreadPoolExecutor
+from itertools import islice
 from typing import Callable, Iterable, List
 
 from cruise_control_tpu.monitor.sampler import (
@@ -167,8 +169,6 @@ class KafkaSampleStore(SampleStore):
     LOAD_CHUNK = 50_000
 
     def load_samples(self, on_partition_sample, on_broker_sample) -> int:
-        from concurrent.futures import ThreadPoolExecutor
-        from itertools import islice
         n = 0
         for topic, cb, cls in (
                 (self.partition_topic, on_partition_sample,

@@ -154,7 +154,8 @@ class Meter:
 
     @property
     def rate(self) -> float:
-        return self._rate
+        with self._lock:
+            return self._rate
 
 
 class Histogram:

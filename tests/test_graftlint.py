@@ -1,7 +1,8 @@
 """graftlint: per-rule trigger/clean fixtures, the whole-package gate, and
 the runtime steady-state sentinels.
 
-Every rule G001-G009 gets (a) a fixture snippet that TRIGGERS it and (b) a
+Every rule (G001-G009 and the concurrency family G101-G105) gets (a) a
+fixture snippet that TRIGGERS it and (b) a
 clean-idiom snippet that must pass — so a rule that silently stops firing
 (or starts over-firing) breaks here, not in a downstream repo sweep.  The
 gate test is the CI tentpole: the whole ``cruise_control_tpu`` package plus
@@ -417,6 +418,241 @@ def test_g007_whole_package_has_no_unwired_keys():
     assert findings == [], "\n".join(f.format() for f in findings)
 
 
+# -- G101: unguarded shared-attribute access -------------------------------
+
+def test_g101_triggers_on_unguarded_read_and_write():
+    src = """
+    import threading
+
+    class Cache:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+
+        def add(self, x):
+            with self._lock:
+                self._items.append(x)
+
+        def size(self):
+            return len(self._items)        # read outside the lock
+
+        def reset(self):
+            self._items = []               # write outside the lock
+    """
+    assert _codes(src).count("G101") == 2
+
+
+def test_g101_clean_with_cross_method_inference():
+    # _grow mutates the guarded list but is ONLY called with the lock held
+    # — the cross-method fixpoint must treat its body as lock-held (the
+    # aggregator._row/_slot/_roll pattern)
+    src = """
+    import threading
+
+    class Cache:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+
+        def add(self, x):
+            with self._lock:
+                self._grow(x)
+
+        def _grow(self, x):
+            self._items.append(x)
+
+        def size(self):
+            with self._lock:
+                return len(self._items)
+    """
+    assert "G101" not in _codes(src)
+
+
+def test_g101_clean_with_inline_disable_and_init_exempt():
+    src = """
+    import threading
+
+    class Cache:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []           # construction: happens-before, exempt
+
+        def add(self, x):
+            with self._lock:
+                self._items.append(x)
+
+        def size(self):
+            return len(self._items)    # graftlint: disable=G101
+    """
+    assert "G101" not in _codes(src)
+
+
+# -- G102: lock-order cycles (project rule) --------------------------------
+
+def test_g102_triggers_on_opposite_acquisition_orders(tmp_path):
+    (tmp_path / "m.py").write_text(textwrap.dedent("""
+    import threading
+
+    A = threading.Lock()
+    B = threading.Lock()
+
+    def f():
+        with A:
+            with B:
+                pass
+
+    def g():
+        with B:
+            with A:
+                pass
+    """))
+    findings = lint([str(tmp_path / "m.py")], select=["G102"],
+                    root=str(tmp_path), with_project_rules=True)
+    assert [f.code for f in findings] == ["G102", "G102"]
+
+
+def test_g102_clean_on_consistent_order(tmp_path):
+    (tmp_path / "m.py").write_text(textwrap.dedent("""
+    import threading
+
+    A = threading.Lock()
+    B = threading.Lock()
+
+    def f():
+        with A:
+            with B:
+                pass
+
+    def g():
+        with A:
+            with B:
+                pass
+    """))
+    findings = lint([str(tmp_path / "m.py")], select=["G102"],
+                    root=str(tmp_path), with_project_rules=True)
+    assert findings == []
+
+
+# -- G103: background thread without a shutdown path -----------------------
+
+def test_g103_triggers_on_fire_and_forget_and_unjoined():
+    src = """
+    import threading
+
+    def kick(fn):
+        threading.Thread(target=fn, daemon=True).start()
+
+    class Svc:
+        def start(self, fn):
+            self._thread = threading.Thread(target=fn, daemon=True)
+            self._thread.start()
+    """
+    assert _codes(src).count("G103") == 2
+
+
+def test_g103_clean_on_event_join_pair():
+    src = """
+    import threading
+
+    class Svc:
+        def start(self, fn):
+            self._shutdown = threading.Event()
+            self._thread = threading.Thread(target=fn, daemon=True)
+            self._thread.start()
+
+        def close(self):
+            self._shutdown.set()
+            self._thread.join(timeout=5)
+
+    def run_sync(fn):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+    """
+    assert "G103" not in _codes(src)
+
+
+# -- G104: check-then-act outside the lock ---------------------------------
+
+def test_g104_triggers_on_unlocked_check_then_act():
+    src = """
+    import threading
+
+    class Svc:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._worker = None
+
+        def _set(self, w):
+            with self._lock:
+                self._worker = w
+
+        def ensure(self, w):
+            if self._worker is None:   # racy: another thread can win
+                self._worker = w
+    """
+    assert "G104" in _codes(src)
+
+
+def test_g104_clean_when_locked():
+    src = """
+    import threading
+
+    class Svc:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._worker = None
+
+        def _set(self, w):
+            with self._lock:
+                self._worker = w
+
+        def ensure(self, w):
+            with self._lock:
+                if self._worker is None:
+                    self._worker = w
+    """
+    assert "G104" not in _codes(src)
+
+
+# -- G105: blocking call while a lock is held ------------------------------
+
+def test_g105_triggers_on_sleep_and_result_under_lock():
+    src = """
+    import threading
+    import time
+
+    class Svc:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def tick(self, future):
+            with self._lock:
+                time.sleep(0.5)
+                return future.result()
+    """
+    assert _codes(src).count("G105") == 2
+
+
+def test_g105_clean_outside_lock_and_snapshot_idiom():
+    src = """
+    import threading
+    import time
+
+    class Svc:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._pending = []
+
+        def tick(self, future):
+            with self._lock:
+                batch = list(self._pending)
+            time.sleep(0.5)            # outside the critical section
+            return batch, future.result()
+    """
+    assert "G105" not in _codes(src)
+
+
 # -- baseline mechanics ----------------------------------------------------
 
 def test_baseline_suppresses_exact_count_and_flags_growth(tmp_path):
@@ -445,6 +681,56 @@ def test_baseline_suppresses_exact_count_and_flags_growth(tmp_path):
             == [f.fingerprint for f in shifted])
 
 
+def test_prune_stale_drops_dead_entries_preserving_live(tmp_path):
+    import json
+    live = LE.Finding("G003", "cruise_control_tpu/x.py", 3, 0, "m",
+                      snippet="jnp.zeros(4)")
+    path = tmp_path / "b.json"
+    path.write_text(json.dumps({"version": 1, "suppressions": [
+        {"fingerprint": live.fingerprint, "count": 2, "line": 3,
+         "justification": "keep me verbatim"},
+        {"fingerprint": "G003|cruise_control_tpu/gone.py|old()", "count": 1,
+         "line": 9, "justification": "dead"},
+        {"fingerprint": "G101|cruise_control_tpu/gone.py|old()", "count": 1,
+         "line": 9, "justification": "dead, other code"},
+    ]}))
+    kept, dropped = LE.prune_stale_baseline([live], path=str(path))
+    assert kept == 1 and len(dropped) == 2
+    after = load_baseline(str(path))
+    # the live entry survives VERBATIM — count and justification untouched
+    assert after[live.fingerprint]["count"] == 2
+    assert after[live.fingerprint]["justification"] == "keep me verbatim"
+
+
+def test_prune_stale_scoped_to_selected_codes(tmp_path):
+    """A --rules-filtered run must not drop entries its rules never
+    produced: pruning with codes={G101} leaves the stale G003 alone."""
+    import json
+    path = tmp_path / "b.json"
+    path.write_text(json.dumps({"version": 1, "suppressions": [
+        {"fingerprint": "G003|cruise_control_tpu/gone.py|old()", "count": 1,
+         "line": 9, "justification": "stale but out of scope"},
+        {"fingerprint": "G101|cruise_control_tpu/gone.py|old()", "count": 1,
+         "line": 9, "justification": "stale and in scope"},
+    ]}))
+    kept, dropped = LE.prune_stale_baseline([], path=str(path),
+                                            codes={"G101"})
+    assert kept == 1
+    assert dropped == ["G101|cruise_control_tpu/gone.py|old()"]
+
+
+def test_cli_rules_filter(capsys):
+    """--rules is the --select alias: a G103-only run over rest.py sees
+    exactly the baselined serve_forever thread — exit 0 with the baseline,
+    exit 1 without it."""
+    assert LE.main(["--rules", "G103", "--no-project-rules",
+                    "cruise_control_tpu/server/rest.py"]) == 0
+    assert LE.main(["--rules", "G103", "--no-project-rules", "--no-baseline",
+                    "cruise_control_tpu/server/rest.py"]) == 1
+    out = capsys.readouterr().out
+    assert "G103" in out
+
+
 # -- the tentpole gate -----------------------------------------------------
 
 def test_package_lints_clean_against_baseline():
@@ -454,9 +740,13 @@ def test_package_lints_clean_against_baseline():
     findings = lint(["cruise_control_tpu", "bench.py"], root=LE.REPO_ROOT,
                     with_project_rules=True)
     baseline = load_baseline()
-    new, _suppressed, _stale = apply_baseline(findings, baseline)
+    new, _suppressed, stale = apply_baseline(findings, baseline)
     assert new == [], "new graftlint findings:\n" + "\n".join(
         f.format() for f in new)
+    # zero stale entries: a fixed finding must take its suppression with it
+    # (python -m tools.graftlint --prune-stale drops them)
+    assert stale == [], "stale baseline entries (run --prune-stale):\n" + \
+        "\n".join(stale)
     for entry in baseline.values():
         assert entry.get("justification", "").strip() not in (
             "", "TODO: justify or fix"), (

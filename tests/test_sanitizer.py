@@ -1,0 +1,314 @@
+"""Runtime lock sanitizer (cruise_control_tpu/common/sanitizer.py): the
+TSan-style twin of graftlint's static G101-G105 family.
+
+Unit tier: the sanitizer detects a deliberately-inverted acquisition order
+(the acceptance-criteria test), handles RLock reentrancy without self
+edges, records over-threshold hold times, and instrument_locks() restores
+the original locks on exit.
+
+Regression tier: the two concrete races fixed in this change stay fixed —
+the load-monitor pause-clobber in sample_once and the executor's unlocked
+stop_execution check-then-act.
+
+E2E smoke: an app proposal tick, a detector sweep/drain, and a full
+executor run under instrument_locks() observe ZERO lock-order inversions.
+Everything here is seeded/deterministic and CPU-cheap.
+"""
+
+import threading
+import time
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from cruise_control_tpu.common import sanitizer as TS  # noqa: E402
+from cruise_control_tpu.common.sanitizer import (  # noqa: E402
+    LockSanitizer,
+    TracedLock,
+    instrument_locks,
+)
+
+pytestmark = pytest.mark.tsan
+
+W = 60_000
+
+
+# ------------------------------------------------------------------- unit
+
+def test_traced_lock_detects_inverted_acquisition_order():
+    """THE acceptance test: acquire a→b, then b→a; the second pair is a
+    lock-order inversion even single-threaded (the edge graph remembers)."""
+    san = LockSanitizer()
+    a = TracedLock(threading.Lock(), "a", san)
+    b = TracedLock(threading.Lock(), "b", san)
+    with a:
+        with b:
+            pass
+    assert san.inversions == []          # one order so far: consistent
+    with b:
+        with a:                          # deliberate inversion
+            pass
+    assert len(san.inversions) == 1
+    inv = san.inversions[0]
+    assert inv["held"] == "b" and inv["acquiring"] == "a"
+    with pytest.raises(AssertionError, match="inversion"):
+        san.check()
+    # the report is JSON-shaped and names both sites
+    rep = san.report()
+    assert rep["inversions"] and rep["edges"]
+    assert rep["acquireCounts"] == {"a": 2, "b": 2}
+
+
+def test_rlock_reentrancy_no_self_edge_single_count():
+    san = LockSanitizer()
+    r = TracedLock(threading.RLock(), "r", san)
+    with r:
+        with r:                          # reentrant: not a new acquisition
+            with r:
+                pass
+    assert san.acquire_counts == {"r": 1}
+    assert san.edges == {} and san.inversions == []
+    san.check()                          # clean
+
+
+def test_failed_nonblocking_acquire_not_recorded():
+    raw = threading.Lock()
+    san = LockSanitizer()
+    tl = TracedLock(raw, "gate", san)
+    raw.acquire()                        # someone else holds it
+    try:
+        assert tl.acquire(blocking=False) is False
+        assert san.acquire_counts == {}
+    finally:
+        raw.release()
+    assert tl.acquire(blocking=False) is True
+    tl.release()
+    assert san.acquire_counts == {"gate": 1}
+
+
+def test_long_hold_recorded_over_threshold():
+    san = LockSanitizer(hold_threshold_s=0.01)
+    lk = TracedLock(threading.Lock(), "slow", san)
+    with lk:
+        time.sleep(0.05)
+    with lk:
+        pass                             # fast hold: not recorded
+    assert len(san.long_holds) == 1
+    assert san.long_holds[0]["lock"] == "slow"
+    assert san.long_holds[0]["heldForS"] >= 0.01
+
+
+def test_instrument_locks_swaps_and_restores():
+    class Obj:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._rlock = threading.RLock()
+            self.data = 0
+
+    o = Obj()
+    orig_lock, orig_rlock = o._lock, o._rlock
+    with instrument_locks(o) as san:
+        assert isinstance(o._lock, TracedLock)
+        assert isinstance(o._rlock, TracedLock)
+        with o._lock:
+            o.data += 1
+        assert san.acquire_counts == {"Obj._lock": 1}
+    assert o._lock is orig_lock and o._rlock is orig_rlock
+
+
+def test_cross_thread_inversion_detected():
+    """The two-thread shape TSan exists for: thread 1 takes a→b, thread 2
+    takes b→a (sequenced by events so there is no actual deadlock)."""
+    san = LockSanitizer()
+    a = TracedLock(threading.Lock(), "a", san)
+    b = TracedLock(threading.Lock(), "b", san)
+    done = threading.Event()
+
+    def t1():
+        with a:
+            with b:
+                pass
+        done.set()
+
+    th = threading.Thread(target=t1)
+    th.start()
+    th.join(timeout=5)
+    assert done.is_set()
+    with b:                              # opposite order, main thread
+        with a:
+            pass
+    assert len(san.inversions) == 1
+    assert san.inversions[0]["thread"] == "MainThread"
+
+
+# -------------------------------------------------------------- regressions
+
+def _metadata(num_brokers=4, num_parts=8, rf=2):
+    from cruise_control_tpu.monitor.sampler import (
+        BrokerMetadata, ClusterMetadata, PartitionMetadata)
+    brokers = [BrokerMetadata(i, rack=f"r{i % 2}", host=f"h{i}")
+               for i in range(num_brokers)]
+    parts = []
+    for p in range(num_parts):
+        reps = tuple((p + j) % num_brokers for j in range(rf))
+        parts.append(PartitionMetadata(topic="T", partition=p,
+                                       leader=reps[0], replicas=reps))
+    return ClusterMetadata(brokers=brokers, partitions=parts, generation=1)
+
+
+def test_pause_during_sample_once_is_not_clobbered():
+    """Race fix regression (load_monitor.sample_once): a pause() landing
+    while a sampling pass is in flight must stick — the pass's restore
+    used to write the pre-sample state back over PAUSED."""
+    from cruise_control_tpu.monitor.load_monitor import (
+        LoadMonitor, MonitorState, StaticMetadataSource)
+    from cruise_control_tpu.monitor.sampler import SyntheticLoadSampler
+
+    class PausingSource(StaticMetadataSource):
+        """Delivers metadata, then pauses the monitor — deterministically
+        simulating a user pause landing mid-sample."""
+
+        monitor = None
+
+        def get_metadata(self):
+            md = super().get_metadata()
+            if self.monitor is not None:
+                self.monitor.pause("mid-sample pause")
+            return md
+
+    src = PausingSource(_metadata())
+    lm = LoadMonitor(src, SyntheticLoadSampler(seed=5),
+                     num_windows=3, window_ms=W)
+    src.monitor = lm
+    with lm._lock:
+        lm._state = MonitorState.RUNNING
+    lm.sample_once(now_ms=30_000)
+    assert lm.state == MonitorState.PAUSED, (
+        "pause issued during a sampling pass was clobbered by the "
+        "post-sample state restore")
+    assert lm.state_snapshot(now_ms=W)["reasonOfPauseOrResume"] \
+        == "mid-sample pause"
+
+
+def test_stop_execution_check_then_act_under_lock():
+    """Race fix regression (executor.stop_execution): the ongoing-execution
+    check and the STOPPING_EXECUTION write happen under the executor lock,
+    and an idle executor is never wedged into STOPPING_EXECUTION."""
+    from cruise_control_tpu.executor.executor import (
+        Executor, ExecutorConfig, ExecutorState, FakeClusterAdapter)
+    ex = Executor(FakeClusterAdapter({}),
+                  ExecutorConfig(execution_progress_check_interval_ms=1))
+    with instrument_locks(ex) as san:
+        ex.stop_execution()
+        # idle: the conditional write must NOT fire...
+        assert ex.state == ExecutorState.NO_TASK_IN_PROGRESS
+        # ...and both the check and the act took the executor lock
+        assert san.acquire_counts.get("Executor._lock", 0) >= 2
+        with ex._lock:
+            ex._state = ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
+        ex.stop_execution()
+        assert ex.state == ExecutorState.STOPPING_EXECUTION
+        san.check()
+    ex._stop_requested.clear()
+    with ex._lock:
+        ex._state = ExecutorState.NO_TASK_IN_PROGRESS
+
+
+def test_graft_tsan_env_gate(tmp_path, monkeypatch):
+    """GRAFT_TSAN=1 instruments the app's locks at startup and dumps a
+    report at shutdown; with the variable unset nothing is instrumented."""
+    from cruise_control_tpu.app import CruiseControlApp
+    from cruise_control_tpu.common.config import CruiseControlConfig
+    from cruise_control_tpu.executor.executor import FakeClusterAdapter
+    from cruise_control_tpu.monitor.load_monitor import StaticMetadataSource
+    from cruise_control_tpu.monitor.sampler import SyntheticLoadSampler
+
+    def _mini_app():
+        return CruiseControlApp(
+            CruiseControlConfig({
+                "optimizer.engine": "greedy",
+                "partition.metrics.window.ms": W,
+                "num.partition.metrics.windows": 3,
+                "skip.loading.samples": True,
+                "failed.brokers.file.path": "",
+            }),
+            StaticMetadataSource(_metadata()), SyntheticLoadSampler(seed=4),
+            cluster_adapter=FakeClusterAdapter({}))
+
+    monkeypatch.delenv("GRAFT_TSAN", raising=False)
+    app = _mini_app()
+    app.startup()
+    try:
+        assert not isinstance(app.executor._lock, TracedLock)
+        assert getattr(app, "_lock_sanitizer", None) is None
+    finally:
+        app.shutdown()
+
+    report = tmp_path / "tsan.json"
+    monkeypatch.setenv("GRAFT_TSAN", "1")
+    monkeypatch.setenv("GRAFT_TSAN_REPORT", str(report))
+    app = _mini_app()
+    app.startup()
+    try:
+        assert isinstance(app.executor._lock, TracedLock)
+        app.state()
+    finally:
+        app.shutdown()
+    assert report.exists()
+    rep = app._lock_sanitizer.report()
+    assert rep["inversions"] == []
+    assert rep["acquireCounts"], "no lock activity traced under GRAFT_TSAN"
+
+
+# -------------------------------------------------------------- e2e smoke
+
+def test_app_tick_and_executor_run_zero_inversions():
+    """End-to-end: a proposal precompute tick, a /state render, a detector
+    sweep+drain, and a full executor run — with every lock of every
+    component traced — observe zero lock-order inversions."""
+    from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+    from cruise_control_tpu.app import CruiseControlApp
+    from cruise_control_tpu.common.config import CruiseControlConfig
+    from cruise_control_tpu.executor.executor import FakeClusterAdapter
+    from cruise_control_tpu.monitor.load_monitor import StaticMetadataSource
+    from cruise_control_tpu.monitor.sampler import SyntheticLoadSampler
+
+    md = _metadata(num_brokers=6, num_parts=30)
+    cfg = CruiseControlConfig({
+        "optimizer.engine": "greedy",
+        "partition.metrics.window.ms": W,
+        "num.partition.metrics.windows": 3,
+        "min.valid.partition.ratio": 0.0,
+        "execution.progress.check.interval.ms": 1,
+        "failed.brokers.file.path": "",
+    })
+    adapter = FakeClusterAdapter(
+        {f"{p.topic}-{p.partition}": tuple(p.replicas)
+         for p in md.partitions},
+        latency_polls=1)
+    app = CruiseControlApp(cfg, StaticMetadataSource(md),
+                           SyntheticLoadSampler(seed=4),
+                           cluster_adapter=adapter)
+    app.load_monitor._now = lambda: 4 * W
+    with instrument_locks(
+            app, app.executor, app.load_monitor, app.anomaly_detector,
+            app.load_monitor.partition_aggregator,
+            app.load_monitor.broker_aggregator,
+            hold_threshold_s=30.0) as san:
+        for w in range(4):
+            app.load_monitor.sample_once(now_ms=w * W + 30_000)
+        app.precompute_tick()
+        app.state()
+        app.anomaly_detector.sweep()
+        app.anomaly_detector.handle_pending()
+        props = [ExecutionProposal(
+            topic="T", partition=p.partition, old_leader=p.leader,
+            old_replicas=tuple(p.replicas),
+            new_replicas=tuple(reversed(p.replicas)), data_size=10.0)
+            for p in md.partitions[:4]]
+        summary = app.executor.execute_proposals(props)
+        assert summary["taskCounts"], summary
+        app.state()
+        san.check()                      # zero inversions observed
+        assert san.acquire_counts, "tracing observed no lock activity?"

@@ -212,6 +212,31 @@ def save_baseline(findings: Iterable[Finding], path: str = DEFAULT_BASELINE,
         fh.write("\n")
 
 
+def prune_stale_baseline(findings: Sequence[Finding],
+                         path: str = DEFAULT_BASELINE,
+                         codes: Optional[set] = None) -> Tuple[int, List[str]]:
+    """Drop baseline entries whose fingerprint matches no current finding.
+
+    Unlike ``save_baseline`` (which rewrites counts from the current
+    findings), live entries are preserved verbatim — count, line, and
+    justification untouched — so pruning is a pure deletion and never
+    widens a suppression.  When ``codes`` is given (a ``--rules``-filtered
+    run), only entries for those rule codes are eligible — a filtered run
+    must not drop entries its rules never produced.  Returns
+    ``(kept, dropped_fingerprints)``.
+    """
+    old = load_baseline(path)
+    live = {f.fingerprint for f in findings}
+    dropped = [fp for fp in old if fp not in live
+               and (codes is None or fp.split("|", 1)[0] in codes)]
+    if dropped:
+        entries = [old[fp] for fp in sorted(old) if fp in live]
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump({"version": 1, "suppressions": entries}, fh, indent=1)
+            fh.write("\n")
+    return len(old) - len(dropped), dropped
+
+
 def apply_baseline(findings: Sequence[Finding],
                    baseline: Dict[str, dict]
                    ) -> Tuple[List[Finding], List[Finding], List[str]]:
@@ -241,7 +266,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     import argparse
     parser = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
-        description="JAX/XLA hazard static analyzer (rules G001-G008)")
+        description="JAX/XLA hazard + concurrency static analyzer "
+                    "(rules G001-G009, G101-G105)")
     parser.add_argument("paths", nargs="*",
                         default=["cruise_control_tpu", "bench.py"],
                         help="files/directories to lint "
@@ -256,12 +282,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--select", default=None,
                         help="comma-separated rule codes to run (e.g. "
                              "G001,G002)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule codes to run (alias of "
+                             "--select; merged when both are given)")
+    parser.add_argument("--prune-stale", action="store_true",
+                        help="drop baseline entries whose fingerprints no "
+                             "longer match any finding, then exit")
     parser.add_argument("--no-project-rules", action="store_true",
-                        help="skip whole-project rules (G007); they import "
-                             "the package")
+                        help="skip whole-project rules (G007/G102); they "
+                             "walk the whole package")
     args = parser.parse_args(argv)
 
-    select = args.select.split(",") if args.select else None
+    select = None
+    if args.select or args.rules:
+        select = [c for spec in (args.select, args.rules) if spec
+                  for c in spec.split(",") if c]
     os.chdir(REPO_ROOT)
     findings = lint(args.paths, select=select,
                     with_project_rules=not args.no_project_rules)
@@ -270,6 +305,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         save_baseline(findings, path=args.baseline)
         print(f"graftlint: wrote {len(findings)} suppression(s) to "
               f"{os.path.relpath(args.baseline, REPO_ROOT)}")
+        return 0
+
+    if args.prune_stale:
+        kept, dropped = prune_stale_baseline(
+            findings, path=args.baseline,
+            codes=set(select) if select else None)
+        for fp in dropped:
+            print(f"graftlint: pruned {fp}")
+        print(f"graftlint: baseline: {kept} kept, {len(dropped)} pruned")
         return 0
 
     baseline = {} if args.no_baseline else load_baseline(args.baseline)

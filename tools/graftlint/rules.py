@@ -35,6 +35,31 @@ G009  Silent broad exception swallow — an ``except Exception:`` /
       ``except BaseException:`` / bare ``except:`` block that neither
       logs, re-raises, nor carries a ``# graftlint: disable=G009``
       justification turns a permanently-failing path invisible.
+
+Concurrency family (G101-G105) — lock discipline over the service's daemon
+threads and pools, paired with the runtime sanitizer in
+``cruise_control_tpu/common/sanitizer.py``:
+
+G101  Unguarded shared-attribute access: for each class owning a
+      ``threading.Lock/RLock`` attribute, the set of ``self._x`` attributes
+      mutated under ``with self._lock`` is inferred (cross-method: a
+      private helper reached only from lock-held call sites counts as
+      lock-held), and any access to those attributes outside the lock
+      flags.
+G102  Lock-order cycle: nested ``with lockA: ... with lockB:`` acquisition
+      pairs collected project-wide form a directed graph; an edge on a
+      cycle is a lock-order inversion candidate (deadlock).
+G103  Background ``threading.Thread`` started without a shutdown path —
+      fire-and-forget ``Thread(...).start()`` or a stored thread that no
+      method ever ``join()``s.
+G104  Check-then-act on guarded state outside the lock: an ``if`` whose
+      test reads a guarded attribute (directly or through a same-class
+      method/property) and whose body writes one, with the guarding lock
+      not held.
+G105  Blocking call while a lock is held — ``time.sleep``,
+      ``future.result()``, ``Event.wait()``, ``Queue.get(timeout=...)``,
+      or an adapter RPC inside a lock-held region serializes every other
+      thread behind the slow operation.
 """
 
 from __future__ import annotations
@@ -832,3 +857,551 @@ def check_impure_jit(ctx: ModuleContext) -> Iterator[Finding]:
                 "G008", node,
                 f"{what} inside a jitted function — executes at trace time "
                 f"only and its result is frozen into the compiled program")
+
+
+# ==========================================================================
+# Concurrency family G101-G105 — lock-discipline inference
+# ==========================================================================
+
+_LOCK_CTOR_NAMES = frozenset({"Lock", "RLock"})
+
+#: method names that mutate their receiver in place (list/dict/set/deque)
+_MUTATOR_METHODS = frozenset({
+    "append", "appendleft", "extend", "insert", "remove", "discard", "pop",
+    "popleft", "popitem", "clear", "update", "setdefault", "add", "sort",
+    "reverse", "move_to_end"})
+
+#: free functions whose first argument is mutated in place
+_MUTATOR_FUNCS = frozenset({"heappush", "heappop", "heapify", "heapreplace",
+                            "heappushpop"})
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    """``threading.Lock()`` / ``threading.RLock()`` (or bare ``Lock()``)."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id in _LOCK_CTOR_NAMES
+    return (isinstance(f, ast.Attribute) and f.attr in _LOCK_CTOR_NAMES
+            and _attr_root(f) == "threading")
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _module_lock_names(tree: ast.Module) -> FrozenSet[str]:
+    out = set()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and _is_lock_ctor(stmt.value):
+            out.update(t.id for t in stmt.targets if isinstance(t, ast.Name))
+    return frozenset(out)
+
+
+def _map_lexical_held(fn: ast.AST, recognize, out: Dict[int, FrozenSet[str]]
+                      ) -> None:
+    """For every node in ``fn``'s body, record the set of lock names held
+    lexically (via enclosing ``with`` statements).  Nested function bodies
+    reset the held set — they run when *called*, not where they're defined."""
+
+    def visit(node: ast.AST, held: FrozenSet[str]) -> None:
+        out[id(node)] = held
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            for child in ast.iter_child_nodes(node):
+                visit(child, frozenset())
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = set()
+            for item in node.items:
+                visit(item, held)       # context exprs run before acquisition
+                name = recognize(item.context_expr)
+                if name:
+                    acquired.add(name)
+            inner = held | frozenset(acquired)
+            for stmt in node.body:
+                visit(stmt, inner)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in getattr(fn, "body", []):
+        visit(stmt, frozenset())
+
+
+def _mutated_self_attr(node: ast.AST) -> Optional[str]:
+    """Attr name when ``node`` mutates a ``self.<attr>`` value in place or
+    rebinds it: direct store/del, subscript store (``self.x[k] = v``,
+    ``self.x[k] += v``), mutating method call (``self.x.append(v)``), or a
+    heapq-style free function (``heappush(self.x, v)``)."""
+    if isinstance(node, ast.Attribute) and isinstance(
+            node.ctx, (ast.Store, ast.Del)):
+        return _self_attr(node)
+    if isinstance(node, ast.Subscript) and isinstance(
+            node.ctx, (ast.Store, ast.Del)):
+        return _self_attr(node.value)
+    if isinstance(node, ast.Call):
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr in _MUTATOR_METHODS):
+            return _self_attr(f.value)
+        fname = (f.id if isinstance(f, ast.Name)
+                 else f.attr if isinstance(f, ast.Attribute) else None)
+        if fname in _MUTATOR_FUNCS and node.args:
+            return _self_attr(node.args[0])
+    return None
+
+
+class _ClassLockInfo:
+    """Per-class lock-discipline model shared by G101/G104/G105.
+
+    ``held_at(node, method)`` is the *effective* held set: lexical ``with``
+    nesting plus cross-method inference — a private method (leading
+    underscore, not dunder) whose every same-class call site holds lock L
+    is analyzed as if its body held L (fixpoint over the private-call
+    graph, so helpers of helpers resolve too)."""
+
+    def __init__(self, cls: ast.ClassDef,
+                 module_locks: FrozenSet[str] = frozenset()):
+        self.cls = cls
+        self.methods: List[ast.AST] = [
+            n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        lock_attrs = set()
+        for n in ast.walk(cls):
+            if isinstance(n, ast.Assign) and _is_lock_ctor(n.value):
+                for t in n.targets:
+                    a = _self_attr(t)
+                    if a:
+                        lock_attrs.add(a)
+        self.lock_attrs: FrozenSet[str] = frozenset(lock_attrs)
+
+        def recognize(expr: ast.AST) -> Optional[str]:
+            a = _self_attr(expr)
+            if a is not None and a in self.lock_attrs:
+                return a
+            if isinstance(expr, ast.Name) and expr.id in module_locks:
+                return expr.id
+            return None
+
+        self._lexical: Dict[int, FrozenSet[str]] = {}
+        for m in self.methods:
+            _map_lexical_held(m, recognize, self._lexical)
+
+        # cross-method propagation: base held set per private method =
+        # intersection of the effective held sets at its call sites
+        method_names = {m.name for m in self.methods}
+        private = {n for n in method_names
+                   if n.startswith("_") and not n.startswith("__")}
+        # call sites: callee -> [(caller_name, lexical_held_at_site)]
+        sites: Dict[str, List] = {}
+        for m in self.methods:
+            for n in ast.walk(m):
+                if isinstance(n, ast.Call):
+                    callee = _self_attr(n.func)
+                    if callee in private:
+                        sites.setdefault(callee, []).append(
+                            (m.name, self._lexical.get(id(n), frozenset())))
+        self._base: Dict[str, FrozenSet[str]] = {
+            n: frozenset() for n in method_names}
+        changed = True
+        while changed:
+            changed = False
+            for callee in private:
+                callee_sites = sites.get(callee)
+                if not callee_sites:
+                    continue
+                base = None
+                for caller, lex in callee_sites:
+                    eff = lex | self._base.get(caller, frozenset())
+                    base = eff if base is None else (base & eff)
+                base = base or frozenset()
+                if base != self._base[callee]:
+                    self._base[callee] = base
+                    changed = True
+
+        # guarded-set inference: attr -> locks it is mutated under (and one
+        # witness method name, for the message); __init__ is construction —
+        # it happens-before publication and never needs the lock
+        self.guards: Dict[str, FrozenSet[str]] = {}
+        self.guard_witness: Dict[str, str] = {}
+        for m in self.methods:
+            if m.name == "__init__":
+                continue
+            for n in ast.walk(m):
+                attr = _mutated_self_attr(n)
+                if attr is None or attr in self.lock_attrs:
+                    continue
+                held = self.held_at(n, m)
+                if held:
+                    prev = self.guards.get(attr, frozenset())
+                    self.guards[attr] = prev | held
+                    self.guard_witness.setdefault(attr, m.name)
+
+        # guarded attrs each method READS directly (for G104's
+        # property/method indirection in `if self.has_ongoing_execution:`)
+        self.method_reads: Dict[str, FrozenSet[str]] = {}
+        for m in self.methods:
+            reads = {a for n in ast.walk(m)
+                     for a in [_self_attr(n)]
+                     if a in self.guards and isinstance(n, ast.Attribute)
+                     and isinstance(n.ctx, ast.Load)}
+            self.method_reads[m.name] = frozenset(reads)
+
+    def held_at(self, node: ast.AST, method: ast.AST) -> FrozenSet[str]:
+        return (self._lexical.get(id(node), frozenset())
+                | self._base.get(method.name, frozenset()))
+
+
+def _classes(tree: ast.Module) -> Iterator[ast.ClassDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            yield node
+
+
+# --------------------------------------------------------------------------
+# G101 — unguarded access to lock-guarded attributes
+# --------------------------------------------------------------------------
+
+@file_rule("G101", "unguarded-shared-attr")
+def check_unguarded_shared_attr(ctx: ModuleContext) -> Iterator[Finding]:
+    module_locks = _module_lock_names(ctx.tree)
+    for cls in _classes(ctx.tree):
+        info = _ClassLockInfo(cls, module_locks)
+        if not info.lock_attrs or not info.guards:
+            continue
+        for m in info.methods:
+            if m.name == "__init__":
+                continue
+            for n in ast.walk(m):
+                if not (isinstance(n, ast.Attribute)
+                        and isinstance(n.ctx, (ast.Load, ast.Store, ast.Del))):
+                    continue
+                attr = _self_attr(n)
+                if attr is None or attr not in info.guards:
+                    continue
+                if info.held_at(n, m) & info.guards[attr]:
+                    continue
+                if _suppressed(ctx, n, "G101"):
+                    continue
+                locks = " / ".join(f"self.{k}"
+                                   for k in sorted(info.guards[attr]))
+                kind = ("write" if isinstance(n.ctx, (ast.Store, ast.Del))
+                        else "read")
+                yield ctx.finding(
+                    "G101", n,
+                    f"`self.{attr}` is written under `{locks}` (e.g. in "
+                    f"`{info.guard_witness[attr]}`) but {kind} here without "
+                    f"the lock — unguarded shared state across threads")
+
+
+# --------------------------------------------------------------------------
+# G102 — project-wide lock-order cycle detection
+# --------------------------------------------------------------------------
+
+@project_rule("G102", "lock-order-cycle")
+def check_lock_order_cycles(root: str, paths) -> Iterator[Finding]:
+    """Collect every lexically-nested lock acquisition pair ``A held ->
+    acquire B`` across the project into a directed graph; any edge on a
+    cycle means two code paths acquire the same locks in opposite orders —
+    a lock-order inversion (deadlock) candidate.  Lock identity is static:
+    ``ClassName.attr`` for ``self.<attr>`` locks, ``module:name`` for
+    module-level locks."""
+    from tools.graftlint import engine
+    abs_paths = [p if os.path.isabs(p) else os.path.join(root, p)
+                 for p in paths]
+    #: (a, b) -> (relpath, line, snippet) of the first site acquiring b
+    #: while a is held
+    edges: Dict[tuple, tuple] = {}
+    for fpath in engine.iter_py_files(abs_paths):
+        try:
+            with open(fpath, encoding="utf-8") as fh:
+                source = fh.read()
+            tree = ast.parse(source)
+        except (OSError, SyntaxError):
+            continue
+        rel = os.path.relpath(fpath, root).replace(os.sep, "/")
+        lines = source.splitlines()
+        modname = os.path.splitext(os.path.basename(fpath))[0]
+        module_locks = _module_lock_names(tree)
+
+        def scan(fn: ast.AST, recognize) -> None:
+            held_map: Dict[int, FrozenSet[str]] = {}
+            _map_lexical_held(fn, recognize, held_map)
+            for n in ast.walk(fn):
+                if not isinstance(n, (ast.With, ast.AsyncWith)):
+                    continue
+                held = held_map.get(id(n), frozenset())
+                if not held:
+                    continue
+                for item in n.items:
+                    b = recognize(item.context_expr)
+                    if b is None:
+                        continue
+                    for a in held:
+                        if a != b and (a, b) not in edges:
+                            line = n.lineno
+                            snippet = (lines[line - 1].strip()
+                                       if line <= len(lines) else "")
+                            edges[(a, b)] = (rel, line, snippet)
+
+        def mod_recognize(expr: ast.AST) -> Optional[str]:
+            if isinstance(expr, ast.Name) and expr.id in module_locks:
+                return f"{modname}:{expr.id}"
+            return None
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                lock_attrs = frozenset(
+                    a for n in ast.walk(node)
+                    if isinstance(n, ast.Assign) and _is_lock_ctor(n.value)
+                    for t in n.targets for a in [_self_attr(t)] if a)
+
+                def cls_recognize(expr, _attrs=lock_attrs, _cls=node.name):
+                    a = _self_attr(expr)
+                    if a is not None and a in _attrs:
+                        return f"{_cls}.{a}"
+                    return mod_recognize(expr)
+
+                for m in node.body:
+                    if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        scan(m, cls_recognize)
+            elif (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                  and module_locks):
+                scan(node, mod_recognize)
+
+    # an edge (a, b) is cyclic iff b reaches a through the graph
+    graph: Dict[str, set] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+
+    def reaches(src: str, dst: str) -> bool:
+        seen, stack = set(), [src]
+        while stack:
+            cur = stack.pop()
+            if cur == dst:
+                return True
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(graph.get(cur, ()))
+        return False
+
+    for (a, b) in sorted(edges):
+        if not reaches(b, a):
+            continue
+        rel, line, snippet = edges[(a, b)]
+        reverse = next((f"{edges[e][0]}:{edges[e][1]}" for e in sorted(edges)
+                        if e != (a, b) and reaches(e[1], a) and e[0] == b),
+                       "another path")
+        yield Finding(
+            "G102", rel, line, 0,
+            f"lock-order cycle: `{a}` is held while acquiring `{b}`, but "
+            f"the opposite order also occurs (see {reverse}) — lock-order "
+            f"inversion (deadlock) candidate; pick one global order",
+            snippet=snippet)
+
+
+# --------------------------------------------------------------------------
+# G103 — background thread without a shutdown path
+# --------------------------------------------------------------------------
+
+def _is_thread_ctor(func: ast.AST) -> bool:
+    if isinstance(func, ast.Name):
+        return func.id == "Thread"
+    return (isinstance(func, ast.Attribute) and func.attr == "Thread"
+            and _attr_root(func) == "threading")
+
+
+def _enclosing_class(ctx: ModuleContext, node: ast.AST
+                     ) -> Optional[ast.ClassDef]:
+    cur = ctx.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur
+        cur = ctx.parents.get(cur)
+    return None
+
+
+def _joins_target(scope: ast.AST, is_target) -> bool:
+    """Does ``scope`` contain ``<target>.join(...)``?"""
+    for n in ast.walk(scope):
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "join" and is_target(n.func.value)):
+            return True
+    return False
+
+
+@file_rule("G103", "thread-without-shutdown")
+def check_thread_shutdown(ctx: ModuleContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and _is_thread_ctor(node.func)):
+            continue
+        if _suppressed(ctx, node, "G103"):
+            continue
+        par = ctx.parents.get(node)
+        # Thread(...).start() — nothing retains the thread
+        if isinstance(par, ast.Attribute) and par.attr == "start":
+            yield ctx.finding(
+                "G103", node,
+                "fire-and-forget `threading.Thread(...).start()` — no "
+                "reference is kept, so nothing can signal shutdown or "
+                "`join()` it; store it and pair it with a shutdown "
+                "Event + join")
+            continue
+        if isinstance(par, ast.Assign) and len(par.targets) == 1:
+            tgt = par.targets[0]
+            attr = _self_attr(tgt)
+            if attr is not None:
+                cls = _enclosing_class(ctx, node)
+                if cls is not None and _joins_target(
+                        cls, lambda v, a=attr: _self_attr(v) == a):
+                    continue
+                yield ctx.finding(
+                    "G103", node,
+                    f"background thread stored in `self.{attr}` but no "
+                    f"method of the class ever calls `self.{attr}.join()` "
+                    f"— add a shutdown Event + join path")
+                continue
+            if isinstance(tgt, ast.Name):
+                fn = _enclosing_function(ctx, node) or ctx.tree
+                if _joins_target(
+                        fn, lambda v, name=tgt.id: isinstance(v, ast.Name)
+                        and v.id == name):
+                    continue
+                yield ctx.finding(
+                    "G103", node,
+                    f"background thread `{tgt.id}` is never joined in its "
+                    f"scope — pair it with a shutdown Event + join (or "
+                    f"hand ownership to something that does)")
+                continue
+        yield ctx.finding(
+            "G103", node,
+            "`threading.Thread` created without a tracked owner — nothing "
+            "can signal shutdown or join it")
+
+
+# --------------------------------------------------------------------------
+# G104 — check-then-act on guarded state outside the lock
+# --------------------------------------------------------------------------
+
+@file_rule("G104", "check-then-act")
+def check_then_act_outside_lock(ctx: ModuleContext) -> Iterator[Finding]:
+    module_locks = _module_lock_names(ctx.tree)
+    for cls in _classes(ctx.tree):
+        info = _ClassLockInfo(cls, module_locks)
+        if not info.lock_attrs or not info.guards:
+            continue
+        for m in info.methods:
+            if m.name == "__init__":
+                continue
+            for n in ast.walk(m):
+                if not isinstance(n, ast.If):
+                    continue
+                # guarded attrs the test observes — directly, or through a
+                # same-class method/property it references
+                test_attrs = set()
+                for t in ast.walk(n.test):
+                    a = _self_attr(t)
+                    if a is None:
+                        continue
+                    if a in info.guards:
+                        test_attrs.add(a)
+                    elif a in info.method_reads:
+                        test_attrs |= info.method_reads[a]
+                if not test_attrs:
+                    continue
+                written = {a for b in n.body for nn in ast.walk(b)
+                           for a in [_mutated_self_attr(nn)] if a}
+                overlap = test_attrs & written
+                if not overlap:
+                    continue
+                held = info.held_at(n, m)
+                racy = sorted(a for a in overlap
+                              if not (held & info.guards[a]))
+                if not racy or _suppressed(ctx, n, "G104"):
+                    continue
+                attrs = ", ".join(f"`self.{a}`" for a in racy)
+                yield ctx.finding(
+                    "G104", n,
+                    f"check-then-act on {attrs} outside the guarding lock — "
+                    f"the state can change between the test and the act; "
+                    f"take the lock around both (double-checked re-test "
+                    f"inside is fine)")
+
+
+# --------------------------------------------------------------------------
+# G105 — blocking call while a lock is held
+# --------------------------------------------------------------------------
+
+def _blocking_call(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    if f.attr == "sleep" and _attr_root(f) == "time":
+        return "`time.sleep`"
+    if f.attr == "result":
+        return "`.result()` on a future"
+    if f.attr == "wait":
+        return "`.wait()`"
+    if f.attr == "get" and any(kw.arg == "timeout" for kw in node.keywords):
+        return "`.get(timeout=...)`"
+    parts = []
+    cur: ast.AST = f.value
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    if any("adapter" in p.lower() for p in parts):
+        return f"adapter RPC `.{f.attr}()`"
+    return None
+
+
+@file_rule("G105", "blocking-under-lock")
+def check_blocking_under_lock(ctx: ModuleContext) -> Iterator[Finding]:
+    module_locks = _module_lock_names(ctx.tree)
+
+    def flag(call: ast.Call, held: FrozenSet[str]) -> Optional[Finding]:
+        what = _blocking_call(call)
+        if what is None or _suppressed(ctx, call, "G105"):
+            return None
+        locks = ", ".join(f"`{k}`" for k in sorted(held))
+        return ctx.finding(
+            "G105", call,
+            f"{what} while holding {locks} — every thread contending for "
+            f"the lock blocks behind the slow call; move it outside the "
+            f"critical section (snapshot under the lock, then call)")
+
+    for cls in _classes(ctx.tree):
+        info = _ClassLockInfo(cls, module_locks)
+        if not info.lock_attrs and not module_locks:
+            continue
+        for m in info.methods:
+            for n in ast.walk(m):
+                if isinstance(n, ast.Call):
+                    held = info.held_at(n, m)
+                    if held:
+                        f = flag(n, held)
+                        if f:
+                            yield f
+    if module_locks:
+        def recognize(expr: ast.AST) -> Optional[str]:
+            if isinstance(expr, ast.Name) and expr.id in module_locks:
+                return expr.id
+            return None
+        for node in ctx.tree.body:
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            held_map: Dict[int, FrozenSet[str]] = {}
+            _map_lexical_held(node, recognize, held_map)
+            for n in ast.walk(node):
+                if isinstance(n, ast.Call):
+                    held = held_map.get(id(n), frozenset())
+                    if held:
+                        f = flag(n, held)
+                        if f:
+                            yield f
