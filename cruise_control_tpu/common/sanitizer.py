@@ -89,6 +89,19 @@ class LockSanitizer:
         self.inversions: List[dict] = []
         self.long_holds: List[dict] = []
         self.acquire_counts: Dict[str, int] = {}
+        #: base name -> how many locks claimed it (see unique_name)
+        self._name_seq: Dict[str, int] = {}
+
+    def unique_name(self, base: str) -> str:
+        """Disambiguate ``base`` across lock instances: the first claimant
+        keeps it, later ones get ``base#2``, ``base#3``, ...  Two instances
+        of the same class must NOT share a name — the sanitizer would
+        misread acquiring one while holding the other as a reentrant
+        acquire and record no edge."""
+        with self._internal:
+            n = self._name_seq.get(base, 0) + 1
+            self._name_seq[base] = n
+        return base if n == 1 else f"{base}#{n}"
 
     # -- TracedLock callbacks --
 
@@ -183,12 +196,24 @@ class TracedLock:
         ok = self._lock.acquire(blocking, timeout)
         if ok:
             self._sanitizer.note_acquired(self._name)
-            self._acquired_at.t = time.monotonic()
+            state = self._acquired_at
+            depth = getattr(state, "depth", 0)
+            if depth == 0:
+                # stamp only the OUTERMOST acquire — a reentrant RLock
+                # acquire must not reset the clock, or holds spanning
+                # reentrant sections get measured from the innermost one
+                state.t = time.monotonic()
+            state.depth = depth + 1
         return ok
 
     def release(self) -> None:
-        held_for = time.monotonic() - getattr(self._acquired_at, "t",
-                                              time.monotonic())
+        state = self._acquired_at
+        depth = getattr(state, "depth", 1)
+        state.depth = depth - 1
+        # held_for only matters on the final release (the sanitizer ignores
+        # reentrant ones), measured from the outermost acquire
+        held_for = (time.monotonic() - getattr(state, "t", time.monotonic())
+                    if state.depth == 0 else 0.0)
         self._lock.release()
         self._sanitizer.note_released(self._name, held_for)
 
@@ -209,7 +234,7 @@ def _instrument_object(obj, sanitizer: LockSanitizer) -> List[Tuple[str, object]
     replaced: List[Tuple[str, object]] = []
     for attr, value in list(vars(obj).items()):
         if isinstance(value, _LOCK_TYPES):
-            name = f"{type(obj).__name__}.{attr}"
+            name = sanitizer.unique_name(f"{type(obj).__name__}.{attr}")
             setattr(obj, attr, TracedLock(value, name, sanitizer))
             replaced.append((attr, value))
     return replaced

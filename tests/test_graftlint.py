@@ -533,6 +533,29 @@ def test_g102_clean_on_consistent_order(tmp_path):
     assert findings == []
 
 
+def test_g102_multi_item_with_records_acquisition_order(tmp_path):
+    """`with A, B:` acquires B while holding A — one statement, same edge
+    as nested withs; an opposite-order path elsewhere is still a cycle."""
+    (tmp_path / "m.py").write_text(textwrap.dedent("""
+    import threading
+
+    A = threading.Lock()
+    B = threading.Lock()
+
+    def f():
+        with A, B:
+            pass
+
+    def g():
+        with B:
+            with A:
+                pass
+    """))
+    findings = lint([str(tmp_path / "m.py")], select=["G102"],
+                    root=str(tmp_path), with_project_rules=True)
+    assert [f.code for f in findings] == ["G102", "G102"]
+
+
 # -- G103: background thread without a shutdown path -----------------------
 
 def test_g103_triggers_on_fire_and_forget_and_unjoined():
@@ -653,6 +676,26 @@ def test_g105_clean_outside_lock_and_snapshot_idiom():
     assert "G105" not in _codes(src)
 
 
+def test_g105_clean_on_domain_object_result_and_wait():
+    """`.result()`/`.wait()` only count when the receiver NAME suggests a
+    synchronization object — a domain object's methods of the same name
+    (an HTTP response's .result(), a process proxy's .wait()) don't flag."""
+    src = """
+    import threading
+
+    class Svc:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def tick(self, response, fut):
+            with self._lock:
+                summary = response.result()    # domain .result(): clean
+                self.handle.wait()             # domain .wait(): clean
+                return summary, fut.result()   # future: still flagged
+    """
+    assert _codes(src).count("G105") == 1
+
+
 # -- baseline mechanics ----------------------------------------------------
 
 def test_baseline_suppresses_exact_count_and_flags_growth(tmp_path):
@@ -717,6 +760,12 @@ def test_prune_stale_scoped_to_selected_codes(tmp_path):
                                             codes={"G101"})
     assert kept == 1
     assert dropped == ["G101|cruise_control_tpu/gone.py|old()"]
+    # the rewritten FILE must still hold the out-of-scope entry: it is
+    # neither live (its rule never ran) nor dropped (codes filter)
+    after = load_baseline(str(path))
+    assert set(after) == {"G003|cruise_control_tpu/gone.py|old()"}
+    assert (after["G003|cruise_control_tpu/gone.py|old()"]["justification"]
+            == "stale but out of scope")
 
 
 def test_cli_rules_filter(capsys):

@@ -99,6 +99,42 @@ def test_long_hold_recorded_over_threshold():
     assert san.long_holds[0]["heldForS"] >= 0.01
 
 
+def test_reentrant_hold_measured_from_outermost_acquire():
+    """A reentrant RLock acquire must not reset the hold clock — the slow
+    part here runs BEFORE the inner acquire, so measuring from the inner
+    one would miss the long hold entirely."""
+    san = LockSanitizer(hold_threshold_s=0.01)
+    r = TracedLock(threading.RLock(), "r", san)
+    with r:
+        time.sleep(0.05)
+        with r:
+            pass
+    assert len(san.long_holds) == 1
+    assert san.long_holds[0]["heldForS"] >= 0.05
+
+
+def test_two_instances_of_same_class_get_distinct_lock_names():
+    """app.startup instruments two MetricSampleAggregators; their locks
+    must not share a name or cross-instance nesting reads as a reentrant
+    acquire — no edge recorded, inversions masked."""
+
+    class Agg:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+    one, two = Agg(), Agg()
+    with instrument_locks(one, two) as san:
+        with one._lock:
+            with two._lock:              # NOT reentrant: a real edge
+                pass
+        assert set(san.acquire_counts) == {"Agg._lock", "Agg._lock#2"}
+        assert ("Agg._lock", "Agg._lock#2") in san.edges
+        with two._lock:
+            with one._lock:              # cross-instance inversion detected
+                pass
+        assert len(san.inversions) == 1
+
+
 def test_instrument_locks_swaps_and_restores():
     class Obj:
         def __init__(self):

@@ -230,7 +230,10 @@ def prune_stale_baseline(findings: Sequence[Finding],
     dropped = [fp for fp in old if fp not in live
                and (codes is None or fp.split("|", 1)[0] in codes)]
     if dropped:
-        entries = [old[fp] for fp in sorted(old) if fp in live]
+        # keep everything NOT dropped — a filtered run's out-of-scope
+        # entries are neither live nor dropped and must survive the rewrite
+        dropped_set = set(dropped)
+        entries = [old[fp] for fp in sorted(old) if fp not in dropped_set]
         with open(path, "w", encoding="utf-8") as fh:
             json.dump({"version": 1, "suppressions": entries}, fh, indent=1)
             fh.write("\n")

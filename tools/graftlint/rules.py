@@ -916,13 +916,15 @@ def _map_lexical_held(fn: ast.AST, recognize, out: Dict[int, FrozenSet[str]]
                 visit(child, frozenset())
             return
         if isinstance(node, (ast.With, ast.AsyncWith)):
-            acquired = set()
+            inner = held
             for item in node.items:
-                visit(item, held)       # context exprs run before acquisition
+                # each item's context expr evaluates with the previously
+                # listed locks already held — `with a, b:` acquires b
+                # under a, exactly like nested withs
+                visit(item, inner)
                 name = recognize(item.context_expr)
                 if name:
-                    acquired.add(name)
-            inner = held | frozenset(acquired)
+                    inner = inner | frozenset((name,))
             for stmt in node.body:
                 visit(stmt, inner)
             return
@@ -1134,13 +1136,14 @@ def check_lock_order_cycles(root: str, paths) -> Iterator[Finding]:
             for n in ast.walk(fn):
                 if not isinstance(n, (ast.With, ast.AsyncWith)):
                     continue
-                held = held_map.get(id(n), frozenset())
-                if not held:
-                    continue
                 for item in n.items:
                     b = recognize(item.context_expr)
                     if b is None:
                         continue
+                    # the item's own held set includes locks from earlier
+                    # items of the same statement (`with a, b:` is an
+                    # a -> b acquisition), not just enclosing withs
+                    held = held_map.get(id(item), frozenset())
                     for a in held:
                         if a != b and (a, b) not in edges:
                             line = n.lineno
@@ -1337,18 +1340,20 @@ def check_then_act_outside_lock(ctx: ModuleContext) -> Iterator[Finding]:
 # G105 — blocking call while a lock is held
 # --------------------------------------------------------------------------
 
+#: receiver-name fragments that mark a `.result()`/`.wait()` receiver as a
+#: synchronization object; any domain object may define methods with those
+#: names (an HTTP response's .result(), say), so bare-attr matching would
+#: drown the rule in false positives
+_WAITY_RECEIVER_HINTS = ("future", "fut", "event", "thread", "task",
+                         "cond", "promise", "proc", "barrier")
+
+
 def _blocking_call(node: ast.Call) -> Optional[str]:
     f = node.func
     if not isinstance(f, ast.Attribute):
         return None
     if f.attr == "sleep" and _attr_root(f) == "time":
         return "`time.sleep`"
-    if f.attr == "result":
-        return "`.result()` on a future"
-    if f.attr == "wait":
-        return "`.wait()`"
-    if f.attr == "get" and any(kw.arg == "timeout" for kw in node.keywords):
-        return "`.get(timeout=...)`"
     parts = []
     cur: ast.AST = f.value
     while isinstance(cur, ast.Attribute):
@@ -1356,6 +1361,12 @@ def _blocking_call(node: ast.Call) -> Optional[str]:
         cur = cur.value
     if isinstance(cur, ast.Name):
         parts.append(cur.id)
+    if f.attr in ("result", "wait") and any(
+            h in p.lower() for p in parts for h in _WAITY_RECEIVER_HINTS):
+        return ("`.result()` on a future" if f.attr == "result"
+                else "`.wait()`")
+    if f.attr == "get" and any(kw.arg == "timeout" for kw in node.keywords):
+        return "`.get(timeout=...)`"
     if any("adapter" in p.lower() for p in parts):
         return f"adapter RPC `.{f.attr}()`"
     return None
