@@ -45,8 +45,14 @@ from cruise_control_tpu.analyzer import goals as G
 from cruise_control_tpu.analyzer import objective as OBJ
 from cruise_control_tpu.common import resources as res
 from cruise_control_tpu.common import sentinels as SENT
-from cruise_control_tpu.models.cluster import Assignment
-from cruise_control_tpu.ops.aggregates import DeviceTopology, compute_aggregates
+from cruise_control_tpu.models.cluster import (Assignment,
+                                               BROKER_BUCKET_FLOOR,
+                                               REPLICA_BUCKET_FLOOR,
+                                               bucket_size)
+from cruise_control_tpu.ops.aggregates import (DeviceTopology,
+                                               compute_aggregates,
+                                               leader_count_weights,
+                                               replica_count_weights)
 
 _INF = jnp.float32(3.0e38)
 
@@ -440,11 +446,30 @@ def _apply_leads(dt: DeviceTopology, st: ChainState, p_vec, new_leader_vec
 
 def make_step_fn(dt: DeviceTopology, th, weights, opts, cfg: AnnealConfig,
                  movable_idx, dest_idx, initial_broker_of, topic_mode: str,
-                 topic_reps=None):
-    """Build the per-chain annealer step (module-level for profiling/tests)."""
+                 topic_reps=None, n_movable=None, n_dest=None):
+    """Build the per-chain annealer step (module-level for profiling/tests).
+
+    ``n_movable`` / ``n_dest``: traced scalar sampling bounds over the
+    real prefix of bucket-padded candidate pools. None (the unpadded path)
+    keeps the historical static ``.size`` bounds; a bucketed run passes the
+    real pool sizes so pool drift within a bucket changes only these scalar
+    *values* — no retrace — while ``jax.random.randint`` draws stay
+    identical to an unpadded run's (equal bound values ⇒ equal draws, the
+    padded == unpadded proposal contract)."""
     R, P, B = dt.num_replicas, dt.num_partitions, dt.num_brokers
     Km, Kl, Ks = cfg.tries_move, cfg.tries_lead, cfg.tries_swap
     m = dt.max_rf
+    if n_movable is None:
+        n_movable = movable_idx.size
+    if n_dest is None:
+        n_dest = dest_idx.size
+    # real partition count: padded partitions must never be sampled (their
+    # sentinel replicas are immovable anyway, but the RNG stream has to
+    # match the unpadded run draw for draw)
+    if dt.partition_weight is not None:
+        n_parts = jnp.sum(dt.partition_weight)
+    else:
+        n_parts = P
     if topic_reps is None:
         topic_reps = jax.device_put(np.full((1, 1), -1, np.int32))
     use_topic = topic_mode == "dense"   # maintained-histogram updates
@@ -459,12 +484,12 @@ def make_step_fn(dt: DeviceTopology, th, weights, opts, cfg: AnnealConfig,
         ks = jax.random.split(key, 11)
         # --- candidate replica moves: two-choice biased source (hotter
         # broker) and destination (colder broker)
-        r1 = movable_idx[jax.random.randint(ks[0], (Km,), 0, movable_idx.size)]
-        r2 = movable_idx[jax.random.randint(ks[1], (Km,), 0, movable_idx.size)]
+        r1 = movable_idx[jax.random.randint(ks[0], (Km,), 0, n_movable)]
+        r2 = movable_idx[jax.random.randint(ks[1], (Km,), 0, n_movable)]
         hot = _pressure(st, st.broker_of[r1]) >= _pressure(st, st.broker_of[r2])
         r_c = jnp.where(hot, r1, r2)
-        b1 = dest_idx[jax.random.randint(ks[2], (Km,), 0, dest_idx.size)]
-        b2 = dest_idx[jax.random.randint(ks[3], (Km,), 0, dest_idx.size)]
+        b1 = dest_idx[jax.random.randint(ks[2], (Km,), 0, n_dest)]
+        b2 = dest_idx[jax.random.randint(ks[3], (Km,), 0, n_dest)]
         cold = _pressure(st, b1) <= _pressure(st, b2)
         b_c = jnp.where(cold, b1, b2)
         d_move = jax.vmap(
@@ -473,19 +498,19 @@ def make_step_fn(dt: DeviceTopology, th, weights, opts, cfg: AnnealConfig,
                                      topic_reps, r, b)
         )(r_c, b_c)
         # --- candidate leadership moves
-        p_c = jax.random.randint(ks[4], (Kl,), 0, P)
+        p_c = jax.random.randint(ks[4], (Kl,), 0, n_parts)
         s_c = jax.random.randint(ks[5], (Kl,), 0, m)
         d_lead = jax.vmap(
             lambda p, s: _lead_delta(dt, th, weights, opts, st, p, s)
         )(p_c, s_c)
 
         # --- candidate swaps: hot-biased r1, cold-biased r2
-        w1 = movable_idx[jax.random.randint(ks[7], (Ks,), 0, movable_idx.size)]
-        w2 = movable_idx[jax.random.randint(ks[8], (Ks,), 0, movable_idx.size)]
+        w1 = movable_idx[jax.random.randint(ks[7], (Ks,), 0, n_movable)]
+        w2 = movable_idx[jax.random.randint(ks[8], (Ks,), 0, n_movable)]
         hot_w = _pressure(st, st.broker_of[w1]) >= _pressure(st, st.broker_of[w2])
         s_r1 = jnp.where(hot_w, w1, w2)
-        w3 = movable_idx[jax.random.randint(ks[9], (Ks,), 0, movable_idx.size)]
-        w4 = movable_idx[jax.random.randint(ks[10], (Ks,), 0, movable_idx.size)]
+        w3 = movable_idx[jax.random.randint(ks[9], (Ks,), 0, n_movable)]
+        w4 = movable_idx[jax.random.randint(ks[10], (Ks,), 0, n_movable)]
         cold_w = _pressure(st, st.broker_of[w3]) <= _pressure(st, st.broker_of[w4])
         s_r2 = jnp.where(cold_w, w3, w4)
         d_swap = jax.vmap(
@@ -638,7 +663,11 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
     # topic term: dense maintained histogram when it fits; beyond the dense
     # limit the default hands TopicReplicaDistributionGoal to the optimizer's
     # targeted repair pass (analyzer/repair.py); cfg.topic_mode = "sparse"
-    # forces exact in-step CSR counts at any scale instead.
+    # forces exact in-step CSR counts at any scale instead. Mode routing
+    # uses the REAL broker count on bucketed models so a padded and an
+    # unpadded run of the same cluster pick the same mode near the limit.
+    B_eff = (int(np.asarray(jax.device_get(dt.broker_present)).sum())
+             if dt.broker_present is not None else B)
     topic_on = "TopicReplicaDistributionGoal" in tuple(goal_names)
     if cfg.topic_mode not in (None, "dense", "sparse", "off"):
         raise ValueError(f"invalid topic_mode {cfg.topic_mode!r}: "
@@ -647,7 +676,7 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
         topic_mode = "off"
     elif cfg.topic_mode is not None:
         topic_mode = cfg.topic_mode
-    elif B * num_topics <= cfg.topic_term_limit:
+    elif B_eff * num_topics <= cfg.topic_term_limit:
         topic_mode = "dense"
     else:
         topic_mode = "off"
@@ -676,10 +705,24 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
     # optimization still runs.
     movable_np = np.flatnonzero(np.asarray(jax.device_get(opts.replica_movable)))
     dest_np = np.flatnonzero(np.asarray(jax.device_get(opts.move_dest_ok)))
-    movable_idx = jax.device_put(np.asarray(
-        movable_np if movable_np.size else np.array([0]), np.int32))
-    dest_idx = jax.device_put(np.asarray(
-        dest_np if dest_np.size else np.array([0]), np.int32))
+    movable_src = movable_np if movable_np.size else np.array([0], np.int64)
+    dest_src = dest_np if dest_np.size else np.array([0], np.int64)
+    n_mov_dev = n_dst_dev = None
+    if dt.replica_weight is not None:
+        # bucketed model: bucket the candidate pools too (a pool-size drift
+        # would otherwise retrace the whole PT scan) and sample over the
+        # real prefix with traced bounds. The zero fill is never drawn.
+        def _padpool(a, floor):
+            out = np.zeros(bucket_size(a.size, floor), a.dtype)
+            out[:a.size] = a
+            return out
+        movable_src = _padpool(movable_src, REPLICA_BUCKET_FLOOR)
+        dest_src = _padpool(dest_src, BROKER_BUCKET_FLOOR)
+        # bounds are device scalars (put *before* the transfer guard)
+        n_mov_dev = jax.device_put(np.int32(max(movable_np.size, 1)))
+        n_dst_dev = jax.device_put(np.int32(max(dest_np.size, 1)))
+    movable_idx = jax.device_put(np.asarray(movable_src, np.int32))
+    dest_idx = jax.device_put(np.asarray(dest_src, np.int32))
 
     # when the topic term is off, skip building the (potentially huge) dense
     # [B, T] histogram — pass a 1-topic axis instead
@@ -712,10 +755,16 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
     # steady-state dispatch: every argument is a device array (or hashed
     # static), so any implicit transfer inside this call is a hazard the
     # sentinel should catch, not tolerate (see common/sentinels.py)
+    # CPU XLA rejects donation per-buffer (with a warning each); everywhere
+    # else the broadcast seed state is donated — it is a fresh buffer no
+    # caller reuses, and donating halves the chain-state HBM footprint.
+    run_pt = _run_pt if jax.default_backend() == "cpu" else _run_pt_donated
     with SENT.no_implicit_transfers():
-        chains, temps = _run_pt(chains, temps0, keys, dt, th, weights, opts,
-                                movable_idx, dest_idx, initial_broker_of,
-                                topic_reps, cfg, topic_mode, n_rounds)
+        chains, temps = run_pt(chains, temps0, keys, dt, th, weights, opts,
+                               movable_idx, dest_idx, initial_broker_of,
+                               topic_reps, cfg, topic_mode, n_rounds,
+                               n_movable=n_mov_dev, n_dest=n_dst_dev)
+    chain_rows = None
     if mesh is not None and topic_mode in ("dense", "off"):
         # replica-sharded exact rescore (parallel/sharding.py): the per-chain
         # O(R) gathers and segment-sums run on replica shards with one psum,
@@ -727,8 +776,16 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
             initial_broker_of, use_topic=use_topic,
             topic_count=chains.topic_count if use_topic else None)
     else:
-        energies = _rescore_chains(chains, dt, th, weights, initial_broker_of,
-                                   topic_mode, num_topics)       # f32[C, 2]
+        # the donating variant frees the post-run chain state (loads,
+        # counts, histogram) and passes only the assignment rows through;
+        # the mesh path keeps the undonated program (parity contract).
+        rescore = (_rescore_chains_donated
+                   if mesh is None and jax.default_backend() != "cpu"
+                   else _rescore_chains)
+        energies, bo_all, lo_all = rescore(
+            chains, dt, th, weights, initial_broker_of,
+            topic_mode, num_topics)                              # f32[C, 2]
+        chain_rows = (bo_all, lo_all)
     # lexicographic best chain, combined in f64 on host — the f32 combined
     # scalar would absorb the cost channel under any hard violation
     e2 = np.asarray(jax.device_get(energies), np.float64)
@@ -738,7 +795,11 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec
         out_s = NamedSharding(mesh, PartitionSpec())
-    best_bo, best_lo = _take_chain(chains, best, out_s=out_s)
+    if chain_rows is None:
+        best_bo, best_lo = _take_chain(chains, best, out_s=out_s)
+    else:
+        best_bo, best_lo = _take_chain_rows(chain_rows[0], chain_rows[1],
+                                            best, out_s=out_s)
     return AnnealResult(
         assignment=Assignment(broker_of=best_bo, leader_of=best_lo),
         energy=jnp.float32(comb[best]),
@@ -752,10 +813,10 @@ _chain_energy_jit = jax.jit(_chain_energy,
                             static_argnames=("topic_mode", "num_topics"))
 
 
-@_partial(jax.jit, static_argnames=("cfg", "topic_mode", "n_rounds"))
-def _run_pt(chains, temps, keys, dt, th, weights, opts, movable_idx,
-            dest_idx, initial_broker_of, topic_reps, cfg: AnnealConfig,
-            topic_mode: str, n_rounds: int):
+def _run_pt_impl(chains, temps, keys, dt, th, weights, opts, movable_idx,
+                 dest_idx, initial_broker_of, topic_reps, cfg: AnnealConfig,
+                 topic_mode: str, n_rounds: int,
+                 n_movable=None, n_dest=None):
     """The whole parallel-tempering run as ONE module-level jit.
 
     Module-level matters: a jit wrapper created inside ``optimize_anneal``
@@ -765,10 +826,17 @@ def _run_pt(chains, temps, keys, dt, th, weights, opts, movable_idx,
     ~50× the actual device time of the annealing steps). Keyed here by the
     (hashable, frozen) AnnealConfig + shapes, repeat calls are pure cache
     hits and pay device time only.
+
+    Jitted twice below: ``_run_pt`` (no donation — CPU, where XLA rejects
+    donation with a warning per buffer) and ``_run_pt_donated`` (chain
+    state donated, argnum 0) so warm ticks don't hold two copies of the
+    500k-replica chain state in HBM. The input ``chains`` is always a
+    fresh ``_broadcast_chains`` output, never reused by the caller.
     """
     C = temps.shape[0]
     step = make_step_fn(dt, th, weights, opts, cfg, movable_idx, dest_idx,
-                        initial_broker_of, topic_mode, topic_reps)
+                        initial_broker_of, topic_mode, topic_reps,
+                        n_movable=n_movable, n_dest=n_dest)
 
     def chain_round(st: ChainState, temp, key):
         ks = jax.random.split(key, cfg.swap_interval)
@@ -810,14 +878,27 @@ def _run_pt(chains, temps, keys, dt, th, weights, opts, movable_idx,
     return chains, temps
 
 
-@_partial(jax.jit, static_argnames=("topic_mode", "num_topics"))
-def _rescore_chains(chains, dt, th, weights, initial_broker_of,
-                    topic_mode: str, num_topics: int = 1):
+_RUN_PT_STATICS = ("cfg", "topic_mode", "n_rounds")
+_run_pt = _partial(jax.jit, static_argnames=_RUN_PT_STATICS)(_run_pt_impl)
+_run_pt_donated = _partial(jax.jit, static_argnames=_RUN_PT_STATICS,
+                           donate_argnums=(0,))(_run_pt_impl)
+
+
+def _rescore_chains_impl(chains, dt, th, weights, initial_broker_of,
+                         topic_mode: str, num_topics: int = 1):
     """Exact per-chain rescore: recomputed load aggregates (immune to
     incremental float drift) plus the *maintained* topic counts — integer
     scatter-adds, hence already exact. Rebuilding the dense [B, T]
-    histogram per chain here would cost more than the whole anneal."""
+    histogram per chain here would cost more than the whole anneal.
+
+    Returns ``(energies, broker_of, leader_of)``: passing the assignment
+    rows through as outputs lets the donating variant free every *other*
+    chain-state buffer (loads, counts, histogram) while the caller can
+    still slice out the winning chain — with plain donation the caller's
+    later ``chains.broker_of[best]`` would read a deleted buffer."""
     R, P, B = dt.num_replicas, dt.num_partitions, dt.num_brokers
+    ones = replica_count_weights(dt).astype(jnp.float32)
+    lead_ones = leader_count_weights(dt).astype(jnp.float32)
 
     def rescore(st: ChainState):
         eff = (dt.replica_base_load
@@ -827,7 +908,6 @@ def _rescore_chains(chains, dt, th, weights, initial_broker_of,
         broker_load = jax.ops.segment_sum(eff, st.broker_of, num_segments=B)
         host_load = jax.ops.segment_sum(broker_load, dt.host_of_broker,
                                         num_segments=dt.num_hosts)
-        ones = jnp.ones((R,), jnp.float32)
         leader_broker = st.broker_of[st.leader_of]
         pl = (dt.leader_extra[:, res.NW_OUT]
               + dt.replica_base_load[st.leader_of, res.NW_OUT])
@@ -835,7 +915,7 @@ def _rescore_chains(chains, dt, th, weights, initial_broker_of,
             broker_load=broker_load,
             host_load=host_load,
             replica_count=jax.ops.segment_sum(ones, st.broker_of, num_segments=B),
-            leader_count=jax.ops.segment_sum(jnp.ones((P,), jnp.float32),
+            leader_count=jax.ops.segment_sum(lead_ones,
                                              leader_broker, num_segments=B),
             potential_nw_out=jax.ops.segment_sum(
                 pl[dt.partition_of_replica], st.broker_of, num_segments=B),
@@ -845,4 +925,22 @@ def _rescore_chains(chains, dt, th, weights, initial_broker_of,
         return _chain_energy(dt, th, weights, st2, initial_broker_of,
                              topic_mode, num_topics)
 
-    return jax.vmap(rescore)(chains)
+    return (jax.vmap(rescore)(chains), chains.broker_of, chains.leader_of)
+
+
+_RESCORE_STATICS = ("topic_mode", "num_topics")
+_rescore_chains = _partial(jax.jit,
+                           static_argnames=_RESCORE_STATICS)(_rescore_chains_impl)
+_rescore_chains_donated = _partial(jax.jit, static_argnames=_RESCORE_STATICS,
+                                   donate_argnums=(0,))(_rescore_chains_impl)
+
+
+@_partial(jax.jit, static_argnames=("out_s",))
+def _take_chain_rows(broker_of, leader_of, best, out_s=None):
+    """`_take_chain` over the rescore's passed-through assignment rows —
+    used when the chain state itself was donated away by the rescore."""
+    bo, lo = broker_of[best], leader_of[best]
+    if out_s is not None:
+        bo = jax.lax.with_sharding_constraint(bo, out_s)
+        lo = jax.lax.with_sharding_constraint(lo, out_s)
+    return bo, lo

@@ -223,6 +223,29 @@ def default_options(topo: ClusterTopology) -> DeviceOptions:
     return build_options(topo)
 
 
+def pad_options(opts: DeviceOptions, num_replicas: int,
+                num_brokers: int) -> DeviceOptions:
+    """Pad the option masks to bucketed axis sizes (models.cluster.
+    pad_topology): padded replicas are immovable in both channels and padded
+    brokers can never receive replicas or leadership — the masks are the
+    enforcement vehicle that keeps sentinel entries frozen."""
+    def _pad(x, n):
+        # pad on host: a device-side concatenate would trace+compile per
+        # distinct REAL size, defeating the bucketing scheme's whole point
+        # (one compiled program per bucket); device_put does not trace
+        x = np.asarray(jax.device_get(x))
+        k = n - x.shape[0]
+        if k:
+            x = np.concatenate([x, np.zeros((k,), x.dtype)])
+        return jnp.asarray(x)
+    return DeviceOptions(
+        replica_movable=_pad(opts.replica_movable, num_replicas),
+        leadership_movable=_pad(opts.leadership_movable, num_replicas),
+        move_dest_ok=_pad(opts.move_dest_ok, num_brokers),
+        leader_dest_ok=_pad(opts.leader_dest_ok, num_brokers),
+    )
+
+
 # ---------------------------------------------------------------------------
 # Thresholds: every constant of the optimization, computed once.
 # ---------------------------------------------------------------------------
@@ -286,7 +309,12 @@ def compute_thresholds(dt: DeviceTopology, constraint: BalancingConstraint,
     low_util = avg_pct < jnp.asarray(constraint.low_utilization_threshold_array())
 
     n_replicas = jnp.sum(initial.replica_count).astype(jnp.float32)
-    n_parts = jnp.float32(dt.num_partitions)
+    # bucketed models: the partition axis is padded, so the leader-count
+    # average must come from the real-partition weight sum, not the shape
+    if dt.partition_weight is not None:
+        n_parts = jnp.sum(dt.partition_weight).astype(jnp.float32)
+    else:
+        n_parts = jnp.float32(dt.num_partitions)
     rep_avg = n_replicas / n_alive
     led_avg = n_parts / n_alive
     rp = jnp.float32(constraint.replica_balance_percentage)

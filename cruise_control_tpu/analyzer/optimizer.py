@@ -37,7 +37,9 @@ from cruise_control_tpu.analyzer import greedy as GR
 from cruise_control_tpu.analyzer import objective as OBJ
 from cruise_control_tpu.analyzer import proposals as PR
 from cruise_control_tpu.common.resources import BalancingConstraint
-from cruise_control_tpu.models.cluster import Assignment, ClusterTopology
+from cruise_control_tpu.models.cluster import (Assignment, ClusterTopology,
+                                               PaddingInfo, pad_topology,
+                                               unpad_assignment)
 from cruise_control_tpu.ops.aggregates import compute_aggregates, device_topology
 from cruise_control_tpu.ops.stats import compute_cluster_stats
 
@@ -75,6 +77,37 @@ def routes_to_anneal(topo, engine: str = "auto") -> bool:
 #: B·T above which the dense [B, T] topic histogram is replaced by the
 #: sort-based sparse topic penalty (matches AnnealConfig.topic_term_limit)
 TOPIC_DENSE_LIMIT = 2_000_000
+
+
+def engages_bucketing(topo, engine: str = "auto", mesh=None,
+                      bucketing: Optional[bool] = None) -> bool:
+    """Single source of truth for shape bucketing: does this optimize()
+    call pad the model to bucket shapes (models.cluster.pad_topology)?
+
+    Auto policy (``bucketing=None``): the anneal-scale regime with no mesh
+    — exactly where cluster drift retracing the PT scan costs tens of
+    seconds per tick. Small models (and the explicit greedy engine) keep
+    their historical exact shapes; an already-padded topology is never
+    re-padded. ``bucketing=True``/``False`` forces either way (True on an
+    already-padded model is still a no-op). warm_kernels and optimize()
+    both route through here so warmed shapes always match dispatched ones.
+    """
+    if getattr(topo, "broker_present", None) is not None:
+        return False    # already bucket-padded
+    if bucketing is not None:
+        return bucketing
+    return (mesh is None and engine != "greedy"
+            and topo.num_replicas * topo.num_brokers > GREEDY_LIMIT)
+
+
+def _bucket_model(topo, assign, options):
+    """Pad (topo, assign, options) to bucket shapes. Options are built at
+    the real shapes first (default_options on a padded topology would mark
+    the sentinel replicas movable) and then mask-padded."""
+    opts = options if options is not None else G.default_options(topo)
+    topo_p, assign_p, info = pad_topology(topo, assign)
+    opts_p = G.pad_options(opts, topo_p.num_replicas, topo_p.num_brokers)
+    return topo_p, assign_p, opts_p, info
 
 #: balancedness defaults (KafkaCruiseControlConfig goal.balancedness.*);
 #: the service threads its configured values through
@@ -299,7 +332,12 @@ def _setup_model(topo, assign, goal_names, constraint, options, mesh):
     opts = options if options is not None else G.default_options(topo)
     dt = device_topology(topo)
     num_topics = topo.num_topics
-    sparse_topic = topo.num_brokers * num_topics > TOPIC_DENSE_LIMIT
+    # route on the REAL broker count: a bucketed and an unbucketed run of
+    # the same cluster must pick the same topic-scoring path
+    n_real_brokers = (int(np.asarray(topo.broker_present).sum())
+                      if getattr(topo, "broker_present", None) is not None
+                      else topo.num_brokers)
+    sparse_topic = n_real_brokers * num_topics > TOPIC_DENSE_LIMIT
     # device_put, not jnp.asarray: a dtype-converting asarray is its own
     # tiny compiled program (cold-start cache-load tax over the tunnel)
     init_broker = jax.device_put(
@@ -348,7 +386,8 @@ def warm_kernels(topo: ClusterTopology, assign: Assignment,
                  goal_names: Optional[Sequence[str]] = None,
                  constraint: Optional[BalancingConstraint] = None,
                  options=None, repair_config=None, mesh=None,
-                 anneal_config=None) -> None:
+                 anneal_config=None,
+                 bucketing: Optional[bool] = None) -> None:
     """Warm the rarely-engaged escape kernels at this model's shapes.
 
     ``optimize()`` warms its own common path on the first call, but the
@@ -370,6 +409,12 @@ def warm_kernels(topo: ClusterTopology, assign: Assignment,
         return
     from cruise_control_tpu.analyzer import repair as REP
     goal_names = tuple(goal_names or G.DEFAULT_GOALS)
+    # mirror optimize()'s bucketing decision so the warmed shapes are the
+    # shapes the serving calls will actually dispatch (the escape kernels
+    # and polish anneal are anneal-path programs, so resolve as anneal)
+    eng = "anneal" if routes_to_anneal(topo, "auto") else "greedy"
+    if engages_bucketing(topo, eng, mesh, bucketing):
+        topo, assign, options, _ = _bucket_model(topo, assign, options)
     (_, opts, dt, num_topics, _, init_broker, _, _, th,
      weights) = _setup_model(topo, assign, goal_names, constraint, options,
                              mesh)
@@ -405,14 +450,19 @@ def optimize(topo: ClusterTopology, assign: Assignment,
              seed: int = 0,
              mesh: Optional["jax.sharding.Mesh"] = None,
              repair_config=None, polish_cycles: int = 2,
-             balancedness_weights=None) -> OptimizerResult:
+             balancedness_weights=None,
+             bucketing: Optional[bool] = None) -> OptimizerResult:
     """Full optimization pass. ``engine``: auto | greedy | anneal.
     ``repair_config``: RepairConfig override for the MAIN repair pass (the
     hard-violation backstop always runs with its own defaults).
     ``polish_cycles``: max anneal-restart+repair cycles when violations
     remain after the main repair (0 disables).
     ``balancedness_weights``: (priority, strictness) for the reported
-    balancedness scores (goal.balancedness.* config)."""
+    balancedness scores (goal.balancedness.* config).
+    ``bucketing``: pad the model to geometric bucket shapes so cluster
+    drift reuses compiled programs (see engages_bucketing for the None =
+    auto policy). Proposals are identical either way — the padded ==
+    unpadded contract of tests/test_bucketing.py."""
     if _routes_to_tiny_cpu(topo, mesh, options):
         try:
             cpu0 = jax.devices("cpu")[0]
@@ -423,15 +473,16 @@ def optimize(topo: ClusterTopology, assign: Assignment,
                 return _optimize_impl(topo, assign, goal_names, constraint,
                                       options, engine, anneal_config, seed,
                                       mesh, repair_config, polish_cycles,
-                                      balancedness_weights)
+                                      balancedness_weights, bucketing)
     return _optimize_impl(topo, assign, goal_names, constraint, options,
                           engine, anneal_config, seed, mesh, repair_config,
-                          polish_cycles, balancedness_weights)
+                          polish_cycles, balancedness_weights, bucketing)
 
 
 def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
                    anneal_config, seed, mesh, repair_config,
-                   polish_cycles, balancedness_weights=None
+                   polish_cycles, balancedness_weights=None,
+                   bucketing: Optional[bool] = None
                    ) -> OptimizerResult:
     from cruise_control_tpu.analyzer import annealer as AN  # cycle-free import
 
@@ -449,9 +500,27 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
             _tp[0] = now
 
     goal_names = tuple(goal_names)
+    # engine routing resolves FIRST (on the real topology) so bucketing can
+    # see the resolved engine — greedy never engages bucketing under auto
+    if engine == "auto":
+        engine = "anneal" if routes_to_anneal(topo, engine) else "greedy"
+    if engine not in ("anneal", "greedy"):
+        raise ValueError(f"unknown engine {engine!r}")
+    # shape bucketing: pad the model once, run the WHOLE pipeline (evals,
+    # stats, engines, repair) at bucket shapes — proposals are identical
+    # (the padded == unpadded contract) and cluster drift within a bucket
+    # reuses every compiled program. ``topo``/``orig_assign`` stay real for
+    # routing thresholds, the sequential oracle, and proposal decode.
+    orig_assign = assign
+    pad_info: Optional[PaddingInfo] = None
+    topo_model = topo
+    if engages_bucketing(topo, engine, mesh, bucketing):
+        topo_model, assign, options, pad_info = _bucket_model(topo, assign,
+                                                              options)
+        _mark("bucket pad")
     (constraint, opts, dt, num_topics, sparse_topic, init_broker, _agg,
-     agg0, th, weights) = _setup_model(topo, assign, goal_names, constraint,
-                                       options, mesh)
+     agg0, th, weights) = _setup_model(topo_model, assign, goal_names,
+                                       constraint, options, mesh)
     _mark("setup")
     before = OBJ.evaluate_objective(dt, assign, th, weights, goal_names,
                                     num_topics, init_broker, agg0,
@@ -460,10 +529,6 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
                                sparse_topic=sparse_topic, agg=agg0)
 
     _mark("eval+stats before")
-    if engine == "auto":
-        engine = "anneal" if routes_to_anneal(topo, engine) else "greedy"
-    if engine not in ("anneal", "greedy"):
-        raise ValueError(f"unknown engine {engine!r}")
     report_progress(f"Optimizing goals with the {engine} engine")
 
     from cruise_control_tpu.common import faults as FLT
@@ -515,14 +580,26 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
             # last rung: the host-side sequential oracle — no stochastic
             # search, no accelerator dependency in the optimization itself
             from cruise_control_tpu.analyzer import sequential as SEQ
-            sres = SEQ.optimize_sequential(
-                topo,
-                np.asarray(jax.device_get(assign.broker_of), np.int32),
-                np.asarray(jax.device_get(assign.leader_of), np.int32),
-                goal_names=goal_names, constraint=constraint)
-            final = Assignment(
-                broker_of=jnp.asarray(sres.broker_of, jnp.int32),
-                leader_of=jnp.asarray(sres.leader_of, jnp.int32))
+            bo_np = np.asarray(jax.device_get(assign.broker_of), np.int32)
+            lo_np = np.asarray(jax.device_get(assign.leader_of), np.int32)
+            if pad_info is not None:
+                # the oracle walks the REAL model; splice its result back
+                # into the padded tail so downstream evals keep bucket shapes
+                sres = SEQ.optimize_sequential(
+                    topo, bo_np[:pad_info.num_replicas].copy(),
+                    lo_np[:pad_info.num_partitions].copy(),
+                    goal_names=goal_names, constraint=constraint)
+                bo_np[:pad_info.num_replicas] = sres.broker_of
+                lo_np[:pad_info.num_partitions] = sres.leader_of
+                final = Assignment(broker_of=jnp.asarray(bo_np, jnp.int32),
+                                   leader_of=jnp.asarray(lo_np, jnp.int32))
+            else:
+                sres = SEQ.optimize_sequential(topo, bo_np, lo_np,
+                                               goal_names=goal_names,
+                                               constraint=constraint)
+                final = Assignment(
+                    broker_of=jnp.asarray(sres.broker_of, jnp.int32),
+                    leader_of=jnp.asarray(sres.leader_of, jnp.int32))
             _mark("sequential fallback")
 
         # the after-eval passes a precomputed agg JUST LIKE the before-eval:
@@ -602,7 +679,8 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
                 healing_ctx = (bool((~np.asarray(topo.broker_alive)).any())
                                or bool(np.asarray(topo.replica_offline).any())
                                or not bool(np.array_equal(
-                                   np.asarray(jax.device_get(opts.move_dest_ok)),
+                                   np.asarray(jax.device_get(
+                                       opts.move_dest_ok))[:topo.num_brokers],
                                    np.asarray(topo.broker_alive))))
                 if (polish_cycles > 0 and not healing_ctx
                         and float(np.asarray(
@@ -714,10 +792,15 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
                               sparse_topic=sparse_topic, agg=agg_after)
     _mark("eval+stats after")
     report_progress("Decoding execution proposals")
+    # decode at REAL shapes: padded sentinel rows never move (immovable +
+    # zero weight), so slicing them off cannot drop a proposal
+    final_real = (unpad_assignment(final, pad_info) if pad_info is not None
+                  else final)
     # movement counts derived from the proposal diff so both engines report
     # the same thing the executor will do; the vectorized stats avoid the
     # ~150K per-proposal set-differences of the property accessors
-    props, n_moves, n_lead, data_to_move = PR.diff(topo, assign, final,
+    props, n_moves, n_lead, data_to_move = PR.diff(topo, orig_assign,
+                                                   final_real,
                                                    with_stats=True)
 
     _mark("proposal diff")
@@ -757,7 +840,7 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
         wall_time_s=time.time() - t0,
         # from the result arrays, not jax.default_backend() — the latter
         # ignores an active jax.default_device(...) context
-        device=next(iter(jnp.asarray(final.broker_of).devices())).platform,
-        final_assignment=final,
+        device=next(iter(jnp.asarray(final_real.broker_of).devices())).platform,
+        final_assignment=final_real,
         fallback_reason=fallback_reason,
     )

@@ -43,7 +43,9 @@ import numpy as np
 from cruise_control_tpu.analyzer import annealer as AN
 from cruise_control_tpu.analyzer import goals as G
 from cruise_control_tpu.analyzer import objective as OBJ
-from cruise_control_tpu.models.cluster import Assignment
+from cruise_control_tpu.models.cluster import (Assignment,
+                                               REPLICA_BUCKET_FLOOR,
+                                               bucket_size)
 from cruise_control_tpu.ops.aggregates import DeviceTopology, compute_aggregates
 
 _INF = float(np.float32(3.0e38))
@@ -1034,7 +1036,17 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
     movable_pool = np.flatnonzero(movable_np)
     if movable_pool.size == 0:
         return assign, 0, 0
-    movable_pool_dev = jax.device_put(np.asarray(movable_pool, np.int32))
+    # bucket the swap-partner pool: its length is a static shape in
+    # _fused_targeted (the randint bound at the swap sampling site), so an
+    # unbucketed pool retraces the whole fused program every time a replica
+    # is added/removed. Fill = pool[0], a real movable replica — every padded
+    # slot stays a valid candidate (slightly oversampled), and a padded and
+    # an unpadded model run see byte-identical pools, keeping their repair
+    # draws identical (the padded == unpadded proposal contract).
+    pool_padded = np.full(bucket_size(movable_pool.size, REPLICA_BUCKET_FLOOR),
+                          movable_pool[0], np.int32)
+    pool_padded[:movable_pool.size] = movable_pool
+    movable_pool_dev = jax.device_put(pool_padded)
     movable_dev = jax.device_put(movable_np)
     offline_dev = jax.device_put(offline_np)
     base_key = jax.random.PRNGKey(seed)

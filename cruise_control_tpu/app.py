@@ -409,6 +409,12 @@ class CruiseControlApp:
             tries_lead=self.config.get("anneal.tries.lead"),
             tries_swap=self.config.get("anneal.tries.swap"))
 
+    def _bucketing(self) -> Optional[bool]:
+        """optimizer.bucketing config -> optimize()'s tri-state flag
+        (None = the engages_bucketing auto policy)."""
+        mode = str(self.config.get("optimizer.bucketing") or "auto").lower()
+        return None if mode == "auto" else mode in ("on", "true", "1")
+
     def _optimize(self, topo: ClusterTopology, assign: Assignment,
                   goal_names: Optional[Sequence[str]] = None,
                   options: Optional[G.DeviceOptions] = None,
@@ -421,7 +427,8 @@ class CruiseControlApp:
             engine=self.config.get("optimizer.engine"),
             anneal_config=self._anneal_config(),
             balancedness_weights=self._balancedness_weights,
-            mesh=self.mesh)
+            mesh=self.mesh,
+            bucketing=self._bucketing())
         if res.fallback_reason:
             # degraded mode: remember the most recent fallback for /state
             # (read by the REST thread, so it shares the cache lock)
@@ -655,7 +662,8 @@ class CruiseControlApp:
                                      anneal_config=(self._anneal_config()
                                                     if routes_anneal
                                                     else None),
-                                     mesh=self.mesh)
+                                     mesh=self.mesh,
+                                     bucketing=self._bucketing())
                 except Exception:
                     logger.warning("escape-kernel warm failed",
                                    exc_info=True)

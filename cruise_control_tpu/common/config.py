@@ -288,6 +288,13 @@ def _service_config_def() -> ConfigDef:
              "Proposal precompute workers.", at_least(0))
     d.define("optimizer.engine", T.STRING, "auto", I.HIGH,
              "auto | greedy | anneal")
+    d.define("optimizer.bucketing", T.STRING, "auto", I.MEDIUM,
+             "Shape-bucketed model padding: auto | on | off. Padding the "
+             "broker/partition axes to geometric bucket sizes lets cluster "
+             "drift within a bucket reuse compiled programs (no retrace); "
+             "proposals are identical either way. auto engages it for "
+             "large single-device anneal runs (see "
+             "analyzer.optimizer.engages_bucketing).")
     d.define("anneal.num.chains", T.INT, 32, I.MEDIUM,
              "Parallel-tempering chains.", at_least(1))
     d.define("anneal.steps", T.INT, 2048, I.MEDIUM, "Annealer steps.",

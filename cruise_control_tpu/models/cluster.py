@@ -112,6 +112,12 @@ class ClusterTopology:
     disk_capacity: Optional[np.ndarray] = None    # f32[D]
     disk_alive: Optional[np.ndarray] = None       # bool[D]
     disk_names: tuple = ()                        # logdir paths, D entries
+    # --- shape-bucketing sentinels (pad_topology): None on unpadded models.
+    # Padded entries are weight-0 / present=False and must never contribute
+    # to a count, total, or goal term (ops.aggregates masks on these).
+    replica_weight: Optional[np.ndarray] = None    # i32[R] 1=real
+    partition_weight: Optional[np.ndarray] = None  # i32[P] 1=real
+    broker_present: Optional[np.ndarray] = None    # bool[B] False=padding
 
     @property
     def has_disks(self) -> bool:
@@ -613,3 +619,178 @@ class ClusterModelBuilder:
         )
         assignment = initial_assignment(topo, np.asarray(broker_of, dtype=np.int32))
         return topo, assignment
+
+
+# ---------------------------------------------------------------------------
+# Shape bucketing: pad the broker/host/partition/replica axes to geometric
+# bucket sizes so cluster drift within a bucket reuses every compiled program.
+# ---------------------------------------------------------------------------
+
+#: geometric bucket growth factor — consecutive buckets differ by ~25%, so a
+#: model wastes at most ~25% padded work and drift retraces O(log n) times
+BUCKET_GROWTH = 1.25
+
+#: per-axis floors: buckets below these collapse to one size, so tiny models
+#: share a single compiled program per axis family
+BROKER_BUCKET_FLOOR = 16
+HOST_BUCKET_FLOOR = 16
+PARTITION_BUCKET_FLOOR = 256
+REPLICA_BUCKET_FLOOR = 512
+
+
+def bucket_size(n: int, floor: int, growth: float = BUCKET_GROWTH) -> int:
+    """Smallest bucket ≥ ``n`` on the geometric ladder ``floor·growth^k``.
+
+    Integer-monotone by construction (each rung is ``ceil(prev·growth)``), so
+    two clusters whose sizes land in the same bucket get identical padded
+    shapes — the property the retrace contract rests on."""
+    if n <= floor:
+        return floor
+    s = floor
+    while s < n:
+        s = int(np.ceil(s * growth))
+    return s
+
+
+@dataclasses.dataclass(frozen=True)
+class PaddingInfo:
+    """Real (unpadded) axis sizes of a bucketed model, for decode/slicing."""
+
+    num_brokers: int
+    num_hosts: int
+    num_partitions: int
+    num_replicas: int
+
+
+def pad_topology(topo: ClusterTopology, assign: Assignment
+                 ) -> "tuple[ClusterTopology, Assignment, PaddingInfo]":
+    """Pad (topology, assignment) to bucketed axis sizes with neutral
+    sentinel entries.
+
+    Sentinel construction (every goal term must see exactly zero from them):
+
+    - padded BROKERS: dead (``broker_alive=False``), zero capacity, parked on
+      padded hosts — every alive-masked broker term vanishes and
+      ``_DeadBrokerPlacement`` stays zero because padded replica *counts* are
+      masked by ``replica_weight`` (ops.aggregates);
+    - padded HOSTS: zero capacity and only padded-broker load (zero), so the
+      host-scope capacity terms vanish;
+    - padded PARTITIONS: rf=1, topic 0, zero loads; their single padded
+      replica leads them (slot 0 — PreferredLeaderElection-neutral) from a
+      padded broker;
+    - padded REPLICAS: zero load, offline=False, ``replica_weight=0``; the
+      caller must also pad the DeviceOptions masks (``goals.pad_options``) so
+      they are immovable and padded brokers are never destinations.
+
+    At least one padded broker and partition always exist (buckets are
+    computed on ``n+1``) so the sentinel host/rack rows are well-defined.
+    Returns the padded pair plus a :class:`PaddingInfo` with the real sizes;
+    real entries occupy the axis *prefix*, so decode is a plain slice.
+    """
+    import jax as _jax
+
+    B, P, R = topo.num_brokers, topo.num_partitions, topo.num_replicas
+    H, K = topo.num_hosts, topo.num_racks
+    m = topo.max_rf
+    B_pad = bucket_size(B + 1, BROKER_BUCKET_FLOOR)
+    P_pad = bucket_size(P + 1, PARTITION_BUCKET_FLOOR)
+    n_pb = B_pad - B
+    n_pp = P_pad - P
+    H_pad = bucket_size(H + 1, HOST_BUCKET_FLOOR)
+    R_pad = bucket_size(R + n_pp, REPLICA_BUCKET_FLOOR)
+    n_pr = R_pad - R
+
+    def _pad(arr, n, fill):
+        arr = np.asarray(arr)
+        pad_shape = (n,) + arr.shape[1:]
+        return np.concatenate(
+            [arr, np.full(pad_shape, fill, dtype=arr.dtype)], axis=0)
+
+    # brokers: dead, zero-capacity, one shared padded rack, padded hosts
+    # spread over [H, H_pad) (the last padded broker pins host H_pad-1 and
+    # rack K so num_hosts/num_racks equal the padded sizes)
+    pad_hosts = H + (np.arange(n_pb) % max(1, H_pad - H))
+    pad_hosts[-1] = H_pad - 1
+    host_of_broker = np.concatenate(
+        [np.asarray(topo.host_of_broker),
+         pad_hosts.astype(topo.host_of_broker.dtype)])
+    rack_of_broker = _pad(topo.rack_of_broker, n_pb, K)
+
+    # partitions: rf=1, topic 0, zero loads, led by their own padded replica
+    pp_leader = (R + np.arange(n_pp)).astype(np.int32)
+    reps_pad = np.full((n_pp, m), -1, dtype=topo.replicas_of_partition.dtype)
+    reps_pad[:, 0] = pp_leader
+    replicas_of_partition = np.concatenate(
+        [np.asarray(topo.replicas_of_partition), reps_pad], axis=0)
+
+    # replicas: the first n_pp padded replicas are the padded partitions'
+    # leaders; any bucket surplus attaches to the first padded partition
+    # (deliberately absent from its replica list — every per-partition walk
+    # iterates replicas_of_partition rows, never the reverse map)
+    pr_part = np.full(n_pr, P, dtype=topo.partition_of_replica.dtype)
+    pr_part[:n_pp] = P + np.arange(n_pp)
+    partition_of_replica = np.concatenate(
+        [np.asarray(topo.partition_of_replica), pr_part])
+
+    topo_pad = dataclasses.replace(
+        topo,
+        rack_of_broker=rack_of_broker,
+        host_of_broker=host_of_broker,
+        capacity=_pad(topo.capacity, n_pb, 0.0),
+        broker_alive=_pad(topo.broker_alive, n_pb, False),
+        broker_new=_pad(topo.broker_new, n_pb, False),
+        broker_demoted=_pad(topo.broker_demoted, n_pb, False),
+        broker_bad_disks=_pad(topo.broker_bad_disks, n_pb, False),
+        partition_of_replica=partition_of_replica,
+        topic_of_partition=_pad(topo.topic_of_partition, n_pp, 0),
+        replicas_of_partition=replicas_of_partition,
+        rf_of_partition=_pad(topo.rf_of_partition, n_pp, 1),
+        initial_leader_slot=_pad(topo.initial_leader_slot, n_pp, 0),
+        replica_offline=_pad(topo.replica_offline, n_pr, False),
+        replica_base_load=_pad(topo.replica_base_load, n_pr, 0.0),
+        leader_extra=_pad(topo.leader_extra, n_pp, 0.0),
+        leader_bytes_in=_pad(topo.leader_bytes_in, n_pp, 0.0),
+        replica_base_load_windows=(
+            _pad(topo.replica_base_load_windows, n_pr, 0.0)
+            if topo.replica_base_load_windows is not None else None),
+        leader_extra_windows=(
+            _pad(topo.leader_extra_windows, n_pp, 0.0)
+            if topo.leader_extra_windows is not None else None),
+        partition_index=(_pad(topo.partition_index, n_pp, -1)
+                         if topo.partition_index is not None else None),
+        broker_ids=(_pad(topo.broker_ids, n_pb, -1)
+                    if topo.broker_ids is not None else None),
+        disk_of_replica=(_pad(topo.disk_of_replica, n_pr, -1)
+                         if topo.disk_of_replica is not None else None),
+        replica_weight=np.concatenate(
+            [np.ones(R, np.int32), np.zeros(n_pr, np.int32)]),
+        partition_weight=np.concatenate(
+            [np.ones(P, np.int32), np.zeros(n_pp, np.int32)]),
+        broker_present=np.concatenate(
+            [np.ones(B, bool), np.zeros(n_pb, bool)]),
+    )
+    # all padded replicas sit on the first padded broker
+    bo = np.concatenate(
+        [np.asarray(_jax.device_get(assign.broker_of), np.int32),
+         np.full(n_pr, B, np.int32)])
+    lo = np.concatenate(
+        [np.asarray(_jax.device_get(assign.leader_of), np.int32), pp_leader])
+    assign_pad = Assignment(broker_of=jnp.asarray(bo),
+                            leader_of=jnp.asarray(lo))
+    return topo_pad, assign_pad, PaddingInfo(
+        num_brokers=B, num_hosts=H, num_partitions=P, num_replicas=R)
+
+
+def unpad_assignment(assign: Assignment, info: PaddingInfo) -> Assignment:
+    """Slice a padded assignment back to the real axis prefixes.
+
+    Padded replicas are immovable and padded brokers are never destinations,
+    so the real prefix of ``broker_of``/``leader_of`` is the complete real
+    assignment.  The slice happens on HOST: a device-side slice would
+    trace+compile per distinct real size while the bucket stays fixed
+    (exactly the retrace class the bucketing scheme exists to kill)."""
+    import jax as _jax
+    bo = np.asarray(_jax.device_get(assign.broker_of), np.int32)
+    lo = np.asarray(_jax.device_get(assign.leader_of), np.int32)
+    return Assignment(broker_of=jnp.asarray(bo[:info.num_replicas]),
+                      leader_of=jnp.asarray(lo[:info.num_partitions]))

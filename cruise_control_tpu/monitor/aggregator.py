@@ -77,6 +77,13 @@ class Completeness:
     num_valid_entities: int
 
 
+def entity_rows(result: AggregationResult) -> Dict[Hashable, int]:
+    """Row index per entity in ``result.values`` — the mapping the
+    incremental model build uses to scatter fresh load columns onto a
+    cached topology (LoadMonitor warm path)."""
+    return {e: i for i, e in enumerate(result.entities)}
+
+
 class MetricSampleAggregator:
     """Cyclic-window aggregator for one entity class (partition or broker).
 

@@ -69,6 +69,24 @@ class NotEnoughValidWindowsError(Exception):
     """monitor/NotEnoughValidWindowsException parity."""
 
 
+def metadata_structure_digest(metadata: ClusterMetadata) -> int:
+    """Digest of the metadata fields the model build derives STRUCTURE from:
+    broker composition (id, rack, host, aliveness) and partition layout
+    (topic, partition, leader, replica list, offline replicas). Load values
+    live in the AggregationResult, not here, so two generations with equal
+    digests differ at most in load — exactly the case the incremental
+    model-build cache (docs/performance.md) can serve with a column refresh
+    instead of a full ``_build_model_bulk``. ``isr`` is deliberately
+    excluded: the build never reads it."""
+    return hash((
+        tuple((b.broker_id, b.rack, b.host, b.alive)
+              for b in metadata.brokers),
+        tuple((p.topic, p.partition, p.leader, p.replicas,
+               p.offline_replicas)
+              for p in metadata.partitions),
+    ))
+
+
 class MetadataSource:
     """SPI: where cluster composition comes from (Kafka admin/ZK adapter in
     production; a fake in tests)."""
@@ -147,6 +165,16 @@ class LoadMonitor:
         #: brokers whose capacity came from the default (-1) entry in the
         #: last model build (allow_capacity_estimation gate)
         self.capacity_estimated_brokers: List[int] = []
+        #: incremental model-build cache: the last BULK-built model plus the
+        #: structural digest of the metadata it came from. A warm tick whose
+        #: composition is unchanged skips _build_model_bulk and refreshes
+        #: only the load columns (docs/performance.md). Reference swap is
+        #: atomic; the dict itself is never mutated after publication.
+        self._model_cache: Optional[dict] = None
+        #: warm-path observability: full builds vs load-column refreshes
+        #: (bench.py JSON, app state). Guarded by self._lock.
+        self.model_cache_hits = 0
+        self.model_cache_misses = 0
         self._state = MonitorState.NOT_STARTED
         self._pause_reason: Optional[str] = None
         self._lock = threading.RLock()
@@ -185,6 +213,8 @@ class LoadMonitor:
             state = self._state.value
             pause_reason = self._pause_reason
             bootstrap_progress = self._bootstrap_progress
+            cache_hits = self.model_cache_hits
+            cache_misses = self.model_cache_misses
         result = self.partition_aggregator.aggregate(now_ms)
         c = result.completeness
         return {
@@ -197,6 +227,8 @@ class LoadMonitor:
             "monitoringCoveragePct": round(100.0 * c.valid_entity_ratio, 3),
             "bootstrapProgressPct": bootstrap_progress,
             "generation": self.model_generation().__dict__,
+            "modelCacheHits": cache_hits,
+            "modelCacheMisses": cache_misses,
         }
 
     def model_generation(self) -> ModelGeneration:
@@ -497,14 +529,168 @@ class LoadMonitor:
         UNMONITORED partitions with zero load instead of dropping them —
         structural goals (rack, counts, PLE, RF changes) must see every
         partition even when its windows are invalid."""
-        if len(metadata.partitions) >= self.BULK_BUILD_THRESHOLD:
+        from cruise_control_tpu.common.metrics import REGISTRY
+        bulk = len(metadata.partitions) >= self.BULK_BUILD_THRESHOLD
+        with self._lock:
+            cached = self._model_cache
+        if (bulk and cached is not None
+                and self._model_cache_hit(cached, metadata, result,
+                                          include_all_topics)):
+            with self._lock:
+                self.model_cache_hits += 1
+            REGISTRY.counter("cluster-model-cache-hit-rate")
+            return self._refresh_model_loads(cached, metadata, result)
+        with self._lock:
+            self.model_cache_misses += 1
+        REGISTRY.counter("cluster-model-cache-miss-rate")
+        if bulk:
             # LinkedIn scale: the per-replica builder calls would dominate
             # the whole REBALANCE wall-clock (~1.5M python dict operations);
             # the bulk path assembles the same arrays vectorized —
             # cluster-model-creation at scale is seconds, not minutes
             # (LoadMonitor.java:178 cluster-model-creation-timer).
-            return self._build_model_bulk(metadata, result,
-                                          include_all_topics)
+            topo, assign = self._build_model_bulk(metadata, result,
+                                                  include_all_topics)
+            self._store_model_cache(metadata, result, include_all_topics,
+                                    topo, assign)
+            return topo, assign
+        return self._build_model_small(metadata, result, include_all_topics)
+
+    def _model_cache_hit(self, cached: dict, metadata: ClusterMetadata,
+                         result: AggregationResult,
+                         include_all_topics: bool) -> bool:
+        """Can ``cached`` serve this build with a load-column refresh?
+        Yes iff the structural metadata is unchanged (snapshot identity, or
+        equal generation + equal structural digest) AND the monitored
+        entity set is row-for-row identical (the cached row scatter must
+        still address ``result.values`` correctly)."""
+        if cached["include_all_topics"] != include_all_topics:
+            return False
+        if cached["entities"] != tuple(result.entities):
+            return False
+        if metadata is cached["metadata"]:
+            # ClusterMetadata is an immutable generation-stamped snapshot;
+            # the same object cannot have drifted structurally
+            return True
+        return (metadata.generation == cached["generation"]
+                and metadata_structure_digest(metadata) == cached["digest"])
+
+    def _store_model_cache(self, metadata: ClusterMetadata,
+                           result: AggregationResult,
+                           include_all_topics: bool, topo, assign) -> None:
+        from cruise_control_tpu.monitor.aggregator import entity_rows
+        ent_row = entity_rows(result)
+        names = topo.topic_names
+        t_of = (np.asarray(topo.topic_of_partition, np.int64)
+                if topo.topic_of_partition is not None
+                else np.zeros(0, np.int64))
+        p_ix = (np.asarray(topo.partition_index, np.int64)
+                if topo.partition_index is not None
+                else np.zeros(t_of.shape[0], np.int64))
+        # entity row per kept partition, -1 = unmonitored (zero load)
+        rows = np.fromiter(
+            (ent_row.get((names[t], p), -1)
+             for t, p in zip(t_of.tolist(), p_ix.tolist())),
+            np.int64, t_of.shape[0])
+        cache = {
+            "metadata": metadata,
+            "generation": metadata.generation,
+            "digest": metadata_structure_digest(metadata),
+            "include_all_topics": include_all_topics,
+            "entities": tuple(result.entities),
+            "topo": topo,
+            "assign": assign,
+            "rows": rows,
+        }
+        with self._lock:
+            self._model_cache = cache
+
+    def _refresh_model_loads(self, cached: dict, metadata: ClusterMetadata,
+                             result: AggregationResult):
+        """Warm-tick model build: the structure (brokers, partitions,
+        replicas, leadership, offline state) is byte-identical to the
+        cached build, so only the load columns can differ. Recompute them
+        with the same vectorized collapse as ``_build_model_bulk`` and
+        splice them onto the cached topology — milliseconds instead of the
+        full array assembly. The cached == from-scratch contract is locked
+        by tests/test_warm_path.py."""
+        from cruise_control_tpu.models.cluster import (
+            leadership_extra_from_leader_load)
+        topo = cached["topo"]
+        rows = cached["rows"]
+        P = rows.shape[0]
+        vals = result.values                              # [E, W, M]
+        monitored_mask = rows >= 0
+        safe_rows = np.where(monitored_mask, rows, 0)
+        W = vals.shape[1]
+        no_entities = vals.shape[0] == 0 or not bool(monitored_mask.any())
+        # only the four resource columns feed the model — slice them ONCE
+        # up front so every collapse/gather below moves 4/M of the data
+        # (this path's whole point is to be milliseconds at 500K replicas)
+        mm_cols = np.empty(res.NUM_RESOURCES, np.int64)
+        mm_cols[res.CPU] = md.ModelMetric.CPU_USAGE
+        mm_cols[res.DISK] = md.ModelMetric.DISK_USAGE
+        mm_cols[res.NW_IN] = md.ModelMetric.LEADER_BYTES_IN
+        mm_cols[res.NW_OUT] = md.ModelMetric.LEADER_BYTES_OUT
+        if no_entities:
+            sub = np.zeros((1, W, res.NUM_RESOURCES))
+            collapsed = np.zeros((1, res.NUM_RESOURCES))
+            safe_rows = np.zeros(P, np.int64)
+        else:
+            sub = vals[:, :, mm_cols]                     # [E, W, 4]
+            collapsed = sub.mean(axis=1)                  # [E, 4]
+            for k in range(res.NUM_RESOURCES):
+                mm = md.ModelMetric(int(mm_cols[k]))
+                if md.METRIC_STRATEGY[mm] == md.Strategy.LATEST:
+                    collapsed[:, k] = sub[:, -1, k]
+        leader_load = np.nan_to_num(
+            collapsed[safe_rows], copy=False).astype(np.float32)  # [P, 4]
+        leader_load[~monitored_mask] = 0.0
+        leader_extra = leadership_extra_from_leader_load(leader_load)
+        follower_load = leader_load - leader_extra
+        if no_entities:
+            leader_extra_windows = follower_windows = None
+        else:
+            win_res = np.nan_to_num(
+                sub[safe_rows], copy=False).astype(np.float32)    # [P, W, 4]
+            win_res[~monitored_mask] = 0.0
+            leader_extra_windows = leadership_extra_from_leader_load(win_res)
+            follower_windows = win_res - leader_extra_windows
+        pid = np.asarray(topo.partition_of_replica)
+        # capacity is re-resolved on every build (estimates can settle
+        # between ticks); B is tiny, the loop is noise
+        B = len(metadata.brokers)
+        capacity = np.zeros((B, res.NUM_RESOURCES), np.float32)
+        estimated: List[int] = []
+        for i, bm in enumerate(metadata.brokers):
+            info = self._capacity_resolver.capacity_for_broker(bm.broker_id)
+            if getattr(info, "is_estimated", False):
+                estimated.append(bm.broker_id)
+            capacity[i] = np.asarray(
+                [float(info.capacity[k]) for k in range(res.NUM_RESOURCES)],
+                np.float32)
+        new_topo = dataclasses.replace(
+            topo, capacity=capacity,
+            replica_base_load=follower_load[pid],
+            leader_extra=leader_extra,
+            leader_bytes_in=leader_load[:, res.NW_IN].copy(),
+            replica_base_load_windows=(None if follower_windows is None
+                                       else follower_windows[pid]),
+            leader_extra_windows=leader_extra_windows)
+        with self._lock:
+            # published whole (PR 3 lock discipline: no reader sees a
+            # half-filled list)
+            self.capacity_estimated_brokers = estimated
+            # re-arm the identity fast path for the next tick's snapshot
+            self._model_cache = dict(cached, metadata=metadata,
+                                     generation=metadata.generation)
+        return new_topo, cached["assign"]
+
+    def _build_model_small(self, metadata: ClusterMetadata,
+                           result: AggregationResult,
+                           include_all_topics: bool = False):
+        """Per-replica builder path (small models; parity reference for the
+        bulk path)."""
         # collapse windows per metric strategy: AVG metrics average valid
         # windows (Load.expectedUtilizationFor, Load.java:84-118), LATEST
         # takes the newest window.
@@ -536,11 +722,11 @@ class LoadMonitor:
 
         b = ClusterModelBuilder()
         alive_brokers = set()
-        self.capacity_estimated_brokers: List[int] = []
+        estimated: List[int] = []
         for bm in metadata.brokers:
             info = self._capacity_resolver.capacity_for_broker(bm.broker_id)
             if getattr(info, "is_estimated", False):
-                self.capacity_estimated_brokers.append(bm.broker_id)
+                estimated.append(bm.broker_id)
             b.create_broker(bm.rack or f"rack-of-{bm.broker_id}",
                             bm.host or f"host{bm.broker_id}", bm.broker_id,
                             {i: float(info.capacity[i])
@@ -548,6 +734,11 @@ class LoadMonitor:
                             alive=bm.alive)
             if bm.alive:
                 alive_brokers.add(bm.broker_id)
+        with self._lock:
+            # published whole under the monitor lock: a concurrent state
+            # reader must never observe a half-filled list (PR 3 lock
+            # discipline)
+            self.capacity_estimated_brokers = estimated
 
         zero_m = np.zeros(md.NUM_MODEL_METRICS, np.float32)
         monitored = 0
@@ -609,7 +800,7 @@ class LoadMonitor:
         # ---- broker axis (B is small; the python loop is negligible) ----
         brokers = metadata.brokers
         B = len(brokers)
-        self.capacity_estimated_brokers = []
+        estimated: List[int] = []
         rack_names: List[str] = []
         rack_idx: Dict[str, int] = {}
         host_keys: List[str] = []
@@ -622,7 +813,7 @@ class LoadMonitor:
         for i, bm in enumerate(brokers):
             info = self._capacity_resolver.capacity_for_broker(bm.broker_id)
             if getattr(info, "is_estimated", False):
-                self.capacity_estimated_brokers.append(bm.broker_id)
+                estimated.append(bm.broker_id)
             rack = bm.rack or f"rack-of-{bm.broker_id}"
             host = bm.host or f"host{bm.broker_id}"
             if rack not in rack_idx:
@@ -638,6 +829,9 @@ class LoadMonitor:
                 np.float32)
             alive[i] = bm.alive
             broker_ids[i] = bm.broker_id
+        with self._lock:
+            # published whole under the monitor lock (PR 3 lock discipline)
+            self.capacity_estimated_brokers = estimated
         host_names = sorted(rack_of_host)          # builder sorts host names
         host_idx = {h: i for i, h in enumerate(host_names)}
         rack_of_broker = np.asarray([rack_idx[r] for r in rack_of_broker_name],

@@ -13,7 +13,7 @@ Everything takes a :class:`DeviceTopology` (device-resident constants) plus an
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +41,13 @@ class DeviceTopology(NamedTuple):
     replica_base_load: jax.Array      # f32[R, 4] follower-role load
     leader_extra: jax.Array           # f32[P, 4] extra load carried by the leader
     leader_bytes_in: jax.Array        # f32[P]
+    # --- shape-bucketing sentinels (models.cluster.pad_topology) ---
+    # None on unpadded models: every kernel then traces exactly the historical
+    # program. When present, padded entries carry weight 0 / present=False and
+    # must contribute nothing to any count, total, or goal term.
+    replica_weight: Optional[jax.Array] = None    # i32[R] 1=real, 0=padding
+    partition_weight: Optional[jax.Array] = None  # i32[P] 1=real, 0=padding
+    broker_present: Optional[jax.Array] = None    # bool[B] False=padding
 
     @property
     def num_brokers(self) -> int:
@@ -80,7 +87,35 @@ def device_topology(topo: ClusterTopology) -> DeviceTopology:
         replica_base_load=jnp.asarray(topo.replica_base_load, jnp.float32),
         leader_extra=jnp.asarray(topo.leader_extra, jnp.float32),
         leader_bytes_in=jnp.asarray(topo.leader_bytes_in, jnp.float32),
+        replica_weight=(jnp.asarray(topo.replica_weight, jnp.int32)
+                        if getattr(topo, "replica_weight", None) is not None
+                        else None),
+        partition_weight=(jnp.asarray(topo.partition_weight, jnp.int32)
+                          if getattr(topo, "partition_weight", None) is not None
+                          else None),
+        broker_present=(jnp.asarray(topo.broker_present)
+                        if getattr(topo, "broker_present", None) is not None
+                        else None),
     )
+
+
+def replica_count_weights(dt: DeviceTopology) -> jax.Array:
+    """i32[R] per-replica count weight: 1s, or the padding mask when bucketed.
+
+    Every replica-count segment sum (aggregates, chain rescore, sharded
+    aggregates, stats) must use this instead of raw ones so padded sentinel
+    replicas never count — a padded replica sits on a dead padded broker and
+    an unweighted count would fire _DeadBrokerPlacement."""
+    if dt.replica_weight is not None:
+        return dt.replica_weight
+    return jnp.ones_like(dt.partition_of_replica)
+
+
+def leader_count_weights(dt: DeviceTopology) -> jax.Array:
+    """i32[P] per-partition leader-count weight (1s, or the padding mask)."""
+    if dt.partition_weight is not None:
+        return dt.partition_weight
+    return jnp.ones_like(dt.topic_of_partition)
 
 
 class BrokerAggregates(NamedTuple):
@@ -114,11 +149,11 @@ def compute_aggregates(dt: DeviceTopology, assign: Assignment, num_topics: int) 
 
     broker_load = jax.ops.segment_sum(eff, assign.broker_of, num_segments=B)
     host_load = jax.ops.segment_sum(broker_load, dt.host_of_broker, num_segments=dt.num_hosts)
-    ones = jnp.ones_like(assign.broker_of)
+    ones = replica_count_weights(dt)
     replica_count = jax.ops.segment_sum(ones, assign.broker_of, num_segments=B)
     leader_broker = assign.leader_broker()
     leader_count = jax.ops.segment_sum(
-        jnp.ones_like(leader_broker), leader_broker, num_segments=B)
+        leader_count_weights(dt), leader_broker, num_segments=B)
     # Potential leadership NW_OUT: every replica contributes its partition's
     # *current leader's* NW_OUT to the broker it lives on
     # (ClusterModel.java:205,361 — potentialLeadershipLoadByBrokerId).
@@ -153,8 +188,9 @@ def topic_totals(dt: DeviceTopology, num_topics: int) -> jax.Array:
     topic never changes), so goal thresholds can use this without ever
     materializing the [B, T] histogram."""
     t_of_r = dt.topic_of_partition[dt.partition_of_replica]
-    return jax.ops.segment_sum(jnp.ones_like(t_of_r, jnp.float32), t_of_r,
-                               num_segments=num_topics)
+    return jax.ops.segment_sum(
+        replica_count_weights(dt).astype(jnp.float32), t_of_r,
+        num_segments=num_topics)
 
 
 def partition_rack_excess(dt: DeviceTopology, broker_of: jax.Array) -> jax.Array:

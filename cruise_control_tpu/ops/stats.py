@@ -23,6 +23,7 @@ from cruise_control_tpu.ops.aggregates import (
     broker_resource_utilization,
     broker_scope_capacity,
     compute_aggregates,
+    replica_count_weights,
 )
 
 _BIG = jnp.float32(3.4e38)
@@ -115,8 +116,15 @@ def compute_cluster_stats(dt: DeviceTopology, assign: Assignment,
     def _count_stats(count):
         cnt = count.astype(jnp.float32)
         avg = jnp.sum(cnt) / n_alive
-        mx = jnp.max(cnt)
-        mn = jnp.min(cnt)
+        if dt.broker_present is not None:
+            # MAX/MIN run over *real* brokers only (dead included, matching
+            # the reference); padded sentinel rows carry count 0 and would
+            # otherwise pin MIN to zero.
+            mx = jnp.max(jnp.where(dt.broker_present, cnt, 0.0))
+            mn = jnp.min(jnp.where(dt.broker_present, cnt, _BIG))
+        else:
+            mx = jnp.max(cnt)
+            mn = jnp.min(cnt)
         sd = jnp.sqrt(jnp.sum(jnp.where(alive, (cnt - avg) ** 2, 0.0)) / n_alive)
         return avg, mx, mn, sd
 
@@ -129,16 +137,25 @@ def compute_cluster_stats(dt: DeviceTopology, assign: Assignment,
         T = num_topics
         R = dt.num_replicas
         t_of_r = dt.topic_of_partition[dt.partition_of_replica]
-        per_topic_total = jax.ops.segment_sum(
-            jnp.ones((R,), jnp.float32), t_of_r, num_segments=T)
+        w_r = replica_count_weights(dt).astype(jnp.float32)
+        per_topic_total = jax.ops.segment_sum(w_r, t_of_r, num_segments=T)
         per_topic_avg = per_topic_total / n_alive
         # non-empty (broker, topic) cell counts via sorted key runs. ALL
         # brokers' cells are counted (the dense path's max/min run over every
         # broker row, dead included); the variance term below masks to alive
         # cells just as the dense path does.
         alive_r = alive[assign.broker_of]
-        BT = dt.num_brokers * T
-        key = assign.broker_of * T + t_of_r
+        if dt.broker_present is not None:
+            # bucketed model: the (broker, topic) matrix is the *real*
+            # broker rows only; padded sentinel replicas park at an
+            # out-of-range key so their cell never enters the extrema
+            n_real_b = jnp.sum(dt.broker_present.astype(jnp.int32))
+            BT = n_real_b * T
+            key = jnp.where(w_r > 0, assign.broker_of * T + t_of_r,
+                            dt.num_brokers * T)
+        else:
+            BT = dt.num_brokers * T
+            key = assign.broker_of * T + t_of_r
         sk = jnp.sort(key)
         first = jnp.concatenate([jnp.ones((1,), bool), sk[1:] != sk[:-1]])
         cell_id = jnp.cumsum(first.astype(jnp.int32)) - 1
@@ -163,7 +180,8 @@ def compute_cluster_stats(dt: DeviceTopology, assign: Assignment,
         topic_max = jnp.max(jnp.where(valid_c, counts, 0.0))
         # min over the full (broker, topic) matrix: 0 unless every cell of
         # every broker (dead included, dense-path parity) is non-empty
-        topic_min = jnp.where(n_cells >= BT,
+        n_valid = jnp.sum(valid_c.astype(jnp.int32))
+        topic_min = jnp.where(n_valid >= BT,
                               jnp.min(jnp.where(valid_c, counts, _BIG)), 0.0)
     else:
         tc = agg.topic_count.astype(jnp.float32)             # [B, T]
@@ -172,8 +190,12 @@ def compute_cluster_stats(dt: DeviceTopology, assign: Assignment,
         t_var = jnp.sum(jnp.where(alive[:, None], (tc - per_topic_avg[None, :]) ** 2, 0.0), axis=0) / n_alive
         topic_avg = jnp.mean(per_topic_avg)
         topic_std = jnp.mean(jnp.sqrt(t_var))
-        topic_max = jnp.max(tc)
-        topic_min = jnp.min(tc)
+        if dt.broker_present is not None:
+            topic_max = jnp.max(jnp.where(dt.broker_present[:, None], tc, 0.0))
+            topic_min = jnp.min(jnp.where(dt.broker_present[:, None], tc, _BIG))
+        else:
+            topic_max = jnp.max(tc)
+            topic_min = jnp.min(tc)
 
     # partitions with offline replicas
     p_off = jax.ops.segment_max(
@@ -217,8 +239,13 @@ def sanity_check(dt: DeviceTopology, assign: Assignment, num_topics: int) -> dic
     leader_part = p[assign.leader_of]
     leader_valid = jnp.all(leader_part == jnp.arange(dt.num_partitions))
     brokers_in_range = jnp.all((assign.broker_of >= 0) & (assign.broker_of < dt.num_brokers))
-    count_ok = jnp.sum(agg.replica_count) == dt.num_replicas
-    leader_count_ok = jnp.sum(agg.leader_count) == dt.num_partitions
+    # weighted counts on bucketed models sum to the *real* entity counts
+    expected_r = (jnp.sum(dt.replica_weight) if dt.replica_weight is not None
+                  else dt.num_replicas)
+    expected_p = (jnp.sum(dt.partition_weight)
+                  if dt.partition_weight is not None else dt.num_partitions)
+    count_ok = jnp.sum(agg.replica_count) == expected_r
+    leader_count_ok = jnp.sum(agg.leader_count) == expected_p
     return {
         "load_broker_consistent": bool(jnp.all(jnp.abs(total_from_replicas - total_from_brokers) <= eps)),
         "load_host_consistent": bool(jnp.all(jnp.abs(total_from_replicas - total_from_hosts) <= eps)),

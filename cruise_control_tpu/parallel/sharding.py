@@ -34,7 +34,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cruise_control_tpu.common import resources as res
-from cruise_control_tpu.ops.aggregates import DeviceTopology
+from cruise_control_tpu.ops.aggregates import (DeviceTopology,
+                                               leader_count_weights,
+                                               replica_count_weights)
 
 
 def make_cpu_mesh(n_devices: int, axis: str = "chains") -> Mesh:
@@ -133,7 +135,11 @@ def sharded_aggregates(mesh: Mesh, dt: DeviceTopology,
 
     # --- padded, shard-ready operands ---
     bo = _pad_axis(broker_of, R_pad, 1)                       # i32[C, R_pad]
-    valid_r = _pad_axis(jnp.ones((R,), jnp.float32), R_pad, 0)
+    # count weights double as the shard-padding validity mask; on a
+    # bucketed model they also zero the sentinel replicas/partitions out
+    # of every count (their loads are already zero)
+    valid_r = _pad_axis(replica_count_weights(dt).astype(jnp.float32),
+                        R_pad, 0)
     por = _pad_axis(dt.partition_of_replica, R_pad, 0)
     rbl = _pad_axis(dt.replica_base_load, R_pad, 0)
     roff = _pad_axis(dt.replica_offline, R_pad, 0)
@@ -144,7 +150,8 @@ def sharded_aggregates(mesh: Mesh, dt: DeviceTopology,
     pl_rep = pl                                               # replicated [C, P]
     alive_rep = dt.broker_alive
     lb = _pad_axis(leader_broker, P_pad, 1)                   # i32[C, P_pad]
-    valid_p = _pad_axis(jnp.ones((Pn,), jnp.float32), P_pad, 0)
+    valid_p = _pad_axis(leader_count_weights(dt).astype(jnp.float32),
+                        P_pad, 0)
     lbi_p = _pad_axis(dt.leader_bytes_in, P_pad, 0)
 
     def local(bo, valid_r, por, rbl, roff, ridx, init_bo,

@@ -1,0 +1,126 @@
+"""Shape-bucketing contract: padded and unpadded runs are equivalent.
+
+The warm path pads the broker/host/partition/replica axes to geometric
+bucket sizes (models.cluster.pad_topology) so cluster drift within a bucket
+reuses compiled programs. The padding is only legal because it is
+OBSERVATIONALLY NEUTRAL: sentinel entries contribute exactly zero to every
+goal term and the optimizer produces the same proposal set either way.
+These tests are that contract's lock — optimize()'s docstring cites them.
+"""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import optimizer as OPT
+from cruise_control_tpu.analyzer import proposals as PR
+from cruise_control_tpu.analyzer.annealer import AnnealConfig
+from cruise_control_tpu.models import fixtures
+from cruise_control_tpu.models.cluster import (
+    BROKER_BUCKET_FLOOR, PARTITION_BUCKET_FLOOR, REPLICA_BUCKET_FLOOR,
+    bucket_size, pad_topology, unpad_assignment)
+
+
+# -- bucket geometry --------------------------------------------------------
+
+def test_bucket_size_floor_and_growth():
+    assert bucket_size(0, 16) == 16
+    assert bucket_size(16, 16) == 16
+    # geometric ladder: each bucket is >= 1.25x the previous
+    sizes = sorted({bucket_size(n, 16) for n in range(1, 4000)})
+    assert all(b >= a * 1.25 - 1e-9 for a, b in zip(sizes, sizes[1:]))
+    # covering: every n fits its bucket
+    for n in (1, 17, 100, 257, 512, 513, 3999):
+        assert bucket_size(n, 16) >= n
+
+
+def test_bucket_size_is_stable_within_bucket():
+    """Drift below the bucket boundary must not change the bucket (that is
+    the whole compiled-program-reuse argument)."""
+    b = bucket_size(100, PARTITION_BUCKET_FLOOR)
+    for n in range(100, b + 1):
+        assert bucket_size(n, PARTITION_BUCKET_FLOOR) == b
+
+
+# -- pad_topology structure -------------------------------------------------
+
+def test_pad_topology_prefix_and_sentinels():
+    topo, assign = fixtures.unbalanced()
+    tp, ap, info = pad_topology(topo, assign)
+    # real sizes recorded; real entries occupy the axis prefix
+    assert (info.num_brokers, info.num_partitions, info.num_replicas) == (
+        topo.num_brokers, topo.num_partitions, topo.num_replicas)
+    assert tp.num_brokers == bucket_size(topo.num_brokers + 1,
+                                         BROKER_BUCKET_FLOOR)
+    assert tp.num_replicas >= bucket_size(topo.num_replicas + 1,
+                                          REPLICA_BUCKET_FLOOR) - 1
+    np.testing.assert_array_equal(
+        np.asarray(tp.rack_of_broker)[:topo.num_brokers],
+        np.asarray(topo.rack_of_broker))
+    np.testing.assert_array_equal(
+        np.asarray(ap.broker_of)[:info.num_replicas],
+        np.asarray(assign.broker_of))
+    # sentinels: dead zero-capacity brokers, zero-weight replicas
+    assert not np.asarray(tp.broker_alive)[topo.num_brokers:].any()
+    assert (np.asarray(tp.capacity)[topo.num_brokers:] == 0).all()
+    assert (np.asarray(tp.replica_weight)[:info.num_replicas] == 1).all()
+    assert (np.asarray(tp.replica_weight)[info.num_replicas:] == 0).all()
+    assert np.asarray(tp.broker_present)[:topo.num_brokers].all()
+    assert not np.asarray(tp.broker_present)[topo.num_brokers:].any()
+    # round-trip decode
+    back = unpad_assignment(ap, info)
+    np.testing.assert_array_equal(np.asarray(back.broker_of),
+                                  np.asarray(assign.broker_of))
+    np.testing.assert_array_equal(np.asarray(back.leader_of),
+                                  np.asarray(assign.leader_of))
+
+
+def test_pad_topology_is_not_repadded():
+    topo, assign = fixtures.unbalanced()
+    tp, ap, _ = pad_topology(topo, assign)
+    assert not OPT.engages_bucketing(tp, "anneal", None, True)
+
+
+# -- engagement policy ------------------------------------------------------
+
+def test_engages_bucketing_policy():
+    topo, _ = fixtures.unbalanced()
+    # explicit flag wins in both directions
+    assert OPT.engages_bucketing(topo, "anneal", None, True)
+    assert not OPT.engages_bucketing(topo, "anneal", None, False)
+    # auto: small models and explicit greedy keep exact historical shapes
+    assert not OPT.engages_bucketing(topo, "auto", None, None)
+    assert not OPT.engages_bucketing(topo, "greedy", None, None)
+
+
+# -- the headline contract: identical proposals padded vs unpadded ----------
+
+def _proposal_key(p):
+    return (p.topic, p.partition, p.old_leader, p.old_replicas,
+            p.new_replicas)
+
+
+@pytest.mark.parametrize("engine", ["anneal", "greedy"])
+@pytest.mark.parametrize("fixture", ["unbalanced", "small_cluster_model",
+                                     "dead_broker"])
+def test_padded_and_unpadded_proposals_identical(engine, fixture):
+    topo, assign = getattr(fixtures, fixture)()
+    cfg = AnnealConfig(num_chains=8, steps=128, swap_interval=32,
+                       tries_move=8, tries_lead=4, tries_swap=4)
+    kw = dict(engine=engine, anneal_config=cfg, seed=7, polish_cycles=0)
+    r_plain = OPT.optimize(topo, assign, bucketing=False, **kw)
+    r_bucket = OPT.optimize(topo, assign, bucketing=True, **kw)
+    # the bucketed run must not leak padded axes into its result
+    assert np.asarray(r_bucket.final_assignment.broker_of).shape == (
+        topo.num_replicas,)
+    assert np.asarray(r_bucket.final_assignment.leader_of).shape == (
+        topo.num_partitions,)
+    np.testing.assert_array_equal(
+        np.asarray(r_bucket.final_assignment.broker_of),
+        np.asarray(r_plain.final_assignment.broker_of))
+    np.testing.assert_array_equal(
+        np.asarray(r_bucket.final_assignment.leader_of),
+        np.asarray(r_plain.final_assignment.leader_of))
+    props_plain = PR.diff(topo, assign, r_plain.final_assignment)
+    props_bucket = PR.diff(topo, assign, r_bucket.final_assignment)
+    assert ({_proposal_key(p) for p in props_bucket}
+            == {_proposal_key(p) for p in props_plain})

@@ -271,16 +271,6 @@ def test_g005_clean_on_explicit_dtype_and_preserving_conversions():
 
 # -- G006: retrace storms --------------------------------------------------
 
-def test_g006_triggers_on_jit_inside_function_body():
-    src = """
-    import jax
-
-    def make_step(scale):
-        return jax.jit(lambda x: x * scale)
-    """
-    assert "G006" in _codes(src)
-
-
 def test_g006_triggers_on_high_cardinality_static():
     src = """
     import jax
@@ -305,6 +295,68 @@ def test_g006_clean_on_module_level_jit_with_shape_statics():
     f_jit = jax.jit(f, static_argnames=("topic_mode",))
     """
     assert "G006" not in _codes(src)
+
+
+# -- G010: jit wrapper created inside a function body ----------------------
+
+def test_g010_triggers_on_jit_inside_function_body():
+    src = """
+    import jax
+
+    def make_step(scale):
+        return jax.jit(lambda x: x * scale)
+    """
+    assert "G010" in _codes(src)
+
+
+def test_g010_triggers_on_partial_jit_inside_function_body():
+    src = """
+    import jax
+    from functools import partial
+
+    def make_step(scale):
+        step = partial(jax.jit, donate_argnums=(0,))(lambda x: x * scale)
+        return step
+    """
+    assert "G010" in _codes(src)
+
+
+def test_g010_triggers_on_decorated_nested_def():
+    src = """
+    import jax
+
+    def outer(y):
+        @jax.jit
+        def inner(x):
+            return x + y
+        return inner
+    """
+    assert "G010" in _codes(src)
+
+
+def test_g010_clean_on_module_level_wrappers():
+    src = """
+    import jax
+    from functools import partial
+
+    @jax.jit
+    def f(x):
+        return x
+
+    g = jax.jit(lambda x: x + 1)
+    h = partial(jax.jit, static_argnames=("mode",))(f)
+    """
+    assert "G010" not in _codes(src)
+
+
+def test_g010_inline_suppression():
+    src = """
+    import jax
+
+    def warmup():
+        jax.jit(lambda x: x + 1)(1.0)  # graftlint: disable=G010
+    """
+    assert "G010" not in _codes(src)
 
 
 # -- G008: forbidden impurity inside jit -----------------------------------

@@ -1,0 +1,229 @@
+"""Incremental warm path: topology-cached model build + drift-in-bucket.
+
+The LoadMonitor caches the lowered ``(ClusterTopology, Assignment)`` keyed
+by a digest of the metadata's structural fields; when only loads changed,
+the cached build is refreshed with a vectorized load-column splice instead
+of a full rebuild.  These tests are the lock for:
+
+- cached (warm-refresh) builds being EXACTLY equal to a from-scratch build
+  (``LoadMonitor._refresh_model_loads`` cites this file);
+- the digest hit/miss rules (structural drift, include_all_topics,
+  entity-set drift all invalidate);
+- the end-to-end drift sequence (add a broker, add partitions, kill a
+  replica) staying inside one shape bucket with ZERO uncovered retraces
+  under ``retrace_sentinel()``, and the cached and from-scratch build
+  paths producing identical proposals.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import optimizer as OPT
+from cruise_control_tpu.analyzer import proposals as PR
+from cruise_control_tpu.analyzer.annealer import AnnealConfig
+from cruise_control_tpu.common.sentinels import (
+    check_steady_state, retrace_sentinel)
+from cruise_control_tpu.monitor import metricdef as md
+from cruise_control_tpu.monitor.aggregator import (
+    AggregationResult, Completeness)
+from cruise_control_tpu.monitor.load_monitor import (
+    LoadMonitor, StaticMetadataSource, metadata_structure_digest)
+from cruise_control_tpu.monitor.sampler import (
+    BrokerMetadata, ClusterMetadata, PartitionMetadata, SyntheticLoadSampler)
+
+W = 4  # aggregation windows
+
+
+def _metadata(num_brokers=10, num_parts=60, rf=3, dead=(),
+              drop_replica=None, generation=1):
+    brokers = [BrokerMetadata(i, rack=f"r{i % 3}", host=f"h{i}",
+                              alive=i not in dead)
+               for i in range(num_brokers)]
+    parts = []
+    for p in range(num_parts):
+        reps = tuple((p + j) % num_brokers for j in range(rf))
+        if drop_replica == p:
+            reps = reps[:-1]          # the "killed" replica
+        parts.append(PartitionMetadata(topic=f"T{p % 6}", partition=p,
+                                       leader=reps[0], replicas=reps))
+    return ClusterMetadata(brokers=brokers, partitions=parts,
+                           generation=generation)
+
+
+def _agg(metadata, seed, generation):
+    parts = metadata.partitions
+    P = len(parts)
+    rng = np.random.default_rng(seed)
+    return AggregationResult(
+        entities=[(pm.topic, pm.partition) for pm in parts],
+        values=rng.exponential(50.0, (P, W, md.NUM_MODEL_METRICS)),
+        window_times=np.arange(W, dtype=np.int64) * 60_000,
+        extrapolations=np.zeros((P, W), np.int8),
+        completeness=Completeness(np.ones(W, np.float32), 1.0, 1, W, P),
+        generation=generation)
+
+
+def _monitor(metadata):
+    return LoadMonitor(StaticMetadataSource(metadata),
+                       SyntheticLoadSampler())
+
+
+def _assert_model_equal(t1, a1, t2, a2):
+    for f in dataclasses.fields(t1):
+        v1, v2 = getattr(t1, f.name), getattr(t2, f.name)
+        if v1 is None or isinstance(v1, (str, int, float, bool, tuple)):
+            assert v1 == v2, f.name
+        else:
+            np.testing.assert_array_equal(
+                np.asarray(v1), np.asarray(v2), err_msg=f.name)
+    np.testing.assert_array_equal(np.asarray(a1.broker_of),
+                                  np.asarray(a2.broker_of))
+    np.testing.assert_array_equal(np.asarray(a1.leader_of),
+                                  np.asarray(a2.leader_of))
+
+
+# -- cache hit/miss rules ---------------------------------------------------
+
+def test_warm_refresh_exactly_matches_from_scratch(monkeypatch):
+    monkeypatch.setattr(LoadMonitor, "BULK_BUILD_THRESHOLD", 1)
+    meta = _metadata()
+    lm = _monitor(meta)
+    lm._build_model(meta, _agg(meta, seed=1, generation=1))
+    # same snapshot object, new loads -> identity fast-path hit
+    r2 = _agg(meta, seed=2, generation=2)
+    warm_t, warm_a = lm._build_model(meta, r2)
+    assert (lm.model_cache_hits, lm.model_cache_misses) == (1, 1)
+    scratch_t, scratch_a = _monitor(meta)._build_model(meta, r2)
+    _assert_model_equal(warm_t, warm_a, scratch_t, scratch_a)
+
+
+def test_digest_hit_on_equal_structure_new_snapshot(monkeypatch):
+    monkeypatch.setattr(LoadMonitor, "BULK_BUILD_THRESHOLD", 1)
+    meta = _metadata()
+    lm = _monitor(meta)
+    lm._build_model(meta, _agg(meta, seed=1, generation=1))
+    # a NEW metadata object, structurally identical, same generation
+    meta2 = _metadata()
+    assert meta2 is not meta
+    assert metadata_structure_digest(meta2) == metadata_structure_digest(meta)
+    warm_t, warm_a = lm._build_model(meta2, _agg(meta2, 2, 1))
+    assert (lm.model_cache_hits, lm.model_cache_misses) == (1, 1)
+    scratch_t, scratch_a = _monitor(meta2)._build_model(
+        meta2, _agg(meta2, 2, 1))
+    _assert_model_equal(warm_t, warm_a, scratch_t, scratch_a)
+
+
+@pytest.mark.parametrize("drift", ["partitions", "broker", "dead",
+                                   "replica", "generation"])
+def test_cache_miss_on_structural_drift(monkeypatch, drift):
+    monkeypatch.setattr(LoadMonitor, "BULK_BUILD_THRESHOLD", 1)
+    meta = _metadata()
+    lm = _monitor(meta)
+    lm._build_model(meta, _agg(meta, seed=1, generation=1))
+    drifted = {
+        "partitions": _metadata(num_parts=61, generation=2),
+        "broker": _metadata(num_brokers=11, generation=2),
+        "dead": _metadata(dead=(3,), generation=2),
+        "replica": _metadata(drop_replica=0, generation=2),
+        "generation": _metadata(generation=2),
+    }[drift]
+    lm._build_model(drifted, _agg(drifted, seed=2, generation=2))
+    assert (lm.model_cache_hits, lm.model_cache_misses) == (0, 2)
+
+
+def test_cache_miss_on_include_all_topics_flip(monkeypatch):
+    monkeypatch.setattr(LoadMonitor, "BULK_BUILD_THRESHOLD", 1)
+    meta = _metadata()
+    lm = _monitor(meta)
+    lm._build_model(meta, _agg(meta, 1, 1), include_all_topics=False)
+    lm._build_model(meta, _agg(meta, 2, 1), include_all_topics=True)
+    assert (lm.model_cache_hits, lm.model_cache_misses) == (0, 2)
+
+
+def test_cache_miss_on_entity_set_drift(monkeypatch):
+    monkeypatch.setattr(LoadMonitor, "BULK_BUILD_THRESHOLD", 1)
+    meta = _metadata()
+    lm = _monitor(meta)
+    lm._build_model(meta, _agg(meta, 1, 1))
+    r2 = _agg(meta, 2, 1)
+    r2 = dataclasses.replace(r2, entities=list(reversed(r2.entities)))
+    lm._build_model(meta, r2)
+    assert (lm.model_cache_hits, lm.model_cache_misses) == (0, 2)
+
+
+def test_small_models_bypass_cache():
+    """Below BULK_BUILD_THRESHOLD the per-replica builder path runs and the
+    cache stays cold (the threshold IS the cache-engagement gate, keeping
+    the builder/bulk parity tests honest)."""
+    meta = _metadata()
+    lm = _monitor(meta)
+    lm._build_model(meta, _agg(meta, 1, 1))
+    lm._build_model(meta, _agg(meta, 2, 2))
+    assert lm.model_cache_hits == 0
+
+
+def test_state_snapshot_reports_cache_counters(monkeypatch):
+    monkeypatch.setattr(LoadMonitor, "BULK_BUILD_THRESHOLD", 1)
+    meta = _metadata()
+    lm = _monitor(meta)
+    lm._build_model(meta, _agg(meta, 1, 1))
+    lm._build_model(meta, _agg(meta, 2, 2))
+    snap = lm.state_snapshot()
+    assert snap["modelCacheHits"] == 1
+    assert snap["modelCacheMisses"] == 1
+
+
+# -- drift within one bucket: zero retraces, identical proposals ------------
+
+def test_drift_within_bucket_zero_retraces_identical_proposals(monkeypatch):
+    """The tentpole's end-to-end story: warm the bucketed programs once,
+    then drift the cluster (add a broker, add partitions, kill a replica)
+    WITHIN one bucket — every optimize() tick reuses the compiled programs
+    (zero uncovered retraces under the sentinel), and a warm-cache model
+    build optimizes to exactly the proposals of a from-scratch build."""
+    monkeypatch.setattr(LoadMonitor, "BULK_BUILD_THRESHOLD", 1)
+    cfg = AnnealConfig(num_chains=8, steps=128, swap_interval=32,
+                       tries_move=8, tries_lead=4, tries_swap=4)
+
+    def run(topo, assign, seed=11):
+        return OPT.optimize(topo, assign, engine="anneal",
+                            anneal_config=cfg, seed=seed,
+                            polish_cycles=0, bucketing=True)
+
+    # warm: compile the bucketed programs at the bucket shapes
+    meta0 = _metadata(num_brokers=10, num_parts=60, rf=3)
+    topo0, a0 = _monitor(meta0)._build_model(meta0, _agg(meta0, 1, 1))
+    run(topo0, a0)
+
+    drifts = [
+        _metadata(num_brokers=11, num_parts=60, rf=3, generation=2),
+        _metadata(num_brokers=11, num_parts=70, rf=3, generation=3),
+        _metadata(num_brokers=11, num_parts=70, rf=3, drop_replica=0,
+                  generation=4),
+    ]
+    with retrace_sentinel() as log:
+        for i, meta in enumerate(drifts):
+            lm = _monitor(meta)
+            topo, assign = lm._build_model(
+                meta, _agg(meta, seed=10 + i, generation=meta.generation))
+            run(topo, assign)
+    uncovered = check_steady_state(log, strict=False)
+    assert uncovered == [], log.summary()
+
+    # warm-cache vs from-scratch build -> identical proposals
+    last = drifts[-1]
+    lm = _monitor(last)
+    lm._build_model(last, _agg(last, seed=20, generation=4))
+    r_load_only = _agg(last, seed=21, generation=5)
+    warm_t, warm_a = lm._build_model(last, r_load_only)       # cache hit
+    assert lm.model_cache_hits == 1
+    scratch_t, scratch_a = _monitor(last)._build_model(last, r_load_only)
+    res_warm = run(warm_t, warm_a)
+    res_scratch = run(scratch_t, scratch_a)
+    props_warm = PR.diff(warm_t, warm_a, res_warm.final_assignment)
+    props_scratch = PR.diff(scratch_t, scratch_a,
+                            res_scratch.final_assignment)
+    assert set(props_warm) == set(props_scratch)
+    assert props_warm, "drifted fixture should produce at least one proposal"

@@ -1,4 +1,4 @@
-"""graftlint rules G001-G008: JAX/XLA hazard AST passes.
+"""graftlint rules G001-G010: JAX/XLA hazard AST passes.
 
 Each rule is registered with the engine and yields :class:`engine.Finding`s.
 The rules are deliberately heuristic — a static pass cannot prove an array is
@@ -22,10 +22,8 @@ G005  dtype-promotion hazard: host ``np.*`` array constructors without an
       explicit dtype in device-adjacent code (numpy defaults are
       float64/int64; x64-disabled JAX silently downcasts, x64-enabled JAX
       silently upcasts the whole expression).
-G006  Retrace storms: ``jax.jit`` wrapping created inside a function body
-      (fresh callable per call defeats the jit cache), and
-      ``static_argnums``/``static_argnames`` on high-cardinality values
-      (every distinct value is a full retrace).
+G006  Retrace storms from statics: ``static_argnums``/``static_argnames``
+      on high-cardinality values (every distinct value is a full retrace).
 G007  Config keys defined but never consumed by source (the reference's
       config-key audit, as a lint rule).
 G008  Forbidden impurity inside a jitted function — ``np.random``/
@@ -35,6 +33,13 @@ G009  Silent broad exception swallow — an ``except Exception:`` /
       ``except BaseException:`` / bare ``except:`` block that neither
       logs, re-raises, nor carries a ``# graftlint: disable=G009``
       justification turns a permanently-failing path invisible.
+G010  Fresh-wrapper-per-call retrace hazard: ``jax.jit(...)`` or
+      ``partial(jax.jit, ...)`` evaluated inside a function body builds a
+      new callable (and a new jit cache) on every invocation of the
+      enclosing function — zero cache hits, one trace+compile per call.
+      The static twin of ``retrace_sentinel()``
+      (cruise_control_tpu/common/sentinels.py): the sentinel catches the
+      storm at runtime, this rule catches it in review.
 
 Concurrency family (G101-G105) — lock discipline over the service's daemon
 threads and pools, paired with the runtime sanitizer in
@@ -666,22 +671,7 @@ def check_retrace_storm(ctx: ModuleContext) -> Iterator[Finding]:
             continue
         if _suppressed(ctx, node, "G006"):
             continue
-        # (a) jit wrapper built inside a function body: fresh function
-        # object per call -> zero cache hits, one retrace per invocation
-        if _enclosing_function(ctx, node) is not None:
-            par = ctx.parents.get(node)
-            is_decorator = (isinstance(
-                par, (ast.FunctionDef, ast.AsyncFunctionDef))
-                and node in par.decorator_list)
-            # decorating a NESTED def is the same hazard (new def object
-            # per enclosing call), so no exemption for decorators
-            del is_decorator, par
-            yield ctx.finding(
-                "G006", node,
-                "`jax.jit` wrapper created inside a function body — a "
-                "fresh callable per call never hits the jit cache "
-                "(one full retrace per invocation); hoist to module level")
-        # (b) high-cardinality statics
+        # high-cardinality statics (in-body wrapper creation is G010)
         statics = _jit_call_statics(node)
         suspects = sorted(statics & SUSPECT_STATIC_NAMES)
         if suspects:
@@ -839,6 +829,48 @@ def check_silent_broad_except(ctx: ModuleContext) -> Iterator[Finding]:
             f"broad `{label}` swallows the error without logging or "
             f"re-raising — a permanently-failing path becomes invisible; "
             f"log it, re-raise, or justify with `# graftlint: disable=G009`")
+
+
+# --------------------------------------------------------------------------
+# G010 — jit wrapper created inside a function body
+# --------------------------------------------------------------------------
+
+@file_rule("G010", "jit-wrapper-in-body")
+def check_jit_wrapper_in_body(ctx: ModuleContext) -> Iterator[Finding]:
+    """``jax.jit(...)`` / ``partial(jax.jit, ...)`` evaluated inside a
+    function body: every invocation of the enclosing function builds a
+    fresh callable with an empty jit cache, so the wrapped computation
+    trace+compiles on every call.  The static twin of the runtime
+    ``retrace_sentinel()`` — hoist the wrapper to module level (the warm
+    path's whole shape-bucketing scheme exists so module-level wrappers
+    stay hit across ticks)."""
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            is_partial_jit = (_is_partial_ref(node.func) and node.args
+                              and _is_jit_ref(node.args[0]))
+            if not (_is_jit_ref(node.func) or is_partial_jit):
+                continue
+            what = ("`partial(jax.jit, ...)`" if is_partial_jit
+                    else "`jax.jit`")
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # bare `@jax.jit` on a NESTED def: same hazard, no Call node
+            # (the `@jax.jit(...)` / `@partial(jax.jit, ...)` decorator
+            # forms are Calls and hit the branch above)
+            if not any(_is_jit_ref(d) for d in node.decorator_list
+                       if not isinstance(d, ast.Call)):
+                continue
+            what = "`@jax.jit`"
+        else:
+            continue
+        if _enclosing_function(ctx, node) is None:
+            continue
+        if _suppressed(ctx, node, "G010"):
+            continue
+        yield ctx.finding(
+            "G010", node,
+            f"{what} wrapper created inside a function body — a fresh "
+            f"callable per call never hits the jit cache (one full "
+            f"trace+compile per invocation); hoist to module level")
 
 
 @file_rule("G008", "impure-jit")
