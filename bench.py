@@ -144,6 +144,19 @@ def main():
             traceback.print_exc()
             model_build = None
 
+    # ---- provisioner what-if grid at bench scale: 64 counterfactual
+    # scenarios (adds + capacity scalings) scored by ONE vmapped compiled
+    # program. Non-fatal for the same reason as model_build: an extra
+    # measurement must not zero the headline number.
+    whatif = None
+    if size == "linkedin":
+        try:
+            whatif = _measure_whatif_grid(topo, assign)
+        except Exception:
+            import traceback
+            traceback.print_exc()
+            whatif = None
+
     # proposal decode alone (PR.diff: final assignment -> executor
     # proposals + movement stats) — the warm tick's tail stage, measured
     # on the steady-state result above
@@ -206,6 +219,8 @@ def main():
         out.update(model_build)
         warm_tick += model_build["warm_model_build_s"]
     out["warm_tick_s"] = round(warm_tick, 3)
+    if whatif is not None:
+        out.update(whatif)
 
     # ---- measured single-threaded baseline (round-5 VERDICT #1): the
     # north star's ">=20x vs single-threaded GoalOptimizer at
@@ -427,6 +442,42 @@ def _bench_selfheal(seed: int):
         "violated_goals_after_remove": len(r_rm.violated_goals_after),
         "device": str(jax.devices()[0].platform),
     }))
+
+
+def _measure_whatif_grid(topo, assign):
+    """Provisioner what-if: 64 scenarios (baseline + 31 broker adds + 32
+    capacity scalings) over the bench model, padded into ONE shared bucket
+    and scored by a single vmapped compiled call (provisioner.whatif).
+    Steady-state methodology matches the headline timer: warm once to
+    compile, time the second evaluation, which must perform ZERO retraces."""
+    import time as _time
+
+    from cruise_control_tpu import provisioner as PROV
+    from cruise_control_tpu.analyzer import goals as G
+    from cruise_control_tpu.common import sentinels as SENT
+    from cruise_control_tpu.common.resources import BalancingConstraint
+
+    scenarios = [PROV.Scenario("baseline", ())]
+    scenarios += [PROV.Scenario(f"add-{n}", (PROV.add_brokers(n),))
+                  for n in range(1, 32)]
+    for res_name in ("cpu", "nw_in", "nw_out", "disk"):
+        for f in (0.6, 0.8, 1.2, 1.4, 1.6, 1.8, 2.0, 2.2):
+            scenarios.append(PROV.Scenario(
+                f"scale-{res_name}-{f}", (PROV.scale_capacity(res_name, f),)))
+    assert len(scenarios) == 64
+    grid = PROV.compile_grid(topo, assign, tuple(scenarios))
+    constraint = BalancingConstraint()
+    goal_names = G.ANOMALY_DETECTION_GOALS
+    PROV.evaluate_grid(grid, constraint, goal_names)          # compile
+    t0 = _time.time()
+    with SENT.retrace_sentinel() as rl:
+        PROV.evaluate_grid(grid, constraint, goal_names)
+    elapsed = _time.time() - t0
+    return {
+        "whatif_grid_s": round(elapsed, 3),
+        "whatif_grid_scenarios": len(scenarios),
+        "whatif_grid_retraces": rl.count,
+    }
 
 
 def _measure_model_build(topo, assign):

@@ -221,6 +221,21 @@ class CruiseControlApp:
         from cruise_control_tpu.detector.anomalies import (
             BrokerFailures, DiskFailures, GoalViolations, MetricAnomaly,
             resolve_anomaly_class)
+        # provisioner: batched rightsizing grid shared by the goal-violation
+        # detector (an unfixable violation becomes an under-provisioned
+        # anomaly carrying the recommendation) and the RIGHTSIZE / WHAT_IF
+        # endpoints
+        from cruise_control_tpu.provisioner import Provisioner
+        self.provisioner = Provisioner(
+            constraint=self.constraint,
+            goal_names=tuple(config.get("anomaly.detection.goals")),
+            headroom_margin=config.get("provision.headroom.margin"),
+            max_added_brokers=config.get("provision.max.added.brokers"),
+            max_removed_brokers=config.get("provision.max.removed.brokers"),
+            balancedness_weights=self._balancedness_weights)
+        #: most recent rightsizing verdict (surfaced in /state; guarded by
+        #: _cache_lock)
+        self._last_provision_recommendation: Optional[dict] = None
         self.anomaly_detector = AnomalyDetectorService(
             notifier, context=self,
             has_ongoing_execution=lambda: self.executor.has_ongoing_execution,
@@ -244,6 +259,8 @@ class CruiseControlApp:
                         "anomaly.detection.allow.capacity.estimation"),
                     anomaly_class=resolve_anomaly_class(
                         config.get("goal.violations.class"), GoalViolations),
+                    provisioner=self.provisioner,
+                    on_recommendation=self._record_provision_recommendation,
                 ).detect,
                 "disk_failure": DiskFailureDetector(
                     adapter.describe_logdirs,
@@ -720,6 +737,86 @@ class CruiseControlApp:
                 **(executor_kw or {}))
             summary["execution"] = exec_summary
         return summary
+
+    def _record_provision_recommendation(self, rec) -> None:
+        """Latest rightsizing verdict, surfaced in /state (called by the
+        goal-violation detector and the RIGHTSIZE runnable)."""
+        with self._cache_lock:
+            self._last_provision_recommendation = rec.to_dict()
+
+    def what_if(self, add_broker_counts: Sequence[int] = (),
+                add_broker_rack: Optional[str] = None,
+                remove_broker_ids: Sequence[int] = (),
+                fail_racks: Sequence[str] = (),
+                scale_capacity: Sequence[str] = (),
+                add_partitions: Sequence[str] = (),
+                deep: bool = False,
+                headroom_margin: Optional[float] = None,
+                allow_capacity_estimation: bool = True,
+                data_from: Optional[str] = None,
+                min_valid_partition_ratio: Optional[float] = None,
+                **_ignored) -> dict:
+        """WHAT_IF: score counterfactual scenarios against the hard goals
+        in one compiled batch (always includes the as-is baseline).
+
+        ``scale_capacity`` entries are ``resource:factor`` (e.g.
+        ``disk:0.5``); ``add_partitions`` entries are ``topic:count``."""
+        from cruise_control_tpu import provisioner as PROV
+        scenarios = [PROV.Scenario("baseline", ())]
+        for n in add_broker_counts:
+            scenarios.append(PROV.Scenario(
+                f"add-{int(n)}",
+                (PROV.add_brokers(int(n), rack=add_broker_rack),)))
+        if remove_broker_ids:
+            ids = tuple(int(b) for b in remove_broker_ids)
+            scenarios.append(PROV.Scenario(
+                "remove-" + ",".join(str(b) for b in ids),
+                (PROV.remove_brokers(ids),)))
+        for rack in fail_racks:
+            scenarios.append(PROV.Scenario(
+                f"fail-rack-{rack}", (PROV.fail_rack(rack),)))
+        for spec in scale_capacity:
+            res_name, _, factor = str(spec).partition(":")
+            scenarios.append(PROV.Scenario(
+                f"scale-{res_name}-{factor}",
+                (PROV.scale_capacity(res_name, float(factor)),)))
+        for spec in add_partitions:
+            topic, _, count = str(spec).partition(":")
+            scenarios.append(PROV.Scenario(
+                f"add-partitions-{topic}-{count}",
+                (PROV.add_partitions(topic, int(count)),)))
+        topo, assign = self._model(
+            data_from=data_from,
+            min_valid_partition_ratio=min_valid_partition_ratio)
+        self._check_capacity_estimation(allow_capacity_estimation)
+        return self.provisioner.what_if(
+            topo, assign, scenarios, deep=deep,
+            headroom=headroom_margin).to_dict()
+
+    def rightsize(self, headroom_margin: Optional[float] = None,
+                  max_added_brokers: Optional[int] = None,
+                  max_removed_brokers: Optional[int] = None,
+                  deep: bool = False,
+                  verbose: bool = False,
+                  allow_capacity_estimation: bool = True,
+                  data_from: Optional[str] = None,
+                  min_valid_partition_ratio: Optional[float] = None,
+                  **_ignored) -> dict:
+        """RIGHTSIZE: classify the cluster UNDER/OVER/RIGHT_SIZED and
+        record the verdict (RightsizeRunnable surface)."""
+        topo, assign = self._model(
+            data_from=data_from,
+            min_valid_partition_ratio=min_valid_partition_ratio)
+        self._check_capacity_estimation(allow_capacity_estimation)
+        rec, grid = self.provisioner.recommend(
+            topo, assign, headroom_margin=headroom_margin,
+            max_added_brokers=max_added_brokers,
+            max_removed_brokers=max_removed_brokers, deep=deep)
+        self._record_provision_recommendation(rec)
+        out = rec.to_dict()
+        if verbose:
+            out["whatIf"] = grid.to_dict()
+        return out
 
     def add_brokers(self, broker_ids: Sequence[int], dryrun: bool = True,
                     data_from: Optional[str] = None,
@@ -1215,6 +1312,7 @@ class CruiseControlApp:
         with self._cache_lock:
             proposal_ready = self._proposal_cache is not None
             last_fallback = self._last_fallback
+            last_provision = self._last_provision_recommendation
         out = {
             "MonitorState": self.load_monitor.state_snapshot(),
             "ExecutorState": self.executor.state_snapshot(),
@@ -1223,6 +1321,7 @@ class CruiseControlApp:
                 "readyGoals": list(self._ready_goals()),
                 "lastOptimizationFallback": last_fallback,
                 "precomputeFailures": self._precompute_failures,
+                "lastProvisionRecommendation": last_provision,
             },
             "AnomalyDetectorState": self.anomaly_detector.state_snapshot(),
         }

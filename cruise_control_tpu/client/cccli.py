@@ -141,6 +141,26 @@ ENDPOINTS: List[Endpoint] = [
         Parameter("start", "start", "int"), Parameter("end", "end", "int"),
         Parameter("clearmetrics", "clearmetrics", "bool",
                   "Clear previous training samples (default true)"),)),
+    Endpoint("what_if", "GET", "Score counterfactual scenarios", (
+        Parameter("add_brokers", "add-brokers", "csv-int",
+                  "Broker counts to add (one scenario per count)"),
+        Parameter("add_broker_rack", "add-broker-rack", "string",
+                  "Rack for added brokers (default: one new rack each)"),
+        Parameter("remove_broker_ids", "remove-brokers", "csv-int",
+                  "Broker ids to remove (one combined scenario)"),
+        Parameter("fail_racks", "fail-racks", "csv",
+                  "Racks to fail (one scenario per rack)"),
+        Parameter("scale_capacity", "scale-capacity", "csv",
+                  "resource:factor pairs, e.g. disk:0.5,cpu:1.5"),
+        Parameter("add_partitions", "add-partitions", "csv",
+                  "topic:count pairs"),
+        Parameter("deep", "deep", "bool",
+                  "Anneal each scenario for a post-rebalance estimate"),
+        Parameter("headroom_margin", "headroom-margin", "string",
+                  "Capacity headroom fraction (0..1)"),
+        Parameter("allow_capacity_estimation",
+                  "allow-capacity-estimation", "bool"),
+        Parameter("data_from", "data-from", "string"),), is_async=True),
     Endpoint("rebalance", "POST", "Rebalance the cluster", (
         _DRYRUN, _GOALS,
         Parameter("excluded_topics", "excluded-topics", "csv"),
@@ -211,6 +231,18 @@ ENDPOINTS: List[Endpoint] = [
     Endpoint("review", "POST", "Approve/discard review requests", (
         Parameter("approve", "approve", "csv-int"),
         Parameter("discard", "discard", "csv-int"),)),
+    Endpoint("rightsize", "POST", "Rightsizing recommendation", (
+        Parameter("headroom_margin", "headroom-margin", "string",
+                  "Capacity headroom fraction (0..1)"),
+        Parameter("max_added_brokers", "max-added-brokers", "int"),
+        Parameter("max_removed_brokers", "max-removed-brokers", "int"),
+        Parameter("deep", "deep", "bool",
+                  "Anneal each candidate for a post-rebalance estimate"),
+        Parameter("verbose", "verbose", "bool",
+                  "Include the full what-if grid"),
+        Parameter("allow_capacity_estimation",
+                  "allow-capacity-estimation", "bool"),
+        Parameter("data_from", "data-from", "string"),), is_async=True),
     Endpoint("topic_configuration", "POST", "Change topic replication factor", (
         Parameter("topic", "topic", "string", "Topic regex"),
         Parameter("replication_factor", "replication-factor", "int"),

@@ -378,6 +378,18 @@ def _service_config_def() -> ConfigDef:
              "(SlowBrokerFinder escalation).")
     d.define("slow.broker.decommission.score", T.INT, 6, I.LOW,
              "Consecutive slow detections before removal.")
+    # provisioner (provision/ProvisionRecommendation semantics): rightsizing
+    # grid bounds + the capacity headroom a recommendation must preserve
+    d.define("provision.headroom.margin", T.DOUBLE, 0.1, I.MEDIUM,
+             "Fraction of thresholded capacity the rightsizer keeps free "
+             "when judging a broker count feasible (0 = size to the limit).",
+             between(0.0, 1.0))
+    d.define("provision.max.added.brokers", T.INT, 16, I.MEDIUM,
+             "Largest broker-addition scenario in the rightsizing grid.",
+             at_least(1))
+    d.define("provision.max.removed.brokers", T.INT, 8, I.MEDIUM,
+             "Largest broker-removal scenario in the rightsizing grid "
+             "(0 disables over-provisioning detection).", at_least(0))
     # webserver (KafkaCruiseControlMain/WebServerConfig)
     d.define("webserver.http.port", T.INT, 9090, I.HIGH, "REST port.")
     d.define("webserver.http.address", T.STRING, "127.0.0.1", I.HIGH,

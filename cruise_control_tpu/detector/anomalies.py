@@ -95,6 +95,9 @@ class GoalViolations(Anomaly):
 
     fixable_violated_goals: List[str] = dataclasses.field(default_factory=list)
     unfixable_violated_goals: List[str] = dataclasses.field(default_factory=list)
+    #: provisioner verdict when the detector decided some violations are
+    #: unfixable by any assignment (ProvisionRecommendation.to_dict())
+    provision_recommendation: Optional[dict] = None
 
     def fix(self, context):
         if not self.fixable_violated_goals:
@@ -102,9 +105,12 @@ class GoalViolations(Anomaly):
         return context.rebalance(self_healing=True)
 
     def summary(self):
-        return {**super().summary(),
-                "fixableViolatedGoals": self.fixable_violated_goals,
-                "unfixableViolatedGoals": self.unfixable_violated_goals}
+        s = {**super().summary(),
+             "fixableViolatedGoals": self.fixable_violated_goals,
+             "unfixableViolatedGoals": self.unfixable_violated_goals}
+        if self.provision_recommendation is not None:
+            s["provisionRecommendation"] = self.provision_recommendation
+        return s
 
 
 @dataclasses.dataclass

@@ -103,7 +103,8 @@ class GoalViolationDetector:
 
     def __init__(self, load_monitor, goal_names: Optional[Sequence[str]] = None,
                  allow_capacity_estimation: bool = True, now_fn=_now_ms,
-                 anomaly_class: type = GoalViolations):
+                 anomaly_class: type = GoalViolations,
+                 provisioner=None, on_recommendation=None):
         from cruise_control_tpu.analyzer import goals as G
         self._lm = load_monitor
         self._goals = tuple(goal_names or G.ANOMALY_DETECTION_GOALS)
@@ -112,6 +113,13 @@ class GoalViolationDetector:
         self._now = now_fn
         #: goal.violations.class
         self._anomaly_class = anomaly_class
+        #: optional cruise_control_tpu.provisioner.Provisioner — violations
+        #: no assignment can fix become an under-provisioned anomaly
+        #: carrying the recommendation instead of a futile self-heal
+        self._provisioner = provisioner
+        #: callback(ProvisionRecommendation) — the app records the latest
+        #: verdict for /state
+        self._on_recommendation = on_recommendation
 
     def detect(self) -> Optional[GoalViolations]:
         from cruise_control_tpu.analyzer import goals as G
@@ -139,11 +147,29 @@ class GoalViolationDetector:
         violated = [g for i, g in enumerate(self._goals) if viol[i] > 0]
         if viol[-1] > 0:           # offline/self-healing term
             violated.append("OfflineReplicas")
-        if violated:
-            return self._anomaly_class(AnomalyType.GOAL_VIOLATION,
-                                       self._now(),
-                                       fixable_violated_goals=violated)
-        return None
+        if not violated:
+            return None
+        unfixable: Set[str] = set()
+        rec_dict = None
+        if self._provisioner is not None:
+            try:
+                rec, _ = self._provisioner.recommend(topo, assign)
+                unfixable = set(rec.unfixable_goals)
+                rec_dict = rec.to_dict()
+                if self._on_recommendation is not None:
+                    self._on_recommendation(rec)
+            except Exception:
+                # a broken rightsizing pass must not swallow the violation
+                # anomaly itself — self-healing still has to run
+                logger.exception("provision recommendation failed; "
+                                 "reporting all violations as fixable")
+        return self._anomaly_class(
+            AnomalyType.GOAL_VIOLATION, self._now(),
+            fixable_violated_goals=[g for g in violated
+                                    if g not in unfixable],
+            unfixable_violated_goals=[g for g in violated
+                                     if g in unfixable],
+            provision_recommendation=rec_dict)
 
 
 class DiskFailureDetector:
@@ -175,18 +201,29 @@ def percentile_anomalies(history: np.ndarray, current: float,
                          upper_margin: float = 0.5,
                          lower_margin: float = 0.2) -> Optional[str]:
     """core PercentileMetricAnomalyFinder.java: current value beyond
-    [P_low·(1−margin·…), P_high·(1+margin)] of its own history."""
+    [P_low·(1−margin·…), P_high·(1+margin)] of its own history.
+
+    Thin np wrapper over :func:`cruise_control_tpu.ops.stats.
+    percentile_flags` (the jnp/vmappable implementation the provisioner's
+    headroom logic shares). An empty or too-short history is NOT an
+    anomaly — a zero-length percentile window is undefined, so the guard
+    returns None before the kernel runs."""
+    import jax.numpy as jnp
+    from cruise_control_tpu.ops import stats as STATS
     history = np.asarray(history, dtype=np.float64)
     if history.size < 3:
         return None
-    hi = np.percentile(history, upper_percentile)
-    lo = np.percentile(history, lower_percentile)
-    if current > hi * (1 + upper_margin):
+    flags = STATS.percentile_flags(
+        jnp.asarray(history, jnp.float32), jnp.float32(current),
+        jnp.float32(upper_percentile), jnp.float32(lower_percentile),
+        jnp.float32(upper_margin), jnp.float32(lower_margin))
+    if bool(flags.above):
         return (f"value {current:.3f} above {upper_percentile:.0f}th "
-                f"percentile {hi:.3f} * {1 + upper_margin:.2f}")
-    if current < lo * lower_margin:
+                f"percentile {float(flags.upper):.3f} * "
+                f"{1 + upper_margin:.2f}")
+    if bool(flags.below):
         return (f"value {current:.3f} below {lower_percentile:.0f}th "
-                f"percentile {lo:.3f} * {lower_margin:.2f}")
+                f"percentile {float(flags.lower):.3f} * {lower_margin:.2f}")
     return None
 
 

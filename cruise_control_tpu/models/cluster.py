@@ -662,7 +662,11 @@ class PaddingInfo:
     num_replicas: int
 
 
-def pad_topology(topo: ClusterTopology, assign: Assignment
+def pad_topology(topo: ClusterTopology, assign: Assignment, *,
+                 broker_target: "Optional[int]" = None,
+                 host_target: "Optional[int]" = None,
+                 partition_target: "Optional[int]" = None,
+                 replica_target: "Optional[int]" = None,
                  ) -> "tuple[ClusterTopology, Assignment, PaddingInfo]":
     """Pad (topology, assignment) to bucketed axis sizes with neutral
     sentinel entries.
@@ -686,19 +690,35 @@ def pad_topology(topo: ClusterTopology, assign: Assignment
     computed on ``n+1``) so the sentinel host/rack rows are well-defined.
     Returns the padded pair plus a :class:`PaddingInfo` with the real sizes;
     real entries occupy the axis *prefix*, so decode is a plain slice.
+
+    The ``*_target`` keywords override the per-axis bucket choice with an
+    explicit padded size (the provisioner pads every scenario of a what-if
+    grid to ONE shared bucket so the batch stacks into a single vmapped
+    program). A target must leave room for the sentinel rows the padding
+    scheme requires — at least one padded broker/host/partition, and one
+    padded replica per padded partition; too-small targets raise.
     """
     import jax as _jax
 
     B, P, R = topo.num_brokers, topo.num_partitions, topo.num_replicas
     H, K = topo.num_hosts, topo.num_racks
     m = topo.max_rf
-    B_pad = bucket_size(B + 1, BROKER_BUCKET_FLOOR)
-    P_pad = bucket_size(P + 1, PARTITION_BUCKET_FLOOR)
+    B_pad = (bucket_size(B + 1, BROKER_BUCKET_FLOOR)
+             if broker_target is None else int(broker_target))
+    P_pad = (bucket_size(P + 1, PARTITION_BUCKET_FLOOR)
+             if partition_target is None else int(partition_target))
     n_pb = B_pad - B
     n_pp = P_pad - P
-    H_pad = bucket_size(H + 1, HOST_BUCKET_FLOOR)
-    R_pad = bucket_size(R + n_pp, REPLICA_BUCKET_FLOOR)
+    H_pad = (bucket_size(H + 1, HOST_BUCKET_FLOOR)
+             if host_target is None else int(host_target))
+    R_pad = (bucket_size(R + n_pp, REPLICA_BUCKET_FLOOR)
+             if replica_target is None else int(replica_target))
     n_pr = R_pad - R
+    if n_pb < 1 or n_pp < 1 or H_pad < H + 1 or n_pr < n_pp:
+        raise ValueError(
+            f"pad targets too small: B {B}->{B_pad}, H {H}->{H_pad}, "
+            f"P {P}->{P_pad}, R {R}->{R_pad} (need >=1 padded "
+            "broker/host/partition and a padded replica per padded partition)")
 
     def _pad(arr, n, fill):
         arr = np.asarray(arr)

@@ -254,3 +254,42 @@ def sanity_check(dt: DeviceTopology, assign: Assignment, num_topics: int) -> dic
         "replica_count_consistent": bool(count_ok),
         "leader_count_consistent": bool(leader_count_ok),
     }
+
+
+# ---------------------------------------------------------------------------
+# Robust-stats percentile band (PercentileMetricAnomalyFinder.java core).
+# Shared by the MetricAnomalyDetector (thin np wrapper in
+# detector/detectors.py keeps its message format) and the provisioner's
+# adaptive headroom margin. jnp + vmappable: flags instead of Optional[str].
+# ---------------------------------------------------------------------------
+
+
+class PercentileFlags(NamedTuple):
+    """Outcome of one percentile-band check (all 0-d arrays; ``above`` /
+    ``below`` are bool, the rest f32)."""
+
+    above: jax.Array
+    below: jax.Array
+    upper: jax.Array   # the raw upper-percentile value of the history
+    lower: jax.Array   # the raw lower-percentile value of the history
+
+
+@partial(jax.jit, static_argnames=())
+def percentile_flags(history: jax.Array, current: jax.Array,
+                     upper_percentile: jax.Array,
+                     lower_percentile: jax.Array,
+                     upper_margin: jax.Array,
+                     lower_margin: jax.Array) -> PercentileFlags:
+    """``current`` beyond [P_low·lower_margin, P_high·(1+upper_margin)] of
+    its own ``history``. Pure jnp so a [N, W] history batch vmaps to [N]
+    verdicts in one program; callers guard the degenerate empty-history
+    case (a zero-length percentile window is undefined, not an anomaly)."""
+    hi = jnp.percentile(history, upper_percentile)
+    lo = jnp.percentile(history, lower_percentile)
+    current = jnp.asarray(current, hi.dtype)
+    return PercentileFlags(
+        above=current > hi * (1.0 + upper_margin),
+        below=current < lo * lower_margin,
+        upper=hi,
+        lower=lo,
+    )

@@ -1,0 +1,39 @@
+"""Provisioner: batched counterfactual what-if engine + rightsizing.
+
+The reference Cruise Control ships a Provisioner subsystem
+(``provision/Provisioner.java``, ``ProvisionRecommendation.java``): goals
+report UNDER/OVER_PROVISIONED status and the detector turns "no feasible
+fix" into an add-capacity recommendation. This package is the TPU-shaped
+port: a counterfactual is just a mutated :class:`ClusterTopology`, so an
+entire grid of scenarios pads into ONE shared shape bucket and scores as a
+single vmapped ``full_goal_penalties`` call — the reference's one-at-a-time
+simulation becomes one compiled batch.
+
+- :mod:`.scenarios` — declarative scenario spec + host-side grid compiler
+- :mod:`.whatif` — vmapped grid evaluator (+ optional deep anneal mode)
+- :mod:`.provisioner` — recommendation fold + detector/service surface
+"""
+
+from cruise_control_tpu.provisioner.provisioner import (  # noqa: F401
+    ProvisionRecommendation,
+    Provisioner,
+    RIGHT_SIZED,
+    OVER_PROVISIONED,
+    UNDER_PROVISIONED,
+)
+from cruise_control_tpu.provisioner.scenarios import (  # noqa: F401
+    Scenario,
+    ScenarioGrid,
+    add_brokers,
+    add_partitions,
+    apply_scenario,
+    compile_grid,
+    fail_rack,
+    remove_brokers,
+    scale_capacity,
+)
+from cruise_control_tpu.provisioner.whatif import (  # noqa: F401
+    ScenarioScore,
+    WhatIfResult,
+    evaluate_grid,
+)

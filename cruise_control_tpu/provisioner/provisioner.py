@@ -1,0 +1,231 @@
+"""Rightsizing: fold a what-if grid into a ProvisionRecommendation.
+
+Port of the reference Provisioner surface (``provision/
+ProvisionRecommendation.java``, ``RightsizeOptions.java``): classify the
+cluster UNDER/OVER/RIGHT_SIZED against the hard goals, find the minimum
+broker count that satisfies all of them under a configurable headroom
+margin, and report the cheapest feasible scenario + an estimate of the
+moves a subsequent rebalance needs.
+
+Classification runs on the assignment-invariant structural bounds from
+:mod:`.whatif` — an as-is violation that some assignment could fix is a
+job for self-healing, not for provisioning; only a bound that NO
+assignment can satisfy makes the cluster under-provisioned.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from cruise_control_tpu.analyzer import goals as G
+from cruise_control_tpu.analyzer.annealer import AnnealConfig
+from cruise_control_tpu.common.resources import BalancingConstraint
+from cruise_control_tpu.models.cluster import Assignment, ClusterTopology
+from cruise_control_tpu.provisioner.scenarios import (
+    BASELINE,
+    Scenario,
+    add_brokers,
+    compile_grid,
+    remove_brokers,
+)
+from cruise_control_tpu.provisioner.whatif import (
+    ScenarioScore,
+    WhatIfResult,
+    evaluate_grid,
+)
+
+UNDER_PROVISIONED = "UNDER_PROVISIONED"
+OVER_PROVISIONED = "OVER_PROVISIONED"
+RIGHT_SIZED = "RIGHT_SIZED"
+
+
+@dataclasses.dataclass(frozen=True)
+class ProvisionRecommendation:
+    """The operator-facing verdict (ProvisionRecommendation.java)."""
+
+    status: str
+    num_brokers: int                       # alive brokers today
+    recommended_brokers: Optional[int]     # min/target alive broker count
+    headroom_margin: float
+    unfixable_goals: Tuple[str, ...]       # hard goals no assignment fixes
+    cheapest_feasible_scenario: Optional[str]
+    moves_required: Optional[int]          # replica moves (estimate)
+    reason: str
+
+    @property
+    def delta_brokers(self) -> int:
+        if self.recommended_brokers is None:
+            return 0
+        return self.recommended_brokers - self.num_brokers
+
+    def to_dict(self) -> dict:
+        return {
+            "status": self.status,
+            "numBrokers": self.num_brokers,
+            "recommendedBrokers": self.recommended_brokers,
+            "deltaBrokers": self.delta_brokers,
+            "headroomMargin": self.headroom_margin,
+            "unfixableGoals": list(self.unfixable_goals),
+            "cheapestFeasibleScenario": self.cheapest_feasible_scenario,
+            "movesRequired": self.moves_required,
+            "reason": self.reason,
+        }
+
+
+def _move_estimate(score: ScenarioScore,
+                   goal_names: Sequence[str]) -> int:
+    """Replica moves a fix needs, from the scenario's as-is picture: every
+    offline replica must move, plus the as-is rack-excess replicas (each
+    excess is one misplaced replica). A lower bound — deep mode replaces
+    it with the anneal witness."""
+    if score.estimated_replica_moves is not None:
+        return score.estimated_replica_moves
+    rack_excess = 0.0
+    goal_names = tuple(goal_names)
+    if "RackAwareGoal" in goal_names:
+        rack_excess = float(
+            score.violations[goal_names.index("RackAwareGoal")])
+    return int(np.ceil(score.offline_replicas + rack_excess))
+
+
+class Provisioner:
+    """Batched rightsizing engine over the what-if grid evaluator."""
+
+    def __init__(self, constraint: Optional[BalancingConstraint] = None,
+                 goal_names: Optional[Sequence[str]] = None,
+                 headroom_margin: float = 0.1,
+                 max_added_brokers: int = 16,
+                 max_removed_brokers: int = 8,
+                 balancedness_weights=None,
+                 anneal_config: Optional[AnnealConfig] = None):
+        self._constraint = constraint or BalancingConstraint()
+        self._goals = tuple(goal_names or G.ANOMALY_DETECTION_GOALS)
+        self._headroom = float(headroom_margin)
+        self._max_added = int(max_added_brokers)
+        self._max_removed = int(max_removed_brokers)
+        self._balancedness_weights = balancedness_weights
+        self._anneal_config = anneal_config
+
+    # -- ad-hoc what-if (the WHAT_IF endpoint) ---------------------------
+
+    def what_if(self, topo: ClusterTopology, assign: Assignment,
+                scenarios: Sequence[Scenario], deep: bool = False,
+                headroom: Optional[float] = None,
+                seed: int = 0) -> WhatIfResult:
+        grid = compile_grid(topo, assign, tuple(scenarios))
+        return evaluate_grid(
+            grid, self._constraint, self._goals,
+            headroom=self._headroom if headroom is None else float(headroom),
+            balancedness_weights=self._balancedness_weights,
+            deep=deep, anneal_config=self._anneal_config, seed=seed)
+
+    # -- rightsizing (detector + RIGHTSIZE endpoint) ---------------------
+
+    def _least_loaded_alive(self, topo: ClusterTopology,
+                            assign: Assignment, k: int) -> Tuple[int, ...]:
+        """External ids of the k least-loaded alive brokers (ties by id)."""
+        bo = np.asarray(jax.device_get(assign.broker_of))
+        counts = np.bincount(bo, minlength=topo.num_brokers)
+        rows = sorted(np.flatnonzero(np.asarray(topo.broker_alive)),
+                      key=lambda b: (counts[b], b))[:k]
+        if topo.broker_ids is not None:
+            return tuple(int(topo.broker_ids[r]) for r in rows)
+        return tuple(int(r) for r in rows)
+
+    def recommend(self, topo: ClusterTopology, assign: Assignment,
+                  headroom_margin: Optional[float] = None,
+                  max_added_brokers: Optional[int] = None,
+                  max_removed_brokers: Optional[int] = None,
+                  deep: bool = False, seed: int = 0,
+                  ) -> Tuple[ProvisionRecommendation, WhatIfResult]:
+        """Classify the cluster and return (recommendation, full grid).
+
+        One compiled batch scores the baseline plus every add/remove
+        candidate; the fold below is pure host logic."""
+        headroom = (self._headroom if headroom_margin is None
+                    else float(headroom_margin))
+        max_add = (self._max_added if max_added_brokers is None
+                   else int(max_added_brokers))
+        max_rm = (self._max_removed if max_removed_brokers is None
+                  else int(max_removed_brokers))
+        n_alive = int(np.sum(np.asarray(topo.broker_alive)))
+        max_rm = min(max_rm, max(n_alive - 1, 0))
+
+        scenarios = [BASELINE]
+        scenarios += [Scenario(f"add-{n}", (add_brokers(n),))
+                      for n in range(1, max_add + 1)]
+        remove_ks = list(range(1, max_rm + 1))
+        for k in remove_ks:
+            ids = self._least_loaded_alive(topo, assign, k)
+            scenarios.append(Scenario(f"remove-{k}", (remove_brokers(ids),)))
+
+        result = self.what_if(topo, assign, scenarios, deep=deep,
+                              headroom=headroom, seed=seed)
+        base = result.scores[0]
+        adds = {n: result.score_of(f"add-{n}")
+                for n in range(1, max_add + 1)}
+        removes = {k: result.score_of(f"remove-{k}") for k in remove_ks}
+
+        if not base.feasible:
+            fix_n = next((n for n in sorted(adds) if adds[n].feasible), None)
+            if fix_n is None:
+                return ProvisionRecommendation(
+                    status=UNDER_PROVISIONED,
+                    num_brokers=n_alive,
+                    recommended_brokers=None,
+                    headroom_margin=headroom,
+                    unfixable_goals=base.infeasible_goals,
+                    cheapest_feasible_scenario=None,
+                    moves_required=None,
+                    reason=(f"no assignment satisfies "
+                            f"{', '.join(base.infeasible_goals)} even "
+                            f"after adding {max_add} brokers"),
+                ), result
+            chosen = adds[fix_n]
+            return ProvisionRecommendation(
+                status=UNDER_PROVISIONED,
+                num_brokers=n_alive,
+                recommended_brokers=n_alive + fix_n,
+                headroom_margin=headroom,
+                unfixable_goals=base.infeasible_goals,
+                cheapest_feasible_scenario=chosen.scenario.name,
+                moves_required=_move_estimate(chosen, self._goals),
+                reason=(f"{', '.join(base.infeasible_goals)} cannot be "
+                        f"satisfied by any assignment on {n_alive} alive "
+                        f"brokers; adding {fix_n} restores feasibility "
+                        f"with {headroom:.0%} headroom"),
+            ), result
+
+        shrink = max((k for k in remove_ks if removes[k].feasible),
+                     default=0)
+        if shrink > 0:
+            chosen = removes[shrink]
+            return ProvisionRecommendation(
+                status=OVER_PROVISIONED,
+                num_brokers=n_alive,
+                recommended_brokers=n_alive - shrink,
+                headroom_margin=headroom,
+                unfixable_goals=(),
+                cheapest_feasible_scenario=chosen.scenario.name,
+                moves_required=_move_estimate(chosen, self._goals),
+                reason=(f"all hard goals stay satisfiable with "
+                        f"{headroom:.0%} headroom after removing the "
+                        f"{shrink} least-loaded broker(s)"),
+            ), result
+
+        return ProvisionRecommendation(
+            status=RIGHT_SIZED,
+            num_brokers=n_alive,
+            recommended_brokers=n_alive,
+            headroom_margin=headroom,
+            unfixable_goals=(),
+            cheapest_feasible_scenario=BASELINE.name,
+            moves_required=0,
+            reason=(f"hard goals satisfiable on the current {n_alive} "
+                    f"alive brokers; no removal candidate keeps "
+                    f"{headroom:.0%} headroom"),
+        ), result

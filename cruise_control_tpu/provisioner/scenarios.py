@@ -1,0 +1,502 @@
+"""Declarative what-if scenarios + the host-side grid compiler.
+
+A :class:`Scenario` is a named tuple of ops applied in order to the frozen
+(:class:`ClusterTopology`, :class:`Assignment`) pair on the HOST — the same
+mutation idiom the service uses for real operations (``app.remove_brokers``):
+removed/failed brokers flip to dead and their replicas go offline, added
+brokers enter as empty-but-alive rows on fresh failure domains.
+
+``compile_grid`` pads every mutated scenario of a grid into ONE shared
+bucket (``pad_topology`` with explicit per-axis targets) so the broker /
+host / partition / replica axes agree across the batch and the whole grid
+stacks into a single vmapped program. For a singleton grid the shared
+targets collapse to exactly the stock ``pad_topology`` bucket choice, so a
+one-scenario grid is bit-identical to padding the mutated model directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.analyzer import goals as G
+from cruise_control_tpu.common import resources as res
+from cruise_control_tpu.models.cluster import (
+    BROKER_BUCKET_FLOOR,
+    HOST_BUCKET_FLOOR,
+    PARTITION_BUCKET_FLOOR,
+    REPLICA_BUCKET_FLOOR,
+    Assignment,
+    ClusterTopology,
+    PaddingInfo,
+    bucket_size,
+    pad_topology,
+)
+from cruise_control_tpu.ops.aggregates import DeviceTopology, device_topology
+
+# ---------------------------------------------------------------------------
+# Scenario spec
+# ---------------------------------------------------------------------------
+
+ADD_BROKERS = "ADD_BROKERS"
+REMOVE_BROKERS = "REMOVE_BROKERS"
+SCALE_CAPACITY = "SCALE_CAPACITY"
+FAIL_RACK = "FAIL_RACK"
+ADD_PARTITIONS = "ADD_PARTITIONS"
+
+#: resource spelling accepted by SCALE_CAPACITY — canonical names
+#: (``res.RESOURCE_NAMES``) plus the short aliases operators actually type
+_RESOURCE_ALIASES = {
+    "cpu": res.CPU,
+    "networkinbound": res.NW_IN,
+    "nw_in": res.NW_IN,
+    "networkoutbound": res.NW_OUT,
+    "nw_out": res.NW_OUT,
+    "disk": res.DISK,
+}
+
+
+def resolve_resource(name: str) -> int:
+    key = str(name).strip().lower()
+    if key not in _RESOURCE_ALIASES:
+        raise ValueError(
+            f"unknown resource {name!r}: use one of "
+            f"{sorted(set(_RESOURCE_ALIASES))}")
+    return _RESOURCE_ALIASES[key]
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioOp:
+    """One mutation step; use the module-level constructors below."""
+
+    kind: str
+    count: int = 0
+    rack: Optional[str] = None
+    broker_ids: Tuple[int, ...] = ()
+    resource: Optional[str] = None
+    factor: float = 1.0
+    topic: Optional[str] = None
+
+
+def add_brokers(count: int, rack: Optional[str] = None) -> ScenarioOp:
+    """``count`` empty-but-alive brokers. ``rack=None`` puts each on its OWN
+    new rack and host (conservative new-failure-domain assumption — what a
+    capacity request would actually provision); a named rack targets that
+    existing rack, or one shared new rack if the name is unknown."""
+    if count < 1:
+        raise ValueError(f"add_brokers needs count >= 1, got {count}")
+    return ScenarioOp(ADD_BROKERS, count=int(count), rack=rack)
+
+
+def remove_brokers(broker_ids: Sequence[int]) -> ScenarioOp:
+    """Flip the listed brokers dead + their replicas offline (the exact
+    ``app.remove_brokers`` decommission semantics)."""
+    ids = tuple(int(b) for b in broker_ids)
+    if not ids:
+        raise ValueError("remove_brokers needs at least one broker id")
+    return ScenarioOp(REMOVE_BROKERS, broker_ids=ids)
+
+
+def scale_capacity(resource: str, factor: float) -> ScenarioOp:
+    """Scale one capacity column by ``factor`` (e.g. disk 0.5 = half-size
+    volumes, cpu 2.0 = doubled cores)."""
+    if not factor > 0:
+        raise ValueError(f"scale_capacity factor must be > 0, got {factor}")
+    resolve_resource(resource)
+    return ScenarioOp(SCALE_CAPACITY, resource=str(resource),
+                      factor=float(factor))
+
+
+def fail_rack(rack: str) -> ScenarioOp:
+    """Kill every broker in the rack (rack name, or rack index when the
+    model carries no rack names)."""
+    return ScenarioOp(FAIL_RACK, rack=str(rack))
+
+
+def add_partitions(topic: str, count: int) -> ScenarioOp:
+    """Grow a topic by ``count`` partitions at the topic's typical rf,
+    placed rack-diverse on the least-loaded alive brokers, with loads set
+    to the topic's per-partition mean."""
+    if count < 1:
+        raise ValueError(f"add_partitions needs count >= 1, got {count}")
+    return ScenarioOp(ADD_PARTITIONS, topic=str(topic), count=int(count))
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """A named, ordered composition of ops. Empty ops = the baseline."""
+
+    name: str
+    ops: Tuple[ScenarioOp, ...] = ()
+
+
+BASELINE = Scenario("baseline", ())
+
+
+# ---------------------------------------------------------------------------
+# Host-side application of one scenario to the frozen model
+# ---------------------------------------------------------------------------
+
+
+def _apply_add_brokers(topo: ClusterTopology, bo: np.ndarray, lo: np.ndarray,
+                       op: ScenarioOp):
+    n = op.count
+    B, H, K = topo.num_brokers, topo.num_hosts, topo.num_racks
+    alive = np.asarray(topo.broker_alive)
+    cap_src = topo.capacity[alive] if alive.any() else topo.capacity
+    cap_row = np.asarray(cap_src, np.float32).mean(axis=0)
+
+    rack_names = tuple(topo.rack_names)
+    if op.rack is None:
+        new_racks = K + np.arange(n)
+        if rack_names:
+            rack_names += tuple(f"provision-rack-{K + i}" for i in range(n))
+    else:
+        if rack_names and op.rack in rack_names:
+            r = rack_names.index(op.rack)
+        else:
+            try:
+                r = int(op.rack)
+            except ValueError:
+                r = -1
+            if not 0 <= r < K:
+                r = K  # one shared new rack under the requested name
+                if rack_names:
+                    rack_names += (str(op.rack),)
+        new_racks = np.full(n, r)
+    new_hosts = H + np.arange(n)
+    host_names = tuple(topo.host_names)
+    if host_names:
+        host_names += tuple(f"provision-host-{H + i}" for i in range(n))
+
+    broker_ids = topo.broker_ids
+    if broker_ids is not None:
+        ids = np.asarray(broker_ids)
+        start = int(ids.max()) + 1
+        broker_ids = np.concatenate(
+            [ids, np.arange(start, start + n, dtype=ids.dtype)])
+
+    def _app(arr, fill):
+        arr = np.asarray(arr)
+        pad = np.full((n,) + arr.shape[1:], fill, dtype=arr.dtype)
+        return np.concatenate([arr, pad], axis=0)
+
+    topo = dataclasses.replace(
+        topo,
+        rack_of_broker=np.concatenate(
+            [np.asarray(topo.rack_of_broker),
+             new_racks.astype(topo.rack_of_broker.dtype)]),
+        host_of_broker=np.concatenate(
+            [np.asarray(topo.host_of_broker),
+             new_hosts.astype(topo.host_of_broker.dtype)]),
+        capacity=np.concatenate(
+            [np.asarray(topo.capacity),
+             np.tile(cap_row, (n, 1)).astype(topo.capacity.dtype)], axis=0),
+        broker_alive=_app(topo.broker_alive, True),
+        broker_new=_app(topo.broker_new, False),
+        broker_demoted=_app(topo.broker_demoted, False),
+        broker_bad_disks=_app(topo.broker_bad_disks, False),
+        broker_ids=broker_ids,
+        host_names=host_names,
+        rack_names=rack_names,
+    )
+    return topo, bo, lo
+
+
+def _broker_rows(topo: ClusterTopology, ids: Sequence[int]) -> List[int]:
+    """External broker ids → topology rows (``app.remove_brokers`` idiom)."""
+    if topo.broker_ids is not None:
+        idx = {int(b): i for i, b in enumerate(topo.broker_ids)}
+    else:
+        idx = {i: i for i in range(topo.num_brokers)}
+    rows = []
+    for b in ids:
+        if int(b) not in idx:
+            raise ValueError(f"unknown broker id {b}")
+        rows.append(idx[int(b)])
+    return rows
+
+
+def _kill_rows(topo: ClusterTopology, bo: np.ndarray, rows: Sequence[int]):
+    alive = np.asarray(topo.broker_alive).copy()
+    offline = np.asarray(topo.replica_offline).copy()
+    for r_i in rows:
+        alive[r_i] = False
+        offline |= bo == r_i
+    return dataclasses.replace(topo, broker_alive=alive,
+                               replica_offline=offline)
+
+
+def _apply_remove_brokers(topo, bo, lo, op: ScenarioOp):
+    rows = _broker_rows(topo, op.broker_ids)
+    return _kill_rows(topo, bo, rows), bo, lo
+
+
+def _apply_fail_rack(topo, bo, lo, op: ScenarioOp):
+    rack_names = tuple(topo.rack_names)
+    if rack_names and op.rack in rack_names:
+        k = rack_names.index(op.rack)
+    else:
+        try:
+            k = int(op.rack)
+        except ValueError:
+            raise ValueError(f"unknown rack {op.rack!r}") from None
+        if not 0 <= k < topo.num_racks:
+            raise ValueError(f"rack index {k} out of range "
+                             f"[0, {topo.num_racks})")
+    rows = np.flatnonzero(np.asarray(topo.rack_of_broker) == k)
+    return _kill_rows(topo, bo, rows), bo, lo
+
+
+def _apply_scale_capacity(topo, bo, lo, op: ScenarioOp):
+    r = resolve_resource(op.resource)
+    cap = np.asarray(topo.capacity).copy()
+    cap[:, r] *= op.factor
+    return dataclasses.replace(topo, capacity=cap), bo, lo
+
+
+def _apply_add_partitions(topo: ClusterTopology, bo: np.ndarray,
+                          lo: np.ndarray, op: ScenarioOp):
+    names = tuple(topo.topic_names)
+    if names and op.topic in names:
+        t = names.index(op.topic)
+    else:
+        try:
+            t = int(op.topic)
+        except ValueError:
+            raise ValueError(f"unknown topic {op.topic!r}") from None
+        if not 0 <= t < topo.num_topics:
+            raise ValueError(f"topic index {t} out of range "
+                             f"[0, {topo.num_topics})")
+    t_parts = np.flatnonzero(np.asarray(topo.topic_of_partition) == t)
+    if t_parts.size == 0:
+        raise ValueError(f"topic {op.topic!r} has no partitions to model "
+                         "the new ones after")
+    t_reps_mask = np.isin(np.asarray(topo.partition_of_replica), t_parts)
+    rfs = np.asarray(topo.rf_of_partition)[t_parts]
+    rf = int(np.bincount(rfs).argmax())  # the topic's typical rf
+    alive_rows = np.flatnonzero(np.asarray(topo.broker_alive))
+    if rf > alive_rows.size:
+        raise ValueError(
+            f"topic rf {rf} exceeds {alive_rows.size} alive brokers")
+
+    n = op.count
+    B, P, R = topo.num_brokers, topo.num_partitions, topo.num_replicas
+    rack = np.asarray(topo.rack_of_broker)
+    counts = np.bincount(bo, minlength=B).astype(np.int64)
+    lead_extra_row = np.asarray(
+        topo.leader_extra[t_parts], np.float32).mean(axis=0)
+    lbi = float(np.asarray(topo.leader_bytes_in[t_parts]).mean())
+    base_row = np.asarray(
+        topo.replica_base_load[t_reps_mask], np.float32).mean(axis=0)
+
+    # rack-diverse least-loaded placement, deterministic (ties by row)
+    placements = []
+    for _ in range(n):
+        chosen: List[int] = []
+        used_racks: set = set()
+        for _slot in range(rf):
+            order = sorted(alive_rows, key=lambda b: (counts[b], b))
+            pick = next((b for b in order
+                         if b not in chosen and rack[b] not in used_racks),
+                        None)
+            if pick is None:
+                pick = next(b for b in order if b not in chosen)
+            chosen.append(int(pick))
+            used_racks.add(int(rack[pick]))
+            counts[pick] += 1
+        placements.append(chosen)
+
+    m = topo.max_rf
+    reps_new = np.full((n, m), -1, dtype=topo.replicas_of_partition.dtype)
+    new_rep_brokers = []
+    off = 0
+    for i, chosen in enumerate(placements):
+        reps_new[i, :rf] = R + off + np.arange(rf)
+        new_rep_brokers.extend(chosen)
+        off += rf
+    n_new_reps = off
+
+    part_index = topo.partition_index
+    if part_index is not None:
+        nxt = int(np.max(np.asarray(part_index)[t_parts])) + 1
+        part_index = np.concatenate(
+            [np.asarray(part_index),
+             np.arange(nxt, nxt + n, dtype=np.asarray(part_index).dtype)])
+
+    def _rep_rows(arr, row):
+        arr = np.asarray(arr)
+        new = np.broadcast_to(row, (n_new_reps,) + arr.shape[1:])
+        return np.concatenate([arr, new.astype(arr.dtype)], axis=0)
+
+    def _part_rows(arr, row):
+        arr = np.asarray(arr)
+        new = np.broadcast_to(row, (n,) + arr.shape[1:])
+        return np.concatenate([arr, new.astype(arr.dtype)], axis=0)
+
+    win_r = topo.replica_base_load_windows
+    if win_r is not None:
+        win_r = _rep_rows(win_r, np.asarray(
+            win_r[t_reps_mask], np.float32).mean(axis=0))
+    win_p = topo.leader_extra_windows
+    if win_p is not None:
+        win_p = _part_rows(win_p, np.asarray(
+            win_p[t_parts], np.float32).mean(axis=0))
+
+    topo = dataclasses.replace(
+        topo,
+        partition_of_replica=np.concatenate(
+            [np.asarray(topo.partition_of_replica),
+             np.repeat(P + np.arange(n), rf).astype(
+                 topo.partition_of_replica.dtype)]),
+        topic_of_partition=_part_rows(topo.topic_of_partition, t),
+        replicas_of_partition=np.concatenate(
+            [np.asarray(topo.replicas_of_partition), reps_new], axis=0),
+        rf_of_partition=_part_rows(topo.rf_of_partition, rf),
+        initial_leader_slot=_part_rows(topo.initial_leader_slot, 0),
+        replica_offline=_rep_rows(topo.replica_offline, False),
+        replica_base_load=_rep_rows(topo.replica_base_load, base_row),
+        leader_extra=_part_rows(topo.leader_extra, lead_extra_row),
+        leader_bytes_in=_part_rows(topo.leader_bytes_in, lbi),
+        replica_base_load_windows=win_r,
+        leader_extra_windows=win_p,
+        partition_index=part_index,
+        disk_of_replica=(_rep_rows(topo.disk_of_replica, -1)
+                         if topo.disk_of_replica is not None else None),
+    )
+    bo = np.concatenate([bo, np.asarray(new_rep_brokers, np.int32)])
+    lo = np.concatenate(
+        [lo, (R + np.arange(0, n_new_reps, rf)).astype(np.int32)])
+    return topo, bo, lo
+
+
+_APPLY = {
+    ADD_BROKERS: _apply_add_brokers,
+    REMOVE_BROKERS: _apply_remove_brokers,
+    SCALE_CAPACITY: _apply_scale_capacity,
+    FAIL_RACK: _apply_fail_rack,
+    ADD_PARTITIONS: _apply_add_partitions,
+}
+
+
+def apply_scenario(topo: ClusterTopology, assign: Assignment,
+                   scenario: Scenario
+                   ) -> Tuple[ClusterTopology, Assignment]:
+    """Apply a scenario's ops in order; returns the mutated UNPADDED pair.
+
+    Pure host-side — the inputs are never modified (frozen dataclass +
+    copy-on-write arrays)."""
+    if topo.replica_weight is not None:
+        raise ValueError("apply_scenario expects an unpadded model "
+                         "(got bucketing sentinels)")
+    bo = np.asarray(jax.device_get(assign.broker_of), np.int32)
+    lo = np.asarray(jax.device_get(assign.leader_of), np.int32)
+    for op in scenario.ops:
+        if op.kind not in _APPLY:
+            raise ValueError(f"unknown scenario op kind {op.kind!r}")
+        topo, bo, lo = _APPLY[op.kind](topo, bo, lo, op)
+    return topo, Assignment(broker_of=jnp.asarray(bo),
+                            leader_of=jnp.asarray(lo))
+
+
+# ---------------------------------------------------------------------------
+# Grid compiler: pad every scenario into ONE shared bucket and stack
+# ---------------------------------------------------------------------------
+
+
+def _widen_rf(topo: ClusterTopology, m: int) -> ClusterTopology:
+    """Widen the replica-slot axis to ``m`` columns (-1 fill — the valid
+    mask every per-partition walk already applies)."""
+    cur = topo.max_rf
+    if cur >= m:
+        return topo
+    reps = np.full((topo.num_partitions, m), -1,
+                   dtype=topo.replicas_of_partition.dtype)
+    reps[:, :cur] = np.asarray(topo.replicas_of_partition)
+    return dataclasses.replace(topo, replicas_of_partition=reps)
+
+
+@dataclasses.dataclass(frozen=True)
+class CompiledScenario:
+    """One padded scenario of a grid (host handle for decode/deep mode)."""
+
+    scenario: Scenario
+    topo: ClusterTopology           # padded, shared bucket
+    assign: Assignment              # padded
+    options: G.DeviceOptions        # padded
+    info: PaddingInfo               # real sizes of THIS scenario
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioGrid:
+    """A compiled grid: per-scenario handles + the stacked device batch."""
+
+    compiled: Tuple[CompiledScenario, ...]
+    dts: DeviceTopology             # every leaf stacked on a leading S axis
+    assigns: Assignment             # stacked
+    options: G.DeviceOptions        # stacked
+    num_topics: int
+    bucket: Tuple[int, int, int, int]  # (B_pad, H_pad, P_pad, R_pad)
+
+    @property
+    def num_scenarios(self) -> int:
+        return len(self.compiled)
+
+    @property
+    def scenarios(self) -> Tuple[Scenario, ...]:
+        return tuple(c.scenario for c in self.compiled)
+
+
+def grid_targets(mutated: Sequence[ClusterTopology]
+                 ) -> Tuple[int, int, int, int]:
+    """Shared bucket targets covering every scenario of a grid.
+
+    Chosen so a singleton grid reproduces stock ``pad_topology`` exactly:
+    the replica target is sized off the worst-case padded-partition count
+    (``R_i + (P_pad - P_i)`` — one sentinel replica per padded partition)."""
+    B_t = bucket_size(max(t.num_brokers for t in mutated) + 1,
+                      BROKER_BUCKET_FLOOR)
+    H_t = bucket_size(max(t.num_hosts for t in mutated) + 1,
+                      HOST_BUCKET_FLOOR)
+    P_t = bucket_size(max(t.num_partitions for t in mutated) + 1,
+                      PARTITION_BUCKET_FLOOR)
+    R_t = bucket_size(max(t.num_replicas - t.num_partitions
+                          for t in mutated) + P_t, REPLICA_BUCKET_FLOOR)
+    return B_t, H_t, P_t, R_t
+
+
+def compile_grid(topo: ClusterTopology, assign: Assignment,
+                 scenarios: Sequence[Scenario]) -> ScenarioGrid:
+    """Apply every scenario, pad all of them into one shared bucket, and
+    stack the device mirrors into a single leading-axis batch."""
+    if not scenarios:
+        raise ValueError("compile_grid needs at least one scenario")
+    mutated = [apply_scenario(topo, assign, s) for s in scenarios]
+    m = max(t.max_rf for t, _ in mutated)
+    mutated = [(_widen_rf(t, m), a) for t, a in mutated]
+    B_t, H_t, P_t, R_t = grid_targets([t for t, _ in mutated])
+
+    compiled = []
+    for s, (t, a) in zip(scenarios, mutated):
+        opts = G.default_options(t)
+        t_p, a_p, info = pad_topology(
+            t, a, broker_target=B_t, host_target=H_t,
+            partition_target=P_t, replica_target=R_t)
+        opts_p = G.pad_options(opts, R_t, B_t)
+        compiled.append(CompiledScenario(
+            scenario=s, topo=t_p, assign=a_p, options=opts_p, info=info))
+
+    dts = [device_topology(c.topo) for c in compiled]
+    stack = lambda *xs: jnp.stack(xs)  # noqa: E731 — tree.map thunk
+    return ScenarioGrid(
+        compiled=tuple(compiled),
+        dts=jax.tree.map(stack, *dts),
+        assigns=jax.tree.map(stack, *[c.assign for c in compiled]),
+        options=jax.tree.map(stack, *[c.options for c in compiled]),
+        num_topics=topo.num_topics,
+        bucket=(B_t, H_t, P_t, R_t),
+    )

@@ -1,0 +1,297 @@
+"""Vmapped what-if evaluator: score an entire scenario grid in ONE
+compiled program.
+
+Per scenario the evaluator produces the full as-is goal picture
+(violation/cost vectors from ``full_goal_penalties``, the violated-goal
+set, a balancedness score) PLUS assignment-invariant structural
+feasibility bounds — exact necessary conditions no rebalance can work
+around:
+
+- rack bound:     Σ_p max(0, rf_p − #alive racks)
+- replica bound:  max(0, R − #alive brokers · max_replicas_per_broker)
+- capacity bound: per resource, max(0, total load − Σ_alive capacity·
+  threshold·(1 − headroom))
+
+A scenario failing a bound is PROVABLY infeasible for any assignment; a
+scenario passing all bounds is a candidate fix. The optional "deep" mode
+refines candidates with a short donated PT anneal per scenario
+(constructive witness: post-rebalance violations + move counts). All
+scenarios of a grid share one shape bucket (scenarios.compile_grid), so
+the batched evaluation is a single jit trace and re-evaluating any grid
+in the same bucket retraces nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.analyzer import goals as G
+from cruise_control_tpu.analyzer import objective as OBJ
+from cruise_control_tpu.analyzer.annealer import AnnealConfig, optimize_anneal
+from cruise_control_tpu.analyzer.optimizer import (
+    MAX_BALANCEDNESS_SCORE,
+    TOPIC_DENSE_LIMIT,
+    balancedness_cost_by_goal,
+)
+from cruise_control_tpu.common import resources as res
+from cruise_control_tpu.common.resources import BalancingConstraint
+from cruise_control_tpu.ops.aggregates import (
+    compute_aggregates,
+    device_topology,
+    topic_totals,
+)
+from cruise_control_tpu.provisioner.scenarios import Scenario, ScenarioGrid
+
+#: structural-bound order: rack, replica-count, then one per resource
+BOUND_GOALS = ("RackAwareGoal", "ReplicaCapacityGoal", "CpuCapacityGoal",
+               "NetworkInboundCapacityGoal", "NetworkOutboundCapacityGoal",
+               "DiskCapacityGoal")
+_BOUND_RESOURCES = (res.CPU, res.NW_IN, res.NW_OUT, res.DISK)
+
+#: deep-mode default: a deliberately small PT ladder — the point is a
+#: feasibility witness + move estimate per scenario, not a polished plan
+DEEP_ANNEAL_CONFIG = AnnealConfig(num_chains=8, steps=256, swap_interval=32)
+
+
+def _structural_bounds(dt, th, headroom: jax.Array) -> jax.Array:
+    """f32[6] assignment-invariant infeasibility bounds (0 = satisfiable).
+
+    Works on padded models: padded brokers are dead (excluded from every
+    alive mask) and padded replicas/partitions carry weight 0."""
+    B = dt.rack_of_broker.shape[0]
+    alive_f = th.alive.astype(jnp.float32)
+    w_r = (dt.replica_weight.astype(jnp.float32)
+           if dt.replica_weight is not None
+           else jnp.ones(dt.partition_of_replica.shape[0], jnp.float32))
+    w_p = (dt.partition_weight.astype(jnp.float32)
+           if dt.partition_weight is not None
+           else jnp.ones(dt.topic_of_partition.shape[0], jnp.float32))
+
+    # rack bound — rack ids are data; a rack is alive iff it holds an alive
+    # broker. Rack ids are < B by construction (≤ one rack per broker).
+    racks_alive = jax.ops.segment_sum(alive_f, dt.rack_of_broker,
+                                      num_segments=B)
+    n_racks = jnp.sum(racks_alive > 0).astype(jnp.float32)
+    rf = dt.rf_of_partition.astype(jnp.float32)
+    rack_bound = jnp.sum(jnp.maximum(rf - n_racks, 0.0) * w_p)
+
+    # replica-count bound
+    n_real = jnp.sum(w_r)
+    repl_bound = jnp.maximum(
+        n_real - th.n_alive * th.max_replicas_per_broker, 0.0)
+
+    # capacity bounds — total load (follower base + leader extra) vs the
+    # thresholded alive capacity shaved by the headroom margin
+    total_load = (jnp.sum(dt.replica_base_load * w_r[:, None], axis=0)
+                  + jnp.sum(dt.leader_extra * w_p[:, None], axis=0))  # [4]
+    avail = jnp.sum(th.cap_limit_broker * alive_f[:, None], axis=0)
+    avail = avail * (1.0 - headroom)
+    cap_bound = jnp.maximum(total_load - avail, 0.0)                  # [4]
+
+    return jnp.concatenate([
+        jnp.stack([rack_bound, repl_bound]),
+        cap_bound[jnp.asarray(_BOUND_RESOURCES)],
+    ])
+
+
+@partial(jax.jit,
+         static_argnames=("num_topics", "goal_names", "constraint",
+                          "sparse_topic"))
+def _eval_grid(dts, assigns, headroom, num_topics: int,
+               goal_names: Tuple[str, ...],
+               constraint: BalancingConstraint, sparse_topic: bool):
+    """One compiled program scoring every scenario of the stacked grid."""
+
+    def _one(dt, assign):
+        agg = compute_aggregates(dt, assign,
+                                 1 if sparse_topic else num_topics)
+        th = G.compute_thresholds(
+            dt, constraint, agg,
+            topic_total=(topic_totals(dt, num_topics)
+                         if sparse_topic else None))
+        pen = G.full_goal_penalties(dt, assign, th, num_topics, goal_names,
+                                    initial_broker_of=assign.broker_of,
+                                    agg=agg, sparse_topic=sparse_topic)
+        return pen.violations, pen.cost, _structural_bounds(dt, th, headroom)
+
+    return jax.vmap(_one)(dts, assigns)
+
+
+# ---------------------------------------------------------------------------
+# Host-side result fold
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioScore:
+    """Everything the grid evaluation learned about one scenario."""
+
+    scenario: Scenario
+    num_brokers: int                 # real brokers in the mutated model
+    num_alive_brokers: int
+    violations: np.ndarray           # f32[G+1] per-goal violation measures
+    costs: np.ndarray                # f32[G+1] per-goal soft costs
+    violated_goals: Tuple[str, ...]  # as-is violated goal names
+    offline_replicas: float          # the appended self-healing term
+    structural_bounds: np.ndarray    # f32[6], BOUND_GOALS order
+    infeasible_goals: Tuple[str, ...]  # goals whose bound fires (no
+    #                                    assignment can satisfy them)
+    balancedness: float
+    # deep-mode extras (None unless evaluated deep)
+    post_rebalance_violations: Optional[float] = None
+    estimated_replica_moves: Optional[int] = None
+    estimated_leadership_moves: Optional[int] = None
+
+    @property
+    def feasible(self) -> bool:
+        """No structural bound fires — some assignment satisfies every
+        bounded hard goal (deep mode refines this to a witness)."""
+        return not self.infeasible_goals
+
+    def to_dict(self) -> dict:
+        d = {
+            "scenario": self.scenario.name,
+            "numBrokers": self.num_brokers,
+            "numAliveBrokers": self.num_alive_brokers,
+            "violatedGoals": list(self.violated_goals),
+            "offlineReplicas": self.offline_replicas,
+            "structurallyInfeasibleGoals": list(self.infeasible_goals),
+            "feasible": self.feasible,
+            "balancedness": self.balancedness,
+        }
+        if self.post_rebalance_violations is not None:
+            d["postRebalanceViolations"] = self.post_rebalance_violations
+            d["estimatedReplicaMoves"] = self.estimated_replica_moves
+            d["estimatedLeadershipMoves"] = self.estimated_leadership_moves
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class WhatIfResult:
+    goal_names: Tuple[str, ...]
+    headroom_margin: float
+    scores: Tuple[ScenarioScore, ...]
+
+    def score_of(self, name: str) -> ScenarioScore:
+        for s in self.scores:
+            if s.scenario.name == name:
+                return s
+        raise KeyError(name)
+
+    def to_dict(self) -> dict:
+        return {
+            "goals": list(self.goal_names),
+            "headroomMargin": self.headroom_margin,
+            "scenarios": [s.to_dict() for s in self.scores],
+        }
+
+
+def _balancedness(goal_names, violations, weights=None) -> float:
+    pw, sw = weights if weights is not None else (None, None)
+    costs = balancedness_cost_by_goal(goal_names, priority_weight=pw,
+                                      strictness_weight=sw)
+    score = MAX_BALANCEDNESS_SCORE
+    for g, v in zip(goal_names, violations):
+        if v > 0:
+            score -= costs[g]
+    return float(max(score, 0.0))
+
+
+def evaluate_grid(grid: ScenarioGrid, constraint: BalancingConstraint,
+                  goal_names: Sequence[str], headroom: float = 0.0,
+                  balancedness_weights=None,
+                  sparse_topic: Optional[bool] = None,
+                  deep: bool = False,
+                  anneal_config: Optional[AnnealConfig] = None,
+                  seed: int = 0) -> WhatIfResult:
+    """Score every scenario of a compiled grid in one vmapped call.
+
+    ``sparse_topic=None`` auto-selects the sort-based topic scoring above
+    ``TOPIC_DENSE_LIMIT`` B·T cells (a dense [S, B, T] histogram at
+    LinkedIn scale would be tens of GB). ``deep=True`` additionally runs a
+    short PT anneal per bound-feasible scenario; the shared grid bucket
+    means every anneal reuses one compiled program."""
+    goal_names = tuple(goal_names)
+    if sparse_topic is None:
+        max_real_b = max(c.info.num_brokers for c in grid.compiled)
+        sparse_topic = max_real_b * grid.num_topics > TOPIC_DENSE_LIMIT
+    viol, cost, bounds = _eval_grid(
+        grid.dts, grid.assigns, jnp.float32(headroom),
+        num_topics=grid.num_topics, goal_names=goal_names,
+        constraint=constraint, sparse_topic=bool(sparse_topic))
+    viol = np.asarray(jax.device_get(viol))      # f32[S, G+1]
+    cost = np.asarray(jax.device_get(cost))
+    bounds = np.asarray(jax.device_get(bounds))  # f32[S, 6]
+
+    bounded = [g for g in BOUND_GOALS if g in goal_names]
+    scores = []
+    for i, c in enumerate(grid.compiled):
+        alive = np.asarray(c.topo.broker_alive)
+        present = np.asarray(c.topo.broker_present)
+        infeasible = tuple(
+            g for j, g in enumerate(BOUND_GOALS)
+            if bounds[i, j] > 0 and g in bounded)
+        scores.append(ScenarioScore(
+            scenario=c.scenario,
+            num_brokers=c.info.num_brokers,
+            num_alive_brokers=int(np.sum(alive & present)),
+            violations=viol[i],
+            costs=cost[i],
+            violated_goals=tuple(
+                g for j, g in enumerate(goal_names) if viol[i, j] > 0),
+            offline_replicas=float(viol[i, -1]),
+            structural_bounds=bounds[i],
+            infeasible_goals=infeasible,
+            balancedness=_balancedness(goal_names, viol[i],
+                                       balancedness_weights),
+        ))
+    if deep:
+        scores = _deep_refine(grid, scores, constraint, goal_names,
+                              anneal_config or DEEP_ANNEAL_CONFIG, seed)
+    return WhatIfResult(goal_names=goal_names,
+                        headroom_margin=float(headroom),
+                        scores=tuple(scores))
+
+
+def _deep_refine(grid: ScenarioGrid, scores, constraint, goal_names,
+                 config: AnnealConfig, seed: int):
+    """Anneal each bound-feasible scenario briefly; report the witness.
+
+    Host loop — every scenario shares the grid bucket, so after the first
+    anneal compiles, the rest reuse the same program."""
+    weights = OBJ.build_weights(goal_names)
+    out = []
+    for i, (c, sc) in enumerate(zip(grid.compiled, scores)):
+        if not sc.feasible:
+            out.append(sc)
+            continue
+        dt = device_topology(c.topo)
+        agg = compute_aggregates(dt, c.assign, grid.num_topics)
+        th = G.compute_thresholds(dt, constraint, agg)
+        init_bo = c.assign.broker_of          # already a device int32 array
+        result = optimize_anneal(dt, c.assign, th, weights, c.options,
+                                 grid.num_topics, config=config,
+                                 seed=seed + i, goal_names=goal_names,
+                                 initial_broker_of=init_bo)
+        pen = G.full_goal_penalties(dt, result.assignment, th,
+                                    grid.num_topics, goal_names,
+                                    initial_broker_of=init_bo)
+        post = np.asarray(jax.device_get(pen.violations))
+        R, P = c.info.num_replicas, c.info.num_partitions
+        bo0 = np.asarray(jax.device_get(c.assign.broker_of))[:R]
+        bo1 = np.asarray(jax.device_get(result.assignment.broker_of))[:R]
+        lo0 = np.asarray(jax.device_get(c.assign.leader_of))[:P]
+        lo1 = np.asarray(jax.device_get(result.assignment.leader_of))[:P]
+        out.append(dataclasses.replace(
+            sc,
+            post_rebalance_violations=float(post[:-1].sum() + post[-1]),
+            estimated_replica_moves=int(np.sum(bo0 != bo1)),
+            estimated_leadership_moves=int(np.sum(lo0 != lo1)),
+        ))
+    return out

@@ -1,4 +1,4 @@
-"""REST API: the 21-endpoint servlet over the service facade.
+"""REST API: the 23-endpoint servlet over the service facade.
 
 Rebuild of ``servlet/KafkaCruiseControlServlet.java:95-135`` +
 ``servlet/CruiseControlEndPoint.java:16-36`` on the stdlib threading HTTP
@@ -34,38 +34,49 @@ from cruise_control_tpu.server.async_ops import (
     UserTaskManager,
 )
 
-GET_ENDPOINTS = [
-    "BOOTSTRAP", "TRAIN", "LOAD", "PARTITION_LOAD", "PROPOSALS", "STATE",
-    "KAFKA_CLUSTER_STATE", "USER_TASKS", "REVIEW_BOARD", "METRICS",
-]
-POST_ENDPOINTS = [
-    "ADD_BROKER", "REMOVE_BROKER", "FIX_OFFLINE_REPLICAS", "REBALANCE",
-    "STOP_PROPOSAL_EXECUTION", "PAUSE_SAMPLING", "RESUME_SAMPLING",
-    "DEMOTE_BROKER", "ADMIN", "REVIEW", "TOPIC_CONFIGURATION",
-]
-ALL_ENDPOINTS = GET_ENDPOINTS + POST_ENDPOINTS
+#: The ONE endpoint registry: (name, HTTP method, EndpointType). Every
+#: derived structure below — the method-specific lists the dispatcher
+#: validates against, ALL_ENDPOINTS in 404 payloads, and the EndpointType
+#: classification (CruiseControlEndPoint.java:17-36) driving per-type
+#: completed-task retention — comes from this table, so a new endpoint
+#: cannot be half-registered (in ENDPOINT_TYPES but missing from the
+#: method list, or vice versa).
+_ENDPOINT_TABLE = (
+    # -- GET --------------------------------------------------------------
+    ("BOOTSTRAP", "GET", "CRUISE_CONTROL_ADMIN"),
+    ("TRAIN", "GET", "CRUISE_CONTROL_ADMIN"),
+    ("LOAD", "GET", "KAFKA_MONITOR"),
+    ("PARTITION_LOAD", "GET", "KAFKA_MONITOR"),
+    ("PROPOSALS", "GET", "KAFKA_MONITOR"),
+    ("STATE", "GET", "CRUISE_CONTROL_MONITOR"),
+    ("KAFKA_CLUSTER_STATE", "GET", "KAFKA_MONITOR"),
+    ("USER_TASKS", "GET", "CRUISE_CONTROL_MONITOR"),
+    ("REVIEW_BOARD", "GET", "CRUISE_CONTROL_MONITOR"),
+    ("METRICS", "GET", "CRUISE_CONTROL_MONITOR"),
+    ("WHAT_IF", "GET", "KAFKA_MONITOR"),
+    # -- POST -------------------------------------------------------------
+    ("ADD_BROKER", "POST", "KAFKA_ADMIN"),
+    ("REMOVE_BROKER", "POST", "KAFKA_ADMIN"),
+    ("FIX_OFFLINE_REPLICAS", "POST", "KAFKA_ADMIN"),
+    ("REBALANCE", "POST", "KAFKA_ADMIN"),
+    ("STOP_PROPOSAL_EXECUTION", "POST", "KAFKA_ADMIN"),
+    ("PAUSE_SAMPLING", "POST", "CRUISE_CONTROL_ADMIN"),
+    ("RESUME_SAMPLING", "POST", "CRUISE_CONTROL_ADMIN"),
+    ("DEMOTE_BROKER", "POST", "KAFKA_ADMIN"),
+    ("ADMIN", "POST", "CRUISE_CONTROL_ADMIN"),
+    ("REVIEW", "POST", "CRUISE_CONTROL_ADMIN"),
+    ("TOPIC_CONFIGURATION", "POST", "KAFKA_ADMIN"),
+    ("RIGHTSIZE", "POST", "KAFKA_ADMIN"),
+)
+
+GET_ENDPOINTS = [n for n, m, _ in _ENDPOINT_TABLE if m == "GET"]
+POST_ENDPOINTS = [n for n, m, _ in _ENDPOINT_TABLE if m == "POST"]
+ALL_ENDPOINTS = [n for n, _, _ in _ENDPOINT_TABLE]
+ENDPOINT_TYPES = {n: t for n, _, t in _ENDPOINT_TABLE}
 
 #: POST endpoints subject to 2-step verification when enabled
 REVIEWABLE = {"ADD_BROKER", "REMOVE_BROKER", "FIX_OFFLINE_REPLICAS",
               "REBALANCE", "DEMOTE_BROKER", "TOPIC_CONFIGURATION"}
-
-#: EndpointType classification (CruiseControlEndPoint.java:17-36) — drives
-#: per-type completed-task retention/caching
-ENDPOINT_TYPES = {
-    "BOOTSTRAP": "CRUISE_CONTROL_ADMIN", "TRAIN": "CRUISE_CONTROL_ADMIN",
-    "PAUSE_SAMPLING": "CRUISE_CONTROL_ADMIN",
-    "RESUME_SAMPLING": "CRUISE_CONTROL_ADMIN",
-    "ADMIN": "CRUISE_CONTROL_ADMIN", "REVIEW": "CRUISE_CONTROL_ADMIN",
-    "STATE": "CRUISE_CONTROL_MONITOR", "USER_TASKS": "CRUISE_CONTROL_MONITOR",
-    "REVIEW_BOARD": "CRUISE_CONTROL_MONITOR",
-    "METRICS": "CRUISE_CONTROL_MONITOR",
-    "LOAD": "KAFKA_MONITOR", "PARTITION_LOAD": "KAFKA_MONITOR",
-    "PROPOSALS": "KAFKA_MONITOR", "KAFKA_CLUSTER_STATE": "KAFKA_MONITOR",
-    "ADD_BROKER": "KAFKA_ADMIN", "REMOVE_BROKER": "KAFKA_ADMIN",
-    "FIX_OFFLINE_REPLICAS": "KAFKA_ADMIN", "REBALANCE": "KAFKA_ADMIN",
-    "STOP_PROPOSAL_EXECUTION": "KAFKA_ADMIN", "DEMOTE_BROKER": "KAFKA_ADMIN",
-    "TOPIC_CONFIGURATION": "KAFKA_ADMIN",
-}
 
 
 def _parse_bool(params: dict, name: str, default: bool) -> bool:
@@ -223,9 +234,11 @@ class RestApi:
             return 404, {"errorMessage": f"Unknown endpoint {endpoint}",
                          "validEndpoints": ALL_ENDPOINTS}
         if method == "GET" and endpoint not in GET_ENDPOINTS:
-            return 405, {"errorMessage": f"{endpoint} requires POST"}
+            return 405, {"errorMessage": f"{endpoint} requires POST",
+                         "validEndpoints": GET_ENDPOINTS}
         if method == "POST" and endpoint not in POST_ENDPOINTS:
-            return 405, {"errorMessage": f"{endpoint} requires GET"}
+            return 405, {"errorMessage": f"{endpoint} requires GET",
+                         "validEndpoints": POST_ENDPOINTS}
         # two-step verification (Purgatory.java:116-166)
         consumed_review: Optional[int] = None
         if (method == "POST" and self.purgatory is not None
@@ -555,7 +568,56 @@ class RestApi:
                          start, end, clear_metrics=clear),
                      "startMs": start, "endMs": end})
 
+    def _what_if(self, params, client_id, request_url):
+        """WHAT_IF: dry-run a counterfactual-scenario grid.
+
+        ``add_brokers=2,4`` (one scenario per count, optional
+        ``add_broker_rack``), ``remove_broker_ids=3,7`` (one scenario
+        removing all listed), ``fail_racks=r1,r2`` (one per rack),
+        ``scale_capacity=disk:0.5,cpu:1.5`` (one per resource:factor),
+        ``add_partitions=topic:count``, ``deep=true`` for the anneal-based
+        post-rebalance estimate."""
+        kw = dict(
+            add_broker_counts=_parse_csv_ints(params, "add_brokers"),
+            add_broker_rack=params.get("add_broker_rack"),
+            remove_broker_ids=_parse_csv_ints(params, "remove_broker_ids"),
+            fail_racks=_parse_csv(params, "fail_racks"),
+            scale_capacity=_parse_csv(params, "scale_capacity"),
+            add_partitions=_parse_csv(params, "add_partitions"),
+            deep=_parse_bool(params, "deep", False),
+            headroom_margin=(float(params["headroom_margin"])
+                             if params.get("headroom_margin") else None),
+            allow_capacity_estimation=_parse_bool(
+                params, "allow_capacity_estimation", True),
+            data_from=params.get("data_from"),
+            min_valid_partition_ratio=(
+                float(params["min_valid_partition_ratio"])
+                if params.get("min_valid_partition_ratio") else None),
+        )
+        return self._async_op("WHAT_IF", params, client_id, request_url,
+                              lambda: self.app.what_if(**kw))
+
     # ------------------------------------------------------------ POST
+
+    def _rightsize(self, params, client_id, request_url):
+        """RIGHTSIZE: classify the cluster UNDER/OVER/RIGHT_SIZED and
+        surface the recommendation (also recorded in /state)."""
+        kw = dict(
+            headroom_margin=(float(params["headroom_margin"])
+                             if params.get("headroom_margin") else None),
+            max_added_brokers=(int(params["max_added_brokers"])
+                               if params.get("max_added_brokers") else None),
+            max_removed_brokers=(
+                int(params["max_removed_brokers"])
+                if params.get("max_removed_brokers") else None),
+            deep=_parse_bool(params, "deep", False),
+            verbose=_parse_bool(params, "verbose", False),
+            allow_capacity_estimation=_parse_bool(
+                params, "allow_capacity_estimation", True),
+            data_from=params.get("data_from"),
+        )
+        return self._async_op("RIGHTSIZE", params, client_id, request_url,
+                              lambda: self.app.rightsize(**kw))
 
     def _rebalance(self, params, client_id, request_url):
         if _parse_bool(params, "rebalance_disk", False):

@@ -853,6 +853,11 @@ def test_package_lints_clean_against_baseline():
             "", "TODO: justify or fix"), (
             f"baseline entry lacks a real justification: "
             f"{entry['fingerprint']}")
+    # the provisioner package shipped lint-clean: no suppression may ever
+    # point into it (fingerprints embed the path — G001–G105 all enforced)
+    prov = [fp for fp in baseline
+            if fp.split("|")[1].startswith("cruise_control_tpu/provisioner/")]
+    assert prov == [], f"provisioner package must stay baseline-free: {prov}"
 
 
 # -- runtime sentinels -----------------------------------------------------

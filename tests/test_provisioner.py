@@ -1,0 +1,361 @@
+"""Provisioner suite: what-if grid semantics + rightsizing verdicts.
+
+Three contracts lock the subsystem:
+
+1. **Singleton parity** — evaluating a one-scenario grid is bit-identical
+   to mutating the topology directly and scoring it through the stock
+   ``pad_topology`` + ``full_goal_penalties`` path (the grid's shared
+   bucket targets collapse to the stock bucket choice for one scenario).
+2. **One compiled program** — a 64-scenario grid evaluates in a single
+   vmapped call; re-evaluating a DIFFERENT grid in the same bucket
+   performs zero retraces.
+3. **Deterministic recommendations** — the rack-unsatisfiable fixture
+   yields UNDER_PROVISIONED with a known minimal broker add, end-to-end
+   through the detector, ``app.state()``, GET /state, and cccli.
+"""
+
+import dataclasses
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from cruise_control_tpu import provisioner as PROV
+from cruise_control_tpu.analyzer import goals as G
+from cruise_control_tpu.common.resources import BalancingConstraint
+from cruise_control_tpu.models import fixtures
+from cruise_control_tpu.models.cluster import pad_topology
+from cruise_control_tpu.ops.aggregates import (
+    compute_aggregates,
+    device_topology,
+)
+from cruise_control_tpu.provisioner.scenarios import (
+    BASELINE,
+    Scenario,
+    add_brokers,
+    add_partitions,
+    compile_grid,
+    fail_rack,
+    remove_brokers,
+    scale_capacity,
+)
+from cruise_control_tpu.provisioner.whatif import evaluate_grid
+
+pytestmark = pytest.mark.provisioner
+
+GOALS = G.ANOMALY_DETECTION_GOALS
+CONSTRAINT = BalancingConstraint()
+
+
+# -- 1. singleton parity ----------------------------------------------------
+
+
+def _scenarios_for(topo):
+    """One scenario per op kind, valid for any of the shared fixtures."""
+    bid = int(topo.broker_ids[0]) if topo.broker_ids is not None else 0
+    rack = topo.rack_names[0] if topo.rack_names else "0"
+    topic = topo.topic_names[0] if topo.topic_names else "0"
+    return {
+        "baseline": BASELINE,
+        "add_brokers": Scenario("add", (add_brokers(2),)),
+        "remove_brokers": Scenario("rm", (remove_brokers((bid,)),)),
+        "scale_capacity": Scenario("scale", (scale_capacity("disk", 0.5),)),
+        "fail_rack": Scenario("failrack", (fail_rack(rack),)),
+        "add_partitions": Scenario("addparts", (add_partitions(topic, 2),)),
+    }
+
+
+def _direct_penalties(topo, assign, scenario):
+    """The reference path: mutate, stock-pad, score — no grid involved."""
+    mt, ma = PROV.apply_scenario(topo, assign, scenario)
+    tp, ap, _info = pad_topology(mt, ma)
+    dt = device_topology(tp)
+    agg = compute_aggregates(dt, ap, tp.num_topics)
+    th = G.compute_thresholds(dt, CONSTRAINT, agg)
+    pen = G.full_goal_penalties(dt, ap, th, tp.num_topics, GOALS,
+                                initial_broker_of=ap.broker_of, agg=agg)
+    return (np.asarray(jax.device_get(pen.violations)),
+            np.asarray(jax.device_get(pen.cost)))
+
+
+@pytest.mark.parametrize("kind", ["baseline", "add_brokers",
+                                  "remove_brokers", "scale_capacity",
+                                  "fail_rack", "add_partitions"])
+@pytest.mark.parametrize("fixture", ["unbalanced", "small_cluster_model",
+                                     "dead_broker"])
+def test_singleton_grid_matches_direct_mutation(kind, fixture):
+    topo, assign = getattr(fixtures, fixture)()
+    scenario = _scenarios_for(topo)[kind]
+    grid = compile_grid(topo, assign, (scenario,))
+    result = evaluate_grid(grid, CONSTRAINT, GOALS)
+    viol_direct, cost_direct = _direct_penalties(topo, assign, scenario)
+    score = result.scores[0]
+    # bit-identical, not approximately equal: same bucket, same program
+    # structure, same reduction order
+    np.testing.assert_array_equal(score.violations, viol_direct)
+    np.testing.assert_array_equal(score.costs, cost_direct)
+
+
+def test_singleton_grid_targets_match_stock_bucket():
+    """The shared-bucket formula collapses to the stock pad for one
+    scenario — that is WHY the parity above is exact."""
+    topo, assign = fixtures.unbalanced()
+    grid = compile_grid(topo, assign, (BASELINE,))
+    tp, _, _ = pad_topology(topo, assign)
+    B_t, H_t, P_t, R_t = grid.bucket
+    assert (B_t, P_t) == (tp.num_brokers, tp.num_partitions)
+    assert R_t == tp.num_replicas
+
+
+# -- 2. one compiled program / zero retraces --------------------------------
+
+
+def _grid_64(topo, assign, factor_shift=0.0):
+    """64 scenarios: baseline + 31 adds + 32 capacity scalings."""
+    scenarios = [BASELINE]
+    scenarios += [Scenario(f"add-{n}", (add_brokers(n),))
+                  for n in range(1, 32)]
+    for res_name in ("cpu", "nw_in", "nw_out", "disk"):
+        for f in (0.6, 0.8, 1.2, 1.4, 1.6, 1.8, 2.0, 2.2):
+            f += factor_shift
+            scenarios.append(Scenario(
+                f"scale-{res_name}-{f}",
+                (scale_capacity(res_name, f),)))
+    assert len(scenarios) == 64
+    return compile_grid(topo, assign, tuple(scenarios))
+
+
+def test_64_scenario_grid_zero_retraces():
+    """Warm on one 64-scenario grid, then evaluate a DIFFERENT grid in the
+    same bucket: zero retraces — the whole grid is one compiled call."""
+    from cruise_control_tpu.common import sentinels as SENT
+    topo, assign = fixtures.unbalanced()
+    warm = _grid_64(topo, assign)
+    evaluate_grid(warm, CONSTRAINT, GOALS)                # compiles once
+    other = _grid_64(topo, assign, factor_shift=0.05)     # same bucket
+    assert other.bucket == warm.bucket
+    with SENT.retrace_sentinel() as rl:
+        result = evaluate_grid(other, CONSTRAINT, GOALS)
+    assert rl.count == 0, rl.summary()
+    assert len(result.scores) == 64
+    # adds only ever help: a bigger cluster can't become infeasible
+    base = result.scores[0]
+    for n in range(1, 32):
+        add = result.score_of(f"add-{n}")
+        assert np.all(add.structural_bounds <= base.structural_bounds + 1e-5)
+
+
+def test_pad_targets_validation():
+    """Explicit pad targets below the sentinel minimum must be rejected,
+    not silently produce a model with no padded broker/partition row."""
+    topo, assign = fixtures.unbalanced()
+    with pytest.raises(ValueError, match="pad targets too small"):
+        pad_topology(topo, assign, broker_target=topo.num_brokers)
+    with pytest.raises(ValueError, match="pad targets too small"):
+        pad_topology(topo, assign, partition_target=topo.num_partitions,
+                     replica_target=topo.num_replicas)
+
+
+# -- 3. deterministic recommendations ---------------------------------------
+
+
+def test_under_provisioned_minimal_add():
+    """rack_aware_unsatisfiable: 3 brokers on 2 racks, one rf=3 partition.
+    No assignment can rack-spread rf 3 over 2 racks; ONE added broker (on
+    its own new rack) restores feasibility."""
+    topo, assign = fixtures.rack_aware_unsatisfiable()
+    p = PROV.Provisioner(max_added_brokers=4, max_removed_brokers=2)
+    rec, result = p.recommend(topo, assign)
+    assert rec.status == PROV.UNDER_PROVISIONED
+    assert rec.delta_brokers == 1
+    assert rec.cheapest_feasible_scenario == "add-1"
+    assert "RackAwareGoal" in rec.unfixable_goals
+    assert rec.moves_required >= 1
+    assert not result.scores[0].feasible
+
+
+def test_healthy_cluster_right_sized():
+    """small_cluster_model with shrinking disabled (a legitimate operator
+    setting) classifies RIGHT_SIZED: nothing to fix, nothing to change."""
+    topo, assign = fixtures.small_cluster_model()
+    p = PROV.Provisioner(max_removed_brokers=0)
+    rec, result = p.recommend(topo, assign)
+    assert rec.status == PROV.RIGHT_SIZED
+    assert rec.delta_brokers == 0
+    assert rec.moves_required == 0
+    assert rec.unfixable_goals == ()
+    assert result.scores[0].feasible
+
+
+def test_over_provisioned_shrink():
+    """With removals allowed, small_cluster_model can spare its least
+    loaded broker and stay bounds-feasible — OVER_PROVISIONED."""
+    topo, assign = fixtures.small_cluster_model()
+    p = PROV.Provisioner(max_added_brokers=2, max_removed_brokers=2)
+    rec, _ = p.recommend(topo, assign)
+    assert rec.status == PROV.OVER_PROVISIONED
+    assert rec.delta_brokers < 0
+
+
+def test_deep_mode_produces_witness():
+    """dead_broker heals by rebalance (not provisioning): deep mode must
+    report a post-rebalance witness with the offline replicas moved."""
+    from cruise_control_tpu.analyzer.annealer import AnnealConfig
+    topo, assign = fixtures.dead_broker()
+    p = PROV.Provisioner(
+        max_removed_brokers=0,
+        anneal_config=AnnealConfig(num_chains=4, steps=64, swap_interval=16))
+    rec, result = p.recommend(topo, assign, max_added_brokers=1, deep=True)
+    assert rec.status == PROV.RIGHT_SIZED
+    base = result.scores[0]
+    assert base.post_rebalance_violations is not None
+    assert base.estimated_replica_moves >= 1
+
+
+# -- end-to-end: detector -> state -> REST -> cccli -------------------------
+
+
+def _under_provisioned_app():
+    """An app over a 3-broker / 2-rack cluster with rf=3 partitions: the
+    RackAwareGoal is violated AND structurally unfixable."""
+    from tests.test_server import _app
+    from cruise_control_tpu.monitor.sampler import (
+        BrokerMetadata, ClusterMetadata, PartitionMetadata)
+    brokers = [BrokerMetadata(i, rack=f"r{i % 2}", host=f"h{i}", alive=True)
+               for i in range(3)]
+    parts = [PartitionMetadata("T", p, leader=p % 3,
+                               replicas=(p % 3, (p + 1) % 3, (p + 2) % 3))
+             for p in range(6)]
+    md = ClusterMetadata(brokers=brokers, partitions=parts, generation=1)
+    return _app(metadata=md, overrides={"provision.max.added.brokers": "2",
+                                        "provision.max.removed.brokers": "2"})
+
+
+def test_under_provisioned_end_to_end():
+    from cruise_control_tpu.client import cccli
+    from cruise_control_tpu.detector.detectors import GoalViolationDetector
+    from cruise_control_tpu.server import rest
+
+    app = _under_provisioned_app()
+    # the detector the app wires: unfixable violation -> recommendation
+    det = GoalViolationDetector(
+        app.load_monitor, now_fn=lambda: 4 * 60_000,
+        provisioner=app.provisioner,
+        on_recommendation=app._record_provision_recommendation)
+    anomaly = det.detect()
+    assert anomaly is not None
+    assert "RackAwareGoal" in anomaly.unfixable_violated_goals
+    assert "RackAwareGoal" not in anomaly.fixable_violated_goals
+    rec = anomaly.provision_recommendation
+    assert rec["status"] == "UNDER_PROVISIONED"
+    assert rec["deltaBrokers"] == 1
+    assert rec["status"] == anomaly.summary()[
+        "provisionRecommendation"]["status"]
+
+    # recorded verdict reaches app.state() ...
+    st = app.state()
+    assert (st["AnalyzerState"]["lastProvisionRecommendation"]["status"]
+            == "UNDER_PROVISIONED")
+
+    # ... GET /state over live HTTP ... and cccli prints it
+    server = rest.serve(app, port=0, address="127.0.0.1")
+    try:
+        port = server.server_address[1]
+        rc = 1
+        import io
+        from contextlib import redirect_stdout
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = cccli.main(["-a", f"127.0.0.1:{port}", "state",
+                             "--substates", "analyzer"])
+        body = json.loads(buf.getvalue())
+        assert rc == 0
+        assert (body["AnalyzerState"]["lastProvisionRecommendation"]
+                ["status"] == "UNDER_PROVISIONED")
+    finally:
+        server.shutdown()
+        server.api.close()
+
+
+def test_healthy_app_no_spurious_under_provisioning():
+    """RIGHTSIZE on a healthy cluster: RIGHT_SIZED, no unfixable goals —
+    and the goal-violation path reports nothing unfixable."""
+    from tests.test_server import _app
+    from cruise_control_tpu.server import rest
+
+    app = _app(overrides={"provision.max.removed.brokers": "0"})
+    api = rest.RestApi(app)
+    try:
+        code, body = api.dispatch(
+            "POST", "RIGHTSIZE", {"get_response_timeout_ms": "60000"})
+        assert code == 200
+        assert body["status"] == "RIGHT_SIZED"
+        assert body["unfixableGoals"] == []
+        st = app.state()
+        assert (st["AnalyzerState"]["lastProvisionRecommendation"]["status"]
+                == "RIGHT_SIZED")
+    finally:
+        api.close()
+
+
+def test_what_if_endpoint_grid():
+    """WHAT_IF dry-runs the full grid as JSON: every requested scenario
+    appears with its feasibility verdict."""
+    from tests.test_server import _app
+    from cruise_control_tpu.server import rest
+
+    app = _app()
+    api = rest.RestApi(app)
+    try:
+        code, body = api.dispatch(
+            "GET", "WHAT_IF",
+            {"add_brokers": "1,2", "fail_racks": "r0",
+             "scale_capacity": "disk:0.5", "add_partitions": "T:4",
+             "get_response_timeout_ms": "60000"})
+        assert code == 200
+        names = [s["scenario"] for s in body["scenarios"]]
+        assert names[0] == "baseline"
+        assert {"add-1", "add-2", "fail-rack-r0", "scale-disk-0.5",
+                "add-partitions-T-4"} <= set(names)
+        for s in body["scenarios"]:
+            assert isinstance(s["feasible"], bool)
+            assert "structurallyInfeasibleGoals" in s
+    finally:
+        api.close()
+
+
+# -- shared robust-stats hoist (ops/stats.py) --------------------------------
+
+
+def test_percentile_flags_vmappable_and_detector_parity():
+    """The hoisted jnp percentile band: vmaps over [N, W] histories and
+    agrees with the detector's np wrapper."""
+    import jax.numpy as jnp
+    from cruise_control_tpu.detector.detectors import percentile_anomalies
+    from cruise_control_tpu.ops import stats as STATS
+
+    rng = np.random.default_rng(0)
+    hist = rng.normal(50.0, 5.0, (4, 32)).astype(np.float32)
+    cur = np.array([50.0, 120.0, 1.0, 49.0], np.float32)
+    flags = jax.vmap(
+        lambda h, c: STATS.percentile_flags(h, c, 95.0, 5.0, 0.1, 0.9)
+    )(jnp.asarray(hist), jnp.asarray(cur))
+    above = np.asarray(flags.above)
+    below = np.asarray(flags.below)
+    assert not above[0] and not below[0]
+    assert above[1] and not below[1]
+    assert below[2] and not above[2]
+    for i in range(4):
+        msg = percentile_anomalies(hist[i], cur[i], upper_percentile=95.0,
+                                   lower_percentile=5.0, upper_margin=0.1,
+                                   lower_margin=0.9)
+        assert (msg is not None) == bool(above[i] or below[i])
+
+
+def test_percentile_anomalies_short_history_is_no_anomaly():
+    """Empty or too-short history must mean 'no anomaly', never a crash
+    or a spurious flag off a degenerate percentile."""
+    from cruise_control_tpu.detector.detectors import percentile_anomalies
+    assert percentile_anomalies(np.array([]), 100.0) is None
+    assert percentile_anomalies(np.array([1.0, 2.0]), 100.0) is None

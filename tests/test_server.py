@@ -325,6 +325,32 @@ def test_rest_wrong_method(server):
     assert code == 405
 
 
+def test_rest_endpoint_method_matrix(server):
+    """Every endpoint x {GET, POST}: the supported method never 404s/405s,
+    the wrong method 405s with the list of endpoints valid FOR the method
+    attempted, and unknown paths 404 with the full table."""
+    assert len(rest.ALL_ENDPOINTS) == 23
+    assert set(rest.GET_ENDPOINTS) | set(rest.POST_ENDPOINTS) == set(
+        rest.ALL_ENDPOINTS)
+    assert not set(rest.GET_ENDPOINTS) & set(rest.POST_ENDPOINTS)
+    assert "WHAT_IF" in rest.GET_ENDPOINTS
+    assert "RIGHTSIZE" in rest.POST_ENDPOINTS
+    for name in rest.ALL_ENDPOINTS:
+        path = f"/kafkacruisecontrol/{name.lower()}"
+        if name in rest.GET_ENDPOINTS:
+            good, bad, bad_list = _get, _post, rest.POST_ENDPOINTS
+        else:
+            good, bad, bad_list = _post, _get, rest.GET_ENDPOINTS
+        code, _ = good(server, path + "?json=true")
+        assert code not in (404, 405), (name, code)
+        code, body = bad(server, path)
+        assert code == 405, (name, code)
+        assert body["validEndpoints"] == bad_list
+        assert name not in body["validEndpoints"]
+    code, body = _get(server, "/kafkacruisecontrol/nope")
+    assert code == 404 and body["validEndpoints"] == rest.ALL_ENDPOINTS
+
+
 def test_rest_two_step_verification():
     app = _app(overrides={"two.step.verification.enabled": True})
     api = rest.RestApi(app)
