@@ -1,0 +1,179 @@
+"""Incremental goal rescore for the steady-state control loop.
+
+Most monitor ticks change the measured load of a handful of partitions and
+nothing structural. Re-running the full anneal on every tick would spend
+seconds re-deriving a proposal the deltas cannot have invalidated. This
+module keeps a **rescore baseline** — the device-resident topology, the
+assignment it was scored with, and the per-goal violation verdicts at the
+time the cached proposal was computed — and re-evaluates ONLY the goal
+penalty pipeline (aggregates → thresholds → penalties) after splicing the
+dirty load rows in on device (:func:`~cruise_control_tpu.ops.aggregates.
+splice_replica_loads`).
+
+The rescore is bit-identical to scoring a freshly built model: the splice
+scatters the exact rows the host build wrote, and the same jitted pipeline
+then runs on bit-identical inputs (locked by tests/test_incremental.py).
+``app.py`` serves the cached proposal iff no goal's violated/clean verdict
+flips and the delta mass stays under the configured threshold; any flip
+falls back to the full anneal, which rebuilds the baseline.
+
+Index buffers are padded to power-of-two buckets with the axis length as
+the drop sentinel, so steady-state ticks reuse one compiled program
+regardless of how many partitions went dirty.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from cruise_control_tpu.analyzer import goals as G
+from cruise_control_tpu.models.cluster import Assignment, ClusterTopology
+from cruise_control_tpu.ops.aggregates import (DeviceTopology,
+                                               compute_aggregates,
+                                               device_topology,
+                                               load_delta_mass,
+                                               splice_replica_loads,
+                                               topic_totals)
+from cruise_control_tpu.ops.windows import bucket_len
+
+
+@dataclasses.dataclass
+class RescoreBaseline:
+    """Everything needed to re-score goal verdicts against load deltas."""
+
+    dt: DeviceTopology                 # resident arrays, spliced tick-to-tick
+    assign: Assignment
+    init_broker: jax.Array             # i32[R] — current state IS initial here
+    goal_names: Tuple[str, ...]
+    constraint: object
+    num_topics: int
+    sparse_topic: bool
+    topic_total: Optional[jax.Array]   # f32[T] cached when sparse (structure-
+                                       # invariant: loads never change it)
+    penalties: G.GoalPenalties
+    violated: np.ndarray               # bool[G+1] verdicts at proposal time
+    pid_host: np.ndarray               # i64[R] partition of each replica
+    capacity_host: np.ndarray          # f32[B, 4] — splice can't carry
+                                       # capacity drift; guard on equality
+    digest: Optional[str]              # structural digest of the model build
+
+
+@dataclasses.dataclass
+class RescoreResult:
+    penalties: G.GoalPenalties
+    violated: np.ndarray               # bool[G+1]
+    flips: np.ndarray                  # bool[G+1] verdict changed vs baseline
+    any_flip: bool
+    dirty_partitions: int
+    dirty_replicas: int
+    delta_mass: float
+    total_mass: float
+    dt: DeviceTopology                 # spliced arrays — the next baseline dt
+
+
+def _score_pipeline(dt: DeviceTopology, assign: Assignment,
+                    init_broker: jax.Array, constraint,
+                    goal_names: Tuple[str, ...], num_topics: int,
+                    sparse_topic: bool,
+                    topic_total: Optional[jax.Array]) -> G.GoalPenalties:
+    """THE scoring pipeline — one definition shared by baseline build and
+    delta rescore, so both run the same compiled programs on the same
+    routing (the bit-identity contract depends on this)."""
+    agg = compute_aggregates(dt, assign, 1 if sparse_topic else num_topics)
+    th = G.compute_thresholds(dt, constraint, agg, topic_total=topic_total)
+    return G.full_goal_penalties(dt, assign, th, num_topics, goal_names,
+                                 init_broker, agg, sparse_topic)
+
+
+def build_baseline(topo: ClusterTopology, assign: Assignment,
+                   goal_names: Sequence[str], constraint,
+                   digest: Optional[str] = None) -> RescoreBaseline:
+    """Score the current state of ``topo`` and capture the verdict baseline.
+
+    Topic-scoring routing (dense vs sparse) mirrors ``optimizer._setup_model``
+    — real broker count × topics against ``TOPIC_DENSE_LIMIT`` — so the
+    rescore never traces a differently-routed program than the optimize it
+    shadows."""
+    from cruise_control_tpu.analyzer.optimizer import TOPIC_DENSE_LIMIT
+    dt = device_topology(topo)
+    num_topics = topo.num_topics
+    n_real_brokers = (int(np.asarray(topo.broker_present).sum())
+                      if getattr(topo, "broker_present", None) is not None
+                      else topo.num_brokers)
+    sparse_topic = n_real_brokers * num_topics > TOPIC_DENSE_LIMIT
+    goal_names = tuple(goal_names)
+    init_broker = jax.device_put(
+        np.asarray(jax.device_get(assign.broker_of), np.int32))
+    tt = topic_totals(dt, num_topics) if sparse_topic else None
+    pen = _score_pipeline(dt, assign, init_broker, constraint, goal_names,
+                          num_topics, sparse_topic, tt)
+    violated = np.asarray(pen.violations) > 0
+    return RescoreBaseline(
+        dt=dt, assign=assign, init_broker=init_broker,
+        goal_names=goal_names, constraint=constraint,
+        num_topics=num_topics, sparse_topic=sparse_topic, topic_total=tt,
+        penalties=pen, violated=violated,
+        pid_host=np.asarray(jax.device_get(dt.partition_of_replica),
+                            np.int64),
+        capacity_host=np.asarray(topo.capacity, np.float32).copy(),
+        digest=digest)
+
+
+def rescore_deltas(baseline: RescoreBaseline, topo: ClusterTopology,
+                   dirty_partitions: np.ndarray) -> Optional[RescoreResult]:
+    """Re-score goal verdicts after ``dirty_partitions`` changed load.
+
+    ``topo`` is the freshly refreshed model (the splice source of truth);
+    ``dirty_partitions`` indexes its partition axis (the monitor's
+    ``dirtyPartitionIndex``). Returns None when the baseline cannot absorb
+    the tick (capacity drifted — the load splice has no lane for it), in
+    which case the caller must fall back to a full recompute."""
+    if not np.array_equal(
+            np.asarray(topo.capacity, np.float32), baseline.capacity_host):
+        return None
+    dp = np.asarray(dirty_partitions, np.int64)
+    P = baseline.pid_host.max(initial=-1) + 1 if baseline.pid_host.size else 0
+    P = max(int(P), int(np.asarray(topo.leader_extra).shape[0]))
+    mask_p = np.zeros(P, bool)
+    mask_p[dp] = True
+    dr = np.flatnonzero(mask_p[baseline.pid_host])
+    R = baseline.pid_host.shape[0]
+
+    # host-side gather of the dirty rows, padded to a power-of-two bucket
+    # with the axis length as the drop sentinel (negatives would wrap)
+    base = np.asarray(topo.replica_base_load, np.float32)
+    extra = np.asarray(topo.leader_extra, np.float32)
+    lbi = np.asarray(topo.leader_bytes_in, np.float32)
+
+    nb = bucket_len(dr.shape[0])
+    r_idx = np.full(nb, R, np.int32)
+    r_idx[:dr.shape[0]] = dr
+    b_rows = np.zeros((nb, base.shape[1]), np.float32)
+    b_rows[:dr.shape[0]] = base[dr]
+    npb = bucket_len(dp.shape[0])
+    p_idx = np.full(npb, P, np.int32)
+    p_idx[:dp.shape[0]] = dp
+    e_rows = np.zeros((npb, extra.shape[1]), np.float32)
+    e_rows[:dp.shape[0]] = extra[dp]
+    l_rows = np.zeros(npb, np.float32)
+    l_rows[:dp.shape[0]] = lbi[dp]
+
+    delta, total = load_delta_mass(baseline.dt, r_idx, b_rows, p_idx, e_rows)
+    dt_new = splice_replica_loads(baseline.dt, r_idx, b_rows, p_idx, e_rows,
+                                  l_rows)
+    pen = _score_pipeline(dt_new, baseline.assign, baseline.init_broker,
+                          baseline.constraint, baseline.goal_names,
+                          baseline.num_topics, baseline.sparse_topic,
+                          baseline.topic_total)
+    violated = np.asarray(pen.violations) > 0
+    flips = violated != baseline.violated
+    return RescoreResult(
+        penalties=pen, violated=violated, flips=flips,
+        any_flip=bool(flips.any()),
+        dirty_partitions=int(dp.shape[0]), dirty_replicas=int(dr.shape[0]),
+        delta_mass=float(delta), total_mass=float(total),
+        dt=dt_new)

@@ -317,6 +317,16 @@ class CruiseControlApp:
         self._last_fallback: Optional[dict] = None
         #: consecutive precompute_tick failures (warning rate is capped)
         self._precompute_failures = 0
+        #: incremental tick path (analyzer/rescore.py): the goal-verdict
+        #: baseline the cached proposal was computed against, plus counters
+        #: for /state (all guarded by _cache_lock; the baseline itself is
+        #: only read/mutated under _compute_gate)
+        self._rescore_state = None
+        self.proposal_cache_hits = 0
+        self.proposal_cache_misses = 0
+        self.incremental_refreshes = 0
+        self.anneal_skips = 0
+        self.last_tick_ms: Optional[float] = None
 
     # ----------------------------------------------------------------- boot
 
@@ -380,16 +390,27 @@ class CruiseControlApp:
 
     def precompute_tick(self) -> bool:
         """One precompute check: recompute the default-goal proposals when
-        the cache is missing/stale/expired. Returns True if it computed."""
+        the cache is missing/stale/expired. Returns True if it computed.
+
+        Tries the incremental path first: a tick whose load deltas flip no
+        goal verdict re-arms the cached proposal without annealing."""
         if self._cache_is_fresh():
             return False
         if not self._compute_gate.acquire(blocking=False):
             return False         # a request thread is already computing
+        t0 = time.monotonic()
         try:
             if self._cache_is_fresh():
                 return False
+            if self._try_incremental_refresh():
+                self._precompute_failures = 0
+                with self._cache_lock:
+                    self.last_tick_ms = (time.monotonic() - t0) * 1000.0
+                return True
             self._compute_and_cache()
             self._precompute_failures = 0
+            with self._cache_lock:
+                self.last_tick_ms = (time.monotonic() - t0) * 1000.0
             return True
         except NotEnoughValidWindowsError:
             return False         # monitor not ready yet: expected at startup
@@ -415,6 +436,62 @@ class CruiseControlApp:
         self.precompute_tick()      # warm immediately, don't wait one interval
         while not self._precompute_shutdown.wait(interval_s):
             self.precompute_tick()
+
+    def _try_incremental_refresh(self) -> bool:
+        """Incremental tick (callers hold ``_compute_gate``): when the model
+        build spliced only a small fraction of partitions and the rescore of
+        the new loads flips no goal verdict, the cached proposal is still
+        the answer the anneal would re-derive — re-stamp it at the current
+        generation and skip the anneal entirely. Any doubt (digest drift,
+        capacity drift, dirty mass over threshold, a verdict flip, the
+        rescore erroring) falls through to the full computation."""
+        threshold = self.config.get("proposal.cache.dirty.mass.threshold")
+        if threshold <= 0:
+            return False
+        with self._cache_lock:
+            c = self._proposal_cache
+            rs = self._rescore_state
+        if c is None or rs is None or rs.digest is None:
+            return False
+        # expiration still applies: an expired cache must be recomputed
+        age = time.time() * 1000 - c.computed_at_ms
+        if age >= self.config.get("proposal.expiration.ms"):
+            return False
+        # generation BEFORE the model build, same staleness discipline as
+        # _compute_and_cache
+        gen_now = self.load_monitor.model_generation()
+        try:
+            topo, assign = self._model()
+        except NotEnoughValidWindowsError:
+            return False
+        info = self.load_monitor.last_build_info()
+        if (not info or info.get("kind") not in ("splice", "refresh")
+                or info.get("digest") != rs.digest
+                or info.get("dirtyPartitionIndex") is None):
+            return False         # structural change (or cold build): anneal
+        monitored = info.get("monitoredPartitions") or 0
+        dirty = info.get("dirtyPartitions") or 0
+        if monitored <= 0 or dirty / monitored > threshold:
+            return False
+        try:
+            from cruise_control_tpu.analyzer import rescore as RS
+            out = RS.rescore_deltas(rs, topo, info["dirtyPartitionIndex"])
+        except Exception:
+            logger.warning("incremental rescore failed; falling back to "
+                           "full computation", exc_info=True)
+            return False
+        if out is None or out.any_flip:
+            return False
+        with self._cache_lock:
+            self._proposal_cache = CachedProposals(
+                c.result, gen_now, int(time.time() * 1000))
+            rs.dt = out.dt       # next tick splices against these arrays
+            self.incremental_refreshes += 1
+            self.anneal_skips += 1
+        REGISTRY.counter("proposal.incremental.refresh")
+        logger.debug("incremental refresh: %d dirty partitions, no verdict "
+                     "flip — anneal skipped", out.dirty_partitions)
+        return True
 
     # ------------------------------------------------------------- optimize
 
@@ -602,15 +679,23 @@ class CruiseControlApp:
                 # the cached result was computed on the same model build
                 # the estimation gate refers to — enforce it on hits too
                 self._check_capacity_estimation(allow_capacity_estimation)
+                with self._cache_lock:
+                    self.proposal_cache_hits += 1
                 return cached
             # one default-goal computation at a time: concurrent requests
             # (and the precompute tick) wait, then re-check the cache the
             # winner just filled (GoalOptimizer._cacheLock semantics)
             with self._compute_gate:
                 cached = self._cached_result_if_fresh()
+                if cached is None and self._try_incremental_refresh():
+                    cached = self._cached_result_if_fresh()
                 if cached is not None:
                     self._check_capacity_estimation(allow_capacity_estimation)
+                    with self._cache_lock:
+                        self.proposal_cache_hits += 1
                     return cached
+                with self._cache_lock:
+                    self.proposal_cache_misses += 1
                 return self._compute_and_cache(allow_capacity_estimation)
         topo, assign = self._model(data_from=data_from,
                                    min_valid_partition_ratio=min_valid_partition_ratio)
@@ -636,9 +721,27 @@ class CruiseControlApp:
                        "topics.excluded.from.partition.movement")
                    else None)
         result = self._optimize(topo, assign, None, options)
+        # goal-verdict baseline for the incremental tick path: scored on the
+        # same model the proposal was computed from; only digest-carrying
+        # (warm-cacheable) builds can ever splice, so skip the rest
+        rs = None
+        try:
+            info = self.load_monitor.last_build_info()
+            if info and info.get("digest") and self.config.get(
+                    "proposal.cache.dirty.mass.threshold") > 0:
+                from cruise_control_tpu.analyzer import rescore as RS
+                rs = RS.build_baseline(topo, assign,
+                                       tuple(self.default_goals),
+                                       self.constraint,
+                                       digest=info["digest"])
+        except Exception:
+            logger.warning("rescore baseline build failed; incremental "
+                           "refresh disabled until next computation",
+                           exc_info=True)
         with self._cache_lock:
             self._proposal_cache = CachedProposals(
                 result, gen0, int(time.time() * 1000))
+            self._rescore_state = rs
         import jax
         if (not self._escape_kernels_warmed
                 and not OPT._routes_to_tiny_cpu(topo, self.mesh, options)
@@ -1313,6 +1416,11 @@ class CruiseControlApp:
             proposal_ready = self._proposal_cache is not None
             last_fallback = self._last_fallback
             last_provision = self._last_provision_recommendation
+            cache_hits = self.proposal_cache_hits
+            cache_misses = self.proposal_cache_misses
+            incr_refreshes = self.incremental_refreshes
+            anneal_skips = self.anneal_skips
+            last_tick_ms = self.last_tick_ms
         out = {
             "MonitorState": self.load_monitor.state_snapshot(),
             "ExecutorState": self.executor.state_snapshot(),
@@ -1322,6 +1430,11 @@ class CruiseControlApp:
                 "lastOptimizationFallback": last_fallback,
                 "precomputeFailures": self._precompute_failures,
                 "lastProvisionRecommendation": last_provision,
+                "proposalCacheHits": cache_hits,
+                "proposalCacheMisses": cache_misses,
+                "incrementalRefreshes": incr_refreshes,
+                "annealSkips": anneal_skips,
+                "lastTickMs": last_tick_ms,
             },
             "AnomalyDetectorState": self.anomaly_detector.state_snapshot(),
         }

@@ -286,6 +286,11 @@ def _service_config_def() -> ConfigDef:
              "Cached proposal staleness bound.", at_least(0))
     d.define("num.proposal.precompute.threads", T.INT, 1, I.LOW,
              "Proposal precompute workers.", at_least(0))
+    d.define("proposal.cache.dirty.mass.threshold", T.DOUBLE, 0.5, I.MEDIUM,
+             "Incremental tick path: largest fraction of monitored "
+             "partitions allowed dirty for a precompute tick to revalidate "
+             "the cached proposal with a goal rescore instead of a full "
+             "anneal. 0 disables the incremental path.", between(0.0, 1.0))
     d.define("optimizer.engine", T.STRING, "auto", I.HIGH,
              "auto | greedy | anneal")
     d.define("optimizer.bucketing", T.STRING, "auto", I.MEDIUM,

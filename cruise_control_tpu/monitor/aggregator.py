@@ -1,13 +1,23 @@
-"""Windowed metric-sample aggregation, array-resident.
+"""Windowed metric-sample aggregation, device-resident.
 
 Rebuild of the core aggregation engine
 (``cruise-control-core/.../MetricSampleAggregator.java:84``,
 ``RawMetricValues.java``): samples land in a cyclic buffer of N time windows
 per entity; aggregation applies each metric's strategy (AVG / MAX / LATEST),
 extrapolates windows with too-few samples, stamps generations, and accounts
-completeness. Unlike the reference's per-entity object maps, state is flat
-ndarrays [E, W, M] — aggregation over 100K entities is a handful of
-vectorized reductions.
+completeness. Unlike the reference's per-entity object maps — and unlike the
+earlier host ndarray port — the window tensors ``[capacity, W+1, M]`` live on
+device (:mod:`cruise_control_tpu.ops.windows`): ingest batches fold on the
+host into one update per touched (entity, window) cell and land in a single
+scatter, rolls are one masked store, and aggregation is one fused collapse
+kernel. The host keeps the entity index plus integer mirrors of the per-cell
+sample counts and latest-sample timestamps, so completeness / extrapolation
+bookkeeping never round-trips the device.
+
+``aggregate(..., update_dirty=True)`` additionally diffs the collapse
+against the previous such call and returns a per-entity **dirty mask** —
+the signal the incremental model build (load-column splice) and the
+analyzer's ``rescore_deltas`` path key off.
 
 Extrapolation semantics (``RawMetricValues.java`` / ``Extrapolation.java``):
 - window with >= min_samples_per_window samples: valid, no extrapolation
@@ -23,11 +33,13 @@ from __future__ import annotations
 import dataclasses
 import enum
 import threading
-from typing import Dict, Hashable, List, Optional, Sequence, Tuple
+from typing import Dict, Hashable, Iterable, List, Optional, Sequence, Tuple
 
+import jax.numpy as jnp
 import numpy as np
 
 from cruise_control_tpu.monitor import metricdef as md
+from cruise_control_tpu.ops import windows as wops
 
 
 class Extrapolation(enum.Enum):
@@ -64,6 +76,15 @@ class AggregationResult:
     extrapolations: np.ndarray            # i8[Ev, Wv] Extrapolation ordinal
     completeness: "Completeness"
     generation: int
+    #: only on ``aggregate(update_dirty=True)`` ticks: bool[Ev], True where
+    #: the entity's stable-window values changed since the previous such
+    #: tick (new entities and post-roll ticks read all-dirty)
+    dirty_mask: Optional[np.ndarray] = None
+    #: monotone id of this dirty tick / of the tick the mask diffs against
+    #: (prev is None when no positional diff was possible — consumers must
+    #: treat the result as fully dirty)
+    tick_id: Optional[int] = None
+    prev_tick_id: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -113,12 +134,10 @@ class MetricSampleAggregator:
             strategies = [md.METRIC_STRATEGY[md.ModelMetric(i)]
                           for i in range(num_metrics)]
         self._strategies = list(strategies)
-        self._avg_cols = np.array([i for i, s in enumerate(self._strategies)
-                                   if s == md.Strategy.AVG], dtype=np.int64)
-        self._max_cols = np.array([i for i, s in enumerate(self._strategies)
-                                   if s == md.Strategy.MAX], dtype=np.int64)
-        self._latest_cols = np.array([i for i, s in enumerate(self._strategies)
-                                      if s == md.Strategy.LATEST], dtype=np.int64)
+        self._avg_mask = np.array([s == md.Strategy.AVG
+                                   for s in self._strategies])
+        self._max_mask = np.array([s == md.Strategy.MAX
+                                   for s in self._strategies])
 
         self._lock = threading.RLock()
         self._entity_rows: Dict[Hashable, int] = {}
@@ -126,11 +145,22 @@ class MetricSampleAggregator:
         self._group_of: Dict[Hashable, Hashable] = {}
         cap = 64
         W1 = num_windows + 1  # + current (incomplete) window
-        self._sum = np.zeros((cap, W1, self.M))
-        self._max = np.full((cap, W1, self.M), -np.inf)
-        self._latest = np.zeros((cap, W1, self.M))
+        self._buffers = wops.make_buffers(cap, W1, self.M)
+        # host integer mirrors: completeness / extrapolation / LATEST-order
+        # bookkeeping without device round-trips (ms times need int64)
         self._latest_t = np.full((cap, W1), -1, np.int64)
-        self._count = np.zeros((cap, W1), np.int32)
+        self._count_h = np.zeros((cap, W1), np.int32)
+        # pending ingest batch: folded + scattered on the next flush point
+        # (aggregate / roll / snapshot), so per-sample cost is list appends
+        self._p_rows: List[int] = []
+        self._p_slots: List[int] = []
+        self._p_times: List[int] = []
+        self._p_vals: List[np.ndarray] = []
+        # dirty-tick state: device collapse of the previous
+        # update_dirty=True aggregate plus its window range
+        self._prev_vals = None
+        self._prev_key: Optional[tuple] = None
+        self._tick_id = 0
         self._oldest_window: Optional[int] = None  # window index (time//window_ms)
         self.generation = 0
         #: monotonic count of accepted samples — generation only bumps on
@@ -144,19 +174,30 @@ class MetricSampleAggregator:
         row = self._entity_rows.get(entity)
         if row is None:
             row = len(self._entities)
-            if row == self._sum.shape[0]:
-                grow = lambda a, fill: np.concatenate(
-                    [a, np.full_like(a, fill)], axis=0)
-                self._sum = grow(self._sum, 0.0)
-                self._max = grow(self._max, -np.inf)
-                self._latest = grow(self._latest, 0.0)
-                self._latest_t = grow(self._latest_t, -1)
-                self._count = grow(self._count, 0)
             self._entity_rows[entity] = row
             self._entities.append(entity)
             self.generation += 1
         self._group_of[entity] = group
         return row
+
+    def _ensure_capacity(self, min_rows: int) -> None:
+        cap = self._latest_t.shape[0]
+        if min_rows <= cap:
+            return
+        new_cap = cap
+        while new_cap < min_rows:
+            new_cap *= 2
+        self._buffers = wops.grow_buffers(self._buffers, new_cap)
+        grow = lambda a, fill: np.concatenate(
+            [a, np.full((new_cap - cap,) + a.shape[1:], fill, a.dtype)])
+        self._latest_t = grow(self._latest_t, -1)
+        self._count_h = grow(self._count_h, 0)
+        if self._prev_vals is not None:
+            # NaN-pad: grown rows always diff as dirty on the next tick
+            pad = jnp.full((new_cap - cap,) + self._prev_vals.shape[1:],
+                           jnp.nan, jnp.float32)
+            self._prev_vals = jnp.concatenate([self._prev_vals, pad])
+            self._prev_key = (self._prev_key[0], new_cap)
 
     def _slot(self, widx: int) -> int:
         """Cyclic slot for a window index; rolls the buffer forward."""
@@ -175,16 +216,21 @@ class MetricSampleAggregator:
         return widx % W1
 
     def _roll(self, shift: int):
-        """Zero the slots that cycle out (they become future windows)."""
+        """Zero the slots that cycle out (they become future windows).
+
+        Pending samples flush FIRST: a sample recorded into a slot that is
+        about to cycle out must land and then be dropped with the slot —
+        sequential parity with the scalar ingest rule."""
+        self._flush_locked()
         W1 = self.num_windows + 1
         shift = min(shift, W1)
+        mask = np.zeros(W1, bool)
         for s in range(shift):
             slot = (self._oldest_window + s) % W1
-            self._sum[:, slot] = 0.0
-            self._max[:, slot] = -np.inf
-            self._latest[:, slot] = 0.0
+            mask[slot] = True
             self._latest_t[:, slot] = -1
-            self._count[:, slot] = 0
+            self._count_h[:, slot] = 0
+        self._buffers = wops.roll_slots(self._buffers, jnp.asarray(mask))
         self.generation += 1
 
     # -- ingest -------------------------------------------------------------
@@ -198,20 +244,46 @@ class MetricSampleAggregator:
             slot = self._slot(widx)
             if slot < 0:
                 return False
-            v = np.asarray(values, dtype=np.float64)
-            present = ~np.isnan(v)
-            vv = np.where(present, v, 0.0)
-            self._sum[row, slot] += vv
-            self._max[row, slot] = np.maximum(self._max[row, slot],
-                                              np.where(present, v, -np.inf))
-            newer = time_ms >= self._latest_t[row, slot]
-            if newer:
-                self._latest[row, slot] = np.where(present, v,
-                                                   self._latest[row, slot])
-                self._latest_t[row, slot] = time_ms
-            self._count[row, slot] += 1
+            self._p_rows.append(row)
+            self._p_slots.append(slot)
+            self._p_times.append(int(time_ms))
+            self._p_vals.append(np.asarray(values, dtype=np.float64))
             self.samples_ingested += 1
             return True
+
+    def add_samples(self, samples: Iterable[Tuple[Hashable, int, np.ndarray,
+                                                  Hashable]]) -> int:
+        """Batch ingest of ``(entity, time_ms, values, group)`` tuples under
+        one lock acquisition; returns the number accepted."""
+        n = 0
+        with self._lock:
+            for entity, time_ms, values, group in samples:
+                if self.add_sample(entity, time_ms, values, group):
+                    n += 1
+        return n
+
+    def _flush_locked(self) -> None:
+        """Fold the pending batch and apply it in one device scatter."""
+        n = len(self._p_rows)
+        if n == 0:
+            return
+        W1 = self.num_windows + 1
+        rows = np.asarray(self._p_rows, np.int64)
+        slots = np.asarray(self._p_slots, np.int64)
+        times = np.asarray(self._p_times, np.int64)
+        vals = np.stack(self._p_vals).astype(np.float64)
+        self._p_rows, self._p_slots = [], []
+        self._p_times, self._p_vals = [], []
+        self._ensure_capacity(int(rows.max()) + 1)
+        (cell_rows, cell_slots, sum_add, cnt_add, max_cand, lat_vals,
+         new_latest_t) = wops.fold_pending(rows, slots, times, vals, W1,
+                                           self._latest_t)
+        self._latest_t[cell_rows, cell_slots] = new_latest_t
+        self._count_h[cell_rows, cell_slots] += cnt_add.astype(np.int32)
+        cap = self._latest_t.shape[0]
+        self._buffers = wops.scatter_batch(
+            self._buffers, *wops.pad_update(cell_rows, cell_slots, sum_add,
+                                            cnt_add, max_cand, lat_vals, cap))
 
     # -- aggregate ----------------------------------------------------------
 
@@ -239,10 +311,16 @@ class MetricSampleAggregator:
 
     def aggregate(self, now_ms: int,
                   requirements: ModelCompletenessRequirements = ModelCompletenessRequirements(),
-                  ) -> AggregationResult:
+                  update_dirty: bool = False) -> AggregationResult:
         """Aggregate all completed windows (newest-to-oldest trimmed to the
-        cyclic capacity), extrapolating sparse windows per entity."""
+        cyclic capacity), extrapolating sparse windows per entity.
+
+        ``update_dirty=True`` (the model-build tick) additionally returns
+        the per-entity dirty mask against the PREVIOUS update_dirty call and
+        advances the dirty baseline; plain calls (state snapshots,
+        completeness checks) never touch it."""
         with self._lock:
+            self._flush_locked()
             E = len(self._entities)
             widxs = self._stable_slots(now_ms)
             Wv = len(widxs)
@@ -253,43 +331,33 @@ class MetricSampleAggregator:
                     window_times=widxs * self.window_ms,
                     extrapolations=np.zeros((0, Wv), np.int8),
                     completeness=Completeness(np.zeros(Wv, np.float32), 0.0, 0, 0, 0),
-                    generation=self.generation)
+                    generation=self.generation,
+                    dirty_mask=(np.zeros(0, bool) if update_dirty else None))
 
             slots = (widxs % W1).astype(np.int64)
             real = self._real_windows(widxs)                    # [Wv]
-            cnt = np.where(real, self._count[:E][:, slots], 0)  # [E, Wv]
-            ssum = np.where(real[None, :, None], self._sum[:E][:, slots], 0.0)
-            smax = np.where(real[None, :, None], self._max[:E][:, slots],
-                            -np.inf)
-            slatest = np.where(real[None, :, None],
-                               self._latest[:E][:, slots], 0.0)
+            # device collapse over the full capacity (bucketed: entity
+            # growth within capacity never retraces); strategy + adjacent
+            # blend in one fused program
+            vals_dev = wops.collapse_windows(
+                self._buffers, jnp.asarray(slots, jnp.int32),
+                jnp.asarray(real), jnp.int32(self.min_samples),
+                jnp.asarray(self._avg_mask), jnp.asarray(self._max_mask))
 
-            safe_cnt = np.maximum(cnt, 1)[:, :, None]
-            vals = np.zeros((E, Wv, self.M))
-            if self._avg_cols.size:
-                vals[:, :, self._avg_cols] = ssum[:, :, self._avg_cols] / safe_cnt
-            if self._max_cols.size:
-                vals[:, :, self._max_cols] = np.where(
-                    np.isfinite(smax[:, :, self._max_cols]),
-                    smax[:, :, self._max_cols], 0.0)
-            if self._latest_cols.size:
-                vals[:, :, self._latest_cols] = slatest[:, :, self._latest_cols]
-
-            full = cnt >= self.min_samples                       # [E, Wv]
+            # host integer bookkeeping (counts mirror): extrapolation codes,
+            # validity, completeness — identical booleans to the device
+            # blend's (both read the same counts)
+            cnt = np.where(real, self._count_h[:E][:, slots], 0)  # [E, Wv]
+            full = cnt >= self.min_samples
             some = cnt > 0
             extra = np.zeros((E, Wv), np.int8)
             extra[some & ~full] = 1                              # AVG_AVAILABLE
-            # AVG_ADJACENT for empty windows with both neighbors full
             left = np.roll(full, 1, axis=1)
             left[:, 0] = False
             right = np.roll(full, -1, axis=1)
             right[:, -1] = False
             adj = ~some & left & right
-            if adj.any():
-                lv = np.roll(vals, 1, axis=1)
-                rv = np.roll(vals, -1, axis=1)
-                vals[adj] = 0.5 * (lv[adj] + rv[adj])
-                extra[adj] = 2                                   # AVG_ADJACENT
+            extra[adj] = 2                                       # AVG_ADJACENT
             invalid = ~some & ~adj
             extra[invalid] = 3                                   # NO_VALID_EXTRAPOLATION
 
@@ -309,10 +377,36 @@ class MetricSampleAggregator:
             groups = {self._group_of.get(e) for i, e in enumerate(self._entities)
                       if entity_valid[i]}
 
+            vals_full = np.asarray(vals_dev)                 # f32[cap, Wv, M]
             rows = np.flatnonzero(entity_valid)
+
+            dirty_full = None
+            tick = prev_tick = None
+            if update_dirty:
+                cap = vals_full.shape[0]
+                # the key deliberately ignores WHICH windows the columns
+                # hold: every consumer derives from the values alone, and a
+                # value-level positional diff stays correct across rolls —
+                # a steady entity's window series is bit-equal before and
+                # after the range advances, so roll ticks go sparse-dirty
+                # instead of all-dirty
+                wkey = (Wv, cap)
+                if self._prev_vals is not None and self._prev_key == wkey:
+                    dirty_full = np.asarray(
+                        wops.changed_rows(vals_dev, self._prev_vals))
+                    prev_tick = self._tick_id
+                else:
+                    # window count grew (warmup) or capacity is fresh: no
+                    # positional diff exists — everything dirty
+                    dirty_full = np.ones(cap, bool)
+                self._prev_vals = vals_dev
+                self._prev_key = wkey
+                self._tick_id += 1
+                tick = self._tick_id
+
             return AggregationResult(
                 entities=[self._entities[i] for i in rows],
-                values=vals[rows],
+                values=vals_full[rows].astype(np.float64),
                 window_times=widxs * self.window_ms,
                 extrapolations=extra[rows],
                 completeness=Completeness(
@@ -323,6 +417,10 @@ class MetricSampleAggregator:
                     num_valid_entities=int(entity_valid.sum()),
                 ),
                 generation=self.generation,
+                dirty_mask=(dirty_full[rows] if dirty_full is not None
+                            else None),
+                tick_id=tick,
+                prev_tick_id=prev_tick,
             )
 
     def completeness(self, now_ms: int,

@@ -27,6 +27,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from cruise_control_tpu.common import faults as _faults
 from cruise_control_tpu.common import resources as res
 from cruise_control_tpu.models.cluster import ClusterModelBuilder
 from cruise_control_tpu.monitor import metricdef as md
@@ -175,6 +176,15 @@ class LoadMonitor:
         #: (bench.py JSON, app state). Guarded by self._lock.
         self.model_cache_hits = 0
         self.model_cache_misses = 0
+        #: incremental-tick observability (guarded by self._lock):
+        #: refreshes that spliced only dirty columns, and how many
+        #: partitions the last build actually recomputed
+        self.model_splice_hits = 0
+        self.last_dirty_partitions: Optional[int] = None
+        #: what the last _build_model produced — kind, structural digest,
+        #: dirty partition index — consumed by the app's incremental
+        #: proposal-rescore path (last_build_info())
+        self._last_build_info: Optional[dict] = None
         self._state = MonitorState.NOT_STARTED
         self._pause_reason: Optional[str] = None
         self._lock = threading.RLock()
@@ -215,6 +225,9 @@ class LoadMonitor:
             bootstrap_progress = self._bootstrap_progress
             cache_hits = self.model_cache_hits
             cache_misses = self.model_cache_misses
+            splice_hits = self.model_splice_hits
+            last_dirty = self.last_dirty_partitions
+            info = self._last_build_info
         result = self.partition_aggregator.aggregate(now_ms)
         c = result.completeness
         return {
@@ -229,6 +242,9 @@ class LoadMonitor:
             "generation": self.model_generation().__dict__,
             "modelCacheHits": cache_hits,
             "modelCacheMisses": cache_misses,
+            "modelSpliceHits": splice_hits,
+            "lastDirtyPartitions": last_dirty,
+            "lastModelBuildKind": (info or {}).get("kind"),
         }
 
     def model_generation(self) -> ModelGeneration:
@@ -350,6 +366,10 @@ class LoadMonitor:
             metadata = self._metadata_source.get_metadata()
             ps, bs = self._fetchers.fetch(
                 metadata, now_ms - self.sampling_interval_ms, now_ms)
+            # chaos-harness seam: fault plans can delay or truncate the
+            # fetched batch right before ingest (tests/test_incremental.py
+            # drives the high-frequency ingest path through this site)
+            ps, bs = _faults.chaos("monitor.ingest", (ps, bs))
             for s in ps:
                 self._ingest_partition_sample(s)
             for s in bs:
@@ -504,7 +524,11 @@ class LoadMonitor:
             metadata = self._metadata_source.get_metadata()
             # pass the requirements down: num_valid_windows counts windows
             # meeting the per-window valid-entity ratio of THESE requirements
-            result = self.partition_aggregator.aggregate(now_ms, requirements)
+            # update_dirty: this is THE model-build tick — advance the
+            # aggregator's dirty baseline and get the per-entity mask the
+            # load-column splice and the analyzer rescore key off
+            result = self.partition_aggregator.aggregate(now_ms, requirements,
+                                                         update_dirty=True)
             if result.completeness.num_valid_windows < requirements.min_required_num_windows:
                 raise NotEnoughValidWindowsError(
                     f"{result.completeness.num_valid_windows} valid windows, "
@@ -522,6 +546,17 @@ class LoadMonitor:
     #: partition count above which model build switches to the vectorized
     #: bulk path (same semantics, locked by a parity test)
     BULK_BUILD_THRESHOLD = 20_000
+
+    def last_build_info(self) -> Optional[dict]:
+        """Snapshot of what the last ``_build_model`` did: ``kind`` (bulk /
+        small / refresh / splice), the structural ``digest`` it was built
+        against, and — on warm builds — the dirty partition index into the
+        model's partition axis. The app's incremental proposal-rescore path
+        reads this right after ``cluster_model()`` to decide whether the
+        cached proposal can be revalidated without an anneal."""
+        with self._lock:
+            info = self._last_build_info
+            return dict(info) if info is not None else None
 
     def _build_model(self, metadata: ClusterMetadata, result: AggregationResult,
                      include_all_topics: bool = False):
@@ -554,7 +589,19 @@ class LoadMonitor:
             self._store_model_cache(metadata, result, include_all_topics,
                                     topo, assign)
             return topo, assign
-        return self._build_model_small(metadata, result, include_all_topics)
+        built = self._build_model_small(metadata, result, include_all_topics)
+        with self._lock:
+            # small models never splice (no digest cached for them); the
+            # incremental rescore path treats kind="small" as "full anneal"
+            self._last_build_info = {
+                "kind": "small",
+                "digest": None,
+                "tickId": result.tick_id,
+                "dirtyPartitions": None,
+                "monitoredPartitions": None,
+                "dirtyPartitionIndex": None,
+            }
+        return built
 
     def _model_cache_hit(self, cached: dict, metadata: ClusterMetadata,
                          result: AggregationResult,
@@ -601,9 +648,21 @@ class LoadMonitor:
             "topo": topo,
             "assign": assign,
             "rows": rows,
+            # partition-level load columns of the LAST build, keyed by the
+            # aggregator tick that produced them; None until the first
+            # warm refresh populates it (enables the dirty-mask splice)
+            "loads": None,
         }
         with self._lock:
             self._model_cache = cache
+            self._last_build_info = {
+                "kind": "bulk",
+                "digest": cache["digest"],
+                "tickId": result.tick_id,
+                "dirtyPartitions": None,
+                "monitoredPartitions": None,
+                "dirtyPartitionIndex": None,
+            }
 
     def _refresh_model_loads(self, cached: dict, metadata: ClusterMetadata,
                              result: AggregationResult):
@@ -613,7 +672,15 @@ class LoadMonitor:
         with the same vectorized collapse as ``_build_model_bulk`` and
         splice them onto the cached topology — milliseconds instead of the
         full array assembly. The cached == from-scratch contract is locked
-        by tests/test_warm_path.py."""
+        by tests/test_warm_path.py.
+
+        Delta splice: when the aggregator handed us a dirty mask for the
+        SAME tick baseline the cached load columns were built from, only
+        the dirty partitions' rows are recomputed and spliced over a copy
+        of the cached columns. Every per-row formula is row-independent
+        (window mean / LATEST pick, ``leadership_extra_from_leader_load``,
+        the follower subtraction), so splice == full recompute bit-for-bit
+        — locked by tests/test_incremental.py."""
         from cruise_control_tpu.models.cluster import (
             leadership_extra_from_leader_load)
         topo = cached["topo"]
@@ -632,30 +699,73 @@ class LoadMonitor:
         mm_cols[res.DISK] = md.ModelMetric.DISK_USAGE
         mm_cols[res.NW_IN] = md.ModelMetric.LEADER_BYTES_IN
         mm_cols[res.NW_OUT] = md.ModelMetric.LEADER_BYTES_OUT
-        if no_entities:
-            sub = np.zeros((1, W, res.NUM_RESOURCES))
-            collapsed = np.zeros((1, res.NUM_RESOURCES))
-            safe_rows = np.zeros(P, np.int64)
-        else:
-            sub = vals[:, :, mm_cols]                     # [E, W, 4]
-            collapsed = sub.mean(axis=1)                  # [E, 4]
+        loads = cached.get("loads")
+        can_splice = (
+            not no_entities
+            and loads is not None
+            and result.dirty_mask is not None
+            and result.prev_tick_id is not None
+            and result.prev_tick_id == loads["tick_id"]
+            and loads["W"] == W)
+        if can_splice:
+            dirty_p = monitored_mask & result.dirty_mask[safe_rows]
+            dp = np.flatnonzero(dirty_p)
+            # recompute ONLY the dirty rows, exact same formulas as the
+            # full branch below
+            sub_d = vals[safe_rows[dp]][:, :, mm_cols]    # [D, W, 4]
+            collapsed_d = sub_d.mean(axis=1)              # [D, 4]
             for k in range(res.NUM_RESOURCES):
                 mm = md.ModelMetric(int(mm_cols[k]))
                 if md.METRIC_STRATEGY[mm] == md.Strategy.LATEST:
-                    collapsed[:, k] = sub[:, -1, k]
-        leader_load = np.nan_to_num(
-            collapsed[safe_rows], copy=False).astype(np.float32)  # [P, 4]
-        leader_load[~monitored_mask] = 0.0
-        leader_extra = leadership_extra_from_leader_load(leader_load)
-        follower_load = leader_load - leader_extra
-        if no_entities:
-            leader_extra_windows = follower_windows = None
+                    collapsed_d[:, k] = sub_d[:, -1, k]
+            ll_d = np.nan_to_num(
+                collapsed_d, copy=False).astype(np.float32)       # [D, 4]
+            le_d = leadership_extra_from_leader_load(ll_d)
+            wr_d = np.nan_to_num(sub_d, copy=False).astype(np.float32)
+            lew_d = leadership_extra_from_leader_load(wr_d)
+            # copy-on-splice: the cached arrays are referenced by the
+            # previously published topology — never mutate them in place
+            follower_load = loads["follower_load"].copy()
+            leader_extra = loads["leader_extra"].copy()
+            lbi = loads["leader_bytes_in"].copy()
+            follower_windows = loads["follower_windows"].copy()
+            leader_extra_windows = loads["leader_extra_windows"].copy()
+            follower_load[dp] = ll_d - le_d
+            leader_extra[dp] = le_d
+            lbi[dp] = ll_d[:, res.NW_IN]
+            follower_windows[dp] = wr_d - lew_d
+            leader_extra_windows[dp] = lew_d
+            build_kind = "splice"
+            dirty_index = dp
         else:
-            win_res = np.nan_to_num(
-                sub[safe_rows], copy=False).astype(np.float32)    # [P, W, 4]
-            win_res[~monitored_mask] = 0.0
-            leader_extra_windows = leadership_extra_from_leader_load(win_res)
-            follower_windows = win_res - leader_extra_windows
+            if no_entities:
+                sub = np.zeros((1, W, res.NUM_RESOURCES))
+                collapsed = np.zeros((1, res.NUM_RESOURCES))
+                safe_rows = np.zeros(P, np.int64)
+            else:
+                sub = vals[:, :, mm_cols]                 # [E, W, 4]
+                collapsed = sub.mean(axis=1)              # [E, 4]
+                for k in range(res.NUM_RESOURCES):
+                    mm = md.ModelMetric(int(mm_cols[k]))
+                    if md.METRIC_STRATEGY[mm] == md.Strategy.LATEST:
+                        collapsed[:, k] = sub[:, -1, k]
+            leader_load = np.nan_to_num(
+                collapsed[safe_rows], copy=False).astype(np.float32)  # [P, 4]
+            leader_load[~monitored_mask] = 0.0
+            leader_extra = leadership_extra_from_leader_load(leader_load)
+            follower_load = leader_load - leader_extra
+            lbi = leader_load[:, res.NW_IN].copy()
+            if no_entities:
+                leader_extra_windows = follower_windows = None
+            else:
+                win_res = np.nan_to_num(
+                    sub[safe_rows], copy=False).astype(np.float32)  # [P, W, 4]
+                win_res[~monitored_mask] = 0.0
+                leader_extra_windows = leadership_extra_from_leader_load(
+                    win_res)
+                follower_windows = win_res - leader_extra_windows
+            build_kind = "refresh"
+            dirty_index = np.flatnonzero(monitored_mask)
         pid = np.asarray(topo.partition_of_replica)
         # capacity is re-resolved on every build (estimates can settle
         # between ticks); B is tiny, the loop is noise
@@ -673,17 +783,44 @@ class LoadMonitor:
             topo, capacity=capacity,
             replica_base_load=follower_load[pid],
             leader_extra=leader_extra,
-            leader_bytes_in=leader_load[:, res.NW_IN].copy(),
+            leader_bytes_in=lbi,
             replica_base_load_windows=(None if follower_windows is None
                                        else follower_windows[pid]),
             leader_extra_windows=leader_extra_windows)
+        if follower_windows is None or result.tick_id is None:
+            new_loads = None
+        else:
+            # next tick may splice against these (arrays shared with the
+            # topology just published — copy-on-splice above keeps them
+            # immutable once out)
+            new_loads = {
+                "tick_id": result.tick_id,
+                "W": W,
+                "follower_load": follower_load,
+                "leader_extra": leader_extra,
+                "leader_bytes_in": lbi,
+                "follower_windows": follower_windows,
+                "leader_extra_windows": leader_extra_windows,
+            }
         with self._lock:
             # published whole (PR 3 lock discipline: no reader sees a
             # half-filled list)
             self.capacity_estimated_brokers = estimated
+            if build_kind == "splice":
+                self.model_splice_hits += 1
+            self.last_dirty_partitions = int(dirty_index.shape[0])
+            self._last_build_info = {
+                "kind": build_kind,
+                "digest": cached["digest"],
+                "tickId": result.tick_id,
+                "dirtyPartitions": int(dirty_index.shape[0]),
+                "monitoredPartitions": int(monitored_mask.sum()),
+                "dirtyPartitionIndex": dirty_index,
+            }
             # re-arm the identity fast path for the next tick's snapshot
             self._model_cache = dict(cached, metadata=metadata,
-                                     generation=metadata.generation)
+                                     generation=metadata.generation,
+                                     loads=new_loads)
         return new_topo, cached["assign"]
 
     def _build_model_small(self, metadata: ClusterMetadata,

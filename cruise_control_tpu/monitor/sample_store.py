@@ -10,6 +10,7 @@ Kafka-backed store plugs in behind the identical SPI.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -20,6 +21,8 @@ from cruise_control_tpu.monitor.sampler import (
     BrokerMetricSample,
     PartitionMetricSample,
 )
+
+logger = logging.getLogger(__name__)
 
 
 class SampleStore:
@@ -242,19 +245,32 @@ class FileSampleStore(SampleStore):
                     f.write(json.dumps(s.to_json()) + "\n")
 
     def load_samples(self, on_partition_sample, on_broker_sample) -> int:
+        """Replay both shards. Corrupt lines (truncated write, bit rot) are
+        skipped with a warning — the same skip-don't-raise contract as
+        ``KafkaSampleStore._deserialize``; ingest-side callback failures
+        still propagate."""
         n = 0
-        if os.path.exists(self._ppath):
-            with open(self._ppath) as f:
+        for path, cb, cls in ((self._ppath, on_partition_sample,
+                               PartitionMetricSample),
+                              (self._bpath, on_broker_sample,
+                               BrokerMetricSample)):
+            if not os.path.exists(path):
+                continue
+            skipped = 0
+            with open(path) as f:
                 for line in f:
-                    if line.strip():
-                        on_partition_sample(
-                            PartitionMetricSample.from_json(json.loads(line)))
-                        n += 1
-        if os.path.exists(self._bpath):
-            with open(self._bpath) as f:
-                for line in f:
-                    if line.strip():
-                        on_broker_sample(
-                            BrokerMetricSample.from_json(json.loads(line)))
-                        n += 1
+                    if not line.strip():
+                        continue
+                    try:
+                        sample = cls.from_json(json.loads(line))
+                    except Exception:
+                        logger.debug("corrupt sample line in %s",
+                                     path, exc_info=True)
+                        skipped += 1
+                        continue
+                    cb(sample)
+                    n += 1
+            if skipped:
+                logger.warning("skipped %d corrupt sample line(s) in %s",
+                               skipped, path)
         return n

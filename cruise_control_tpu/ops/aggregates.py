@@ -236,3 +236,61 @@ def broker_scope_capacity(dt: DeviceTopology) -> jax.Array:
         dt.host_capacity[dt.host_of_broker],
         dt.capacity,
     )
+
+
+# --- delta variants (incremental tick path) ---------------------------------
+#
+# Most control-loop ticks change the load of a handful of partitions and
+# nothing structural. Instead of shipping a whole new DeviceTopology to the
+# device (R×4 + P×4 + P floats at LinkedIn scale), the monitor hands the
+# analyzer only the dirty rows and these kernels scatter them into the
+# resident arrays. Index buffers are padded to power-of-two buckets with the
+# axis length as the sentinel (out-of-range ⇒ mode="drop"/"fill" no-ops), so
+# steady-state ticks reuse one compiled program regardless of how many
+# partitions went dirty.
+
+
+@jax.jit
+def splice_replica_loads(dt: DeviceTopology,
+                         replica_idx: jax.Array, base_rows: jax.Array,
+                         partition_idx: jax.Array, extra_rows: jax.Array,
+                         lbi_rows: jax.Array) -> DeviceTopology:
+    """Scatter dirty load rows into a resident DeviceTopology.
+
+    ``replica_idx`` i32[Rd] / ``base_rows`` f32[Rd, 4] update
+    ``replica_base_load``; ``partition_idx`` i32[Pd] with ``extra_rows``
+    f32[Pd, 4] and ``lbi_rows`` f32[Pd] update ``leader_extra`` /
+    ``leader_bytes_in``. Sentinel indices (== axis length) are dropped.
+    Bit-identical to rebuilding the topology with the spliced host arrays:
+    scatter-set of the exact rows the host path would have written."""
+    return dt._replace(
+        replica_base_load=dt.replica_base_load.at[replica_idx].set(
+            base_rows, mode="drop"),
+        leader_extra=dt.leader_extra.at[partition_idx].set(
+            extra_rows, mode="drop"),
+        leader_bytes_in=dt.leader_bytes_in.at[partition_idx].set(
+            lbi_rows, mode="drop"),
+    )
+
+
+@jax.jit
+def load_delta_mass(dt_old: DeviceTopology,
+                    replica_idx: jax.Array, base_rows: jax.Array,
+                    partition_idx: jax.Array,
+                    extra_rows: jax.Array) -> tuple:
+    """(delta_mass, total_mass) — L1 size of a pending load splice vs the
+    resident arrays. Sentinel-padded indices gather 0 and contribute nothing.
+    The analyzer compares ``delta_mass / max(total_mass, ε)`` against the
+    proposal-cache dirty-mass threshold to decide whether a cached proposal
+    is still worth revalidating instead of re-annealing."""
+    old_base = dt_old.replica_base_load.at[replica_idx].get(
+        mode="fill", fill_value=0.0)
+    old_extra = dt_old.leader_extra.at[partition_idx].get(
+        mode="fill", fill_value=0.0)
+    pad_r = (replica_idx < dt_old.replica_base_load.shape[0])[:, None]
+    pad_p = (partition_idx < dt_old.leader_extra.shape[0])[:, None]
+    delta = (jnp.sum(jnp.abs(jnp.where(pad_r, base_rows - old_base, 0.0)))
+             + jnp.sum(jnp.abs(jnp.where(pad_p, extra_rows - old_extra, 0.0))))
+    total = (jnp.sum(jnp.abs(dt_old.replica_base_load))
+             + jnp.sum(jnp.abs(dt_old.leader_extra)))
+    return delta, total

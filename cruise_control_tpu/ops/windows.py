@@ -1,0 +1,269 @@
+"""Device-resident ring-buffered sample windows.
+
+The kernels behind :class:`~cruise_control_tpu.monitor.aggregator.
+MetricSampleAggregator`'s storage: per-entity cyclic window buffers
+``[capacity, num_windows + 1, num_metrics]`` live on device, and the three
+aggregator hot paths become batched array programs instead of per-sample
+Python:
+
+- **ingest** — ``fold_pending`` collapses a whole batch of samples into one
+  update row per touched ``(entity, window-slot)`` cell on the host (the
+  sequential-equivalence proof is in its docstring), then ``scatter_batch``
+  applies every cell in a single scatter;
+- **roll** — ``roll_slots`` zeroes the slots that cycle out with one masked
+  store over the full buffer instead of a Python loop per slot;
+- **aggregate** — ``collapse_windows`` gathers the queried window slots and
+  applies each metric's strategy (AVG / MAX / LATEST) plus the AVG_ADJACENT
+  blend in one fused program, and ``changed_rows`` diffs the collapse
+  against the previous tick's to produce the per-entity **dirty mask** the
+  incremental model build and goal rescore key off.
+
+Shape discipline (zero retraces in steady state): the entity axis is the
+buffer *capacity* (doubled geometrically, so growth retraces O(log E)
+times), update batches are padded to power-of-two buckets with
+out-of-range sentinel rows (``mode="drop"``), and the window axes are
+fixed by configuration. Only the warmup phase — where the number of
+completed windows is still growing — traces new collapse shapes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class WindowBuffers(NamedTuple):
+    """Device mirror of one aggregator's cyclic sample windows.
+
+    ``W1 = num_windows + 1`` (the N stable windows plus the current,
+    still-filling one). The host keeps twin int mirrors of ``count`` and the
+    per-cell latest-sample timestamp: completeness / extrapolation logic is
+    integer bookkeeping that never needs the device round-trip, and ms
+    timestamps need int64, which device arrays don't carry without x64.
+    """
+
+    sums: jax.Array     # f32[cap, W1, M] NaN-masked running sums
+    maxs: jax.Array     # f32[cap, W1, M] running maxima (-inf = empty)
+    latest: jax.Array   # f32[cap, W1, M] value of the newest sample per cell
+    count: jax.Array    # i32[cap, W1] samples per cell
+
+
+def make_buffers(capacity: int, w1: int, num_metrics: int) -> WindowBuffers:
+    return WindowBuffers(
+        sums=jnp.zeros((capacity, w1, num_metrics), jnp.float32),
+        maxs=jnp.full((capacity, w1, num_metrics), -jnp.inf, jnp.float32),
+        latest=jnp.zeros((capacity, w1, num_metrics), jnp.float32),
+        count=jnp.zeros((capacity, w1), jnp.int32),
+    )
+
+
+def grow_buffers(wb: WindowBuffers, new_capacity: int) -> WindowBuffers:
+    """Double-style capacity growth (host-driven, rare — O(log E) total)."""
+    pad = new_capacity - wb.sums.shape[0]
+    if pad <= 0:
+        return wb
+    tail3 = (pad,) + wb.sums.shape[1:]
+    return WindowBuffers(
+        sums=jnp.concatenate([wb.sums, jnp.zeros(tail3, jnp.float32)]),
+        maxs=jnp.concatenate(
+            [wb.maxs, jnp.full(tail3, -jnp.inf, jnp.float32)]),
+        latest=jnp.concatenate([wb.latest, jnp.zeros(tail3, jnp.float32)]),
+        count=jnp.concatenate(
+            [wb.count, jnp.zeros((pad, wb.count.shape[1]), jnp.int32)]),
+    )
+
+
+def bucket_len(n: int, floor: int = 64) -> int:
+    """Power-of-two batch bucket so ingest batch sizes reuse compiled
+    scatters instead of retracing per tick."""
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+# --------------------------------------------------------------- kernels
+
+def _scatter_batch(wb: WindowBuffers, rows: jax.Array, slots: jax.Array,
+                   sum_add: jax.Array, cnt_add: jax.Array,
+                   max_cand: jax.Array, lat_vals: jax.Array) -> WindowBuffers:
+    """Apply one folded update row per unique (entity row, window slot).
+
+    Padding rows carry ``rows == capacity`` (out of range, NEVER -1:
+    negative indices wrap) and are dropped by the scatter mode. ``lat_vals``
+    is NaN where the batch made no accepted write for that metric — the
+    current device value is kept.
+    """
+    sums = wb.sums.at[rows, slots].add(sum_add, mode="drop")
+    maxs = wb.maxs.at[rows, slots].max(max_cand, mode="drop")
+    count = wb.count.at[rows, slots].add(cnt_add, mode="drop")
+    cur = wb.latest.at[rows, slots].get(mode="fill", fill_value=0.0)
+    latest = wb.latest.at[rows, slots].set(
+        jnp.where(jnp.isnan(lat_vals), cur, lat_vals), mode="drop")
+    return WindowBuffers(sums=sums, maxs=maxs, latest=latest, count=count)
+
+
+def _roll_slots(wb: WindowBuffers, slot_mask: jax.Array) -> WindowBuffers:
+    """Reset every cell of the masked slots (bool[W1]) to the empty state."""
+    m3 = slot_mask[None, :, None]
+    return WindowBuffers(
+        sums=jnp.where(m3, 0.0, wb.sums),
+        maxs=jnp.where(m3, -jnp.inf, wb.maxs),
+        latest=jnp.where(m3, 0.0, wb.latest),
+        count=jnp.where(slot_mask[None, :], 0, wb.count),
+    )
+
+
+# Donation keeps the (cap × W1 × M) buffers from being double-allocated on
+# every ingest/roll; the CPU runtime can't honor it and would warn per call.
+if jax.default_backend() == "cpu":
+    scatter_batch = jax.jit(_scatter_batch)
+    roll_slots = jax.jit(_roll_slots)
+else:
+    scatter_batch = jax.jit(_scatter_batch, donate_argnums=(0,))
+    roll_slots = jax.jit(_roll_slots, donate_argnums=(0,))
+
+
+@jax.jit
+def collapse_windows(wb: WindowBuffers, slots: jax.Array, real: jax.Array,
+                     min_samples: jax.Array, avg_mask: jax.Array,
+                     max_mask: jax.Array) -> jax.Array:
+    """f32[cap, Wv, M] per-window values for the queried window slots.
+
+    ``slots`` (i32[Wv]) are the cyclic slots of the queried windows oldest
+    first; ``real`` (bool[Wv]) masks queried windows that actually live in
+    the buffer (an aliasing slot after a sampling gap must read as empty).
+    Strategy selection per metric: ``avg_mask`` → sum/count, ``max_mask`` →
+    running max (empty → 0), otherwise LATEST. Empty windows whose two
+    neighbors both have ≥ ``min_samples`` samples get the AVG_ADJACENT
+    blend, exactly mirroring the host extrapolation codes.
+    """
+    cnt = jnp.where(real[None, :], wb.count[:, slots], 0)          # [cap, Wv]
+    ssum = jnp.where(real[None, :, None], wb.sums[:, slots], 0.0)
+    smax = jnp.where(real[None, :, None], wb.maxs[:, slots], -jnp.inf)
+    slat = jnp.where(real[None, :, None], wb.latest[:, slots], 0.0)
+    safe = jnp.maximum(cnt, 1)[:, :, None].astype(jnp.float32)
+    vals = jnp.where(
+        avg_mask[None, None, :], ssum / safe,
+        jnp.where(max_mask[None, None, :],
+                  jnp.where(jnp.isfinite(smax), smax, 0.0), slat))
+    full = cnt >= min_samples
+    some = cnt > 0
+    wv = cnt.shape[1]
+    edge = jnp.arange(wv)
+    left = jnp.roll(full, 1, axis=1) & (edge > 0)[None, :]
+    right = jnp.roll(full, -1, axis=1) & (edge < wv - 1)[None, :]
+    adj = (~some) & left & right
+    blend = 0.5 * (jnp.roll(vals, 1, axis=1) + jnp.roll(vals, -1, axis=1))
+    return jnp.where(adj[:, :, None], blend, vals)
+
+
+@jax.jit
+def changed_rows(vals: jax.Array, prev: jax.Array) -> jax.Array:
+    """bool[cap] dirty mask: any per-window value differs from last tick.
+
+    NaN-padded ``prev`` rows (fresh capacity growth) compare unequal, so new
+    entities always read dirty.
+    """
+    return jnp.any(vals != prev, axis=(1, 2))
+
+
+# ---------------------------------------------------------- host-side fold
+
+def fold_pending(rows: np.ndarray, slots: np.ndarray, times: np.ndarray,
+                 vals: np.ndarray, w1: int, latest_t: np.ndarray
+                 ) -> Tuple[np.ndarray, ...]:
+    """Collapse a pending sample batch into one update per (row, slot) cell.
+
+    Sequential-equivalence: replaying the batch sample-by-sample through the
+    scalar ingest rule must give the same buffer state. Sum/max/count are
+    order-free. The LATEST rule accepts sample *i* iff
+    ``t_i >= latest_t`` *at that moment*; since rejected samples never raise
+    the running ``latest_t``, that is exactly
+    ``t_i >= max(buffer_latest_t, max(t_j for j < i in the same cell))`` —
+    the buffer value combined with an exclusive per-cell prefix max over the
+    batch (a rejected earlier time is strictly below the running max, so
+    including it in the prefix never changes it). The final per-metric
+    LATEST value is the last accepted sample in insertion order where that
+    metric was present (NaN = absent), and the new ``latest_t`` is the max
+    accepted time (an all-NaN accepted sample still bumps it, writing no
+    values — matching the scalar rule).
+
+    Returns ``(cell_rows, cell_slots, sum_add f64[K, M], cnt_add i64[K],
+    max_cand f64[K, M], lat_vals f64[K, M] (NaN = keep), new_latest_t
+    i64[K])`` with cells in ascending ``row * w1 + slot`` order.
+    """
+    n = rows.shape[0]
+    m = vals.shape[1]
+    key = rows.astype(np.int64) * w1 + slots
+    order = np.argsort(key, kind="stable")     # stable: keeps insertion order
+    key_s = key[order]
+    t_s = times[order]
+    v_s = vals[order]
+    first = np.empty(n, bool)
+    first[0] = True
+    first[1:] = key_s[1:] != key_s[:-1]
+    starts = np.flatnonzero(first)
+    grp = np.cumsum(first) - 1                                 # [n] cell id
+    cell_rows = (key_s[starts] // w1).astype(np.int64)
+    cell_slots = (key_s[starts] % w1).astype(np.int64)
+    cnt_add = np.diff(np.append(starts, n)).astype(np.int64)
+
+    present = ~np.isnan(v_s)
+    sum_add = np.add.reduceat(np.where(present, v_s, 0.0), starts, axis=0)
+    max_cand = np.maximum.reduceat(
+        np.where(present, v_s, -np.inf), starts, axis=0)
+
+    # exclusive per-cell prefix max of sample times via the offset trick:
+    # shift each cell's times into a disjoint band, one global cummax, then
+    # de-offset — no Python loop over cells
+    t_min = int(t_s.min())
+    band = int(t_s.max()) - t_min + 1
+    shifted = (t_s - t_min) + grp * band
+    cm = np.maximum.accumulate(shifted) - grp * band + t_min   # inclusive
+    low = np.iinfo(np.int64).min
+    prev_cm = np.empty_like(cm)
+    prev_cm[1:] = cm[:-1]
+    prev_cm[first] = low                                       # exclusive
+    buf_lt = latest_t[cell_rows, cell_slots]                   # i64[K]
+    accepted = t_s >= np.maximum(buf_lt[grp], prev_cm)
+
+    lat_vals = np.full((starts.size, m), np.nan)
+    for k in range(m):
+        sel = np.flatnonzero(accepted & present[:, k])
+        if sel.size:
+            g = grp[sel]
+            last = np.append(g[1:] != g[:-1], True)  # last write per cell
+            lat_vals[g[last], k] = v_s[sel[last], k]
+    acc_t = np.where(accepted, t_s, low)
+    grp_max_t = np.maximum.reduceat(acc_t, starts)
+    new_latest_t = np.maximum(buf_lt, grp_max_t)
+    return (cell_rows, cell_slots, sum_add, cnt_add, max_cand, lat_vals,
+            new_latest_t)
+
+
+def pad_update(cell_rows: np.ndarray, cell_slots: np.ndarray,
+               sum_add: np.ndarray, cnt_add: np.ndarray,
+               max_cand: np.ndarray, lat_vals: np.ndarray,
+               capacity: int) -> Tuple[np.ndarray, ...]:
+    """Pad a folded update to its power-of-two bucket with dropped sentinel
+    rows (``row == capacity``, out of range — never -1, which would wrap)."""
+    k = cell_rows.shape[0]
+    kb = bucket_len(k)
+    pad = kb - k
+    m = sum_add.shape[1]
+    rows32 = np.concatenate(
+        [cell_rows, np.full(pad, capacity)]).astype(np.int32)
+    slots32 = np.concatenate([cell_slots, np.zeros(pad)]).astype(np.int32)
+    sum32 = np.concatenate(
+        [sum_add, np.zeros((pad, m))]).astype(np.float32)
+    cnt32 = np.concatenate([cnt_add, np.zeros(pad)]).astype(np.int32)
+    max32 = np.concatenate(
+        [max_cand, np.full((pad, m), -np.inf)]).astype(np.float32)
+    lat32 = np.concatenate(
+        [lat_vals, np.full((pad, m), np.nan)]).astype(np.float32)
+    return rows32, slots32, sum32, cnt32, max32, lat32

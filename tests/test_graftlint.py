@@ -858,6 +858,12 @@ def test_package_lints_clean_against_baseline():
     prov = [fp for fp in baseline
             if fp.split("|")[1].startswith("cruise_control_tpu/provisioner/")]
     assert prov == [], f"provisioner package must stay baseline-free: {prov}"
+    # the incremental tick path (device window kernels + analyzer rescore)
+    # also shipped lint-clean — same standing gate
+    incr = [fp for fp in baseline
+            if fp.split("|")[1] in ("cruise_control_tpu/ops/windows.py",
+                                    "cruise_control_tpu/analyzer/rescore.py")]
+    assert incr == [], f"incremental tick path must stay baseline-free: {incr}"
 
 
 # -- runtime sentinels -----------------------------------------------------

@@ -1,0 +1,568 @@
+"""Sub-second control loop: tier-1 lock for the incremental tick path.
+
+Three contracts from the device-resident-window / delta-aggregation /
+incremental-rescore work:
+
+- **Splice == scratch**: a delta model build that recomputes only the
+  dirty partitions' load columns and splices them over the cached build is
+  bit-identical to a from-scratch build (3-fixture matrix).
+- **Rescore == scratch**: ``rescore_deltas`` — device splice of the dirty
+  rows plus the shared scoring pipeline — produces bit-identical goal
+  penalties/verdicts to ``build_baseline`` on the freshly built model, and
+  detects verdict flips (a load spike past capacity).
+- **The proposal cache is never stale**: the app serves the warm proposal
+  through an incremental refresh ONLY when the structural digest matches
+  and no goal verdict flips; a digest change or a flip falls through to
+  the full computation.
+
+Plus the ride-alongs: corrupt-JSONL skip-don't-raise in FileSampleStore,
+dirty-mask unit semantics, and a few-hundred-tick high-frequency ingest
+stress through the chaos harness with zero uncovered retraces.
+"""
+
+import dataclasses
+import time
+import types
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import goals as G
+from cruise_control_tpu.analyzer import rescore as RS
+from cruise_control_tpu.common import faults as F
+from cruise_control_tpu.common.resources import BalancingConstraint
+from cruise_control_tpu.common.sentinels import (
+    check_steady_state, retrace_sentinel)
+from cruise_control_tpu.monitor import metricdef as md
+from cruise_control_tpu.monitor.aggregator import (
+    AggregationResult, Completeness, MetricSampleAggregator)
+from cruise_control_tpu.monitor.load_monitor import (
+    LoadMonitor, StaticMetadataSource)
+from cruise_control_tpu.monitor.sample_store import FileSampleStore
+from cruise_control_tpu.monitor.sampler import (
+    BrokerMetadata, BrokerMetricSample, ClusterMetadata, PartitionMetadata,
+    PartitionMetricSample, SyntheticLoadSampler)
+
+pytestmark = pytest.mark.incremental
+
+W = 4  # aggregation windows in the model-build fixtures
+
+
+def _metadata(num_brokers=10, num_parts=60, rf=3, dead=(), generation=1):
+    brokers = [BrokerMetadata(i, rack=f"r{i % 3}", host=f"h{i}",
+                              alive=i not in dead)
+               for i in range(num_brokers)]
+    parts = []
+    for p in range(num_parts):
+        reps = tuple((p + j) % num_brokers for j in range(rf))
+        leader = next((r for r in reps if r not in dead), reps[0])
+        parts.append(PartitionMetadata(topic=f"T{p % 6}", partition=p,
+                                       leader=leader, replicas=reps))
+    return ClusterMetadata(brokers=brokers, partitions=parts,
+                           generation=generation)
+
+
+def _agg(metadata, seed, generation, scale=50.0):
+    parts = metadata.partitions
+    P = len(parts)
+    rng = np.random.default_rng(seed)
+    return AggregationResult(
+        entities=[(pm.topic, pm.partition) for pm in parts],
+        values=rng.exponential(scale, (P, W, md.NUM_MODEL_METRICS)),
+        window_times=np.arange(W, dtype=np.int64) * 60_000,
+        extrapolations=np.zeros((P, W), np.int8),
+        completeness=Completeness(np.ones(W, np.float32), 1.0, 1, W, P),
+        generation=generation)
+
+
+def _monitor(metadata):
+    return LoadMonitor(StaticMetadataSource(metadata),
+                       SyntheticLoadSampler())
+
+
+def _assert_model_equal(t1, a1, t2, a2):
+    for f in dataclasses.fields(t1):
+        v1, v2 = getattr(t1, f.name), getattr(t2, f.name)
+        if v1 is None or isinstance(v1, (str, int, float, bool, tuple)):
+            assert v1 == v2, f.name
+        else:
+            np.testing.assert_array_equal(
+                np.asarray(v1), np.asarray(v2), err_msg=f.name)
+    np.testing.assert_array_equal(np.asarray(a1.broker_of),
+                                  np.asarray(a2.broker_of))
+    np.testing.assert_array_equal(np.asarray(a1.leader_of),
+                                  np.asarray(a2.leader_of))
+
+
+def _delta_ticks(lm, meta, seed):
+    """bulk(tick none) -> refresh(tick 2) -> splice(tick 3): the canonical
+    warm-up sequence; returns (r2, refresh_build, r3, splice_build)."""
+    P = len(meta.partitions)
+    lm._build_model(meta, _agg(meta, seed=seed, generation=1))
+    assert lm.last_build_info()["kind"] == "bulk"
+    r2 = dataclasses.replace(_agg(meta, seed=seed + 1, generation=2),
+                             dirty_mask=np.ones(P, bool),
+                             tick_id=2, prev_tick_id=1)
+    refresh = lm._build_model(meta, r2)
+    assert lm.last_build_info()["kind"] == "refresh"
+
+    rng = np.random.default_rng(seed + 2)
+    dirty = np.sort(rng.choice(P, size=max(3, P // 10), replace=False))
+    vals3 = r2.values.copy()
+    vals3[dirty] *= 1.25
+    mask = np.zeros(P, bool)
+    mask[dirty] = True
+    r3 = dataclasses.replace(r2, values=vals3, dirty_mask=mask,
+                             generation=3, tick_id=3, prev_tick_id=2)
+    splice = lm._build_model(meta, r3)
+    return r2, refresh, r3, splice, dirty
+
+
+FIXTURES = [dict(num_brokers=8, num_parts=50, rf=3),
+            dict(num_brokers=12, num_parts=90, rf=2),
+            dict(num_brokers=6, num_parts=36, rf=3, dead=(2,))]
+FIXTURE_IDS = ["b8p50r3", "b12p90r2", "b6p36dead2"]
+
+
+# -- satellite: corrupt-JSONL replay skips, never raises ---------------------
+
+def test_file_store_skips_corrupt_lines_and_monitor_still_warms(tmp_path):
+    store = FileSampleStore(str(tmp_path))
+    ps = [PartitionMetricSample("T0", p, p % 3, 1_000 + p,
+                                np.arange(md.NUM_MODEL_METRICS, dtype=float))
+          for p in range(5)]
+    bs = [BrokerMetricSample(b, 1_000, 0.5) for b in range(3)]
+    store.store_samples(ps, bs)
+    # mangle both shards: a truncated JSON object mid-file (a write cut
+    # short) and raw garbage at the end (bit rot)
+    for fname in ("partition_samples.jsonl", "broker_samples.jsonl"):
+        path = tmp_path / fname
+        lines = path.read_text().splitlines(keepends=True)
+        lines.insert(2, '{"topic": "T0", "par\n')
+        lines.append("not json at all\n")
+        path.write_text("".join(lines))
+
+    got_p, got_b = [], []
+    n = store.load_samples(got_p.append, got_b.append)
+    assert n == len(ps) + len(bs)          # every valid record, none extra
+    assert [(s.topic, s.partition) for s in got_p] == [("T0", p)
+                                                       for p in range(5)]
+    assert [s.broker_id for s in got_b] == [0, 1, 2]
+
+    # the monitor warms from the mangled store: replay feeds its ingest
+    # callbacks and the aggregator ends up with every valid entity
+    meta = _metadata(num_brokers=3, num_parts=5, rf=1)
+    lm = LoadMonitor(StaticMetadataSource(meta), SyntheticLoadSampler(),
+                     sample_store=store)
+    store.load_samples(lm._ingest_partition_sample, lm._ingest_broker_sample)
+    res = lm.partition_aggregator.aggregate(now_ms=70_000)
+    assert sorted(res.entities) == [("T0", p) for p in range(5)]
+
+
+# -- dirty-mask unit semantics ----------------------------------------------
+
+def _unit_agg():
+    return MetricSampleAggregator(
+        num_windows=3, window_ms=1_000, min_samples_per_window=1,
+        num_metrics=3, strategies=[md.Strategy.AVG] * 3)
+
+
+def _fill(agg, entities, windows, value_of):
+    for e in entities:
+        for w in windows:
+            agg.add_sample(e, w * 1_000 + 500,
+                           np.asarray(value_of(e, w), np.float64))
+
+
+def test_dirty_mask_absent_without_update_dirty():
+    agg = _unit_agg()
+    _fill(agg, ["a", "b"], range(3), lambda e, w: [1.0, 2.0, 3.0])
+    res = agg.aggregate(3_100)
+    assert res.dirty_mask is None and res.tick_id is None
+    # snapshot aggregates never advance the tick baseline either
+    first = agg.aggregate(3_100, update_dirty=True)
+    agg.aggregate(3_100)                       # plain snapshot in between
+    second = agg.aggregate(3_100, update_dirty=True)
+    assert second.prev_tick_id == first.tick_id
+
+
+def test_dirty_mask_first_tick_all_dirty_then_tracks_changes():
+    agg = _unit_agg()
+    ents = [f"e{i}" for i in range(6)]
+    _fill(agg, ents, range(3), lambda e, w: [1.0, 2.0, 3.0])
+    r1 = agg.aggregate(3_100, update_dirty=True)
+    assert r1.prev_tick_id is None             # no baseline yet
+    assert r1.dirty_mask.all()
+
+    # nothing ingested: everything clean, tick chain intact
+    r2 = agg.aggregate(3_100, update_dirty=True)
+    assert r2.prev_tick_id == r1.tick_id
+    assert not r2.dirty_mask.any()
+
+    # a LATE sample lands in a completed window for one entity only
+    agg.add_sample("e3", 2_600, np.asarray([9.0, 9.0, 9.0]))
+    r3 = agg.aggregate(3_100, update_dirty=True)
+    assert r3.prev_tick_id == r2.tick_id
+    assert list(np.flatnonzero(r3.dirty_mask)) == [ents.index("e3")]
+    clean = ~r3.dirty_mask
+    np.testing.assert_array_equal(r3.values[clean],
+                                  np.asarray(r2.values)[clean])
+
+
+def test_dirty_mask_sparse_across_window_roll():
+    """A roll moves the window range but steady entities' value series are
+    bit-equal before and after — the positional diff must stay engaged
+    (sparse dirty), not blanket-invalidate every roll tick."""
+    agg = _unit_agg()
+    ents = ["steady0", "steady1", "moving"]
+    _fill(agg, ents, range(4), lambda e, w:
+          [1.0, 2.0, 3.0] if e != "moving" else [float(w), 0.0, 0.0])
+    r1 = agg.aggregate(4_100, update_dirty=True)
+    assert r1.dirty_mask.all()                 # first tick
+
+    # next window: same values for the steady entities, new one for moving
+    _fill(agg, ents, [4], lambda e, w:
+          [1.0, 2.0, 3.0] if e != "moving" else [float(w), 0.0, 0.0])
+    r2 = agg.aggregate(5_100, update_dirty=True)
+    assert r2.prev_tick_id == r1.tick_id       # chain survives the roll
+    assert list(np.flatnonzero(r2.dirty_mask)) == [ents.index("moving")]
+    clean = ~r2.dirty_mask
+    np.testing.assert_array_equal(r2.values[clean],
+                                  np.asarray(r1.values)[clean])
+
+
+# -- tentpole: splice == scratch, bit for bit (3-fixture matrix) -------------
+
+@pytest.mark.parametrize("fx", FIXTURES, ids=FIXTURE_IDS)
+def test_splice_bit_identical_to_scratch(monkeypatch, fx):
+    monkeypatch.setattr(LoadMonitor, "BULK_BUILD_THRESHOLD", 1)
+    meta = _metadata(**fx)
+    lm = _monitor(meta)
+    _, _, r3, (warm_t, warm_a), dirty = _delta_ticks(lm, meta, seed=1)
+    info = lm.last_build_info()
+    assert info["kind"] == "splice"
+    assert lm.model_splice_hits == 1
+    assert info["dirtyPartitions"] == dirty.shape[0]
+    # the index is in the topology's partition-axis order; map the dirty
+    # aggregator rows through the cached row map to compare
+    rows = lm._model_cache["rows"]
+    np.testing.assert_array_equal(np.sort(info["dirtyPartitionIndex"]),
+                                  np.flatnonzero(np.isin(rows, dirty)))
+    assert lm.state_snapshot()["lastModelBuildKind"] == "splice"
+    assert lm.state_snapshot()["lastDirtyPartitions"] == dirty.shape[0]
+
+    scratch_t, scratch_a = _monitor(meta)._build_model(meta, r3)
+    _assert_model_equal(warm_t, warm_a, scratch_t, scratch_a)
+
+
+def test_splice_requires_matching_tick_baseline(monkeypatch):
+    """A dirty mask computed against a DIFFERENT tick than the cached load
+    columns must not splice (prev_tick_id != loads tick) — the build falls
+    back to the full refresh and stays correct."""
+    monkeypatch.setattr(LoadMonitor, "BULK_BUILD_THRESHOLD", 1)
+    meta = _metadata(num_brokers=8, num_parts=50, rf=3)
+    lm = _monitor(meta)
+    P = len(meta.partitions)
+    lm._build_model(meta, _agg(meta, 1, 1))
+    r2 = dataclasses.replace(_agg(meta, 2, 2), dirty_mask=np.ones(P, bool),
+                             tick_id=2, prev_tick_id=1)
+    lm._build_model(meta, r2)
+    # stale chain: claims deltas against tick 7, cache holds tick 2
+    r3 = dataclasses.replace(_agg(meta, 3, 3),
+                             dirty_mask=np.zeros(P, bool),
+                             tick_id=8, prev_tick_id=7)
+    warm_t, warm_a = lm._build_model(meta, r3)
+    assert lm.last_build_info()["kind"] == "refresh"
+    assert lm.model_splice_hits == 0
+    scratch_t, scratch_a = _monitor(meta)._build_model(meta, r3)
+    _assert_model_equal(warm_t, warm_a, scratch_t, scratch_a)
+
+
+# -- tentpole: rescore == scratch, flips detected ----------------------------
+
+@pytest.mark.parametrize("fx", FIXTURES, ids=FIXTURE_IDS)
+def test_rescore_deltas_bit_identical_to_scratch(monkeypatch, fx):
+    monkeypatch.setattr(LoadMonitor, "BULK_BUILD_THRESHOLD", 1)
+    meta = _metadata(**fx)
+    lm = _monitor(meta)
+    P = len(meta.partitions)
+    lm._build_model(meta, _agg(meta, 1, 1))
+    r2 = dataclasses.replace(_agg(meta, 2, 2), dirty_mask=np.ones(P, bool),
+                             tick_id=2, prev_tick_id=1)
+    topo2, assign2 = lm._build_model(meta, r2)
+    constraint = BalancingConstraint()
+    base = RS.build_baseline(topo2, assign2, G.DEFAULT_GOALS, constraint,
+                             digest=lm.last_build_info()["digest"])
+
+    rng = np.random.default_rng(9)
+    dirty = np.sort(rng.choice(P, size=max(3, P // 10), replace=False))
+    vals3 = r2.values.copy()
+    vals3[dirty] *= 1.5
+    mask = np.zeros(P, bool)
+    mask[dirty] = True
+    r3 = dataclasses.replace(r2, values=vals3, dirty_mask=mask,
+                             generation=3, tick_id=3, prev_tick_id=2)
+    topo3, assign3 = lm._build_model(meta, r3)
+    info = lm.last_build_info()
+    assert info["kind"] == "splice"
+
+    out = RS.rescore_deltas(base, topo3, info["dirtyPartitionIndex"])
+    assert out is not None
+    assert out.dirty_partitions == dirty.shape[0]
+    assert out.delta_mass > 0.0
+
+    fresh = RS.build_baseline(topo3, assign3, G.DEFAULT_GOALS, constraint)
+    np.testing.assert_array_equal(np.asarray(out.penalties.violations),
+                                  np.asarray(fresh.penalties.violations))
+    np.testing.assert_array_equal(np.asarray(out.penalties.cost),
+                                  np.asarray(fresh.penalties.cost))
+    np.testing.assert_array_equal(out.violated, fresh.violated)
+    # the spliced device topology chains as the next baseline: rescoring
+    # ZERO further deltas from it reproduces the same verdicts exactly
+    base.dt = out.dt
+    base.violated = out.violated
+    again = RS.rescore_deltas(base, topo3, np.zeros(0, np.int64))
+    np.testing.assert_array_equal(np.asarray(again.penalties.cost),
+                                  np.asarray(out.penalties.cost))
+    assert not again.any_flip
+
+
+def test_rescore_detects_goal_verdict_flip(monkeypatch):
+    monkeypatch.setattr(LoadMonitor, "BULK_BUILD_THRESHOLD", 1)
+    meta = _metadata(num_brokers=8, num_parts=50, rf=3)
+    lm = _monitor(meta)
+    P = len(meta.partitions)
+    # tiny loads: capacity goals start clean
+    lm._build_model(meta, _agg(meta, 1, 1, scale=0.5))
+    r2 = dataclasses.replace(_agg(meta, 2, 2, scale=0.5),
+                             dirty_mask=np.ones(P, bool),
+                             tick_id=2, prev_tick_id=1)
+    topo2, assign2 = lm._build_model(meta, r2)
+    base = RS.build_baseline(topo2, assign2, G.DEFAULT_GOALS,
+                             BalancingConstraint())
+
+    # one partition spikes far past every broker capacity
+    vals3 = r2.values.copy()
+    vals3[7] = 1e10
+    mask = np.zeros(P, bool)
+    mask[7] = True
+    r3 = dataclasses.replace(r2, values=vals3, dirty_mask=mask,
+                             generation=3, tick_id=3, prev_tick_id=2)
+    topo3, _ = lm._build_model(meta, r3)
+    out = RS.rescore_deltas(base, topo3,
+                            lm.last_build_info()["dirtyPartitionIndex"])
+    assert out is not None
+    assert out.any_flip
+    np.testing.assert_array_equal(out.flips, out.violated != base.violated)
+
+
+def test_rescore_refuses_capacity_drift(monkeypatch):
+    monkeypatch.setattr(LoadMonitor, "BULK_BUILD_THRESHOLD", 1)
+    meta = _metadata(num_brokers=8, num_parts=50, rf=3)
+    lm = _monitor(meta)
+    _, (topo2, assign2), r3, (topo3, _), _ = _delta_ticks(lm, meta, seed=3)
+    base = RS.build_baseline(topo2, assign2, G.DEFAULT_GOALS,
+                             BalancingConstraint())
+    drifted = dataclasses.replace(
+        topo3, capacity=np.asarray(topo3.capacity) * 2.0)
+    assert RS.rescore_deltas(
+        base, drifted, lm.last_build_info()["dirtyPartitionIndex"]) is None
+
+
+# -- app wiring: the proposal cache is never stale ---------------------------
+
+W_MS = 60_000
+
+
+def _app(monkeypatch, metadata=None, overrides=None):
+    from cruise_control_tpu.app import CruiseControlApp
+    from cruise_control_tpu.common.config import CruiseControlConfig
+    from cruise_control_tpu.executor.executor import FakeClusterAdapter
+    monkeypatch.setattr(LoadMonitor, "BULK_BUILD_THRESHOLD", 1)
+    cfg = CruiseControlConfig({
+        "optimizer.engine": "greedy",
+        "partition.metrics.window.ms": W_MS,
+        "num.partition.metrics.windows": 3,
+        "min.valid.partition.ratio": 0.0,
+        "execution.progress.check.interval.ms": 1,
+        "failed.brokers.file.path": "",
+        "proposal.cache.dirty.mass.threshold": 1.0,
+        **(overrides or {})})
+    meta = metadata or _metadata(num_brokers=6, num_parts=30, rf=2)
+    adapter = FakeClusterAdapter(
+        {f"{p.topic}-{p.partition}": tuple(p.replicas)
+         for p in meta.partitions},
+        latency_polls=1)
+    app = CruiseControlApp(cfg, StaticMetadataSource(meta),
+                           SyntheticLoadSampler(seed=4),
+                           cluster_adapter=adapter)
+    app.load_monitor._now = lambda: 4 * W_MS
+    for w in range(4):
+        app.load_monitor.sample_once(now_ms=w * W_MS + 30_000)
+    return app
+
+
+def _fake_rescore(any_flip):
+    def fake(rs, topo, dirty):
+        fake.calls += 1
+        return types.SimpleNamespace(
+            any_flip=any_flip, dt=rs.dt, violated=rs.violated,
+            flips=np.zeros_like(rs.violated), penalties=rs.penalties,
+            dirty_partitions=int(np.asarray(dirty).shape[0]),
+            dirty_replicas=0, delta_mass=0.0, total_mass=1.0)
+    fake.calls = 0
+    return fake
+
+
+def _roll_one_window(app):
+    """Advance the monitor one window: generation bumps, cache goes stale."""
+    app.load_monitor._now = lambda: 5 * W_MS
+    app.load_monitor.sample_once(now_ms=4 * W_MS + 30_000)
+
+
+def test_app_incremental_refresh_serves_cached_and_skips_anneal(monkeypatch):
+    app = _app(monkeypatch)
+    r1 = app.proposals()
+    assert app.incremental_refreshes == 0
+    fake = _fake_rescore(any_flip=False)
+    monkeypatch.setattr(RS, "rescore_deltas", fake)
+
+    _roll_one_window(app)
+    assert not app._cache_is_fresh()           # the roll really staled it
+    assert app.precompute_tick() is True
+    assert fake.calls == 1
+    assert app.incremental_refreshes == 1 and app.anneal_skips == 1
+    # the SAME result object is served — re-armed, not recomputed
+    assert app.proposals() is r1
+    snap = app.state()["AnalyzerState"]
+    assert snap["incrementalRefreshes"] == 1
+    assert snap["annealSkips"] == 1
+    assert snap["proposalCacheHits"] >= 1
+    assert snap["lastTickMs"] is not None
+
+
+def test_app_verdict_flip_forces_full_recompute(monkeypatch):
+    app = _app(monkeypatch)
+    r1 = app.proposals()
+    fake = _fake_rescore(any_flip=True)
+    monkeypatch.setattr(RS, "rescore_deltas", fake)
+
+    _roll_one_window(app)
+    assert app.precompute_tick() is True       # computed — the full path
+    assert fake.calls == 1
+    assert app.incremental_refreshes == 0 and app.anneal_skips == 0
+    assert app.proposals() is not r1           # a fresh result, never stale
+
+
+def test_app_digest_change_blocks_incremental_path(monkeypatch):
+    app = _app(monkeypatch)
+    r1 = app.proposals()
+    monkeypatch.setattr(
+        RS, "rescore_deltas",
+        lambda *a, **k: pytest.fail(
+            "rescore must never run across a structural digest change"))
+
+    # structural drift: one more partition, new metadata generation
+    meta2 = _metadata(num_brokers=6, num_parts=31, rf=2, generation=2)
+    app.load_monitor._metadata_source.metadata = meta2
+    assert app.precompute_tick() is True       # full recompute, no rescore
+    assert app.incremental_refreshes == 0
+    assert app.proposals() is not r1
+
+
+def test_app_expired_cache_never_rearmed_incrementally(monkeypatch):
+    app = _app(monkeypatch, overrides={"proposal.expiration.ms": 1})
+    app.proposals()
+    fake = _fake_rescore(any_flip=False)
+    monkeypatch.setattr(RS, "rescore_deltas", fake)
+    time.sleep(0.01)
+    _roll_one_window(app)
+    assert app.precompute_tick() is True
+    # expired: the incremental path must not resurrect it
+    assert fake.calls == 0
+    assert app.incremental_refreshes == 0
+
+
+def test_app_dirty_mass_threshold_gates_incremental(monkeypatch):
+    # threshold 0 disables the incremental path outright
+    app = _app(monkeypatch,
+               overrides={"proposal.cache.dirty.mass.threshold": 0.0})
+    app.proposals()
+    fake = _fake_rescore(any_flip=False)
+    monkeypatch.setattr(RS, "rescore_deltas", fake)
+    _roll_one_window(app)
+    assert app.precompute_tick() is True
+    assert fake.calls == 0
+    assert app.incremental_refreshes == 0
+
+
+# -- satellite: high-frequency ingest under chaos ----------------------------
+
+def test_high_frequency_ingest_chaos_stress():
+    """A few hundred sub-window ticks through the chaos harness (seeded
+    latency + partial-batch faults at the ``monitor.ingest`` site): after
+    warmup the loop runs with ZERO uncovered retraces, window rolls stay
+    monotone, and the dirty mask is exact — entities it marks clean are
+    bit-identical to the previous tick."""
+    meta = _metadata(num_brokers=6, num_parts=30, rf=2)
+    lm = LoadMonitor(StaticMetadataSource(meta), SyntheticLoadSampler(seed=9),
+                     num_windows=4, window_ms=1_000,
+                     min_samples_per_window=1, sampling_interval_ms=1_000)
+    agg = lm.partition_aggregator
+    plan = F.FaultPlan(seed=13, latency_rate=0.15, latency_s=0.0002,
+                       partial_batch_rate=0.25)
+    rng = np.random.default_rng(plan.seed)
+    injected = {"latency": 0, "partial": 0}
+
+    def hook(value):
+        ps, bs = value
+        if rng.random() < plan.latency_rate:
+            time.sleep(plan.latency_s)
+            injected["latency"] += 1
+        if rng.random() < plan.partial_batch_rate:
+            ps = ps[:max(1, len(ps) // 2)]     # batch truncated mid-fetch
+            injected["partial"] += 1
+        return ps, bs
+
+    TICK_MS, WARM, TOTAL = 200, 30, 300
+    F.install_chaos_hook("monitor.ingest", hook)
+    try:
+        prev = None
+        oldest_seen = -1
+        dirty_counts = []
+
+        def tick(i):
+            nonlocal prev, oldest_seen
+            t = (i + 1) * TICK_MS
+            lm.sample_once(now_ms=t)
+            res = agg.aggregate(t, update_dirty=True)
+            assert agg._oldest_window is None or \
+                agg._oldest_window >= oldest_seen, "window roll went backward"
+            oldest_seen = (agg._oldest_window if agg._oldest_window is not None
+                           else oldest_seen)
+            if (prev is not None and res.prev_tick_id == prev.tick_id
+                    and res.entities == prev.entities):
+                clean = ~res.dirty_mask
+                np.testing.assert_array_equal(
+                    res.values[clean], np.asarray(prev.values)[clean],
+                    err_msg="clean-marked rows drifted between ticks")
+                dirty_counts.append(int(res.dirty_mask.sum()))
+            prev = res
+
+        for i in range(WARM):                  # compiles + window fill
+            tick(i)
+        with retrace_sentinel() as log:
+            for i in range(WARM, TOTAL):
+                tick(i)
+        uncovered = check_steady_state(log, strict=False)
+        assert uncovered == [], log.summary()
+    finally:
+        F.clear_chaos_hooks()
+
+    assert injected["latency"] > 10 and injected["partial"] > 10, \
+        "chaos plan never engaged — the stress ran unfaulted"
+    assert len(dirty_counts) >= (TOTAL - WARM) // 2
+    E = len(meta.partitions)
+    # the whole point of the delta path: most ticks touch a strict subset
+    assert any(0 < d < E for d in dirty_counts) or 0 in dirty_counts, \
+        f"every tick was all-dirty: {dirty_counts[:20]}"
