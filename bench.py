@@ -170,6 +170,18 @@ def main():
             traceback.print_exc()
             e2e = None
 
+    # ---- ISSUE 7 headline: the two self-healing proposal paths at this
+    # scale — destination-masked add_broker anneal + fused-shed
+    # remove_broker. Non-fatal like the other extra measurements.
+    selfheal = None
+    if size == "linkedin":
+        try:
+            selfheal = _measure_selfheal(topo, assign, cfg, seed)
+        except Exception:
+            import traceback
+            traceback.print_exc()
+            selfheal = None
+
     # proposal decode alone (PR.diff: final assignment -> executor
     # proposals + movement stats) — the warm tick's tail stage, measured
     # on the steady-state result above
@@ -236,6 +248,8 @@ def main():
         out.update(whatif)
     if e2e is not None:
         out.update(e2e)
+    if selfheal is not None:
+        out.update(selfheal)
 
     # ---- measured single-threaded baseline (round-5 VERDICT #1): the
     # north star's ">=20x vs single-threaded GoalOptimizer at
@@ -286,6 +300,24 @@ def main():
                 and live_digest == recorded["fixture_digest"]):
             out["speedup_vs_sequential_recorded"] = round(
                 recorded["seconds"] / elapsed, 1)
+            # per-goal parity pinning (ROUND5_NOTES lever 3): the recorded
+            # per-goal walls of the sequential walk (docs/PERF.md, same
+            # measurement run as the 2,258.4 s total) ratioed against this
+            # run's whole-portfolio wall. Our engine optimizes all goals
+            # jointly, so per-goal wall has no direct analogue; the honest
+            # per-goal claim is "goal G alone cost the reference W_G
+            # seconds; we deliver the full portfolio in `elapsed`." Gated
+            # by the same digest match as the total.
+            per_goal = {
+                "CpuUsageDistributionGoal": 966.0,
+                "NetworkOutboundUsageDistributionGoal": 357.0,
+                "LeaderReplicaDistributionGoal": 288.0,
+                "DiskUsageDistributionGoal": 255.0,
+                "NetworkInboundUsageDistributionGoal": 219.0,
+            }
+            out["per_goal_sequential_walls_s"] = per_goal
+            out["per_goal_speedup_vs_sequential"] = {
+                g: round(w / elapsed, 1) for g, w in per_goal.items()}
         else:
             out["sequential_baseline_stale"] = True
             print("bench: WARNING recorded sequential baseline was measured "
@@ -493,6 +525,126 @@ def _measure_whatif_grid(topo, assign):
         "whatif_grid_scenarios": len(scenarios),
         "whatif_grid_retraces": rl.count,
     }
+
+
+def _measure_selfheal(topo, assign, cfg, seed):
+    """ISSUE 7 headline: both self-healing proposal paths at LinkedIn
+    scale.  add_broker rides the destination-masked anneal (the propose
+    mask restricts the sampler's destination draws to the two new brokers
+    in-trace, so every destination-restricted request shares one compiled
+    program); remove_broker engages the fused on-device shed ladder in the
+    repair escape path.  Steady-state methodology matches the headline
+    timer: compile, warm the lazily-dispatched escape/polish kernels, then
+    time a run that must perform ZERO uncovered retraces.  A legacy-path
+    comparison (mask stripped / host shed ladder) runs in its own guard
+    and asserts the fast path is no worse on violated goals and
+    balancedness — a legacy-leg failure must not take the timed fields
+    down with it."""
+    import dataclasses
+    import time as _time
+
+    import jax
+
+    from cruise_control_tpu.analyzer import goals as G
+    from cruise_control_tpu.analyzer import optimizer as OPT
+    from cruise_control_tpu.analyzer import repair as REP
+    from cruise_control_tpu.common import sentinels as SENT
+    from cruise_control_tpu.models.cluster import Assignment
+
+    B = topo.num_brokers
+    rng = np.random.default_rng(seed)
+    new_ids = (B - 2, B - 1)
+    # empty the two "new" brokers (same recipe as the 302-broker selfheal
+    # config: they just joined, nothing lives there yet), collision-aware
+    # so no partition doubles up on a broker
+    bo = np.asarray(jax.device_get(assign.broker_of)).copy()
+    pid = np.asarray(topo.partition_of_replica)
+    for r_i in np.flatnonzero(np.isin(bo, new_ids)):
+        siblings = {int(bo[s]) for s in topo.replicas_of_partition[pid[r_i]]
+                    if s >= 0}
+        choices = [b for b in range(B - 2) if b not in siblings]
+        bo[r_i] = int(rng.choice(choices))
+    assign_sh = Assignment(broker_of=bo, leader_of=assign.leader_of)
+
+    # ADD (AddBrokersRunnable): mark them new, request them as destinations
+    # — build_options lowers the requested set into the propose mask
+    topo_add = dataclasses.replace(
+        topo, broker_new=np.isin(np.arange(B), new_ids))
+    opts_add = G.build_options(
+        topo_add, requested_destination_broker_ids=new_ids)
+    # REMOVE (RemoveBrokersRunnable): broker 0 dead, its replicas offline
+    alive = np.asarray(topo.broker_alive).copy()
+    alive[0] = False
+    topo_rm = dataclasses.replace(
+        topo, broker_alive=alive,
+        replica_offline=np.asarray(topo.replica_offline) | (bo == 0))
+    opts_rm = G.build_options(topo_rm,
+                              excluded_brokers_for_replica_move=(0,),
+                              excluded_brokers_for_leadership=(0,))
+    out = {}
+    healed = {}
+    for name, tp, opts in (("add_broker", topo_add, opts_add),
+                           ("remove_broker", topo_rm, opts_rm)):
+        OPT.optimize(tp, assign_sh, options=opts, engine="anneal",
+                     anneal_config=cfg, seed=seed)               # compile
+        OPT.warm_kernels(tp, assign_sh, options=opts, anneal_config=cfg)
+        t0 = _time.time()
+        with SENT.retrace_sentinel() as rl:
+            r = OPT.optimize(tp, assign_sh, options=opts, engine="anneal",
+                             anneal_config=cfg, seed=seed + 1)
+        elapsed = _time.time() - t0
+        uncovered = SENT.check_steady_state(rl)
+        if uncovered:
+            print(f"bench: WARNING selfheal {name} retraced: "
+                  f"{rl.summary()}", file=sys.stderr)
+        healed[name] = r
+        out[f"selfheal_{name}_s"] = round(elapsed, 3)
+        out[f"selfheal_{name}_violated_goals"] = len(r.violated_goals_after)
+        out[f"selfheal_{name}_balancedness"] = round(
+            r.balancedness_after, 3)
+        out[f"selfheal_{name}_soft_cost"] = round(
+            sum(s.cost_after for s in r.goal_summaries if not s.hard), 3)
+        out[f"selfheal_{name}_retraces"] = len(uncovered)
+        out[f"selfheal_{name}_path"] = r.heal_path
+    bo_add = np.asarray(jax.device_get(
+        healed["add_broker"].final_assignment.broker_of))
+    moved = bo_add != bo
+    # the oracle containment contract, checked live at bench scale: every
+    # replica the add_broker proposal moved landed on a requested broker
+    out["selfheal_add_moves_on_new_brokers"] = bool(
+        np.isin(bo_add[moved], new_ids).all())
+    bo_rm = np.asarray(jax.device_get(
+        healed["remove_broker"].final_assignment.broker_of))
+    out["selfheal_broker0_evacuated"] = bool((bo_rm != 0).all())
+    try:
+        legacy = {
+            "add_broker": OPT.optimize(
+                topo_add, assign_sh,
+                options=opts_add._replace(propose_dest_mask=None),
+                engine="anneal", anneal_config=cfg, seed=seed + 1),
+            "remove_broker": OPT.optimize(
+                topo_rm, assign_sh, options=opts_rm, engine="anneal",
+                anneal_config=cfg, seed=seed + 1,
+                repair_config=REP.RepairConfig(fused_shed=False)),
+        }
+        for name, lr in legacy.items():
+            nr = healed[name]
+            ok = (len(nr.violated_goals_after)
+                  <= len(lr.violated_goals_after)
+                  and nr.balancedness_after
+                  >= lr.balancedness_after - 1e-3)
+            out[f"selfheal_{name}_quality_no_worse"] = bool(ok)
+            if not ok:
+                print(f"bench: WARNING selfheal {name} quality worse than "
+                      f"legacy path: violated "
+                      f"{len(nr.violated_goals_after)} vs "
+                      f"{len(lr.violated_goals_after)}, balancedness "
+                      f"{nr.balancedness_after:.3f} vs "
+                      f"{lr.balancedness_after:.3f}", file=sys.stderr)
+    except Exception:
+        import traceback
+        traceback.print_exc()
+    return out
 
 
 def _bench_cluster_metadata(topo, assign):

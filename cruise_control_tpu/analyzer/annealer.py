@@ -463,6 +463,27 @@ def make_step_fn(dt: DeviceTopology, th, weights, opts, cfg: AnnealConfig,
         n_movable = movable_idx.size
     if n_dest is None:
         n_dest = dest_idx.size
+    # --- propose-mask: destination-restricted sampling (add_broker, drain-
+    # this-rack, move-this-topic). The pool handed in is mask-INDEPENDENT
+    # (optimize_anneal builds it from th.alive when a mask is present), and
+    # the restriction happens here in-trace: stable-partition the pool so
+    # allowed destinations form the prefix, then shrink the sampling bound
+    # to the allowed count. Executed once at trace time — hoisted out of the
+    # scanned step — so WHICH brokers are requested changes only array
+    # values, never the compiled program (the zero-retrace heal contract).
+    # An all-true mask partitions to the identity permutation with an equal
+    # bound value, so draws are bit-identical to the unmasked path (equal
+    # randint bounds ⇒ equal draws, same contract as padded == unpadded).
+    mask = getattr(opts, "propose_dest_mask", None)
+    if mask is not None:
+        in_pool = jnp.arange(dest_idx.shape[0]) < n_dest
+        valid = in_pool & mask[dest_idx]
+        order = jnp.argsort(jnp.where(valid, 0, 1).astype(jnp.int32),
+                            stable=True)
+        dest_idx = dest_idx[order]
+        # empty mask clamps to 1: the single drawn destination is illegal
+        # under move_dest_ok, so every such proposal prices at +inf
+        n_dest = jnp.maximum(jnp.sum(valid.astype(jnp.int32)), jnp.int32(1))
     # real partition count: padded partitions must never be sampled (their
     # sentinel replicas are immovable anyway, but the RNG stream has to
     # match the unpadded run draw for draw)
@@ -704,7 +725,16 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
     # legality masks turn those proposals into +inf deltas) so leadership-only
     # optimization still runs.
     movable_np = np.flatnonzero(np.asarray(jax.device_get(opts.replica_movable)))
-    dest_np = np.flatnonzero(np.asarray(jax.device_get(opts.move_dest_ok)))
+    if opts.propose_dest_mask is not None:
+        # propose-mask path: the host-side pool must not depend on WHICH
+        # destinations are requested (a different request would change the
+        # pool contents/size and retrace the PT scan). Build it from the
+        # mask-independent alive set; make_step_fn partitions it in-trace
+        # by the mask. On a mask-free model move_dest_ok == alive, so an
+        # all-true mask reproduces the legacy pool exactly (bit-parity).
+        dest_np = np.flatnonzero(np.asarray(jax.device_get(th.alive)))
+    else:
+        dest_np = np.flatnonzero(np.asarray(jax.device_get(opts.move_dest_ok)))
     movable_src = movable_np if movable_np.size else np.array([0], np.int64)
     dest_src = dest_np if dest_np.size else np.array([0], np.int64)
     n_mov_dev = n_dst_dev = None

@@ -160,6 +160,14 @@ class DeviceOptions(NamedTuple):
     leadership_movable: jax.Array     # bool[R] replica may gain/lose leadership
     move_dest_ok: jax.Array           # bool[B] may receive replicas
     leader_dest_ok: jax.Array         # bool[B] may receive leadership
+    # Propose-mask: when set, the annealer's move sampler draws destinations
+    # only from this traced bool[B] mask, partitioned IN-TRACE over a
+    # mask-independent candidate pool — so every destination-restricted
+    # request (add_broker, drain-this-rack, move-this-topic) shares one
+    # compiled program regardless of WHICH brokers are requested. None means
+    # the sampler keeps its legacy pool (no extra pytree leaf, no retrace of
+    # existing callers); an all-true mask is bit-identical to None.
+    propose_dest_mask: Optional[jax.Array] = None
 
 
 def build_options(
@@ -198,12 +206,17 @@ def build_options(
     for b in excluded_brokers_for_replica_move:
         if b in id_to_idx:
             move_dest[id_to_idx[b]] = False
+    propose_mask = None
     if requested_destination_broker_ids:
         req = np.zeros(B, dtype=bool)
         for b in requested_destination_broker_ids:
             if b in id_to_idx:
                 req[id_to_idx[b]] = True
         move_dest &= req
+        # the final (requested ∩ alive ∩ not-excluded) set doubles as the
+        # annealer's propose-mask: legality stays enforced by move_dest_ok,
+        # the mask just stops the sampler wasting draws outside the set
+        propose_mask = jnp.asarray(move_dest)
     # NEW brokers are always eligible destinations; demoted/bad-disk brokers
     # keep replica eligibility but demoted brokers must not receive leadership.
     leader_dest = np.asarray(topo.broker_alive) & ~np.asarray(topo.broker_demoted)
@@ -216,6 +229,7 @@ def build_options(
         leadership_movable=jnp.asarray(leadership_movable),
         move_dest_ok=jnp.asarray(move_dest),
         leader_dest_ok=jnp.asarray(leader_dest),
+        propose_dest_mask=propose_mask,
     )
 
 
@@ -243,6 +257,8 @@ def pad_options(opts: DeviceOptions, num_replicas: int,
         leadership_movable=_pad(opts.leadership_movable, num_replicas),
         move_dest_ok=_pad(opts.move_dest_ok, num_brokers),
         leader_dest_ok=_pad(opts.leader_dest_ok, num_brokers),
+        propose_dest_mask=(None if opts.propose_dest_mask is None
+                           else _pad(opts.propose_dest_mask, num_brokers)),
     )
 
 

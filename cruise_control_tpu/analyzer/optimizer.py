@@ -184,6 +184,12 @@ class OptimizerResult:
     #: "anneal: <error>; greedy: <error>" per fallen-through rung; None on
     #: the normal path
     fallback_reason: Optional[str] = None
+    #: self-healing route taken: "masked" when the annealer sampled over a
+    #: destination propose-mask (destination-constrained request), "full"
+    #: for a healing context without a mask (dead brokers / offline
+    #: replicas / exclusion-restricted destinations), None for a plain
+    #: rebalance
+    heal_path: Optional[str] = None
 
     @property
     def violated_goals_before(self) -> List[str]:
@@ -213,6 +219,8 @@ class OptimizerResult:
         }
         if self.fallback_reason:
             out["fallbackReason"] = self.fallback_reason
+        if self.heal_path:
+            out["selfHealPath"] = self.heal_path
         if verbose:
             # servlet/response/stats BrokerStats "Statistics" payloads:
             # the full ClusterModelStats before and after optimization,
@@ -479,6 +487,22 @@ def optimize(topo: ClusterTopology, assign: Assignment,
                           polish_cycles, balancedness_weights, bucketing)
 
 
+def healing_context(topo, opts: G.DeviceOptions) -> bool:
+    """True when the request is a self-healing / destination-constrained
+    context: dead brokers, offline replicas, or a destination set narrower
+    than the alive set. The ONE definition shared by the basin-restart gate
+    (restarts stay off here — the parked residual is structural, the
+    reference's ADD/REMOVE semantics ship such violations outright) and the
+    result's ``heal_path`` label. ``opts`` may be bucket-padded; the
+    comparison runs on the real-broker prefix."""
+    return (bool((~np.asarray(topo.broker_alive)).any())
+            or bool(np.asarray(topo.replica_offline).any())
+            or not bool(np.array_equal(
+                np.asarray(jax.device_get(
+                    opts.move_dest_ok))[:topo.num_brokers],
+                np.asarray(topo.broker_alive))))
+
+
 def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
                    anneal_config, seed, mesh, repair_config,
                    polish_cycles, balancedness_weights=None,
@@ -676,12 +700,7 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
                 # placement — re-pays the whole pipeline for a basin that
                 # cannot beat the constraint (measured on the remove_broker
                 # bench: 7.9 s, candidate discarded)
-                healing_ctx = (bool((~np.asarray(topo.broker_alive)).any())
-                               or bool(np.asarray(topo.replica_offline).any())
-                               or not bool(np.array_equal(
-                                   np.asarray(jax.device_get(
-                                       opts.move_dest_ok))[:topo.num_brokers],
-                                   np.asarray(topo.broker_alive))))
+                healing_ctx = healing_context(topo, opts)
                 if (polish_cycles > 0 and not healing_ctx
                         and float(np.asarray(
                             after.penalties.violations).sum()) > 0):
@@ -843,4 +862,6 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
         device=next(iter(jnp.asarray(final_real.broker_of).devices())).platform,
         final_assignment=final_real,
         fallback_reason=fallback_reason,
+        heal_path=("masked" if opts.propose_dest_mask is not None
+                   else "full" if healing_context(topo, opts) else None),
     )

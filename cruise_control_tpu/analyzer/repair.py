@@ -106,6 +106,20 @@ class RepairConfig:
     #: violating (often structurally-constrained) states like a
     #: destination-constrained add_broker request
     escape_max_bad_brokers: int = 8
+    #: run the shed ladder as the fused on-device kernel (``_fused_shed``)
+    #: instead of the host-iterated ``shed_plan`` rounds — ~35 tunnel
+    #: round-trips collapse into one dispatch on the engaged remove_broker
+    #: trace. The host ladder remains the mesh path (the kernel's claim
+    #: scatters are unsharded) and the fused_shed=False escape hatch; both
+    #: sit under the same exact-energy snapshot guard.
+    fused_shed: bool = True
+    #: shed rounds per fused dispatch (the host ladder's 16-round cap)
+    shed_inner: int = 16
+    #: heavy leader partitions examined per violating broker per round
+    #: (the host ladder's [:128] slice)
+    shed_sources: int = 128
+    #: load-matched partners evaluated per heavy partition (host K=32)
+    shed_partners: int = 32
     #: one-step-uphill escapes in the lead phase: when NO single leadership
     #: move improves but lead-band violations remain (a cross-term local
     #: optimum — e.g. every count-fixing handoff worsens bytes-in more),
@@ -883,6 +897,193 @@ def _fused_lead(dt, th, w, opts, st, lead_w, blocked_p, key,
     return st, total, zeros >= 2, rounds
 
 
+@partial(jax.jit,
+         static_argnames=("use_topic", "n_rounds", "n_heavy", "k_part",
+                          "max_bad"),
+         donate_argnums=(4,))
+def _fused_shed(dt, th, w, opts, st, lead_w, initial_broker_of,
+                use_topic: bool, n_rounds: int, n_heavy: int, k_part: int,
+                max_bad: int):
+    """The shed ladder's load-matched partner selection, fused on device.
+
+    ``shed_plan`` (below) is the host original: per violating broker it
+    fetches the lbi mirror, ranks the broker's heaviest leader partitions,
+    scans a host loop for the nearest normalized-E lighter-leader partners,
+    prices the pairs on device, and greedily plans under a used-set — one
+    full tunnel round-trip per call, and the engaged remove_broker trace
+    iterates it ~35 times (~20 s of the heal wall). This kernel is the
+    ``_topic_pair_candidates`` treatment applied to that ladder: the whole
+    iterate — violation gate, heavy ranking, nearest-partner top-k, exact
+    pair pricing, need-prefix greedy, conflict claims, apply — runs as a
+    ``lax.while_loop`` with ONE transfer in and one out.
+
+    Parity is QUALITY parity, not trajectory (ROUND5_NOTES: exact-set
+    equality vs the host ladder is a measured dead end): the kernel keeps
+    every acceptance rule of the host plan — leader↔leader pairs only,
+    lighter-lbi partners, the 2·VIOL_SCALE cascade allowance, the
+    0.7·removed cascade guard, drain-desc/delta-asc pair ranking, the
+    need-prefix stop, one counterparty broker/host and one partition per
+    round — but evaluates rounds against round-start mirrors where the
+    host hand-updates mid-plan. The driver wraps BOTH ladders in the same
+    exact-f64-energy snapshot compare, so neither can regress.
+
+    Round structure (all per round, on the live state):
+    - gate: weighted lead violations; active only when 0 < n_bad ≤
+      ``max_bad`` (the plateau scope of the host ladder's caller);
+    - per violating broker v (need-ranked top-``max_bad``): heavy =
+      top-``n_heavy`` of v's leader partitions by per-partition lbi;
+      partners = top-``k_part`` nearest by Σ|En−En[p]| over lighter-lbi
+      partitions led elsewhere; exact combined swap deltas; per-heavy-row
+      best partner (max drain, delta tiebreak); take the need-prefix;
+    - global scatter-min claims (priority = deterministic v-major order,
+      matching the host's traversal) over both partitions, the
+      counterparty broker, and its host — out-of-bounds sentinel indices
+      drop non-taken rows;
+    - winners apply as the two replica moves of a leader↔leader swap
+      (leadership travels with the replica, so ``leader_of`` is untouched
+      and the caller's leader mirror stays valid);
+    - exits on the first zero-accept round (deterministic — no RNG in
+      this kernel) or after ``n_rounds``.
+
+    Returns (state, accepted_pairs_total, rounds).
+    """
+    P = dt.num_partitions
+    B = dt.num_brokers
+    H = dt.num_hosts
+    # small-model clamp: top_k's k may not exceed the searched axis
+    n_heavy = min(n_heavy, P)
+    k_part = min(k_part, P)
+    max_bad = min(max_bad, B)
+    part_of = dt.partition_of_replica
+    hob = dt.host_of_broker
+    plbi = dt.leader_bytes_in                       # [P] per-partition lbi
+    viol_cap = jnp.float32(2.0 * float(OBJ.VIOL_SCALE))
+    NC = max_bad * n_heavy
+
+    def body(carry):
+        st, i, _last, total = carry
+        lv = _lead_viol_expr(th, w, st, lead_w)
+        lbi_b = st.leader_bytes_in
+        lbi_up = jnp.broadcast_to(th.lbi_upper, lbi_b.shape)
+        need0 = lbi_b - lbi_up
+        bad = lv > 0
+        n_bad = jnp.sum(bad.astype(jnp.int32))
+        active = (n_bad > 0) & (n_bad <= max_bad)
+        # count/demoted-band violations are not LBI-sheddable (need ≤ 0):
+        # rank the sheddable violators by band excess
+        vs_val, vs = jax.lax.top_k(
+            jnp.where(bad & (need0 > 0), need0, -jnp.inf), max_bad)
+        ok_v_vec = vs_val > 0
+        led_broker = st.broker_of[st.leader_of]     # [P]
+        # effective leader load (base of the leader replica + leader
+        # extra), normalized per resource — the load-match metric the host
+        # ladder caches by leader mirror; here it is just recomputed
+        E = dt.replica_base_load[st.leader_of] + dt.leader_extra   # [P,4]
+        En = E / (jnp.mean(jnp.abs(E), axis=0, keepdims=True) + 1e-30)
+
+        def per_v(vi, acc_carry):
+            r1_all, r2_all, take_all = acc_carry
+            v = vs[vi]
+            ok_v = ok_v_vec[vi] & active
+            need_v = jnp.maximum(need0[v], 0.0)
+            mine = led_broker == v
+            heavy_val, heavy = jax.lax.top_k(
+                jnp.where(mine, plbi, -jnp.inf), n_heavy)
+            heavy_ok = heavy_val > -jnp.inf
+            r1 = st.leader_of[heavy]                           # [n_heavy]
+            # partners: LEADER replicas of partitions led elsewhere with
+            # strictly lighter lbi (a follower partner would put +1 leader
+            # count on the counterparty — the band-top blocker)
+            pool_ok = (~mine)[None, :] & (plbi[None, :] < heavy_val[:, None])
+            dist = jnp.sum(jnp.abs(En[heavy][:, None, :] - En[None, :, :]),
+                           axis=-1)                            # [n_heavy,P]
+            dist = jnp.where(pool_ok, dist, jnp.inf)
+            negd, partners = jax.lax.top_k(-dist, k_part)      # [n_heavy,k]
+            part_ok = jnp.isfinite(negd) & heavy_ok[:, None]
+            r2 = st.leader_of[partners]
+            dummy = jnp.full((1, 1), -1, jnp.int32)
+            d2 = jax.vmap(jax.vmap(
+                lambda a_r, b_r: OBJ.combine(AN._swap_delta(
+                    dt, th, w, opts, st, initial_broker_of,
+                    "dense" if use_topic else "off", dummy, a_r, b_r)),
+                in_axes=(None, 0)))(r1, r2)                    # [n_heavy,k]
+            drains = heavy_val[:, None] - plbi[partners]
+            xb = st.broker_of[r2]
+            # controlled cascade (see shed_plan): the counterparty may take
+            # on NEW excess only well below what v sheds, evaluated against
+            # round-start mirrors (the claims below allow one pair per
+            # counterparty broker per round, so the mirrors stay exact for
+            # every accepted pair except v's own draining total)
+            removed = jnp.minimum(drains, need_v)
+            new_x = (jnp.maximum(lbi_b[xb] + drains - lbi_up[xb], 0.0)
+                     - jnp.maximum(lbi_b[xb] - lbi_up[xb], 0.0))
+            elig = (part_ok & (d2 < viol_cap) & (drains > 0)
+                    & (new_x <= 0.7 * removed))
+            # host pair ranking: max drain first, exact delta tiebreak
+            dmax = jnp.max(jnp.where(elig, drains, -jnp.inf), axis=1)
+            tied = elig & (drains == dmax[:, None])
+            best_k = jnp.argmin(jnp.where(tied, d2, jnp.inf), axis=1)
+            row_ok = dmax > -jnp.inf
+            ch_r2 = jnp.take_along_axis(r2, best_k[:, None], axis=1)[:, 0]
+            ch_dr = jnp.where(row_ok, dmax, 0.0)
+            # need-prefix in heavy order: stop planning once the planned
+            # cumulative drain covers v's band excess
+            cum_before = jnp.cumsum(ch_dr) - ch_dr
+            take = row_ok & (cum_before < need_v) & ok_v
+            base = vi * n_heavy
+            r1_all = jax.lax.dynamic_update_slice(
+                r1_all, r1.astype(jnp.int32), (base,))
+            r2_all = jax.lax.dynamic_update_slice(
+                r2_all, ch_r2.astype(jnp.int32), (base,))
+            take_all = jax.lax.dynamic_update_slice(take_all, take, (base,))
+            return r1_all, r2_all, take_all
+
+        r1_all, r2_all, take_all = jax.lax.fori_loop(
+            0, max_bad, per_v,
+            (jnp.zeros((NC,), jnp.int32), jnp.zeros((NC,), jnp.int32),
+             jnp.zeros((NC,), bool)))
+
+        # global claims: ONE pair per partition (both sides), counterparty
+        # broker, and counterparty host per round — the kernel form of the
+        # host used-set. Priority is the deterministic v-major/heavy-minor
+        # index (the host's traversal order); the out-of-bounds sentinel
+        # index drops every non-taken row from the scatter.
+        idxs = jnp.arange(NC, dtype=jnp.int32)
+        big = jnp.int32(NC + 1)
+        pp = part_of[r1_all]
+        pq = part_of[r2_all]
+        xb = st.broker_of[r2_all]
+        xh = hob[xb]
+        cP = (jnp.full((P,), big)
+              .at[jnp.where(take_all, pp, P)].min(idxs)
+              .at[jnp.where(take_all, pq, P)].min(idxs))
+        cB = jnp.full((B,), big).at[jnp.where(take_all, xb, B)].min(idxs)
+        cH = jnp.full((H,), big).at[jnp.where(take_all, xh, H)].min(idxs)
+        win = (take_all & (cP[pp] == idxs) & (cP[pq] == idxs)
+               & (cB[xb] == idxs) & (cH[xh] == idxs))
+        # apply the leader↔leader swap as two replica moves; losers no-op
+        # (destination = current broker), exactly like the fused descent
+        cur1 = st.broker_of[r1_all]
+        cur2 = st.broker_of[r2_all]
+        dst1 = jnp.where(win, cur2, cur1)
+        dst2 = jnp.where(win, cur1, cur2)
+        st = AN._apply_moves(dt, st, jnp.concatenate([r1_all, r2_all]),
+                             jnp.concatenate([dst1, dst2]), use_topic)
+        acc = jnp.sum(win.astype(jnp.int32))
+        return st, i + 1, acc, total + acc
+
+    def cond(carry):
+        _, i, last, _ = carry
+        # deterministic kernel: a zero-accept round reproduces itself
+        # exactly, so the FIRST one is convergence (the host ladder's
+        # shed_plan() -> False break)
+        return (i < n_rounds) & (last > 0)
+
+    st, rounds, _, total = jax.lax.while_loop(
+        cond, body, (st, jnp.int32(0), jnp.int32(1), jnp.int32(0)))
+    return st, total, rounds
+
+
 def _chain_state(dt, assign, num_topics: int,
                  track_topics: bool) -> AN.ChainState:
     agg = compute_aggregates(dt, assign, num_topics if track_topics else 1)
@@ -990,6 +1191,17 @@ def warm_escape_kernels(dt, assign, th, weights, opts, num_topics: int,
                               src_sharding=src_sharding,
                               flag_sharding=flag_sharding)
     outs.append(st.leader_of)
+    if cfg.fused_shed and mesh is None:
+        # the fused shed ladder (remove_broker's engaged path): a real
+        # (discarded) dispatch at this model's shapes, same statics the
+        # driver passes. _fused_shed donates its chain state — hand it a
+        # fresh copy so the lead-descent output appended above survives
+        st_shed = jax.tree.map(lambda x: x + 0, st)
+        st_shed, _, _ = _fused_shed(dt, th, weights, opts, st_shed, lead_w,
+                                    init, topic_on, cfg.shed_inner,
+                                    cfg.shed_sources, cfg.shed_partners,
+                                    cfg.escape_max_bad_brokers)
+        outs.append(st_shed.leader_of)
     jax.block_until_ready(outs)
 
 
@@ -1919,14 +2131,36 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
         # higher-tier residual (left by intra-batch drift of the shed
         # cascade) back into a +1 LBI — which is simply a smaller shed
         # problem for the next pass
+        use_fused_shed = cfg.fused_shed and mesh is None
         for _pass in range(3):
-            shed_any = False
-            for _i_shed in range(16):
-                if not shed_plan():
-                    break
-                shed_any = progressed = True
-                if not lead_viol_any():
-                    break
+            if use_fused_shed:
+                # one dispatch replaces the ≤16 host-iterated shed rounds;
+                # leader_of is untouched (leadership travels with the
+                # replica), so the lo mirror stays valid — only the
+                # broker mirror goes stale
+                st, n_shed, _sh_rounds = _fused_shed(
+                    dt, th, weights, opts, st, lead_w, initial_broker_of,
+                    topic_on, cfg.shed_inner, cfg.shed_sources,
+                    cfg.shed_partners, cfg.escape_max_bad_brokers)
+                n_shed = int(jax.device_get(n_shed))
+                shed_any = n_shed > 0
+                if shed_any:
+                    progressed = True
+                    total_moves += 2 * n_shed
+                    bo = None
+                if _DEBUG:
+                    print(f"[repair shed] fused pass={_pass} "
+                          f"pairs={n_shed} "
+                          f"rounds={int(jax.device_get(_sh_rounds))}",
+                          flush=True)
+            else:
+                shed_any = False
+                for _i_shed in range(16):
+                    if not shed_plan():
+                        break
+                    shed_any = progressed = True
+                    if not lead_viol_any():
+                        break
             if not shed_any:
                 break
             moves_descent(key_offset=100 * (_pass + 1))

@@ -327,6 +327,12 @@ class CruiseControlApp:
         self.incremental_refreshes = 0
         self.anneal_skips = 0
         self.last_tick_ms: Optional[float] = None
+        #: self-heal timing counters for /state (guarded by _cache_lock):
+        #: wall-clock of the most recent healing-context optimize and which
+        #: route it took — "masked" (destination propose-mask in the
+        #: annealer's sampler) or "full" (healing without a mask)
+        self.last_self_heal_ms: Optional[float] = None
+        self.self_heal_path: Optional[str] = None
 
     # ----------------------------------------------------------------- boot
 
@@ -531,6 +537,14 @@ class CruiseControlApp:
                     "engine": res.engine,
                     "reason": res.fallback_reason,
                     "atMs": int(time.time() * 1000)}
+        if res.heal_path is not None:
+            # self-heal timing: every healing entry point (add/remove
+            # brokers, fix_offline_replicas, destination-constrained
+            # rebalance) funnels through here — record the wall and route
+            # for /state (read by the REST thread: cache lock)
+            with self._cache_lock:
+                self.last_self_heal_ms = res.wall_time_s * 1000.0
+                self.self_heal_path = res.heal_path
         return res
 
     def _model(self, requirements=None, data_from: Optional[str] = None,
@@ -1421,6 +1435,8 @@ class CruiseControlApp:
             incr_refreshes = self.incremental_refreshes
             anneal_skips = self.anneal_skips
             last_tick_ms = self.last_tick_ms
+            last_self_heal_ms = self.last_self_heal_ms
+            self_heal_path = self.self_heal_path
         out = {
             "MonitorState": self.load_monitor.state_snapshot(),
             "ExecutorState": self.executor.state_snapshot(),
@@ -1435,6 +1451,8 @@ class CruiseControlApp:
                 "incrementalRefreshes": incr_refreshes,
                 "annealSkips": anneal_skips,
                 "lastTickMs": last_tick_ms,
+                "lastSelfHealMs": last_self_heal_ms,
+                "selfHealPath": self_heal_path,
             },
             "AnomalyDetectorState": self.anomaly_detector.state_snapshot(),
         }

@@ -9,6 +9,7 @@ gate test is the CI tentpole: the whole ``cruise_control_tpu`` package plus
 ``bench.py`` must lint clean against the checked-in baseline.
 """
 
+import json
 import textwrap
 
 import pytest
@@ -864,6 +865,14 @@ def test_package_lints_clean_against_baseline():
             if fp.split("|")[1] in ("cruise_control_tpu/ops/windows.py",
                                     "cruise_control_tpu/analyzer/rescore.py")]
     assert incr == [], f"incremental tick path must stay baseline-free: {incr}"
+    # the self-healing kernels (annealer propose-mask lowering + repair
+    # fused shed ladder) also shipped lint-clean: no suppression may name
+    # them, by fingerprint path or by snippet content
+    heal = [fp for fp, entry in baseline.items()
+            if "_fused_shed" in json.dumps(entry)
+            or "propose_dest_mask" in json.dumps(entry)]
+    assert heal == [], (
+        f"self-heal kernels must stay baseline-free: {heal}")
 
 
 # -- runtime sentinels -----------------------------------------------------

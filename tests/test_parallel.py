@@ -133,7 +133,14 @@ def test_sharded_repair_matches_unsharded(small_model):
     unsharded pass — the [n_src, B] delta matrix, swap deltas and O(R)
     violation scan shard; claims combine via order-independent min
     reductions (VERDICT r3 weak #3: repair was outside the multi-chip
-    story)."""
+    story).
+
+    fused_shed is pinned OFF: the fused shed ladder is an unsharded kernel
+    (its claim scatters don't partition), so the mesh path always takes the
+    host ladder — comparing a fused plain pass against a host-ladder mesh
+    pass would diff two legitimately different trajectories, not the
+    sharding. Fused-vs-host quality parity has its own lock in
+    tests/test_selfheal.py."""
     from cruise_control_tpu.analyzer import repair as REP
     topo, assign = small_model
     dt = device_topology(topo)
@@ -141,7 +148,8 @@ def test_sharded_repair_matches_unsharded(small_model):
     th = G.compute_thresholds(dt, BalancingConstraint(), agg0)
     weights = OBJ.build_weights(G.DEFAULT_GOALS)
     opts = G.default_options(topo)
-    cfg = REP.RepairConfig(fused_inner=24, fused_sources=64, swap_partners=4)
+    cfg = REP.RepairConfig(fused_inner=24, fused_sources=64, swap_partners=4,
+                           fused_shed=False)
     a_plain, n_plain, l_plain = REP.repair(
         dt, assign, th, weights, opts, topo.num_topics, config=cfg, seed=5)
     mesh = make_cpu_mesh(8)

@@ -1,0 +1,174 @@
+"""Self-healing path contracts (ISSUE 7): the destination-masked anneal and
+the fused on-device shed ladder.
+
+Three families:
+
+1. Oracle containment — masked-anneal destination semantics match the
+   sequential reference walk (sequential.py:532-553 / GoalUtils.java:100-104):
+   with ``requested_destination_broker_ids`` set, every non-leadership move
+   lands in the requested set; leadership actions are exempt.
+2. Bit-parity — a propose mask covering all alive brokers is bit-identical
+   to the unmasked path (the RNG-stream invariant: the in-trace partition is
+   an identity permutation and the destination-draw bounds are equal, so
+   every draw in the sampler is unchanged).
+3. Shed-kernel quality parity — the fused ladder reaches an identical
+   violated-goal set at equal-or-better soft cost vs the host ladder on the
+   remove-broker (dead-broker) fixtures.  Exact trajectory equality is a
+   known dead end (docs/ROUND5_NOTES.md): the kernel evaluates candidates
+   against round-start mirrors where the host hand-updates mid-plan, so the
+   contract is QUALITY parity, guarded both ways by the repair driver's
+   exact-energy keep-or-revert snapshot.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import annealer as AN
+from cruise_control_tpu.analyzer import goals as G
+from cruise_control_tpu.analyzer import optimizer as OPT
+from cruise_control_tpu.analyzer import repair as REP
+from cruise_control_tpu.models import fixtures
+
+pytestmark = pytest.mark.selfheal
+
+
+def _random9():
+    return fixtures.random_cluster(fixtures.ClusterProperties(
+        num_racks=3, num_brokers=9, num_replicas=300, num_topics=12), seed=7)
+
+
+def _dead9():
+    return fixtures.random_cluster(fixtures.ClusterProperties(
+        num_racks=3, num_brokers=9, num_replicas=200, num_topics=8,
+        num_dead_brokers=1), seed=11)
+
+
+def _soft_cost(r):
+    return sum(s.cost_after for s in r.goal_summaries if not s.hard)
+
+
+# -- 1. oracle containment --------------------------------------------------
+
+def _requested(topo, k):
+    """The last k ALIVE brokers — a feasible destination-restricted set."""
+    return tuple(int(b) for b in np.flatnonzero(topo.broker_alive)[-k:])
+
+
+@pytest.mark.parametrize("fixture,k", [
+    (_random9, 2),
+    (_dead9, 3),
+    (fixtures.small_cluster_model, 1),
+], ids=["random9", "dead9", "small"])
+def test_masked_moves_land_in_requested_set(fixture, k):
+    """Every replica the masked anneal moves lands on a requested broker
+    (sequential.py:532-539: requested destinations replace the exclusion
+    filters for non-leadership actions).  Leadership changes are exempt —
+    they relocate no replica, so broker containment does not constrain
+    them (GoalUtils parity)."""
+    topo, assign = fixture()
+    req = _requested(topo, k)
+    opts = G.build_options(topo, requested_destination_broker_ids=req)
+    assert opts.propose_dest_mask is not None
+    cfg = AN.AnnealConfig(num_chains=8, steps=512, swap_interval=64)
+    r = OPT.optimize(topo, assign, options=opts, engine="anneal",
+                     anneal_config=cfg, seed=0)
+    bo0 = np.asarray(jax.device_get(assign.broker_of))
+    bo1 = np.asarray(jax.device_get(r.final_assignment.broker_of))
+    moved = bo1 != bo0
+    assert np.isin(bo1[moved], req).all(), (
+        f"moves escaped the requested set {req}: "
+        f"{sorted(set(bo1[moved]) - set(req))}")
+    # the request is a destination-constrained (self-healing) context and
+    # the annealer sampled over the propose mask
+    assert r.heal_path == "masked"
+    assert r.to_json()["selfHealPath"] == "masked"
+
+
+def test_masked_anneal_actually_moves_replicas():
+    """The containment above must not pass vacuously: on the 9-broker
+    fixture with two requested destinations the anneal relocates a
+    meaningful number of replicas onto them."""
+    topo, assign = _random9()
+    req = _requested(topo, 2)
+    opts = G.build_options(topo, requested_destination_broker_ids=req)
+    cfg = AN.AnnealConfig(num_chains=8, steps=512, swap_interval=64)
+    r = OPT.optimize(topo, assign, options=opts, engine="anneal",
+                     anneal_config=cfg, seed=0)
+    assert r.num_replica_movements >= 10
+
+
+# -- 2. bit-parity ----------------------------------------------------------
+
+@pytest.mark.parametrize("fixture", [
+    _random9, _dead9, fixtures.small_cluster_model,
+], ids=["random9", "dead9", "small"])
+def test_all_alive_mask_bit_identical_to_unmasked(fixture):
+    """propose_dest_mask covering every alive broker == no mask, bit for
+    bit: same final broker_of AND leader_of under the same seed.  This is
+    the RNG-stream invariant the mask lowering must preserve — an all-true
+    mask partitions the destination pool into an identity permutation and
+    leaves every randint bound equal, so the sampler's draws are
+    unchanged."""
+    topo, assign = fixture()
+    cfg = AN.AnnealConfig(num_chains=8, steps=256, swap_interval=64)
+    base = G.build_options(topo)
+    masked = base._replace(propose_dest_mask=jnp.asarray(topo.broker_alive))
+    r0 = OPT.optimize(topo, assign, options=base, engine="anneal",
+                      anneal_config=cfg, seed=3)
+    r1 = OPT.optimize(topo, assign, options=masked, engine="anneal",
+                      anneal_config=cfg, seed=3)
+    bo0 = np.asarray(jax.device_get(r0.final_assignment.broker_of))
+    bo1 = np.asarray(jax.device_get(r1.final_assignment.broker_of))
+    lo0 = np.asarray(jax.device_get(r0.final_assignment.leader_of))
+    lo1 = np.asarray(jax.device_get(r1.final_assignment.leader_of))
+    assert (bo0 == bo1).all(), "broker_of diverged under all-alive mask"
+    assert (lo0 == lo1).all(), "leader_of diverged under all-alive mask"
+
+
+# -- 3. fused-shed quality parity -------------------------------------------
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fused_shed_quality_matches_host_ladder(seed):
+    """The fused on-device shed ladder ends at the same violated-goal set
+    with equal-or-better soft cost vs the host ladder on the dead-broker
+    fixture — quality parity, not trajectory (the kernel prices candidates
+    against round-start mirrors; the host hand-updates mid-plan)."""
+    topo, assign = _dead9()
+    cfg = AN.AnnealConfig(num_chains=8, steps=1024, swap_interval=64)
+    rs = {}
+    for fused in (True, False):
+        rs[fused] = OPT.optimize(
+            topo, assign, engine="anneal", anneal_config=cfg, seed=seed,
+            repair_config=REP.RepairConfig(fused_shed=fused))
+    f, h = rs[True], rs[False]
+    assert set(f.violated_goals_after) == set(h.violated_goals_after), (
+        f"violated-goal sets diverged: fused={sorted(f.violated_goals_after)}"
+        f" host={sorted(h.violated_goals_after)}")
+    assert _soft_cost(f) <= _soft_cost(h) + 1e-6, (
+        f"fused shed degraded soft cost: {_soft_cost(f):.4f} vs host "
+        f"{_soft_cost(h):.4f}")
+    # dead broker evacuated on both paths
+    dead = int(np.flatnonzero(~topo.broker_alive)[0])
+    for r in (f, h):
+        bo = np.asarray(jax.device_get(r.final_assignment.broker_of))
+        assert (bo != dead).all()
+
+
+# -- /state counters --------------------------------------------------------
+
+def test_heal_path_label_and_full_context():
+    """A dead-broker request WITHOUT a destination mask is labeled the
+    'full' heal path; a plain rebalance carries no label at all."""
+    topo, assign = _dead9()
+    cfg = AN.AnnealConfig(num_chains=8, steps=256, swap_interval=64)
+    r = OPT.optimize(topo, assign, engine="anneal", anneal_config=cfg,
+                     seed=0)
+    assert r.heal_path == "full"
+    assert r.to_json()["selfHealPath"] == "full"
+    topo2, assign2 = _random9()
+    r2 = OPT.optimize(topo2, assign2, engine="anneal",
+                      anneal_config=cfg, seed=0)
+    assert r2.heal_path is None
+    assert "selfHealPath" not in r2.to_json()
