@@ -19,8 +19,18 @@ Size selection: env BENCH_SIZE picks the BASELINE.md config:
                        goals at 2.6K brokers x 4 disks / 200K replicas
   selfheal           — config 3: add_broker + remove_broker proposals on a
                        RandomCluster (the self-healing path)
+  xl                 — 10×-LinkedIn (26K brokers / 5M replicas,
+                       fixtures.xl_cluster) on an 8-device CPU mesh: the
+                       sharded PT-anneal path end-to-end. Skips gracefully
+                       (JSON carries skipped_reason) when host RAM or the
+                       device count is insufficient.
 Timed region = threshold precompute + optimization + exact rescore + proposal
 decode (model generation excluded, matching the reference timer's scope).
+
+Mesh fields: every proposal envelope records mesh_devices (0 = unmeshed)
+and sharded_path. BENCH_MESH_DEVICES=N runs the standard legs on an
+N-device mesh over the default backend (default 0 = single device,
+bit-path identical to previous rounds).
 """
 
 import json
@@ -62,6 +72,17 @@ def main():
         return _bench_jbod(seed)
     if size == "selfheal":
         return _bench_selfheal(seed)
+    if size == "xl":
+        return _bench_xl(seed)
+
+    # optional mesh for the standard legs: BENCH_MESH_DEVICES=N shards the
+    # anneal/rescore over N devices of the default backend; 0 (default)
+    # keeps the single-device path previous rounds measured
+    mesh = None
+    n_mesh = int(os.environ.get("BENCH_MESH_DEVICES", "0"))
+    if n_mesh > 0:
+        from cruise_control_tpu.parallel.mesh import build_mesh
+        mesh = build_mesh(n_mesh)
 
     goal_names = G.DEFAULT_GOALS
     if size == "linkedin":
@@ -104,14 +125,14 @@ def main():
     jax.jit(lambda x: x + 1)(jnp_ones := np.ones(8, np.float32))
     t_warm = time.time()
     r = OPT.optimize(topo, assign, goal_names=goal_names, engine=engine,
-                     anneal_config=cfg, seed=seed)
+                     anneal_config=cfg, seed=seed, mesh=mesh)
     warm_s = time.time() - t_warm
     # escape kernels (topic-band swap, fused lead descent) only dispatch
     # when a residual violation appears, so the first-run pass above may
     # not have loaded them; warm explicitly so the timed run below is the
     # steady state a warmed service serves (optimizer.warm_kernels)
     OPT.warm_kernels(topo, assign, goal_names=goal_names,
-                     anneal_config=cfg)
+                     anneal_config=cfg, mesh=mesh)
     # steady-state sentinels (common/sentinels.py): the timed run below is
     # the request a warmed service serves — it must perform ZERO retraces
     # (every retrace is a multi-second compile inside a request) and the
@@ -122,7 +143,7 @@ def main():
     t0 = time.time()
     with SENT.retrace_sentinel() as retrace_log:
         r = OPT.optimize(topo, assign, goal_names=goal_names, engine=engine,
-                         anneal_config=cfg, seed=seed + 1)
+                         anneal_config=cfg, seed=seed + 1, mesh=mesh)
     elapsed = time.time() - t0
     steady_uncovered = SENT.check_steady_state(retrace_log)
 
@@ -226,6 +247,11 @@ def main():
         # back to the host CPU backend (optimizer.TINY_CPU_LIMIT): every
         # chunked dispatch otherwise pays remote-TPU tunnel latency
         "device": r.device,
+        # mesh policy: device count the optimize ran sharded over (0 =
+        # unmeshed) and whether the sharded execution path was active
+        "mesh_devices": (0 if mesh is None
+                         else int(np.prod(mesh.devices.shape))),
+        "sharded_path": mesh is not None,
         # runtime sentinels: retraces observed during the timed steady-state
         # run that the runtime baseline does not cover (contract: 0), and
         # the functions that retraced, for file-level attribution
@@ -327,6 +353,130 @@ def main():
                   "speedup_vs_sequential_recorded (re-measure with "
                   "BENCH_SEQ=1)", file=sys.stderr)
     print(json.dumps(out))
+
+
+#: floor for the xl leg: peak residency is the [C, R] chain pytree plus
+#: XLA CPU temporaries of the sharded rescore (measured ~low tens of GB at
+#: 26K/5M); machines under this emit skipped_reason instead of OOMing
+XL_MIN_AVAILABLE_GB = 48.0
+XL_MESH_DEVICES = 8
+
+
+def _xl_skip_reason(avail_gb, n_cpu_devices):
+    """Why the xl leg cannot run here, or None. Pure so the graceful-skip
+    contract is unit-testable without a 5M-replica model."""
+    if avail_gb < XL_MIN_AVAILABLE_GB:
+        return (f"insufficient host RAM: {avail_gb:.1f} GB available < "
+                f"{XL_MIN_AVAILABLE_GB:.0f} GB required for the 26K-broker "
+                f"/ 5M-replica model")
+    if n_cpu_devices < XL_MESH_DEVICES:
+        return (f"cannot build the {XL_MESH_DEVICES}-device CPU mesh: only "
+                f"{n_cpu_devices} CPU devices (jax initialized before "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count="
+                f"{XL_MESH_DEVICES} could land)")
+    return None
+
+
+def _mem_available_gb():
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) / (1024 * 1024)
+    except OSError:
+        pass
+    return float("inf")     # no meminfo (non-Linux): let the leg try
+
+
+def _bench_xl(seed: int):
+    """10×-LinkedIn on the 8-device CPU mesh: the sharded PT anneal
+    end-to-end at 26K brokers / 5M replicas (fixtures.xl_cluster). Chain
+    axis data-parallel over the mesh, exact evaluations replica-sharded —
+    the [R,4] load tensor never materializes on one device. Steady-state
+    methodology matches the headline timer: compile, warm, then a timed
+    run under the retrace sentinel (contract = 0). Skips gracefully with
+    an explicit skipped_reason when host RAM or the forced CPU device
+    count is insufficient — a tier-1 machine must never OOM here."""
+    # the flag must land before the CPU backend initializes; if something
+    # (sitecustomize, an earlier leg) already initialized it, the device
+    # check below reports the skip instead of failing
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{XL_MESH_DEVICES}").strip()
+
+    import jax
+
+    from cruise_control_tpu.analyzer import annealer as AN
+    from cruise_control_tpu.analyzer import goals as G
+    from cruise_control_tpu.analyzer import optimizer as OPT
+    from cruise_control_tpu.common import sentinels as SENT
+    from cruise_control_tpu.models import fixtures
+    from cruise_control_tpu.parallel.sharding import make_cpu_mesh
+
+    try:
+        n_cpu = len(jax.devices("cpu"))
+    except RuntimeError:
+        n_cpu = 0
+    reason = _xl_skip_reason(_mem_available_gb(), n_cpu)
+    if reason is not None:
+        print(json.dumps({
+            "metric": "xl_sharded_proposal_wall_clock",
+            "unit": "s",
+            "skipped": True,
+            "skipped_reason": reason,
+        }))
+        return
+
+    mesh = make_cpu_mesh(XL_MESH_DEVICES)
+    topo, assign = fixtures.xl_cluster(seed=seed)
+    # wide-batch shallow anneal, one chain per device: per-step cost at 5M
+    # replicas is dominated by the maintained-aggregate updates, and the
+    # escape-laddered repair absorbs a shallower schedule (same trade the
+    # linkedin config makes, see docs/PERF.md)
+    cfg = AN.AnnealConfig(num_chains=XL_MESH_DEVICES, steps=96,
+                          swap_interval=48, tries_move=384, tries_lead=64,
+                          tries_swap=192)
+    t_warm = time.time()
+    r = OPT.optimize(topo, assign, engine="anneal", anneal_config=cfg,
+                     seed=seed, mesh=mesh)
+    warm_s = time.time() - t_warm
+    OPT.warm_kernels(topo, assign, anneal_config=cfg, mesh=mesh)
+    t0 = time.time()
+    with SENT.retrace_sentinel() as retrace_log:
+        r = OPT.optimize(topo, assign, engine="anneal", anneal_config=cfg,
+                         seed=seed + 1, mesh=mesh)
+    elapsed = time.time() - t0
+    uncovered = SENT.check_steady_state(retrace_log)
+    if uncovered:
+        print(f"bench: WARNING xl steady state retraced: "
+              f"{retrace_log.summary()}", file=sys.stderr)
+    # linear-scaling extension of the 30 s LinkedIn north star; the real
+    # multi-host target rides actual TPU pods, this records the CPU-mesh
+    # reference point
+    target = 300.0
+    print(json.dumps({
+        "metric": "xl_sharded_proposal_wall_clock",
+        "value": round(elapsed, 3),
+        "unit": "s",
+        "vs_baseline": round(target / elapsed, 3),
+        "first_run_s": round(warm_s, 3),
+        "brokers": topo.num_brokers,
+        "replicas": topo.num_replicas,
+        "engine": r.engine,
+        "mesh_devices": XL_MESH_DEVICES,
+        "sharded_path": True,
+        "violated_goals_before": len(r.violated_goals_before),
+        "violated_goals_after": len(r.violated_goals_after),
+        "hard_violations_after": sum(1 for s in r.goal_summaries
+                                     if s.hard and s.violated_after),
+        "balancedness_before": round(r.balancedness_before, 2),
+        "balancedness_after": round(r.balancedness_after, 2),
+        "num_replica_movements": r.num_replica_movements,
+        "steady_state_retraces": len(uncovered),
+        "device": r.device,
+    }))
 
 
 def _bench_jbod(seed: int):

@@ -675,8 +675,31 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
                     goal_names: Sequence[str] = G.DEFAULT_GOALS,
                     initial_broker_of: Optional[jax.Array] = None,
                     mesh: Optional[jax.sharding.Mesh] = None) -> AnnealResult:
+    """Parallel-tempering anneal; with ``mesh`` the chain axis shards over
+    it (the production multi-device path).
+
+    Chain round-up + RNG contract: the chain count rounds UP to the next
+    multiple of the mesh size so the chain axis tiles the mesh evenly —
+    the extra chains are real extra search (live temperature-ladder slots
+    with their own proposal streams), not dead padding. Per-step chain
+    keys come from ``split(fold_in(step_key, 1), C)``, so the streams
+    depend on the FINAL chain count: a rounded-up run is a legitimately
+    different (larger) search than the unrounded request. A 1-device mesh
+    collapses to ``mesh=None`` right here (and at the optimizer entry, and
+    in parallel/mesh.build_mesh) — same program, therefore BIT-EXACT —
+    pinned by tests/test_parallel.py::test_single_device_mesh_bit_parity.
+    Multi-device meshes run structurally different programs (sharded
+    rescore, distributed psum, different per-chain fusion order), so the
+    end-to-end contract there is quality parity, not bitwise (see
+    docs/performance.md Stage 6).
+    """
     cfg = config or AnnealConfig()
     C = cfg.num_chains
+    if mesh is not None and int(np.prod(mesh.devices.shape)) <= 1:
+        # 1-device mesh == no mesh (optimizer._collapse_trivial_mesh):
+        # sharding over one device would only swap in structurally
+        # different programs; collapsing keeps the bit-parity contract
+        mesh = None
     if mesh is not None:   # chain axis must tile the mesh evenly
         n_dev = int(np.prod(mesh.devices.shape))
         C = -(-C // n_dev) * n_dev
@@ -778,9 +801,19 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
         # chains are embarrassingly parallel: shard the chain axis across the
         # mesh (parallel/sharding.py); XLA inserts the (cheap) collectives
         # for the PT temperature swap and the final argmin.
-        from cruise_control_tpu.parallel.sharding import shard_chains
+        from cruise_control_tpu.parallel.sharding import replicate, shard_chains
         chains = shard_chains(chains, mesh)
         temps0 = shard_chains(temps0, mesh)
+        # every OTHER operand must be placed on the mesh EXPLICITLY too:
+        # the guarded dispatch below runs under transfer_guard("disallow"),
+        # and a device-0-committed array (the round keys, the model
+        # constants) would otherwise be replicated by an IMPLICIT
+        # device-to-device transfer at dispatch — which the guard rejects,
+        # silently degrading the engine chain to greedy
+        (keys, dt, th, weights, opts, movable_idx, dest_idx,
+         initial_broker_of, topic_reps, n_mov_dev, n_dst_dev) = replicate(
+            (keys, dt, th, weights, opts, movable_idx, dest_idx,
+             initial_broker_of, topic_reps, n_mov_dev, n_dst_dev), mesh)
 
     # steady-state dispatch: every argument is a device array (or hashed
     # static), so any implicit transfer inside this call is a hazard the

@@ -370,6 +370,19 @@ def _setup_model(topo, assign, goal_names, constraint, options, mesh):
             _agg, agg0, th, weights)
 
 
+def _collapse_trivial_mesh(mesh):
+    """A 1-device mesh is the unmeshed program: collapse it to None at the
+    entry points (same policy parallel/mesh.build_mesh applies to config
+    requests). Sharding over one device buys nothing and would compile
+    structurally different programs (shard_map rescore, sharded
+    aggregates) whose fusion/reduction order differs at ULP level — the
+    collapse is what makes the single-device bit-parity contract exact
+    (tests/test_parallel.py::test_single_device_mesh_bit_parity)."""
+    if mesh is not None and int(np.prod(mesh.devices.shape)) <= 1:
+        return None
+    return mesh
+
+
 def _routes_to_tiny_cpu(topo, mesh, options) -> bool:
     """True when optimize() will run this model on the host CPU backend
     (tiny model, no mesh/custom options, accelerator default backend) —
@@ -408,6 +421,7 @@ def warm_kernels(topo: ClusterTopology, assign: Assignment,
     ``mesh`` the optimize() calls will use — the escape kernels' static
     shapes and sharded variants follow them. See
     repair.warm_escape_kernels."""
+    mesh = _collapse_trivial_mesh(mesh)
     if _routes_to_tiny_cpu(topo, mesh, options):
         # optimize() routes this model onto the host CPU backend, where
         # compiles are local and fast — warming the remote-TPU variants
@@ -471,6 +485,7 @@ def optimize(topo: ClusterTopology, assign: Assignment,
     drift reuses compiled programs (see engages_bucketing for the None =
     auto policy). Proposals are identical either way — the padded ==
     unpadded contract of tests/test_bucketing.py."""
+    mesh = _collapse_trivial_mesh(mesh)
     if _routes_to_tiny_cpu(topo, mesh, options):
         try:
             cpu0 = jax.devices("cpu")[0]

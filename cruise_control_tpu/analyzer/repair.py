@@ -132,6 +132,15 @@ class RepairConfig:
     lead_uphill_steps: int = 0
     min_improvement: float = 1e-9
 
+    def engages_fused_shed(self, mesh) -> bool:
+        """Single source of truth for the shed-ladder routing: the fused
+        on-device kernel runs only off-mesh (its claim scatters are
+        unsharded), so an active mesh ALWAYS routes to the host ladder —
+        callers can't accidentally run the unsharded kernel under a mesh.
+        ``fused_shed=False`` remains the off-mesh escape hatch. Pinned by
+        tests/test_parallel.py::test_sharded_repair_matches_unsharded."""
+        return self.fused_shed and mesh is None
+
 
 def _bucket(n: int, cap: int, floor: int = 512) -> int:
     """Two-tier bucket: ``floor`` for tail rounds, ``cap`` for bulk ones.
@@ -1191,7 +1200,7 @@ def warm_escape_kernels(dt, assign, th, weights, opts, num_topics: int,
                               src_sharding=src_sharding,
                               flag_sharding=flag_sharding)
     outs.append(st.leader_of)
-    if cfg.fused_shed and mesh is None:
+    if cfg.engages_fused_shed(mesh):
         # the fused shed ladder (remove_broker's engaged path): a real
         # (discarded) dispatch at this model's shapes, same statics the
         # driver passes. _fused_shed donates its chain state — hand it a
@@ -2131,7 +2140,7 @@ def repair(dt: DeviceTopology, assign: Assignment, th: G.GoalThresholds,
         # higher-tier residual (left by intra-batch drift of the shed
         # cascade) back into a +1 LBI — which is simply a smaller shed
         # problem for the next pass
-        use_fused_shed = cfg.fused_shed and mesh is None
+        use_fused_shed = cfg.engages_fused_shed(mesh)
         for _pass in range(3):
             if use_fused_shed:
                 # one dispatch replaces the ≤16 host-iterated shed rounds;

@@ -52,6 +52,7 @@ from cruise_control_tpu.monitor.load_monitor import (
     NotEnoughValidWindowsError,
 )
 from cruise_control_tpu.monitor.sampler import MetricSampler
+from cruise_control_tpu.parallel.mesh import mesh_from_config, mesh_state
 
 
 @dataclasses.dataclass
@@ -74,6 +75,10 @@ class CruiseControlApp:
         self.config = config
         self.constraint = config.balancing_constraint()
         self.default_goals = tuple(config.get("default.goals"))
+        if mesh is None:
+            # optimizer.mesh.enable/.devices — config-driven scale-out; an
+            # explicit mesh arg (tests, driver dry-run) always wins
+            mesh = mesh_from_config(config)
         self.mesh = mesh
         # goal.balancedness.* weights — per-app config threaded into every
         # optimize call (KafkaCruiseControlUtils.java:530 semantics; NOT a
@@ -1453,6 +1458,7 @@ class CruiseControlApp:
                 "lastTickMs": last_tick_ms,
                 "lastSelfHealMs": last_self_heal_ms,
                 "selfHealPath": self_heal_path,
+                **mesh_state(self.mesh),
             },
             "AnomalyDetectorState": self.anomaly_detector.state_snapshot(),
         }

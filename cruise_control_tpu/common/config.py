@@ -300,6 +300,15 @@ def _service_config_def() -> ConfigDef:
              "proposals are identical either way. auto engages it for "
              "large single-device anneal runs (see "
              "analyzer.optimizer.engages_bucketing).")
+    d.define("optimizer.mesh.enable", T.BOOLEAN, False, I.MEDIUM,
+             "Shard the optimizer over a device mesh: chain-axis data "
+             "parallelism for the parallel-tempering anneal plus "
+             "replica-axis sharded exact rescore. Off (default) runs "
+             "single-device, bit-identical to the unmeshed path.")
+    d.define("optimizer.mesh.devices", T.INT, 0, I.MEDIUM,
+             "Device count for the optimizer mesh; 0 = all visible "
+             "devices. Requests beyond the visible count clamp with a "
+             "warning. Ignored unless optimizer.mesh.enable.", at_least(0))
     d.define("anneal.num.chains", T.INT, 32, I.MEDIUM,
              "Parallel-tempering chains.", at_least(1))
     d.define("anneal.steps", T.INT, 2048, I.MEDIUM, "Annealer steps.",

@@ -209,6 +209,18 @@ def dead_broker():
 # ---------------------------------------------------------------------------
 
 
+def xl_cluster(seed: int = 0):
+    """10×-LinkedIn fixture: 26K brokers / 5M replicas — the multi-host
+    regime behind ``BENCH_SIZE=xl`` (bench.py) where the [R,4] load tensor
+    and the chain pytree are meant to live sharded over a mesh, never
+    materialized per-device. Same generator and placement recipe as the
+    LinkedIn config, scaled 10× on brokers/replicas (racks 2×: rack count
+    grows far sublinearly in real fleets; topics capped at 100K — the
+    topic term is beyond the dense limit either way)."""
+    return synthetic_cluster(num_brokers=26_000, num_replicas=5_000_000,
+                             num_racks=80, num_topics=100_000, seed=seed)
+
+
 def synthetic_cluster(num_brokers: int = 2_600, num_replicas: int = 500_000,
                       num_racks: int = 40, rf: int = 3, num_topics: int = 30_000,
                       seed: int = 0, mean_nw_in: float = 50.0,

@@ -1,0 +1,40 @@
+"""Version-tolerant resolution of the shard_map entry point.
+
+jax moved ``shard_map`` from ``jax.experimental.shard_map`` (<= 0.4.x /
+0.5.x) to the top-level ``jax.shard_map`` (0.6+); on 0.4.37 — this
+environment — the top-level name does not exist at all (the deprecation
+module raises ``AttributeError``). Every call site in this package goes
+through :func:`resolve_shard_map` so the API drift is absorbed in exactly
+one place.
+
+Both spellings share the keyword signature used here:
+``shard_map(f, mesh=..., in_specs=..., out_specs=...)``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def resolve_shard_map():
+    """Return the callable ``shard_map`` transform for the installed jax.
+
+    Preference order: top-level ``jax.shard_map`` (0.6+), then
+    ``jax.experimental.shard_map.shard_map`` (0.4.x/0.5.x). Raises
+    ``RuntimeError`` if neither exists — this jax is out of the supported
+    window and the parallel path cannot run.
+    """
+    sm = getattr(jax, "shard_map", None)
+    if callable(sm):
+        return sm
+    try:
+        from jax.experimental.shard_map import shard_map as sm_exp
+    except ImportError as e:  # pragma: no cover - requires a future jax
+        raise RuntimeError(
+            "no shard_map entry point: neither jax.shard_map nor "
+            "jax.experimental.shard_map.shard_map exists in "
+            f"jax {jax.__version__}") from e
+    return sm_exp
+
+
+shard_map = resolve_shard_map()

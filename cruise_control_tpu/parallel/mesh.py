@@ -1,0 +1,85 @@
+"""Mesh policy: config-driven device mesh construction for the optimizer.
+
+The sharding primitives (:mod:`cruise_control_tpu.parallel.sharding`) take a
+``jax.sharding.Mesh`` and don't care where it came from; this module owns
+the *policy* — which devices, how many, and whether to shard at all:
+
+- ``optimizer.mesh.enable`` (bool, default off) turns the sharded path on.
+  Off means every optimize/warm call runs single-device, bit-identical to
+  the unmeshed behavior the rest of the suite pins.
+- ``optimizer.mesh.devices`` (int, default 0 = all visible devices) caps
+  the mesh size. Requests beyond the visible device count clamp with a
+  warning rather than failing the service boot.
+- A resolved size of <= 1 yields **no** mesh: a 1-device mesh is
+  bit-identical to the unmeshed path (pinned by
+  tests/test_parallel.py::test_single_device_mesh_bit_parity) but compiles
+  separate partitioned programs, so the policy collapses it to ``None``.
+
+The mesh is built over the default backend's devices (TPU on a pod host,
+CPU under ``JAX_PLATFORMS=cpu``); tests and the driver dry-run that must
+never touch a TPU build theirs explicitly with
+:func:`cruise_control_tpu.parallel.sharding.make_cpu_mesh`.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import numpy as np
+
+LOG = logging.getLogger(__name__)
+
+MESH_AXIS = "chains"
+
+
+def available_devices(platform: Optional[str] = None) -> int:
+    """Visible device count on ``platform`` (default backend when None);
+    0 if the backend cannot initialize (e.g. no accelerator runtime)."""
+    import jax
+    try:
+        return len(jax.devices(platform) if platform else jax.devices())
+    except RuntimeError:
+        return 0
+
+
+def build_mesh(n_devices: int = 0, platform: Optional[str] = None,
+               axis: str = MESH_AXIS):
+    """A 1-D mesh over the first ``n_devices`` devices (0 = all visible).
+
+    Returns ``None`` when the resolved size is <= 1 — the sharded path
+    degenerates to the single-device one there (see module docstring).
+    Clamps (with a warning) when more devices are requested than exist.
+    """
+    import jax
+    from jax.sharding import Mesh
+    try:
+        devices = jax.devices(platform) if platform else jax.devices()
+    except RuntimeError as e:
+        LOG.warning("mesh disabled: backend unavailable (%s)", e)
+        return None
+    n = int(n_devices) or len(devices)
+    if n > len(devices):
+        LOG.warning("optimizer.mesh.devices=%d but only %d visible; "
+                    "clamping", n, len(devices))
+        n = len(devices)
+    if n <= 1:
+        return None
+    return Mesh(np.asarray(devices[:n]), (axis,))
+
+
+def mesh_from_config(config) -> Optional["object"]:
+    """Resolve the optimizer mesh from service config; ``None`` when the
+    sharded path is disabled or only one device is visible."""
+    if not config.get("optimizer.mesh.enable"):
+        return None
+    return build_mesh(int(config.get("optimizer.mesh.devices")))
+
+
+def mesh_state(mesh) -> dict:
+    """The /state surface for the mesh policy: device count + whether the
+    sharded execution path is active."""
+    if mesh is None:
+        return {"meshDevices": 0, "shardedPath": False}
+    return {"meshDevices": int(np.prod(mesh.devices.shape)),
+            "shardedPath": True}

@@ -12,7 +12,8 @@ The two scale axes of the optimizer (SURVEY §5.7-5.8, §7 step 3):
 - **Replica axis** — the exact full-model evaluations (initial scoring,
   final rescore, goal summaries) are O(R) segment-reductions over all 500K
   replicas. :func:`sharded_aggregates` shards the replica AND partition
-  axes with ``jax.shard_map``: each device computes partial per-broker
+  axes with ``shard_map`` (entry point resolved version-tolerantly in
+  :mod:`cruise_control_tpu.parallel.compat`): each device computes partial per-broker
   segment sums over its replica shard, then one ``psum`` over the ICI mesh
   axis combines them — the standard data-parallel reduction layout, with
   the [B,4] aggregate (small) replicated and the [R,4] load tensor (large)
@@ -37,6 +38,7 @@ from cruise_control_tpu.common import resources as res
 from cruise_control_tpu.ops.aggregates import (DeviceTopology,
                                                leader_count_weights,
                                                replica_count_weights)
+from cruise_control_tpu.parallel.compat import shard_map
 
 
 def make_cpu_mesh(n_devices: int, axis: str = "chains") -> Mesh:
@@ -209,7 +211,7 @@ def sharded_aggregates(mesh: Mesh, dt: DeviceTopology,
         P(ax),                # valid_p
         P(ax),                # lbi_p
     )
-    out = jax.shard_map(
+    out = shard_map(
         local, mesh=mesh, in_specs=specs_in,
         out_specs=(P(None, None, None), P(None, None), P(None, None), P(None),
                    P(None, None), P(None, None)))(

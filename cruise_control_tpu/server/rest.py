@@ -359,6 +359,10 @@ class RestApi:
     # ------------------------------------------------------------ GET
 
     def _state(self, params, client_id, request_url):
+        """CruiseControlState. AnalyzerState carries the mesh-policy
+        surface (meshDevices: device count, 0 when unmeshed; shardedPath:
+        whether optimize/warm-up run the sharded kernels) alongside the
+        proposal/tick fields."""
         state = self.app.state(
             super_verbose=_parse_bool(params, "super_verbose", False))
         substates = _parse_csv(params, "substates")

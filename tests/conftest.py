@@ -46,11 +46,16 @@ try:
     # same (program, shape) pairs run after run — a warm cache turns a
     # >20-minute test_optimizer pass into mostly cache loads. Also applies
     # to the subprocess-spawning mesh tests.
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), ".jax_cache_cpu"))
+    _cache_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".jax_cache_cpu")
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    # the config.update above only reaches THIS process; the subprocess-
+    # isolated mesh tests (test_parallel.py) spawn clean interpreters that
+    # read the env vars at jax import — export them so the subprocesses
+    # share the same persistent cache instead of cold-compiling every run
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir)
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 except Exception:
     pass
 

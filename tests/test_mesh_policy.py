@@ -1,0 +1,134 @@
+"""Mesh policy + shard_map version shim.
+
+These tests need no multi-device mesh (and no ``multichip`` marker): the
+shim must resolve on ANY jax in the supported window under
+``JAX_PLATFORMS=cpu``, and the policy layer must collapse degenerate
+requests (disabled, one device) to the unmeshed path.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------- compat
+
+
+def test_shim_resolves_for_this_jax():
+    import jax
+
+    from cruise_control_tpu.parallel import compat
+    sm = compat.resolve_shard_map()
+    assert callable(sm)
+    assert callable(compat.shard_map)
+    top = getattr(jax, "shard_map", None)
+    if callable(top):          # jax >= 0.6 spelling
+        assert sm is top
+    else:                      # 0.4.x/0.5.x: the experimental entry point
+        from jax.experimental.shard_map import shard_map as sm_exp
+        assert sm is sm_exp
+
+
+def test_shim_imports_under_cpu_platform():
+    """Satellite contract, taken literally: a CLEAN interpreter with only
+    ``JAX_PLATFORMS=cpu`` (no device-count forcing, no conftest) imports
+    the shim and gets a callable."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run(
+        [sys.executable, "-c",
+         f"import sys; sys.path.insert(0, {ROOT!r})\n"
+         "from cruise_control_tpu.parallel.compat import shard_map\n"
+         "assert callable(shard_map)\n"
+         "print('shim ok')"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "shim ok" in out.stdout
+
+
+def test_bench_xl_graceful_skip_reasons():
+    """The xl leg's skip decision (bench.py): explicit skipped_reason on
+    small hosts / unforceable device counts instead of an OOM."""
+    import bench
+    low_ram = bench._xl_skip_reason(8.0, 8)
+    assert low_ram is not None and "RAM" in low_ram
+    few_dev = bench._xl_skip_reason(128.0, 1)
+    assert few_dev is not None and "device" in few_dev
+    assert bench._xl_skip_reason(128.0, 8) is None
+
+
+# ---------------------------------------------------------------- policy
+
+
+def test_build_mesh_sizes_and_degenerate_cases():
+    from cruise_control_tpu.parallel import mesh as MP
+    n = MP.available_devices("cpu")
+    assert n >= 1
+    if n >= 2:
+        m = MP.build_mesh(2, platform="cpu")
+        assert m is not None and m.devices.size == 2
+        assert m.axis_names == (MP.MESH_AXIS,)
+        # 0 = all visible devices
+        m_all = MP.build_mesh(0, platform="cpu")
+        assert m_all is not None and m_all.devices.size == n
+        # over-request clamps instead of failing the boot
+        m_clamp = MP.build_mesh(10 * n, platform="cpu")
+        assert m_clamp is not None and m_clamp.devices.size == n
+    # a 1-device mesh is pointless (bit-identical to unmeshed): policy
+    # collapses it to None
+    assert MP.build_mesh(1, platform="cpu") is None
+
+
+def test_mesh_from_config_and_state_surface():
+    from cruise_control_tpu.common.config import CruiseControlConfig
+    from cruise_control_tpu.parallel import mesh as MP
+
+    cfg_off = CruiseControlConfig({"bootstrap.servers": "x:9092"})
+    assert cfg_off.get("optimizer.mesh.enable") is False
+    assert MP.mesh_from_config(cfg_off) is None
+    assert MP.mesh_state(None) == {"meshDevices": 0, "shardedPath": False}
+
+    if MP.available_devices() >= 2:
+        cfg_on = CruiseControlConfig({"bootstrap.servers": "x:9092",
+                                      "optimizer.mesh.enable": True,
+                                      "optimizer.mesh.devices": 2})
+        m = MP.mesh_from_config(cfg_on)
+        assert m is not None and m.devices.size == 2
+        st = MP.mesh_state(m)
+        assert st == {"meshDevices": 2, "shardedPath": True}
+
+
+def test_app_state_surfaces_mesh_policy():
+    """A config-booted app reports the mesh surface in AnalyzerState even
+    unmeshed (meshDevices=0, shardedPath=False); with a mesh injected, the
+    fields reflect it. No optimize call — state() is pure bookkeeping."""
+    from cruise_control_tpu.app import CruiseControlApp
+    from cruise_control_tpu.common.config import CruiseControlConfig
+    from cruise_control_tpu.executor.executor import FakeClusterAdapter
+    from cruise_control_tpu.monitor.load_monitor import StaticMetadataSource
+    from cruise_control_tpu.monitor.sampler import SyntheticLoadSampler
+    from cruise_control_tpu.parallel import mesh as MP
+    from tests.test_server import _metadata
+
+    config = CruiseControlConfig({"bootstrap.servers": "x:9092",
+                                  "failed.brokers.file.path": ""})
+    md = StaticMetadataSource(_metadata())
+
+    def _mk(mesh=None):
+        return CruiseControlApp(config, md, SyntheticLoadSampler(seed=1),
+                                cluster_adapter=FakeClusterAdapter({}),
+                                mesh=mesh)
+
+    st = _mk().state()["AnalyzerState"]
+    assert st["meshDevices"] == 0 and st["shardedPath"] is False
+
+    m = MP.build_mesh(0, platform="cpu")
+    if m is not None:
+        st2 = _mk(mesh=m).state()["AnalyzerState"]
+        assert st2["meshDevices"] == int(np.prod(m.devices.shape))
+        assert st2["shardedPath"] is True

@@ -255,6 +255,10 @@ def test_rest_state(server):
     assert code == 200
     assert set(body) >= {"MonitorState", "ExecutorState", "AnalyzerState",
                          "AnomalyDetectorState"}
+    # mesh-policy surface: this server boots without optimizer.mesh.enable,
+    # so the sharded path reports inactive
+    assert body["AnalyzerState"]["meshDevices"] == 0
+    assert body["AnalyzerState"]["shardedPath"] is False
     code, body = _get(server, "/kafkacruisecontrol/state?substates=monitor")
     assert list(body) == ["MonitorState"]
 
