@@ -74,6 +74,8 @@ def main():
         return _bench_selfheal(seed)
     if size == "xl":
         return _bench_xl(seed)
+    if size == "scenarios":
+        return _bench_scenarios(seed)
 
     # optional mesh for the standard legs: BENCH_MESH_DEVICES=N shards the
     # anneal/rescore over N devices of the default backend; 0 (default)
@@ -637,6 +639,79 @@ def _bench_selfheal(seed: int):
         "broker0_evacuated": bool((bo_rm != 0).all()),
         "violated_goals_after_add": len(r_add.violated_goals_after),
         "violated_goals_after_remove": len(r_rm.violated_goals_after),
+        "device": str(jax.devices()[0].platform),
+    }))
+
+
+def _bench_scenarios(seed: int):
+    """Scenario suite: three canonical time-axis scenarios (a diurnal week
+    at one-hour ticks, a flash crowd, and a broker death mid-diurnal)
+    through the real control loop on the simulated cluster. The scored
+    quantities are *closed-loop*: convergence ticks, SLO-violation counts,
+    and per-tick wall latency with every subsystem (monitor ingest,
+    detector sweeps, anneal, executor) in the loop."""
+    import jax
+
+    from cruise_control_tpu import simulator as SIM
+
+    suite = (
+        SIM.Scenario(
+            name="diurnal-week", seed=seed, ticks=56, tick_ms=3_600_000,
+            num_brokers=6, partitions_per_topic=6, warmup_ticks=4,
+            workload=SIM.DiurnalWorkload(seed=seed, period_ms=28_800_000)),
+        SIM.Scenario(
+            name="flash-crowd", seed=seed, ticks=30, tick_ms=60_000,
+            num_brokers=6, partitions_per_topic=6, warmup_ticks=4,
+            workload=SIM.FlashCrowdWorkload(
+                seed=seed, onset_ms=10 * 60_000, ramp_ms=2 * 60_000,
+                decay_ms=8 * 60_000, peak_multiplier=5.0,
+                hot_topics=("T0",))),
+        SIM.Scenario(
+            name="kill-broker", seed=seed, ticks=30, tick_ms=60_000,
+            num_brokers=6, partitions_per_topic=6, warmup_ticks=4,
+            faults=SIM.FaultSchedule(events=(
+                SIM.FaultEvent(tick=10, kind="kill_broker", broker_id=2),),
+                seed=seed)),
+    )
+    per_scenario = {}
+    total_ticks = slo_violations = 0
+    walls = []
+    t0 = time.time()
+    for sc in suite:
+        card = SIM.run_scenario(sc)
+        core, wall = card.core, card.wall
+        sc_slo = (wall["sloTickViolations"] + wall["sloSelfHealViolations"]
+                  + core["sloHealTickViolations"])
+        slo_violations += sc_slo
+        total_ticks += core["ticks"]
+        walls.append((wall["tickWallMsP50"], wall["tickWallMsP99"]))
+        per_scenario[sc.name] = {
+            "convergence_tick": core["convergenceTick"],
+            "converged": core["converged"],
+            "replica_moves": core["totalReplicaMoves"],
+            "move_churn": core["moveChurn"],
+            "fallbacks": core["fallbackEvents"],
+            "goal_violation_ticks": core["goalViolationTicks"],
+            "slo_violations": sc_slo,
+            "tick_p50_ms": wall["tickWallMsP50"],
+            "tick_p99_ms": wall["tickWallMsP99"],
+            "heal_ticks": [h["healTicks"] for h in core["selfHeal"]],
+        }
+    elapsed = time.time() - t0
+    # vs_baseline: virtual cluster-time simulated per wall-second — the
+    # quantity that makes scenario regression suites affordable — against a
+    # 1x real-time baseline (a wall-clock replay harness)
+    virtual_s = sum(sc.ticks * sc.tick_ms for sc in suite) / 1000.0
+    print(json.dumps({
+        "metric": "scenario_suite_wall_clock",
+        "value": round(elapsed, 3), "unit": "s",
+        "vs_baseline": round(virtual_s / max(elapsed, 1e-9), 1),
+        "scenarios": len(suite),
+        "total_ticks": total_ticks,
+        "slo_violations": slo_violations,
+        "tick_p50_ms": round(max(w[0] for w in walls), 3),
+        "tick_p99_ms": round(max(w[1] for w in walls), 3),
+        "per_scenario": per_scenario,
         "device": str(jax.devices()[0].platform),
     }))
 

@@ -70,9 +70,18 @@ class CruiseControlApp:
                  sampler: Optional[MetricSampler] = None,
                  cluster_adapter: Optional[ClusterAdapter] = None,
                  capacity_resolver=None, sample_store=None,
-                 mesh=None):
+                 mesh=None, now_fn=None, sleep_fn=None):
         from cruise_control_tpu.common.config import resolve_pluggable
         self.config = config
+        # virtual-time seam: every timestamp that drives *decisions* (cache
+        # freshness, detector thresholds, executor deadlines) flows through
+        # now_fn/sleep_fn so the scenario simulator can run hours of cluster
+        # time in seconds of wall time. Wall-clock *measurements* (tick
+        # latency, self-heal latency) intentionally stay on time.monotonic.
+        self._now_s = now_fn or time.time
+        self._sleep_fn = sleep_fn or time.sleep
+        _now_s = self._now_s
+        self._now_ms_fn = lambda: int(_now_s() * 1000)
         self.constraint = config.balancing_constraint()
         self.default_goals = tuple(config.get("default.goals"))
         if mesh is None:
@@ -156,7 +165,8 @@ class CruiseControlApp:
             partition_completeness_cache_size=config.get(
                 "partition.metric.sample.aggregator.completeness.cache.size"),
             broker_completeness_cache_size=config.get(
-                "broker.metric.sample.aggregator.completeness.cache.size"))
+                "broker.metric.sample.aggregator.completeness.cache.size"),
+            now_fn=self._now_ms_fn if now_fn is not None else None)
         self._metadata_source = metadata_source
         adapter = cluster_adapter or FakeClusterAdapter({})
         check_ms = config.get("execution.progress.check.interval.ms")
@@ -173,6 +183,8 @@ class CruiseControlApp:
         self.executor = Executor(
             adapter,
             strategy=_chain,
+            clock=self._now_s,
+            sleep=self._sleep_fn,
             notifier=resolve_pluggable(
                 config.get("executor.notifier.class"),
                 EXECUTOR_NOTIFIER_REGISTRY, base=ExecutorNotifier)(),
@@ -212,13 +224,18 @@ class CruiseControlApp:
         notifier_cls = resolve_pluggable(
             config.get("anomaly.notifier.class"), NOTIFIER_REGISTRY,
             base=AnomalyNotifier)
-        notifier = notifier_cls(
+        _notifier_kw = dict(
             broker_failure_alert_threshold_ms=config.get(
                 "broker.failure.alert.threshold.ms"),
             self_healing_threshold_ms=config.get(
                 "broker.failure.self.healing.threshold.ms"),
             enabled={t: bool(config.get("self.healing.enabled"))
                      for t in AnomalyType})
+        try:
+            notifier = notifier_cls(now_fn=self._now_ms_fn, **_notifier_kw)
+        except TypeError:
+            # a pluggable notifier predating the virtual-time seam
+            notifier = notifier_cls(**_notifier_kw)
         # the full finder suite the reference schedules
         # (AnomalyDetector.java:167-180): broker failure, goal violation,
         # disk failure (adapter logdir state), metric anomaly and slow-broker
@@ -254,6 +271,7 @@ class CruiseControlApp:
                                   or None),
                     report_backoff_ms=config.get(
                         "broker.failure.detection.backoff.ms"),
+                    now_fn=self._now_ms_fn,
                     anomaly_class=resolve_anomaly_class(
                         config.get("broker.failures.class"), BrokerFailures),
                 ).detect,
@@ -266,9 +284,11 @@ class CruiseControlApp:
                         config.get("goal.violations.class"), GoalViolations),
                     provisioner=self.provisioner,
                     on_recommendation=self._record_provision_recommendation,
+                    now_fn=self._now_ms_fn,
                 ).detect,
                 "disk_failure": DiskFailureDetector(
                     adapter.describe_logdirs,
+                    now_fn=self._now_ms_fn,
                     anomaly_class=resolve_anomaly_class(
                         config.get("disk.failures.class"), DiskFailures),
                 ).detect,
@@ -283,12 +303,14 @@ class CruiseControlApp:
                     upper_percentile=config.get(
                         "metric.anomaly.percentile.upper.threshold"),
                     lower_percentile=config.get(
-                        "metric.anomaly.percentile.lower.threshold")).detect,
+                        "metric.anomaly.percentile.lower.threshold"),
+                    now_fn=self._now_ms_fn).detect,
                 "slow_broker": SlowBrokerFinder(
                     self.load_monitor.broker_metric_history,
                     score_threshold=config.get("slow.broker.demotion.score"),
                     removal_threshold=config.get(
-                        "slow.broker.decommission.score")).detect,
+                        "slow.broker.decommission.score"),
+                    now_fn=self._now_ms_fn).detect,
             },
             interval_ms=config.get("anomaly.detection.interval.ms"),
             intervals_ms={
@@ -300,7 +322,8 @@ class CruiseControlApp:
                     "disk.failure.detection.interval.ms"),
             },
             recheck_delay_ms=config.get("anomaly.detection.recheck.delay.ms"),
-            num_cached_states=config.get("num.cached.recent.anomaly.states"))
+            num_cached_states=config.get("num.cached.recent.anomaly.states"),
+            now_fn=self._now_ms_fn)
         self._proposal_cache: Optional[CachedProposals] = None
         self._cache_lock = threading.Lock()
         #: one-shot: escape kernels warmed after the first default-goal
@@ -338,6 +361,9 @@ class CruiseControlApp:
         #: annealer's sampler) or "full" (healing without a mask)
         self.last_self_heal_ms: Optional[float] = None
         self.self_heal_path: Optional[str] = None
+        #: most recent scenario-simulator scorecard (surfaced in /state as
+        #: SimulatorState; guarded by _cache_lock)
+        self._last_simulation: Optional[dict] = None
 
     # ----------------------------------------------------------------- boot
 
@@ -390,7 +416,7 @@ class CruiseControlApp:
             if c is None:
                 return None
             gen = self.load_monitor.model_generation()
-            age = time.time() * 1000 - c.computed_at_ms
+            age = self._now_s() * 1000 - c.computed_at_ms
             if (not c.generation.is_stale(gen)
                     and age < self.config.get("proposal.expiration.ms")):
                 return c.result
@@ -465,7 +491,7 @@ class CruiseControlApp:
         if c is None or rs is None or rs.digest is None:
             return False
         # expiration still applies: an expired cache must be recomputed
-        age = time.time() * 1000 - c.computed_at_ms
+        age = self._now_s() * 1000 - c.computed_at_ms
         if age >= self.config.get("proposal.expiration.ms"):
             return False
         # generation BEFORE the model build, same staleness discipline as
@@ -495,7 +521,7 @@ class CruiseControlApp:
             return False
         with self._cache_lock:
             self._proposal_cache = CachedProposals(
-                c.result, gen_now, int(time.time() * 1000))
+                c.result, gen_now, int(self._now_s() * 1000))
             rs.dt = out.dt       # next tick splices against these arrays
             self.incremental_refreshes += 1
             self.anneal_skips += 1
@@ -541,7 +567,7 @@ class CruiseControlApp:
                 self._last_fallback = {
                     "engine": res.engine,
                     "reason": res.fallback_reason,
-                    "atMs": int(time.time() * 1000)}
+                    "atMs": int(self._now_s() * 1000)}
         if res.heal_path is not None:
             # self-heal timing: every healing entry point (add/remove
             # brokers, fix_offline_replicas, destination-constrained
@@ -759,7 +785,7 @@ class CruiseControlApp:
                            exc_info=True)
         with self._cache_lock:
             self._proposal_cache = CachedProposals(
-                result, gen0, int(time.time() * 1000))
+                result, gen0, int(self._now_s() * 1000))
             self._rescore_state = rs
         import jax
         if (not self._escape_kernels_warmed
@@ -865,6 +891,12 @@ class CruiseControlApp:
         goal-violation detector and the RIGHTSIZE runnable)."""
         with self._cache_lock:
             self._last_provision_recommendation = rec.to_dict()
+
+    def record_simulation_scorecard(self, scorecard: dict) -> None:
+        """Latest scenario-simulator scorecard, surfaced in /state as
+        SimulatorState (called by simulator.run_scenario)."""
+        with self._cache_lock:
+            self._last_simulation = dict(scorecard)
 
     def what_if(self, add_broker_counts: Sequence[int] = (),
                 add_broker_rack: Optional[str] = None,
@@ -1442,6 +1474,7 @@ class CruiseControlApp:
             last_tick_ms = self.last_tick_ms
             last_self_heal_ms = self.last_self_heal_ms
             self_heal_path = self.self_heal_path
+            last_simulation = self._last_simulation
         out = {
             "MonitorState": self.load_monitor.state_snapshot(),
             "ExecutorState": self.executor.state_snapshot(),
@@ -1462,6 +1495,8 @@ class CruiseControlApp:
             },
             "AnomalyDetectorState": self.anomaly_detector.state_snapshot(),
         }
+        if last_simulation is not None:
+            out["SimulatorState"] = last_simulation
         if super_verbose:
             out["MonitorState"]["extrapolatedMetricSamples"] = (
                 self.load_monitor.sample_extrapolations())

@@ -125,6 +125,15 @@ class FaultyClusterAdapter:
         self._forced_dead: Set[int] = set()
         self._forced_bad_disks: Dict[int, Dict[str, bool]] = {}
 
+    def set_plan(self, plan: FaultPlan) -> None:
+        """Swap the active fault plan. ``self.plan`` is read per guarded
+        call, so a scenario runner can retarget faults tick-by-tick (latency
+        storms that start and end, a broker death armed mid-run) without
+        rebuilding the wrapper — the call counter, consecutive-failure
+        state, and injection tallies all carry across the swap."""
+        self.plan = plan
+        self._rng = random.Random(plan.seed)
+
     # -- fault machinery --
     def _guard(self, method: str) -> None:
         plan = self.plan

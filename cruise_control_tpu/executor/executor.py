@@ -397,11 +397,20 @@ class Executor:
     def __init__(self, adapter: ClusterAdapter,
                  config: Optional[ExecutorConfig] = None,
                  notifier: Optional[ExecutorNotifier] = None,
-                 strategy: Optional[ReplicaMovementStrategy] = None):
+                 strategy: Optional[ReplicaMovementStrategy] = None,
+                 clock: Callable[[], float] = time.time,
+                 sleep: Callable[[float], None] = time.sleep):
         self.adapter = adapter
         self.config = config or ExecutorConfig()
         self.notifier = notifier or ExecutorNotifier()
         self._strategy = strategy
+        # virtual-time seam: every deadline/timestamp decision (stuck tasks,
+        # alerting thresholds, history retention) reads ``clock``; every
+        # poll-interval and retry-backoff wait goes through ``sleep``. A
+        # scenario run passes a VirtualClock so a simulated latency storm
+        # costs zero wall time.
+        self._clock = clock
+        self._sleep_fn = sleep
         self._state = ExecutorState.NO_TASK_IN_PROGRESS
         self._stop_requested = threading.Event()
         self._force_stop = threading.Event()
@@ -426,7 +435,8 @@ class Executor:
         """The retrying view of ``self.adapter`` — built per access so a
         swapped-in adapter (tests) is always the one retried."""
         return RetryingClusterAdapter(self.adapter, self.config,
-                                      on_retry=self._note_retry)
+                                      on_retry=self._note_retry,
+                                      sleep=self._sleep_fn)
 
     def _note_retry(self, method: str) -> None:
         self._exec_retries += 1
@@ -440,7 +450,7 @@ class Executor:
     def _pruned_history(self, hist: Dict[int, float],
                         retention_ms: int) -> Set[int]:
         with self._history_lock:
-            cutoff = time.time() - retention_ms / 1000.0
+            cutoff = self._clock() - retention_ms / 1000.0
             for b in [b for b, ts in hist.items() if ts < cutoff]:
                 del hist[b]
             return set(hist)
@@ -460,7 +470,7 @@ class Executor:
             self.config.demotion_history_retention_ms)
 
     def record_history(self, removed_brokers=(), demoted_brokers=()):
-        now = time.time()
+        now = self._clock()
         with self._history_lock:
             self._removal_history.update(
                 {int(b): now for b in removed_brokers})
@@ -563,7 +573,7 @@ class Executor:
             self._exec_retries = 0
             self._exec_task_failures = 0
             self._exec_stuck = 0
-            t0 = time.time()
+            t0 = self._clock()
             self._interval_override_ms = progress_check_interval_ms
             planner = ExecutionTaskPlanner(strategy)
             planner.add_proposals(proposals)
@@ -629,7 +639,7 @@ class Executor:
                         "failed to clear replication throttles after "
                         "execution (adapter retries exhausted)")
                     REGISTRY.counter("throttle-clear-failed-rate")
-            duration_s = time.time() - t0
+            duration_s = self._clock() - t0
             summary = {
                 "stopped": self._stop_requested.is_set(),
                 "forcedStop": self._force_stop.is_set(),
@@ -685,7 +695,7 @@ class Executor:
             if self.has_ongoing_execution:
                 raise RuntimeError("An execution is already in progress")
             self._state = ExecutorState.INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
-        t0 = time.time()
+        t0 = self._clock()
         applied = 0
         data_mb = 0.0
         try:
@@ -700,7 +710,7 @@ class Executor:
                                for m in batch)
                 if self._stop_requested.is_set():
                     break
-            dur = time.time() - t0
+            dur = self._clock() - t0
             out = {"intraBrokerMoves": applied,
                    "stopped": applied < len(moves),
                    "durationSeconds": round(dur, 3)}
@@ -743,7 +753,7 @@ class Executor:
                 per_broker, self.tracker.in_flight_by_broker)
             if not batch:
                 break
-            now = int(time.time() * 1000)
+            now = int(self._clock() * 1000)
             for t in batch:
                 t.transition(TaskState.IN_PROGRESS, now)
                 self.tracker.mark(t, TaskState.PENDING)
@@ -762,7 +772,7 @@ class Executor:
                 or self.config.num_concurrent_leader_movements)
             if not batch:
                 break
-            now = int(time.time() * 1000)
+            now = int(self._clock() * 1000)
             for t in batch:
                 t.transition(TaskState.IN_PROGRESS, now)
                 self.tracker.mark(t, TaskState.PENDING)
@@ -812,7 +822,7 @@ class Executor:
                 logger.exception(
                     "task %s failed to submit after retries; marking it DEAD",
                     t.proposal.topic_partition)
-                self._fail_task(t, int(time.time() * 1000))
+                self._fail_task(t, int(self._clock() * 1000))
         return survivors
 
     def _fail_task(self, task: ExecutionTask, now_ms: int) -> None:
@@ -871,25 +881,25 @@ class Executor:
         budget = (max_rounds if max_rounds is not None
                   else self.config.max_execution_progress_check_rounds)
         open_tasks = list(batch)
-        batch_t0 = time.time()
+        batch_t0 = self._clock()
         alerted = False
         deadline_ms = self.config.task_stuck_deadline_ms
         # per-task (last probe, wall time it last changed)
         progress: Dict[int, Tuple[object, float]] = {
             id(t): (None, batch_t0) for t in open_tasks}
         while open_tasks and rounds < budget:
-            if (not alerted and (time.time() - batch_t0) * 1000
+            if (not alerted and (self._clock() - batch_t0) * 1000
                     > self.config.task_execution_alerting_threshold_ms):
                 # task.execution.alerting.threshold.ms: surface slow batches
                 alerted = True
                 logger.warning(
                     "%d execution tasks still in flight after %.0f s "
                     "(alerting threshold %.0f s)", len(open_tasks),
-                    time.time() - batch_t0,
+                    self._clock() - batch_t0,
                     self.config.task_execution_alerting_threshold_ms / 1000.0)
             rounds += 1
-            now = int(time.time() * 1000)
-            wall = time.time()
+            now = int(self._clock() * 1000)
+            wall = self._clock()
             still = []
             aborting: List[ExecutionTask] = []
             stuck: List[ExecutionTask] = []
@@ -981,10 +991,10 @@ class Executor:
                     self.tracker.mark(t, TaskState.ABORTING)
             open_tasks = still
             if open_tasks:
-                time.sleep(self._effective_check_interval_ms() / 1000.0)
+                self._sleep_fn(self._effective_check_interval_ms() / 1000.0)
         if open_tasks:   # round budget exhausted
             self._timed_out = True
-            now = int(time.time() * 1000)
+            now = int(self._clock() * 1000)
             for t in open_tasks:
                 prev = t.state
                 t.transition(TaskState.DEAD, now)

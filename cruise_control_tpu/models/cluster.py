@@ -150,7 +150,11 @@ class ClusterTopology:
 
     @property
     def num_topics(self) -> int:
-        return len(self.topic_names) if self.topic_names else int(self.topic_of_partition.max()) + 1
+        if self.topic_names:
+            return len(self.topic_names)
+        if self.topic_of_partition.shape[0] == 0:
+            return 0
+        return int(self.topic_of_partition.max()) + 1
 
     @property
     def max_rf(self) -> int:

@@ -539,6 +539,14 @@ class LoadMonitor:
                     f"monitored partition ratio "
                     f"{result.completeness.valid_entity_ratio:.3f} below "
                     f"{requirements.min_monitored_partitions_percentage}")
+            if (result.completeness.num_valid_entities == 0
+                    and not requirements.include_all_topics):
+                # a 0.0 min ratio makes windows trivially valid even when NO
+                # partition has samples (e.g. the monitor starved through a
+                # latency storm) — a zero-partition model is useless to every
+                # caller and crashes the analyzer, so refuse to build it
+                raise NotEnoughValidWindowsError(
+                    "0 valid partitions in the aggregation windows")
             return self._build_model(
                 metadata, result,
                 include_all_topics=requirements.include_all_topics)

@@ -260,3 +260,17 @@ SAMPLER_REGISTRY = {
     # the reference default's class name, mapped to its analogue here
     "CruiseControlMetricsReporterSampler": _kafka_sampler_factory,
 }
+
+
+def _workload_factory(name):
+    # simulator workload generators, importable by name through the same
+    # SPI (lazy import: sampler.py must not depend on the simulator package)
+    def factory(config):
+        from cruise_control_tpu.simulator import workloads as W
+        return W.WORKLOAD_REGISTRY[name]()
+    return factory
+
+
+for _name in ("DiurnalWorkload", "SpikeWorkload", "FlashCrowdWorkload",
+              "TopicGrowthWorkload", "HotspotDriftWorkload"):
+    SAMPLER_REGISTRY[_name] = _workload_factory(_name)

@@ -362,7 +362,9 @@ class RestApi:
         """CruiseControlState. AnalyzerState carries the mesh-policy
         surface (meshDevices: device count, 0 when unmeshed; shardedPath:
         whether optimize/warm-up run the sharded kernels) alongside the
-        proposal/tick fields."""
+        proposal/tick fields. SimulatorState (present after a scenario
+        run — docs/simulation.md) carries the latest scorecard and is
+        addressable via ``substates=simulator``."""
         state = self.app.state(
             super_verbose=_parse_bool(params, "super_verbose", False))
         substates = _parse_csv(params, "substates")

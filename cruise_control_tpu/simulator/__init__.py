@@ -1,0 +1,45 @@
+"""Time-axis scenario simulator: workloads + fault schedules through the
+real control loop, scored against SLOs. See docs/simulation.md."""
+
+from cruise_control_tpu.simulator.clock import VirtualClock
+from cruise_control_tpu.simulator.cluster import SimulatedKafkaCluster
+from cruise_control_tpu.simulator.faults import (
+    DIRECT_KINDS,
+    WINDOW_KINDS,
+    FaultEvent,
+    FaultSchedule,
+)
+from cruise_control_tpu.simulator.scenario import (
+    Scenario,
+    Scorecard,
+    SLOBudget,
+    build_app,
+    run_scenario,
+)
+from cruise_control_tpu.simulator.score import (
+    batched_goal_violations,
+    snapshot_model,
+    violation_ticks,
+)
+from cruise_control_tpu.simulator.workloads import (
+    WORKLOAD_REGISTRY,
+    CompositeWorkload,
+    DiurnalWorkload,
+    FlashCrowdWorkload,
+    HotspotDriftWorkload,
+    SpikeWorkload,
+    TopicGrowthWorkload,
+    TraceReplayWorkload,
+    WorkloadGenerator,
+    record_trace,
+)
+
+__all__ = [
+    "VirtualClock", "SimulatedKafkaCluster", "FaultEvent", "FaultSchedule",
+    "DIRECT_KINDS", "WINDOW_KINDS", "Scenario", "SLOBudget", "Scorecard",
+    "build_app", "run_scenario", "snapshot_model", "batched_goal_violations",
+    "violation_ticks", "WorkloadGenerator", "DiurnalWorkload",
+    "SpikeWorkload", "FlashCrowdWorkload", "TopicGrowthWorkload",
+    "HotspotDriftWorkload", "CompositeWorkload", "TraceReplayWorkload",
+    "record_trace", "WORKLOAD_REGISTRY",
+]

@@ -1,0 +1,47 @@
+"""Virtual time for the scenario simulator.
+
+Every time-dependent seam in the control loop is injectable — the monitor,
+the detectors, the self-healing notifier, the executor's deadlines, and the
+fault adapter's latency sleeps all take ``now_fn``/``sleep`` callables. A
+:class:`VirtualClock` closes them over one mutable timestamp, so a simulated
+week of diurnal traffic (or a 30 s latency storm inside an execution) costs
+zero wall time while every deadline/backoff/threshold computation sees the
+same consistent timeline.
+
+The clock only moves forward, and only when the scenario runner advances it
+(tick boundaries) or a component "sleeps" (executor poll intervals, retry
+backoffs, injected latency). That makes a scenario a deterministic function
+of (seed, schedule): there is no wall-clock leakage into any recorded
+virtual timestamp.
+"""
+
+from __future__ import annotations
+
+
+class VirtualClock:
+    """A monotonically-advancing simulated clock.
+
+    ``now_s``/``now_ms`` are drop-in replacements for ``time.time`` and the
+    millisecond ``now_fn`` seams; ``sleep`` replaces ``time.sleep`` and
+    advances virtual time instead of blocking.
+    """
+
+    def __init__(self, start_ms: int = 0):
+        self._now_ms = float(start_ms)
+
+    def now_ms(self) -> int:
+        return int(self._now_ms)
+
+    def now_s(self) -> float:
+        """``time.time`` replacement (seconds, float)."""
+        return self._now_ms / 1000.0
+
+    def advance_ms(self, ms: float) -> None:
+        if ms < 0:
+            raise ValueError(f"cannot advance a clock backwards ({ms} ms)")
+        self._now_ms += float(ms)
+
+    def sleep(self, seconds: float) -> None:
+        """``time.sleep`` replacement: advancing time IS the sleep."""
+        if seconds > 0:
+            self._now_ms += float(seconds) * 1000.0

@@ -1,0 +1,250 @@
+"""SimulatedKafkaCluster: one mutable state behind both loop seams.
+
+The control loop touches the cluster through two interfaces: the monitor
+reads ``MetadataSource.get_metadata()`` and the executor applies movements
+through ``ClusterAdapter``. FakeClusterAdapter only implements the second,
+so in every existing test the *model* the analyzer optimizes is frozen
+metadata — proposals never feed back. This class holds topology and
+liveness as one mutable, generation-stamped state: a reassignment the
+executor completes changes the PartitionMetadata the monitor reads on the
+next tick, and a ``kill_broker`` fault changes both the metadata (the
+BrokerFailureDetector's input) and the adapter view (``dead_brokers``) at
+the same instant, exactly like a real cluster.
+
+Reassignments follow FakeClusterAdapter's poll discipline: submitted moves
+apply after ``latency_polls`` progress probes of that partition, so the
+executor's batching/abort/stuck logic is exercised for real.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from cruise_control_tpu.monitor.sampler import (
+    BrokerMetadata,
+    ClusterMetadata,
+    PartitionMetadata,
+)
+
+
+class SimulatedKafkaCluster:
+    """Mutable in-memory cluster: MetadataSource + ClusterAdapter in one."""
+
+    def __init__(self, brokers: Sequence[BrokerMetadata],
+                 partitions: Sequence[PartitionMetadata],
+                 latency_polls: int = 1):
+        self._brokers: Dict[int, BrokerMetadata] = {
+            b.broker_id: dataclasses.replace(b) for b in brokers}
+        self._parts: Dict[str, PartitionMetadata] = {}
+        self._order: List[str] = []
+        for p in partitions:
+            tp = f"{p.topic}-{p.partition}"
+            self._parts[tp] = dataclasses.replace(p)
+            self._order.append(tp)
+        self.latency = latency_polls
+        self.generation = 1
+        self._pending: Dict[str, Tuple[int, Tuple[int, ...]]] = {}
+        self._pending_ple: Dict[str, Tuple[int, Tuple[int, ...]]] = {}
+        self.broker_throttle_rates: Dict[int, int] = {}
+        self.topic_throttled_replicas: Dict[str, Dict[str, Tuple[str, ...]]] = {}
+        self.logdir_state: Dict[int, Dict[str, bool]] = {
+            b.broker_id: {"/data/d0": True} for b in brokers}
+        #: movement tallies + per-partition move counts (scorecard churn)
+        self.moves_applied = 0
+        self.leadership_moves_applied = 0
+        self.move_count_by_tp: Dict[str, int] = {}
+
+    # ------------------------------------------------------------ factory
+    @classmethod
+    def build(cls, num_brokers: int, num_racks: int = 2,
+              topics: Sequence[str] = ("T0", "T1"),
+              partitions_per_topic: int = 4, rf: int = 2,
+              latency_polls: int = 1) -> "SimulatedKafkaCluster":
+        """Deterministic small-cluster layout: brokers round-robin across
+        racks, replica sets striped so every broker leads something."""
+        rf = min(rf, num_brokers)
+        brokers = [BrokerMetadata(i, rack=f"r{i % num_racks}", host=f"h{i}")
+                   for i in range(num_brokers)]
+        partitions = []
+        for ti, topic in enumerate(topics):
+            for p in range(partitions_per_topic):
+                lead = (ti + p) % num_brokers
+                reps = tuple((lead + k) % num_brokers for k in range(rf))
+                partitions.append(PartitionMetadata(
+                    topic, p, leader=lead, replicas=reps, isr=reps))
+        return cls(brokers, partitions, latency_polls=latency_polls)
+
+    # ----------------------------------------------------- MetadataSource
+    def get_metadata(self) -> ClusterMetadata:
+        """Generation-stamped snapshot of the current simulated state."""
+        return ClusterMetadata(
+            brokers=[dataclasses.replace(self._brokers[b])
+                     for b in sorted(self._brokers)],
+            partitions=[dataclasses.replace(self._parts[tp])
+                        for tp in self._order],
+            generation=self.generation)
+
+    # -------------------------------------------------- fake-compat views
+    @property
+    def replicas(self) -> Dict[str, Tuple[int, ...]]:
+        return {tp: p.replicas for tp, p in self._parts.items()}
+
+    @property
+    def leaders(self) -> Dict[str, int]:
+        return {tp: p.leader for tp, p in self._parts.items()}
+
+    def replicas_on_broker(self, broker_id: int) -> Set[str]:
+        return {tp for tp, p in self._parts.items()
+                if broker_id in p.replicas}
+
+    # ------------------------------------------------------ fault surface
+    def kill_broker(self, broker_id: int) -> None:
+        """Broker death: metadata alive=False, leadership fails over to the
+        first surviving replica, stranded replicas go offline."""
+        b = self._brokers.get(int(broker_id))
+        if b is None or not b.alive:
+            return
+        b.alive = False
+        for p in self._parts.values():
+            if broker_id in p.replicas:
+                off = set(p.offline_replicas) | {broker_id}
+                p.offline_replicas = tuple(sorted(off))
+                p.isr = tuple(r for r in p.isr if r != broker_id)
+            if p.leader == broker_id:
+                survivors = [r for r in p.replicas
+                             if self._brokers.get(r) is not None
+                             and self._brokers[r].alive]
+                p.leader = survivors[0] if survivors else -1
+        self.generation += 1
+
+    def restore_broker(self, broker_id: int) -> None:
+        b = self._brokers.get(int(broker_id))
+        if b is None or b.alive:
+            return
+        b.alive = True
+        for p in self._parts.values():
+            if broker_id in p.offline_replicas:
+                p.offline_replicas = tuple(
+                    r for r in p.offline_replicas if r != broker_id)
+                p.isr = tuple(sorted(set(p.isr) | {broker_id}))
+            if p.leader < 0 and broker_id in p.replicas:
+                p.leader = broker_id
+        self.generation += 1
+
+    def fail_disk(self, broker_id: int, logdir: str = "/data/d0") -> None:
+        self.logdir_state.setdefault(int(broker_id), {})[logdir] = False
+
+    def restore_disk(self, broker_id: int, logdir: str = "/data/d0") -> None:
+        self.logdir_state.setdefault(int(broker_id), {})[logdir] = True
+
+    # -------------------------------------------------- ClusterAdapter API
+    def execute_replica_reassignments(self, tasks) -> None:
+        for t in tasks:
+            self._pending[t.proposal.topic_partition] = (
+                self.latency, t.proposal.new_replicas)
+
+    def execute_preferred_leader_elections(self, tasks) -> None:
+        for t in tasks:
+            self._pending_ple[t.proposal.topic_partition] = (
+                self.latency, t.proposal.new_replicas)
+
+    def current_replicas(self, tp: str) -> Tuple[int, ...]:
+        self._tick(tp)
+        p = self._parts.get(tp)
+        return p.replicas if p is not None else ()
+
+    def current_leader(self, tp: str) -> int:
+        self._tick(tp)
+        p = self._parts.get(tp)
+        return p.leader if p is not None else -1
+
+    def in_progress_reassignments(self) -> Set[str]:
+        return set(self._pending)
+
+    def cancel_reassignments(self, tasks) -> None:
+        for t in tasks:
+            self._pending.pop(t.proposal.topic_partition, None)
+
+    def set_broker_throttle_rate(self, broker_ids, rate) -> None:
+        for b in broker_ids:
+            self.broker_throttle_rates[int(b)] = rate
+
+    def clear_broker_throttle_rate(self, broker_ids) -> None:
+        for b in broker_ids:
+            self.broker_throttle_rates.pop(int(b), None)
+
+    def set_topic_throttled_replicas(self, topic, leader_entries,
+                                     follower_entries) -> None:
+        self.topic_throttled_replicas[topic] = {
+            "leader": tuple(leader_entries),
+            "follower": tuple(follower_entries)}
+
+    def clear_topic_throttled_replicas(self, topic) -> None:
+        self.topic_throttled_replicas.pop(topic, None)
+
+    def dead_brokers(self) -> Set[int]:
+        return {b for b, meta in self._brokers.items() if not meta.alive}
+
+    def describe_logdirs(self) -> Dict[int, Dict[str, bool]]:
+        return {b: dict(dirs) for b, dirs in self.logdir_state.items()}
+
+    def alter_replica_logdirs(self, moves) -> None:
+        self.logdir_by_tp_broker = getattr(self, "logdir_by_tp_broker", {})
+        for m in moves:
+            self.logdir_by_tp_broker[
+                (f"{m.topic}-{m.partition}", m.broker_id)] = m.to_logdir
+
+    # ---------------------------------------------------------- mechanics
+    def _tick(self, tp: str) -> None:
+        """Apply a pending movement once its poll latency elapses — and,
+        unlike the fake, fold the result back into the metadata the monitor
+        reads (replica set, leader, offline flags, generation)."""
+        if tp in self._pending:
+            n, target = self._pending[tp]
+            if n <= 1:
+                del self._pending[tp]
+                self._apply_reassignment(tp, target)
+            else:
+                self._pending[tp] = (n - 1, target)
+        if tp in self._pending_ple:
+            n, new_order = self._pending_ple[tp]
+            if n <= 1:
+                del self._pending_ple[tp]
+                self._apply_leadership(tp, new_order)
+            else:
+                self._pending_ple[tp] = (n - 1, new_order)
+
+    def _apply_reassignment(self, tp: str,
+                            target: Tuple[int, ...]) -> None:
+        p = self._parts.get(tp)
+        if p is None:
+            return
+        p.replicas = tuple(target)
+        alive = [r for r in target
+                 if self._brokers.get(r) is not None
+                 and self._brokers[r].alive]
+        p.isr = tuple(alive)
+        p.offline_replicas = tuple(r for r in target if r not in alive)
+        if p.leader not in alive:
+            p.leader = alive[0] if alive else -1
+        self.moves_applied += 1
+        self.move_count_by_tp[tp] = self.move_count_by_tp.get(tp, 0) + 1
+        self.generation += 1
+
+    def _apply_leadership(self, tp: str,
+                          new_order: Tuple[int, ...]) -> None:
+        p = self._parts.get(tp)
+        if p is None:
+            return
+        lead = new_order[0]
+        b = self._brokers.get(lead)
+        if b is None or not b.alive:
+            return               # election against a dead broker: no-op
+        p.leader = lead
+        # the real adapter writes the FULL proposal order before the
+        # election; mirror it exactly when it is a pure reorder
+        if set(p.replicas) == set(new_order):
+            p.replicas = tuple(new_order)
+        self.leadership_moves_applied += 1
+        self.generation += 1

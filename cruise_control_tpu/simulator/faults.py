@@ -1,0 +1,114 @@
+"""Time-indexed fault schedules for scenario runs.
+
+PR 2's ``FaultPlan`` expresses *rates* over one adapter's whole lifetime;
+a scenario needs faults pinned to the time axis: "broker 2 dies at tick
+100", "a 5-tick latency storm starts at tick 40", "the next execution loses
+a broker 30 adapter calls in". :class:`FaultSchedule` is the bridge — the
+runner applies direct events at their tick and compiles the transient
+windows active at each tick into a fresh seeded ``FaultPlan`` for the
+``FaultyClusterAdapter`` wrapper (``set_plan`` swaps it per tick; the plan
+is read per guarded call, so mid-tick swaps are safe).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+from cruise_control_tpu.common.faults import FaultPlan
+
+#: events the runner applies directly against the simulated cluster/app
+DIRECT_KINDS = frozenset({
+    "kill_broker", "restore_broker", "fail_disk", "restore_disk",
+    "kill_broker_mid_execution", "stop_execution",
+})
+
+#: events that open a [tick, tick+duration) window of per-call fault rates
+WINDOW_KINDS = frozenset({
+    "latency_storm", "partial_batches", "transient_storm",
+})
+
+VALID_KINDS = DIRECT_KINDS | WINDOW_KINDS
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultEvent:
+    """One scheduled fault.
+
+    ``tick`` indexes the scenario loop (virtual time = tick × tick_ms).
+    Direct kinds fire once at their tick; window kinds stay active for
+    ``duration_ticks``. ``kill_broker_mid_execution`` arms the chaos
+    adapter to kill ``broker_id`` after ``calls_after`` more guarded
+    adapter calls — landing the death inside that tick's execution batch
+    rather than between ticks.
+    """
+
+    tick: int
+    kind: str
+    broker_id: Optional[int] = None
+    logdir: str = "/data/d0"
+    duration_ticks: int = 1
+    rate: float = 1.0
+    latency_s: float = 0.0
+    calls_after: int = 10
+
+    def __post_init__(self):
+        if self.kind not in VALID_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; "
+                             f"valid: {sorted(VALID_KINDS)}")
+        if self.tick < 0:
+            raise ValueError(f"fault tick must be >= 0, got {self.tick}")
+        if self.kind in WINDOW_KINDS and self.duration_ticks < 1:
+            raise ValueError("window faults need duration_ticks >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSchedule:
+    """The scenario's full fault timeline."""
+
+    events: Tuple[FaultEvent, ...] = ()
+    seed: int = 0
+
+    def __post_init__(self):
+        object.__setattr__(self, "events", tuple(self.events))
+
+    def direct_at(self, tick: int) -> Tuple[FaultEvent, ...]:
+        """Direct events that fire exactly at ``tick``."""
+        return tuple(e for e in self.events
+                     if e.kind in DIRECT_KINDS and e.tick == tick)
+
+    def windows_at(self, tick: int) -> Tuple[FaultEvent, ...]:
+        """Window events whose [tick, tick+duration) covers ``tick``."""
+        return tuple(e for e in self.events if e.kind in WINDOW_KINDS
+                     and e.tick <= tick < e.tick + e.duration_ticks)
+
+    def plan_for_tick(self, tick: int) -> FaultPlan:
+        """Compile the windows active at ``tick`` into one FaultPlan.
+
+        The seed mixes the schedule seed with the tick so each tick's
+        injection draws are independent of how many adapter calls earlier
+        ticks made — the property the byte-identical scorecard test pins.
+        Overlapping windows of one kind combine by max rate.
+        """
+        latency_rate = latency_s = partial = transient = 0.0
+        for e in self.windows_at(tick):
+            if e.kind == "latency_storm":
+                latency_rate = max(latency_rate, e.rate)
+                latency_s = max(latency_s, e.latency_s)
+            elif e.kind == "partial_batches":
+                partial = max(partial, e.rate)
+            elif e.kind == "transient_storm":
+                transient = max(transient, e.rate)
+        return FaultPlan(
+            seed=self.seed * 1_000_003 + tick,
+            latency_rate=latency_rate, latency_s=latency_s,
+            partial_batch_rate=partial,
+            transient_error_rate=transient)
+
+    def kill_broker_events(self) -> Tuple[FaultEvent, ...]:
+        """Broker-death events (both kinds), in tick order — the scorecard's
+        self-heal ground truth."""
+        return tuple(sorted(
+            (e for e in self.events
+             if e.kind in ("kill_broker", "kill_broker_mid_execution")),
+            key=lambda e: e.tick))

@@ -1,0 +1,426 @@
+"""Scenario runner: the real control loop over virtual time, scored.
+
+A :class:`Scenario` is a deterministic, seeded description of "a cluster +
+a workload + a fault timeline + SLO budgets". :func:`run_scenario` builds a
+:class:`~cruise_control_tpu.simulator.cluster.SimulatedKafkaCluster`, wraps
+it in the PR 2 chaos adapter (plan swapped per tick from the
+:class:`~cruise_control_tpu.simulator.faults.FaultSchedule`), boots a real
+``CruiseControlApp`` on a :class:`~cruise_control_tpu.simulator.clock.
+VirtualClock`, and steps the monitor→detector→analyzer→executor loop for
+``ticks`` virtual windows. Executed proposals mutate the simulated cluster,
+so the next tick's model reflects them — convergence, churn, and self-heal
+latency are measured on a genuinely closed loop.
+
+The :class:`Scorecard` separates two channels:
+
+- a **deterministic core** (pure function of the scenario: convergence
+  tick, movement totals, churn, goal-violation ticks, fault tallies,
+  self-heal virtual latencies, provisioner statuses) — serialized by
+  ``canonical_json()``, the byte-identical determinism contract;
+- a **wall section** (tick p50/p99, self-heal wall vs the PR 7 <10 s
+  budget, SLO violation counts, sentinel results) that depends on the host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import time as _time
+from contextlib import nullcontext
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+from cruise_control_tpu.simulator import score as SC
+from cruise_control_tpu.simulator.clock import VirtualClock
+from cruise_control_tpu.simulator.cluster import SimulatedKafkaCluster
+from cruise_control_tpu.simulator.faults import FaultEvent, FaultSchedule
+from cruise_control_tpu.simulator.workloads import DiurnalWorkload
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOBudget:
+    """Per-scenario service-level objectives."""
+
+    #: wall-clock budget for one control-loop tick
+    tick_wall_ms: float = 30_000.0
+    #: wall-clock budget for a self-heal optimize (the PR 7 <10 s contract)
+    self_heal_wall_ms: float = 10_000.0
+    #: virtual ticks allowed from broker death to full evacuation
+    heal_convergence_ticks: int = 10
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """Deterministic scenario spec; see docs/simulation.md."""
+
+    name: str
+    seed: int = 0
+    ticks: int = 60
+    tick_ms: int = 60_000
+    num_brokers: int = 4
+    num_racks: int = 2
+    topics: Tuple[str, ...] = ("T0", "T1")
+    partitions_per_topic: int = 4
+    rf: int = 2
+    #: MetricSampler; None → DiurnalWorkload over half the scenario span
+    workload: Optional[object] = None
+    faults: FaultSchedule = dataclasses.field(default_factory=FaultSchedule)
+    slo: SLOBudget = dataclasses.field(default_factory=SLOBudget)
+    #: control-loop ticks run before measurement starts (programs warm,
+    #: windows full) — the sentinel only wraps the measured ticks
+    warmup_ticks: int = 4
+    #: ground truth for provisioner-accuracy scoring (None = not scored)
+    expected_provision: Optional[str] = None
+    config_overrides: Tuple[Tuple[str, object], ...] = ()
+    latency_polls: int = 1
+
+
+@dataclasses.dataclass
+class Scorecard:
+    """Scenario verdict: deterministic core + host-dependent wall section."""
+
+    core: dict
+    wall: dict
+
+    def canonical_json(self) -> str:
+        """Byte-stable serialization of the deterministic core — two runs
+        of the same (seed, scenario) must produce identical strings."""
+        return json.dumps(self.core, sort_keys=True, separators=(",", ":"))
+
+    def to_json(self) -> dict:
+        return {**self.core, "wall": self.wall}
+
+
+def _scenario_config(sc: Scenario):
+    """Virtual-time-friendly service config: one metrics window per tick,
+    detector/notifier thresholds measured in ticks, anneal engine pinned."""
+    from cruise_control_tpu.common.config import CruiseControlConfig
+    W = sc.tick_ms
+    base = {
+        "optimizer.engine": "anneal",
+        "anneal.num.chains": 4,
+        "anneal.steps": 64,
+        "anneal.tries.move": 16,
+        "anneal.tries.lead": 4,
+        "anneal.tries.swap": 8,
+        "partition.metrics.window.ms": W,
+        "num.partition.metrics.windows": 3,
+        "min.valid.partition.ratio": 0.0,
+        "min.samples.per.partition.metrics.window": 1,
+        "metric.sampling.interval.ms": W,
+        "execution.progress.check.interval.ms": 10,
+        "failed.brokers.file.path": "",
+        "proposal.expiration.ms": 4 * W,
+        "num.proposal.precompute.threads": 0,
+        "anomaly.detection.interval.ms": W,
+        "anomaly.detection.recheck.delay.ms": W,
+        "self.healing.enabled": True,
+        "broker.failure.alert.threshold.ms": W,
+        "broker.failure.self.healing.threshold.ms": 2 * W,
+        "broker.failure.detection.backoff.ms": W,
+    }
+    base.update(dict(sc.config_overrides))
+    return CruiseControlConfig(base)
+
+
+def _apply_direct(ev: FaultEvent, cluster: SimulatedKafkaCluster,
+                  wrapper, app) -> None:
+    """Fire a direct fault event against the simulated cluster/app."""
+    if ev.kind == "kill_broker":
+        cluster.kill_broker(ev.broker_id)
+    elif ev.kind == "restore_broker":
+        cluster.restore_broker(ev.broker_id)
+    elif ev.kind == "fail_disk":
+        cluster.fail_disk(ev.broker_id, ev.logdir)
+    elif ev.kind == "restore_disk":
+        cluster.restore_disk(ev.broker_id, ev.logdir)
+    elif ev.kind == "kill_broker_mid_execution":
+        # arm the chaos adapter: the death lands ``calls_after`` guarded
+        # adapter calls from now — i.e. inside this tick's execution batch
+        wrapper.set_plan(dataclasses.replace(
+            wrapper.plan,
+            kill_broker_id=ev.broker_id,
+            kill_broker_after_calls=wrapper.calls + ev.calls_after))
+    elif ev.kind == "stop_execution":
+        app.executor.stop_execution(forced=True)
+
+
+def build_app(sc: Scenario):
+    """Construct (clock, cluster, chaos wrapper, app) for a scenario —
+    exposed separately so tests can drive partial loops."""
+    from cruise_control_tpu.app import CruiseControlApp
+    from cruise_control_tpu.common.faults import FaultyClusterAdapter
+
+    clock = VirtualClock()
+    cluster = SimulatedKafkaCluster.build(
+        num_brokers=sc.num_brokers, num_racks=sc.num_racks,
+        topics=sc.topics, partitions_per_topic=sc.partitions_per_topic,
+        rf=sc.rf, latency_polls=sc.latency_polls)
+    wrapper = FaultyClusterAdapter(cluster, sc.faults.plan_for_tick(-1),
+                                   sleep=clock.sleep)
+    workload = sc.workload or DiurnalWorkload(
+        seed=sc.seed, period_ms=max(sc.ticks * sc.tick_ms // 2, sc.tick_ms))
+    app = CruiseControlApp(_scenario_config(sc), metadata_source=cluster,
+                           sampler=workload, cluster_adapter=wrapper,
+                           now_fn=clock.now_s, sleep_fn=clock.sleep)
+    return clock, cluster, wrapper, app
+
+
+def run_scenario(sc: Scenario, use_sentinel: bool = False,
+                 score_goals: bool = True) -> Scorecard:
+    """Run one scenario end-to-end; returns its :class:`Scorecard`.
+
+    ``use_sentinel`` wraps the measured ticks in ``retrace_sentinel()``
+    (warmup stays outside) and reports uncovered retraces in the wall
+    section. ``score_goals=False`` skips the per-tick model snapshots and
+    the batched goal scoring (faster, for bench sweeps that only need
+    convergence/churn).
+    """
+    from cruise_control_tpu.common import sentinels as SENT
+    from cruise_control_tpu.monitor.load_monitor import (
+        NotEnoughValidWindowsError)
+
+    clock, cluster, wrapper, app = build_app(sc)
+    W = sc.tick_ms
+    config = app.config
+    goal_names = tuple(config.get("anomaly.detection.goals"))
+
+    def ingest():
+        app.load_monitor.sample_once(now_ms=clock.now_ms() + W // 2)
+        clock.advance_ms(W)
+
+    def loop_once():
+        app.precompute_tick()
+        app.anomaly_detector.sweep()
+        app.anomaly_detector.handle_pending()
+
+    # ---- warmup: fill windows, then run real ticks so every program the
+    # measured loop dispatches (model build, anneal, detector scoring,
+    # provisioner grid, self-healing rebalance) is traced before the
+    # sentinel opens
+    for _ in range(config.get("num.partition.metrics.windows")):
+        ingest()
+    for _ in range(max(sc.warmup_ticks, 1)):
+        ingest()
+        loop_once()
+    # heal-shaped programs: optimize-with-options traces a different
+    # program than the default-goal path, so warm the exact routes the
+    # scheduled faults will take. Warmup failures are expected shapes (a
+    # plan with nothing to fix returns None, tiny models can reject a
+    # remove), not scenario errors — log and continue; the measured run's
+    # own assertions catch anything real. The self-healing rebalance
+    # executes (self_healing forces dryrun off), so warmup may move
+    # replicas — all before the measurement baselines are taken.
+    kills = sc.faults.kill_broker_events()
+    if kills:
+        try:
+            app.remove_brokers([kills[0].broker_id], dryrun=True)
+        except Exception:
+            logger.debug("warmup remove_brokers skipped", exc_info=True)
+    if any(e.kind == "fail_disk" for e in sc.faults.events):
+        try:
+            app.fix_offline_replicas(dryrun=True)
+        except Exception:
+            logger.debug("warmup fix_offline_replicas skipped", exc_info=True)
+    try:
+        app.rebalance(dryrun=True, self_healing=True)
+    except Exception:
+        logger.debug("warmup self-healing rebalance skipped", exc_info=True)
+    # fault drill: a broker death changes compiled shapes downstream — the
+    # provisioner what-if grid is composed from the *alive* broker set and
+    # the post-death rebalance dispatches batched-apply programs the
+    # healthy loop never traces. Dry runs can't reach those, so rehearse
+    # the first scheduled kill against the live cluster: kill, run ticks
+    # until the loop settles, restore, re-settle. Deterministic (same
+    # drill every run) and excluded from the baselines taken below.
+    if kills:
+        def settle(max_ticks: int = 6) -> None:
+            # tick until the loop stops moving replicas/leadership — the
+            # post-death cleanup (heal moves, then the repair engine's
+            # leadership phase) spans several ticks, and each stage
+            # dispatches programs the healthy loop never traces
+            for _ in range(max_ticks):
+                m0 = cluster.moves_applied
+                l0 = cluster.leadership_moves_applied
+                ingest()
+                loop_once()
+                if (cluster.moves_applied == m0
+                        and cluster.leadership_moves_applied == l0):
+                    return
+        drill = kills[0].broker_id
+        cluster.kill_broker(drill)
+        settle()
+        cluster.restore_broker(drill)
+        settle()
+
+    # ---- measurement baselines (warmup movement must not count)
+    base_moves = cluster.moves_applied
+    base_lmoves = cluster.leadership_moves_applied
+    base_churn = dict(cluster.move_count_by_tp)
+    base_injected = dict(wrapper.injected)
+    with app._cache_lock:
+        last_fb = app._last_fallback
+
+    records: List[dict] = []
+    snapshots: List[Optional[dict]] = []
+    tick_walls: List[float] = []
+    provision_statuses: List[str] = []
+    evac_tick: Dict[int, int] = {}
+    base_topo = None
+    fallback_events = 0
+    fallback_reasons: List[str] = []
+    direct_fired = 0
+
+    ctx = SENT.retrace_sentinel() if use_sentinel else nullcontext()
+    with ctx as rlog:
+        for tick in range(sc.ticks):
+            for ev in sc.faults.direct_at(tick):
+                _apply_direct(ev, cluster, wrapper, app)
+                direct_fired += 1
+            if not sc.faults.direct_at(tick):
+                # per-tick transient windows (a mid-execution kill armed
+                # above must not be clobbered by the window plan this tick)
+                wrapper.set_plan(sc.faults.plan_for_tick(tick))
+            ingest()
+            m0 = cluster.moves_applied
+            l0 = cluster.leadership_moves_applied
+            t0 = _time.perf_counter()
+            computed = app.precompute_tick()
+            app.anomaly_detector.sweep()
+            app.anomaly_detector.handle_pending()
+            wall_ms = (_time.perf_counter() - t0) * 1000.0
+            tick_walls.append(wall_ms)
+            with app._cache_lock:
+                res = (app._proposal_cache.result
+                       if app._proposal_cache is not None else None)
+                fb = app._last_fallback
+                pr = app._last_provision_recommendation
+            if fb is not None and fb is not last_fb:
+                fallback_events += 1
+                if fb.get("reason") and fb["reason"] not in fallback_reasons:
+                    fallback_reasons.append(fb["reason"])
+            last_fb = fb
+            status = (pr or {}).get("status")
+            if status and (not provision_statuses
+                           or provision_statuses[-1] != status):
+                provision_statuses.append(status)
+            records.append({
+                "tick": tick,
+                "computed": bool(computed),
+                "engine": res.engine if res is not None else None,
+                "replicaMoves": cluster.moves_applied - m0,
+                "leadershipMoves": cluster.leadership_moves_applied - l0,
+            })
+            for ev in kills:
+                if ev.broker_id in evac_tick or ev.tick > tick:
+                    continue
+                if not cluster.replicas_on_broker(ev.broker_id):
+                    evac_tick[ev.broker_id] = tick
+            if score_goals:
+                try:
+                    topo, assign = app._model()
+                    snap = SC.snapshot_model(topo, assign)
+                    if base_topo is None:
+                        base_topo = topo
+                        base_shapes = {k: v.shape for k, v in snap.items()}
+                    if {k: v.shape for k, v in snap.items()} == base_shapes:
+                        snapshots.append(snap)
+                    else:
+                        # the valid-partition set shrank this tick (e.g. the
+                        # monitor starved through a latency storm): a
+                        # different-shaped model cannot join the vmapped
+                        # timeline stack — count the tick as unscored
+                        snapshots.append(None)
+                except NotEnoughValidWindowsError:
+                    snapshots.append(None)
+    uncovered = SENT.check_steady_state(rlog) if use_sentinel else None
+
+    # ---- batched scoring of the whole timeline (outside the sentinel:
+    # the stacked [T, ...] shapes are a new program by construction)
+    scored = [s for s in snapshots if s is not None]
+    if score_goals and base_topo is not None and scored:
+        viol = SC.batched_goal_violations(base_topo, scored, goal_names)
+        vticks = SC.violation_ticks(viol, goal_names)
+    else:
+        vticks = {"goalViolationTicks": None, "hardViolationTicks": None,
+                  "offlineTicks": None}
+
+    # ---- fold into the scorecard
+    move_ticks = [r["tick"] for r in records if r["replicaMoves"] > 0]
+    last_move_tick = move_ticks[-1] if move_ticks else None
+    churn = sum(
+        max(cluster.move_count_by_tp.get(tp, 0) - base_churn.get(tp, 0) - 1, 0)
+        for tp in cluster.move_count_by_tp)
+    heal = []
+    for ev in kills:
+        e_tick = evac_tick.get(ev.broker_id)
+        heal_ticks = (e_tick - ev.tick) if e_tick is not None else None
+        heal.append({
+            "brokerId": ev.broker_id,
+            "faultTick": ev.tick,
+            "evacuatedTick": e_tick,
+            "healTicks": heal_ticks,
+            "withinTickBudget": (heal_ticks is not None
+                                 and heal_ticks <= sc.slo.heal_convergence_ticks),
+        })
+    engines = sorted({r["engine"] for r in records if r["engine"]})
+    injected = {k: wrapper.injected[k] - base_injected.get(k, 0)
+                for k in wrapper.injected}
+    provision_accurate = (None if sc.expected_provision is None
+                          else sc.expected_provision in provision_statuses)
+    core = {
+        "scenario": sc.name,
+        "seed": sc.seed,
+        "ticks": sc.ticks,
+        "tickMs": sc.tick_ms,
+        "brokers": sc.num_brokers,
+        "partitions": len(sc.topics) * sc.partitions_per_topic,
+        "computeTicks": sum(1 for r in records if r["computed"]),
+        "engines": engines,
+        "fallbackEvents": fallback_events,
+        "fallbackReasons": fallback_reasons,
+        "totalReplicaMoves": cluster.moves_applied - base_moves,
+        "totalLeadershipMoves": cluster.leadership_moves_applied - base_lmoves,
+        "moveChurn": churn,
+        "lastMoveTick": last_move_tick,
+        "convergenceTick": (last_move_tick + 1
+                            if last_move_tick is not None else 0),
+        "converged": last_move_tick is None or last_move_tick < sc.ticks - 1,
+        "scoredTicks": len(scored),
+        **vticks,
+        "selfHeal": heal,
+        "healTicksBudget": sc.slo.heal_convergence_ticks,
+        "sloHealTickViolations": sum(
+            1 for h in heal if not h["withinTickBudget"]),
+        "faultsInjected": injected,
+        "directFaultEvents": direct_fired,
+        "provisionStatuses": provision_statuses,
+        "expectedProvision": sc.expected_provision,
+        "provisionAccurate": provision_accurate,
+    }
+    walls = np.asarray(tick_walls) if tick_walls else np.zeros(1)
+    with app._cache_lock:
+        self_heal_wall = app.last_self_heal_ms
+        heal_path = app.self_heal_path
+    wall = {
+        "tickWallMsP50": round(float(np.percentile(walls, 50)), 3),
+        "tickWallMsP99": round(float(np.percentile(walls, 99)), 3),
+        "tickWallMsMax": round(float(walls.max()), 3),
+        "sloTickWallMs": sc.slo.tick_wall_ms,
+        "sloTickViolations": int((walls > sc.slo.tick_wall_ms).sum()),
+        "selfHealWallMs": self_heal_wall,
+        "selfHealPath": heal_path,
+        "sloSelfHealWallMs": sc.slo.self_heal_wall_ms,
+        "sloSelfHealViolations": int(
+            self_heal_wall is not None
+            and self_heal_wall > sc.slo.self_heal_wall_ms),
+    }
+    if uncovered is not None:
+        wall["uncoveredRetraces"] = [str(u) for u in uncovered]
+    card = Scorecard(core=core, wall=wall)
+    app.record_simulation_scorecard(card.to_json())
+    return card

@@ -1,0 +1,104 @@
+"""Batched goal scoring over a scenario timeline.
+
+A scenario records one model snapshot per tick (load columns + placement +
+liveness). Scoring them one-by-one would pay per-tick dispatch for hundreds
+of ticks; since the topology *structure* (partition/replica layout indices,
+capacities, racks) is tick-invariant in a scenario, the whole timeline
+stacks along a leading axis and every tick scores in ONE compiled vmapped
+program — the same aggregates→thresholds→``full_goal_penalties`` pipeline
+the GoalViolationDetector runs per tick (all documented jit/vmap-safe).
+
+Output: violations ``f32[T, G+1]`` — per-goal totals plus the trailing
+offline/self-healing term, exactly the detector's per-tick verdict vector.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import BalancingConstraint
+
+#: snapshot keys, in the order the vmapped scorer consumes them
+SNAPSHOT_KEYS = ("replica_base_load", "leader_extra", "leader_bytes_in",
+                 "broker_alive", "replica_offline", "broker_of", "leader_of")
+
+
+def snapshot_model(topo, assign) -> Dict[str, np.ndarray]:
+    """Host-side per-tick snapshot of the leaves that vary over a scenario."""
+    import jax
+    return {
+        "replica_base_load": np.asarray(topo.replica_base_load, np.float32),
+        "leader_extra": np.asarray(topo.leader_extra, np.float32),
+        "leader_bytes_in": np.asarray(topo.leader_bytes_in, np.float32),
+        "broker_alive": np.asarray(topo.broker_alive, bool),
+        "replica_offline": np.asarray(topo.replica_offline, bool),
+        "broker_of": np.asarray(jax.device_get(assign.broker_of), np.int32),
+        "leader_of": np.asarray(jax.device_get(assign.leader_of), np.int32),
+    }
+
+
+def batched_goal_violations(base_topo,
+                            snapshots: Sequence[Dict[str, np.ndarray]],
+                            goal_names: Sequence[str],
+                            constraint: Optional[BalancingConstraint] = None,
+                            ) -> np.ndarray:
+    """Score every tick's model in one vmapped compiled call.
+
+    ``base_topo`` supplies the tick-invariant structure; each snapshot (from
+    :func:`snapshot_model`) supplies that tick's load/placement/liveness.
+    Returns ``f32[T, G+1]`` violation totals (trailing entry = the
+    offline/self-healing term).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from cruise_control_tpu.analyzer import goals as G
+    from cruise_control_tpu.ops.aggregates import (
+        compute_aggregates, device_topology)
+
+    if not snapshots:
+        return np.zeros((0, len(goal_names) + 1), np.float32)
+    constraint = constraint or BalancingConstraint()
+    gn = tuple(goal_names)
+    num_topics = base_topo.num_topics
+    dt0 = device_topology(base_topo)
+    stacked = {k: jnp.asarray(np.stack([s[k] for s in snapshots]))
+               for k in SNAPSHOT_KEYS}
+
+    def _score_one(base_load, leader_extra, lbi, alive, offline,
+                   broker_of, leader_of):
+        from cruise_control_tpu.models.cluster import Assignment
+        dt = dt0._replace(replica_base_load=base_load,
+                          leader_extra=leader_extra,
+                          leader_bytes_in=lbi,
+                          broker_alive=alive,
+                          replica_offline=offline)
+        assign = Assignment(broker_of=broker_of, leader_of=leader_of)
+        agg = compute_aggregates(dt, assign, num_topics)
+        th = G.compute_thresholds(dt, constraint, agg)
+        pen = G.full_goal_penalties(dt, assign, th, num_topics, gn,
+                                    initial_broker_of=broker_of, agg=agg)
+        return pen.violations
+
+    out = jax.vmap(_score_one)(*(stacked[k] for k in SNAPSHOT_KEYS))
+    return np.asarray(jax.device_get(out), np.float32)
+
+
+def violation_ticks(violations: np.ndarray,
+                    goal_names: Sequence[str]) -> Dict[str, int]:
+    """Collapse the [T, G+1] matrix into scorecard counters."""
+    from cruise_control_tpu.analyzer import goals as G
+    if violations.size == 0:
+        return {"goalViolationTicks": 0, "hardViolationTicks": 0,
+                "offlineTicks": 0}
+    per_goal = violations[:, :-1]
+    hard_idx = [i for i, g in enumerate(goal_names) if G.is_hard(g)]
+    hard = (per_goal[:, hard_idx].sum(axis=1) > 0 if hard_idx
+            else np.zeros(len(violations), bool))
+    return {
+        "goalViolationTicks": int((per_goal.sum(axis=1) > 0).sum()),
+        "hardViolationTicks": int(np.asarray(hard).sum()),
+        "offlineTicks": int((violations[:, -1] > 0).sum()),
+    }

@@ -1,0 +1,271 @@
+"""Time-varying workload generators behind the MetricSampler SPI.
+
+Each generator extends the SyntheticLoadSampler recipe (stable seeded
+per-partition base rates + per-call jitter) with a deterministic
+**intensity** factor ``intensity(t_ms, topic, partition)`` — a pure function
+of virtual time and identity, so the same (seed, scenario) always emits the
+same sample stream. Because samples are built against the *current* cluster
+metadata (leaders included), executor-applied movements change which broker
+carries a partition's load on the next tick — the loop the one-shot chaos
+harness never closed.
+
+Shapes provided:
+
+- :class:`DiurnalWorkload` — sinusoidal day/night cycle.
+- :class:`SpikeWorkload` — a flat multiplier inside a time window.
+- :class:`FlashCrowdWorkload` — sudden ramp + exponential decay on a hot
+  topic set (the "everyone piles onto one topic" incident shape).
+- :class:`TopicGrowthWorkload` — compounding growth on matching topics.
+- :class:`HotspotDriftWorkload` — a rotating hot partition subset, so the
+  *location* of load drifts even when the total is flat.
+- :class:`CompositeWorkload` — product of component intensities.
+- :class:`TraceReplayWorkload` — JSONL trace replay (FileMetricSampler
+  format); :func:`record_trace` writes such traces from any sampler.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from cruise_control_tpu.monitor import metricdef as md
+from cruise_control_tpu.monitor.sampler import (
+    BrokerMetricSample,
+    ClusterMetadata,
+    FileMetricSampler,
+    MetricSampler,
+    PartitionMetricSample,
+    estimate_partition_cpu,
+)
+
+
+class WorkloadGenerator(MetricSampler):
+    """Base generator: seeded stable rates × time-varying intensity.
+
+    Subclasses override :meth:`intensity`; everything else (per-partition
+    base rates, jitter, broker roll-ups, CPU attribution) follows the
+    SyntheticLoadSampler recipe so windows fill with consistent,
+    extrapolation-friendly data.
+    """
+
+    def __init__(self, seed: int = 0, mean_nw_in: float = 100.0,
+                 mean_nw_out: float = 100.0, mean_disk: float = 500.0,
+                 jitter: float = 0.02):
+        self._seed = seed
+        self._means = (mean_nw_in, mean_nw_out, mean_disk)
+        self._jitter = jitter
+
+    # -- the time axis ----------------------------------------------------
+    def intensity(self, t_ms: int, topic: str, partition: int) -> float:
+        """Multiplier applied to the partition's base rates at time t."""
+        return 1.0
+
+    # -- SyntheticLoadSampler recipe --------------------------------------
+    def _base_rates(self, topic: str, partition: int) -> np.ndarray:
+        h = abs(hash((self._seed, topic, partition))) % (1 << 32)
+        rng = np.random.default_rng(h)
+        return np.array([rng.exponential(self._means[0]),
+                         rng.exponential(self._means[1]),
+                         rng.exponential(self._means[2])])
+
+    def get_samples(self, metadata: ClusterMetadata, start_ms: int,
+                    end_ms: int):
+        rng = np.random.default_rng((self._seed, start_ms & 0xffffffff))
+        t = (start_ms + end_ms) // 2
+        psamples, leader_totals = [], {}
+        per_part = []
+        for pm in metadata.partitions:
+            if pm.leader < 0:
+                continue
+            scale = float(self.intensity(t, pm.topic, pm.partition))
+            rates = self._base_rates(pm.topic, pm.partition) * scale * (
+                1.0 + self._jitter * rng.standard_normal(3))
+            nw_in, nw_out, disk = (max(rates[0], 0.0), max(rates[1], 0.0),
+                                   max(rates[2], 0.0))
+            per_part.append((pm, nw_in, nw_out, disk))
+            agg = leader_totals.setdefault(pm.leader, [0.0, 0.0])
+            agg[0] += nw_in
+            agg[1] += nw_out
+        bsamples = []
+        broker_cpu = {}
+        for b in metadata.brokers:
+            lbi, lbo = leader_totals.get(b.broker_id, (0.0, 0.0))
+            # follower bytes-in ≈ replication in; approximate with lbi
+            cpu = min(90.0, 0.0008 * (0.7 * lbi + 0.15 * lbo + 0.15 * lbi))
+            broker_cpu[b.broker_id] = (cpu, lbi, lbo)
+            if b.alive:
+                bsamples.append(BrokerMetricSample(
+                    broker_id=b.broker_id, time_ms=t, cpu_util=cpu,
+                    leader_bytes_in=lbi, leader_bytes_out=lbo,
+                    replication_bytes_in=lbi, replication_bytes_out=0.0))
+        for pm, nw_in, nw_out, disk in per_part:
+            cpu, blbi, blbo = broker_cpu.get(pm.leader, (0.0, 0.0, 0.0))
+            pcpu = float(estimate_partition_cpu(
+                np.array(nw_in), np.array(nw_out), cpu, blbi, blbo, blbi))
+            metrics = np.full(md.NUM_MODEL_METRICS, np.nan)
+            metrics[md.ModelMetric.CPU_USAGE] = pcpu
+            metrics[md.ModelMetric.DISK_USAGE] = disk
+            metrics[md.ModelMetric.LEADER_BYTES_IN] = nw_in
+            metrics[md.ModelMetric.LEADER_BYTES_OUT] = nw_out
+            psamples.append(PartitionMetricSample(
+                topic=pm.topic, partition=pm.partition,
+                leader_broker=pm.leader, time_ms=t, metrics=metrics))
+        return psamples, bsamples
+
+
+class DiurnalWorkload(WorkloadGenerator):
+    """Sinusoidal day/night cycle: 1 + amplitude·sin(2π(t-phase)/period)."""
+
+    def __init__(self, seed: int = 0, period_ms: int = 86_400_000,
+                 amplitude: float = 0.5, phase_ms: int = 0, **kw):
+        super().__init__(seed=seed, **kw)
+        self._period = max(int(period_ms), 1)
+        self._amplitude = amplitude
+        self._phase = phase_ms
+
+    def intensity(self, t_ms, topic, partition):
+        x = 2.0 * math.pi * ((t_ms - self._phase) % self._period) / self._period
+        return max(1.0 + self._amplitude * math.sin(x), 0.05)
+
+
+class SpikeWorkload(WorkloadGenerator):
+    """Flat multiplier inside [start_ms, end_ms); optionally topic-scoped."""
+
+    def __init__(self, seed: int = 0, start_ms: int = 0, end_ms: int = 0,
+                 multiplier: float = 3.0,
+                 topics: Optional[Sequence[str]] = None, **kw):
+        super().__init__(seed=seed, **kw)
+        self._window = (start_ms, end_ms)
+        self._multiplier = multiplier
+        self._topics = frozenset(topics) if topics is not None else None
+
+    def intensity(self, t_ms, topic, partition):
+        lo, hi = self._window
+        if lo <= t_ms < hi and (self._topics is None or topic in self._topics):
+            return self._multiplier
+        return 1.0
+
+
+class FlashCrowdWorkload(WorkloadGenerator):
+    """Sudden onset + linear ramp + exponential decay on hot topics."""
+
+    def __init__(self, seed: int = 0, onset_ms: int = 0,
+                 ramp_ms: int = 60_000, decay_ms: int = 300_000,
+                 peak_multiplier: float = 5.0,
+                 hot_topics: Sequence[str] = (), **kw):
+        super().__init__(seed=seed, **kw)
+        self._onset = onset_ms
+        self._ramp = max(int(ramp_ms), 1)
+        self._decay = max(int(decay_ms), 1)
+        self._peak = peak_multiplier
+        self._hot = frozenset(hot_topics)
+
+    def intensity(self, t_ms, topic, partition):
+        if self._hot and topic not in self._hot:
+            return 1.0
+        dt = t_ms - self._onset
+        if dt < 0:
+            return 1.0
+        if dt < self._ramp:
+            return 1.0 + (self._peak - 1.0) * dt / self._ramp
+        return 1.0 + (self._peak - 1.0) * math.exp(
+            -(dt - self._ramp) / self._decay)
+
+
+class TopicGrowthWorkload(WorkloadGenerator):
+    """Compounding growth: matching topics multiply by ``growth_per_period``
+    every ``period_ms`` (the organic-adoption shape the provisioner must
+    eventually flag as under-provisioned)."""
+
+    def __init__(self, seed: int = 0, growth_per_period: float = 1.3,
+                 period_ms: int = 3_600_000,
+                 topic_prefix: str = "", **kw):
+        super().__init__(seed=seed, **kw)
+        self._growth = growth_per_period
+        self._period = max(int(period_ms), 1)
+        self._prefix = topic_prefix
+
+    def intensity(self, t_ms, topic, partition):
+        if self._prefix and not topic.startswith(self._prefix):
+            return 1.0
+        return self._growth ** (t_ms / self._period)
+
+
+class HotspotDriftWorkload(WorkloadGenerator):
+    """A rotating hot partition subset: every ``rotation_ms`` the hot group
+    advances, so total load is flat while its *placement* keeps moving —
+    the shape that punishes a rebalancer for chasing transients."""
+
+    def __init__(self, seed: int = 0, rotation_ms: int = 600_000,
+                 num_groups: int = 4, multiplier: float = 4.0, **kw):
+        super().__init__(seed=seed, **kw)
+        self._rotation = max(int(rotation_ms), 1)
+        self._groups = max(int(num_groups), 1)
+        self._multiplier = multiplier
+
+    def intensity(self, t_ms, topic, partition):
+        group = abs(hash((topic, partition))) % self._groups
+        hot = (t_ms // self._rotation) % self._groups
+        return self._multiplier if group == hot else 1.0
+
+
+class CompositeWorkload(WorkloadGenerator):
+    """Product of component intensities (e.g. diurnal × flash-crowd). Base
+    rates/jitter/seed come from this instance; components contribute only
+    their ``intensity``."""
+
+    def __init__(self, components: Sequence[WorkloadGenerator],
+                 seed: int = 0, **kw):
+        super().__init__(seed=seed, **kw)
+        self._components = tuple(components)
+
+    def intensity(self, t_ms, topic, partition):
+        out = 1.0
+        for c in self._components:
+            out *= c.intensity(t_ms, topic, partition)
+        return out
+
+
+class TraceReplayWorkload(FileMetricSampler):
+    """Replay a recorded JSONL trace through the monitor ingest path — the
+    same format FileMetricSampler reads (``kind``-tagged sample objects, one
+    per line)."""
+
+
+def record_trace(path: str, sampler: MetricSampler,
+                 metadata: ClusterMetadata, start_ms: int, end_ms: int,
+                 step_ms: int) -> int:
+    """Materialize a sampler's output as a replayable JSONL trace.
+
+    Writes one ``kind``-tagged JSON object per sample (the tag is what
+    FileMetricSampler dispatches on; the samples' own ``to_json`` omits it).
+    Returns the number of lines written.
+    """
+    n = 0
+    with open(path, "w") as f:
+        t = start_ms
+        while t < end_ms:
+            ps, bs = sampler.get_samples(metadata, t, min(t + step_ms, end_ms))
+            for s in ps:
+                f.write(json.dumps({"kind": "partition", **s.to_json()}) + "\n")
+                n += 1
+            for s in bs:
+                f.write(json.dumps({"kind": "broker", **s.to_json()}) + "\n")
+                n += 1
+            t += step_ms
+    return n
+
+
+#: generator registry for ``metric.sampler.class``-style lookup
+WORKLOAD_REGISTRY = {
+    "DiurnalWorkload": DiurnalWorkload,
+    "SpikeWorkload": SpikeWorkload,
+    "FlashCrowdWorkload": FlashCrowdWorkload,
+    "TopicGrowthWorkload": TopicGrowthWorkload,
+    "HotspotDriftWorkload": HotspotDriftWorkload,
+    "CompositeWorkload": CompositeWorkload,
+    "TraceReplayWorkload": TraceReplayWorkload,
+}

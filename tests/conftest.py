@@ -85,6 +85,19 @@ def _release_compiled_programs():
         pass
 
 
+@pytest.fixture(autouse=True)
+def _clear_chaos_hooks():
+    """Process-global chaos hooks must never leak between tests: a test
+    that installs an injection hook and fails before its cleanup would
+    otherwise poison every later test touching the same site."""
+    yield
+    try:
+        from cruise_control_tpu.common import faults
+        faults.clear_chaos_hooks()
+    except Exception:
+        pass
+
+
 _TESTS_SINCE_CLEAR = {"n": 0}
 
 

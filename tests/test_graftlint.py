@@ -878,6 +878,13 @@ def test_package_lints_clean_against_baseline():
     par = [fp for fp in baseline
            if fp.split("|")[1].startswith("cruise_control_tpu/parallel/")]
     assert par == [], f"parallel package must stay baseline-free: {par}"
+    # the scenario simulator shipped lint-clean — no suppression may point
+    # into it, by fingerprint path or by snippet content
+    sim = [fp for fp, entry in baseline.items()
+           if fp.split("|")[1].startswith("cruise_control_tpu/simulator/")
+           or "SimulatedKafkaCluster" in json.dumps(entry)
+           or "FaultSchedule" in json.dumps(entry)]
+    assert sim == [], f"simulator package must stay baseline-free: {sim}"
 
 
 # -- runtime sentinels -----------------------------------------------------

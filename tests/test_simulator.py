@@ -1,0 +1,446 @@
+"""Scenario simulator suite (docs/simulation.md).
+
+Fast tier: unit contracts for the virtual clock, workload generators,
+fault schedules, the simulated cluster's executor round-trip, vmapped
+scoring parity, and short-run byte-identical determinism. Slow tier: the
+200-tick diurnal + broker-death e2e under the retrace sentinel.
+"""
+
+import dataclasses
+import json
+import time
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu import simulator as SIM
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.common.faults import FaultPlan, FaultyClusterAdapter
+from cruise_control_tpu.executor.executor import Executor, ExecutorConfig
+from cruise_control_tpu.simulator.clock import VirtualClock
+from cruise_control_tpu.simulator.cluster import SimulatedKafkaCluster
+from cruise_control_tpu.simulator.faults import FaultEvent, FaultSchedule
+
+pytestmark = pytest.mark.simulator
+
+
+def _proposal(topic, part, old, new, size=10.0):
+    return ExecutionProposal(topic=topic, partition=part, old_leader=old[0],
+                             old_replicas=tuple(old), new_replicas=tuple(new),
+                             data_size=size)
+
+
+# --------------------------------------------------------------------------
+# virtual clock
+# --------------------------------------------------------------------------
+
+
+def test_virtual_clock_contract():
+    clock = VirtualClock(start_ms=1_000)
+    assert clock.now_ms() == 1_000
+    assert clock.now_s() == 1.0
+    clock.advance_ms(500)
+    assert clock.now_ms() == 1_500
+    clock.sleep(2.5)
+    assert clock.now_ms() == 4_000
+    with pytest.raises(ValueError):
+        clock.advance_ms(-1)
+
+
+def test_virtual_clock_latency_storm_costs_no_wall_time():
+    """A 100% latency plan with 30 virtual seconds per call advances the
+    virtual clock, not the wall clock — the satellite that makes latency
+    scenarios affordable."""
+    clock = VirtualClock()
+    cluster = SimulatedKafkaCluster.build(num_brokers=3)
+    wrapper = FaultyClusterAdapter(
+        cluster, FaultPlan(seed=1, latency_rate=1.0, latency_s=30.0),
+        sleep=clock.sleep)
+    t0 = time.perf_counter()
+    for _ in range(10):
+        wrapper.dead_brokers()
+    wall = time.perf_counter() - t0
+    assert clock.now_s() == pytest.approx(300.0)
+    assert wall < 5.0, f"latency storm leaked into wall time: {wall:.1f}s"
+    assert wrapper.injected["latency"] == 10
+
+
+def test_executor_deadlines_run_on_virtual_clock():
+    """Executor poll sleeps and stuck-task deadlines flow through the
+    injected clock: a 3-poll move with a 10 s check interval completes in
+    ~zero wall time while virtual time advances by the polling delay."""
+    clock = VirtualClock()
+    cluster = SimulatedKafkaCluster.build(num_brokers=3, latency_polls=3)
+    ex = Executor(cluster,
+                  config=ExecutorConfig(
+                      execution_progress_check_interval_ms=10_000),
+                  clock=clock.now_s, sleep=clock.sleep)
+    tp = cluster.get_metadata().partitions[0]
+    old = tp.replicas
+    spare = [b for b in range(3) if b not in old][0]
+    new = (old[0], spare)
+    t0 = time.perf_counter()
+    summary = ex.execute_proposals(
+        [_proposal(tp.topic, tp.partition, old, new)])
+    wall = time.perf_counter() - t0
+    assert not summary["stopped"] and cluster.moves_applied == 1
+    assert clock.now_s() >= 10.0, "poll interval did not use the clock"
+    assert wall < 5.0, f"virtual polling leaked into wall time: {wall:.1f}s"
+
+
+# --------------------------------------------------------------------------
+# workload generators
+# --------------------------------------------------------------------------
+
+
+def _total_rate(workload, metadata, start_ms, w=60_000):
+    ps, _ = workload.get_samples(metadata, start_ms, start_ms + w)
+    from cruise_control_tpu.monitor import metricdef as md
+    return sum(s.metrics[md.ModelMetric.LEADER_BYTES_IN] for s in ps)
+
+
+def test_workloads_are_deterministic():
+    md5 = SimulatedKafkaCluster.build(num_brokers=4).get_metadata()
+    for name, cls in SIM.WORKLOAD_REGISTRY.items():
+        if name == "TraceReplayWorkload":
+            continue
+        a = cls(seed=7) if name != "CompositeWorkload" else cls(
+            [SIM.DiurnalWorkload(seed=7)], seed=7)
+        b = cls(seed=7) if name != "CompositeWorkload" else cls(
+            [SIM.DiurnalWorkload(seed=7)], seed=7)
+        pa, ba = a.get_samples(md5, 60_000, 120_000)
+        pb, bb = b.get_samples(md5, 60_000, 120_000)
+        assert len(pa) == len(pb) and len(ba) == len(bb), name
+        for x, y in zip(pa, pb):
+            np.testing.assert_array_equal(x.metrics, y.metrics, err_msg=name)
+        for x, y in zip(ba, bb):
+            assert x.to_json() == y.to_json(), name
+
+
+def test_diurnal_workload_modulates_with_period():
+    md5 = SimulatedKafkaCluster.build(num_brokers=4).get_metadata()
+    w = SIM.DiurnalWorkload(seed=3, period_ms=86_400_000, amplitude=0.5)
+    peak = _total_rate(w, md5, 6 * 3_600_000)     # sin peak at period/4
+    trough = _total_rate(w, md5, 18 * 3_600_000)  # sin trough at 3/4
+    assert peak > 2.0 * trough
+
+
+def test_spike_and_flash_crowd_shapes():
+    md5 = SimulatedKafkaCluster.build(num_brokers=4).get_metadata()
+    spike = SIM.SpikeWorkload(seed=3, start_ms=100_000, end_ms=200_000,
+                              multiplier=4.0)
+    before = _total_rate(spike, md5, 0)
+    inside = _total_rate(spike, md5, 120_000)
+    assert inside > 3.0 * before
+    fc = SIM.FlashCrowdWorkload(seed=3, onset_ms=300_000, ramp_ms=60_000,
+                                decay_ms=120_000, peak_multiplier=5.0)
+    calm = fc.intensity(0, "T0", 0)
+    peak = fc.intensity(360_000, "T0", 0)
+    decayed = fc.intensity(360_000 + 5 * 120_000, "T0", 0)
+    assert calm == 1.0 and peak == 5.0
+    assert 1.0 < decayed < 1.2
+
+
+def test_topic_growth_and_hotspot_drift():
+    g = SIM.TopicGrowthWorkload(seed=1, growth_per_period=2.0,
+                                period_ms=1_000)
+    assert g.intensity(3_000, "T0", 0) == pytest.approx(8.0)
+    h = SIM.HotspotDriftWorkload(seed=1, rotation_ms=1_000, num_groups=4,
+                                 multiplier=4.0)
+    # exactly one group is hot at any instant, and the hot group rotates
+    groups = {abs(hash(("T0", p))) % 4 for p in range(32)}
+    assert groups == {0, 1, 2, 3}
+    for t in (0, 1_000, 2_000, 3_000):
+        hot = [p for p in range(32) if h.intensity(t, "T0", p) == 4.0]
+        cold = [p for p in range(32) if h.intensity(t, "T0", p) == 1.0]
+        assert hot and cold
+    assert ({p for p in range(32) if h.intensity(0, "T0", p) == 4.0}
+            != {p for p in range(32) if h.intensity(1_000, "T0", p) == 4.0})
+
+
+def test_trace_record_and_replay_round_trip(tmp_path):
+    md5 = SimulatedKafkaCluster.build(num_brokers=4).get_metadata()
+    src = SIM.DiurnalWorkload(seed=11, period_ms=600_000)
+    path = str(tmp_path / "trace.jsonl")
+    n = SIM.record_trace(path, src, md5, 0, 300_000, step_ms=60_000)
+    assert n > 0
+    replay = SIM.TraceReplayWorkload(path)
+    ps_src, bs_src = src.get_samples(md5, 60_000, 120_000)
+    ps_rep, bs_rep = replay.get_samples(md5, 60_000, 120_000)
+    assert len(ps_rep) == len(ps_src)
+    assert len(bs_rep) == len(bs_src), "broker samples lost their kind tag"
+    src_by_key = {(s.topic, s.partition): s for s in ps_src}
+    for s in ps_rep:
+        np.testing.assert_allclose(
+            s.metrics, src_by_key[(s.topic, s.partition)].metrics,
+            rtol=1e-6)
+
+
+# --------------------------------------------------------------------------
+# simulated cluster
+# --------------------------------------------------------------------------
+
+
+def test_simulated_cluster_executor_round_trip():
+    """An executed proposal must change the metadata the monitor reads on
+    the next tick — the loop closure the one-shot harness never had."""
+    cluster = SimulatedKafkaCluster.build(num_brokers=4, latency_polls=1)
+    gen0 = cluster.get_metadata().generation
+    tp = cluster.get_metadata().partitions[0]
+    new = tuple(b for b in range(4) if b not in tp.replicas)[:len(tp.replicas)]
+    new = (new + tp.replicas)[:len(tp.replicas)]
+    ex = Executor(cluster, config=ExecutorConfig(
+        execution_progress_check_interval_ms=1))
+    summary = ex.execute_proposals(
+        [_proposal(tp.topic, tp.partition, tp.replicas, new)])
+    assert not summary["stopped"] and not summary["timedOut"]
+    md_after = cluster.get_metadata()
+    p_after = [p for p in md_after.partitions
+               if p.topic == tp.topic and p.partition == tp.partition][0]
+    assert p_after.replicas == new
+    assert p_after.leader in new
+    assert md_after.generation > gen0
+    assert cluster.moves_applied == 1
+
+
+def test_kill_broker_updates_both_seams():
+    cluster = SimulatedKafkaCluster.build(num_brokers=4, rf=2)
+    victim = 1
+    led = [p for p in cluster.get_metadata().partitions if p.leader == victim]
+    assert led, "layout should give every broker some leadership"
+    cluster.kill_broker(victim)
+    md5 = cluster.get_metadata()
+    assert not [b for b in md5.brokers if b.broker_id == victim][0].alive
+    assert victim in cluster.dead_brokers()
+    for p in md5.partitions:
+        assert p.leader != victim
+        if victim in p.replicas:
+            assert victim in p.offline_replicas
+            assert victim not in p.isr
+    # idempotent; restore reverses everything
+    cluster.kill_broker(victim)
+    cluster.restore_broker(victim)
+    md6 = cluster.get_metadata()
+    assert [b for b in md6.brokers if b.broker_id == victim][0].alive
+    assert all(victim not in p.offline_replicas for p in md6.partitions)
+
+
+def test_leadership_election_against_dead_broker_is_noop():
+    cluster = SimulatedKafkaCluster.build(num_brokers=3, rf=2)
+    tp = cluster.get_metadata().partitions[0]
+    dead = tp.replicas[1]
+    cluster.kill_broker(dead)
+
+    class _Task:
+        def __init__(self, proposal):
+            self.proposal = proposal
+
+    want = (dead,) + tuple(r for r in tp.replicas if r != dead)
+    cluster.execute_preferred_leader_elections(
+        [_Task(_proposal(tp.topic, tp.partition, tp.replicas, want))])
+    cluster.current_leader(f"{tp.topic}-{tp.partition}")
+    p = [x for x in cluster.get_metadata().partitions
+         if x.topic == tp.topic and x.partition == tp.partition][0]
+    assert p.leader != dead
+    assert cluster.leadership_moves_applied == 0
+
+
+# --------------------------------------------------------------------------
+# fault schedules
+# --------------------------------------------------------------------------
+
+
+def test_fault_event_validation():
+    with pytest.raises(ValueError):
+        FaultEvent(tick=0, kind="meteor_strike")
+    with pytest.raises(ValueError):
+        FaultEvent(tick=-1, kind="kill_broker", broker_id=0)
+    with pytest.raises(ValueError):
+        FaultEvent(tick=0, kind="latency_storm", duration_ticks=0)
+
+
+def test_fault_schedule_tick_indexing():
+    sched = FaultSchedule(events=(
+        FaultEvent(tick=5, kind="kill_broker", broker_id=2),
+        FaultEvent(tick=3, kind="latency_storm", duration_ticks=4,
+                   rate=0.5, latency_s=2.0),
+        FaultEvent(tick=4, kind="latency_storm", duration_ticks=1,
+                   rate=0.9, latency_s=1.0),
+        FaultEvent(tick=8, kind="kill_broker_mid_execution", broker_id=1,
+                   calls_after=7),
+    ), seed=42)
+    assert [e.broker_id for e in sched.direct_at(5)] == [2]
+    assert sched.direct_at(3) == ()
+    assert len(sched.windows_at(4)) == 2
+    assert sched.windows_at(7) == ()
+    # overlapping windows combine by max rate; seeds mix in the tick
+    p4 = sched.plan_for_tick(4)
+    assert p4.latency_rate == 0.9 and p4.latency_s == 2.0
+    assert sched.plan_for_tick(4).seed != sched.plan_for_tick(5).seed
+    assert sched.plan_for_tick(7).latency_rate == 0.0
+    assert [e.broker_id for e in sched.kill_broker_events()] == [2, 1]
+
+
+def test_mid_execution_kill_arms_the_chaos_adapter():
+    clock = VirtualClock()
+    cluster = SimulatedKafkaCluster.build(num_brokers=4)
+    wrapper = FaultyClusterAdapter(cluster, FaultPlan(seed=0),
+                                   sleep=clock.sleep)
+    wrapper.dead_brokers()                    # some call traffic first
+    wrapper.set_plan(dataclasses.replace(
+        wrapper.plan, kill_broker_id=2,
+        kill_broker_after_calls=wrapper.calls + 3))
+    for _ in range(2):
+        wrapper.dead_brokers()
+    assert 2 not in cluster.dead_brokers()
+    wrapper.dead_brokers()                    # the armed call count lands
+    assert 2 in cluster.dead_brokers()
+    assert wrapper.injected["broker_death"] == 1
+
+
+# --------------------------------------------------------------------------
+# scoring
+# --------------------------------------------------------------------------
+
+
+def test_batched_scoring_matches_per_tick_loop():
+    """The vmapped [T]-batched scorer must agree with scoring each tick's
+    snapshot alone (T=1) — same pipeline, batching must be transparent."""
+    from cruise_control_tpu.analyzer import goals as G
+    from cruise_control_tpu.models import fixtures
+
+    topo, assign = fixtures.small_cluster_model()
+    goal_names = G.ANOMALY_DETECTION_GOALS
+    rng = np.random.default_rng(5)
+    snaps = []
+    base = SIM.snapshot_model(topo, assign)
+    for _ in range(4):
+        s = dict(base)
+        s["replica_base_load"] = (
+            base["replica_base_load"]
+            * rng.uniform(0.5, 2.0, size=(len(base["replica_base_load"]), 1))
+        ).astype(np.float32)
+        snaps.append(s)
+    batched = SIM.batched_goal_violations(topo, snaps, goal_names)
+    assert batched.shape == (4, len(goal_names) + 1)
+    for i, s in enumerate(snaps):
+        single = SIM.batched_goal_violations(topo, [s], goal_names)
+        np.testing.assert_allclose(batched[i], single[0], rtol=1e-5,
+                                   atol=1e-5)
+
+
+def test_violation_ticks_counters():
+    from cruise_control_tpu.analyzer import goals as G
+    goal_names = ("RackAwareGoal", "LeaderBytesInDistributionGoal")
+    assert G.is_hard("RackAwareGoal")
+    assert not G.is_hard("LeaderBytesInDistributionGoal")
+    v = np.array([
+        [0.0, 0.0, 0.0],   # clean tick
+        [1.0, 0.0, 0.0],   # hard violation
+        [0.0, 2.0, 0.0],   # soft violation
+        [0.0, 0.0, 3.0],   # offline replicas only
+    ], np.float32)
+    out = SIM.violation_ticks(v, goal_names)
+    assert out == {"goalViolationTicks": 2, "hardViolationTicks": 1,
+                   "offlineTicks": 1}
+
+
+# --------------------------------------------------------------------------
+# scenario runs
+# --------------------------------------------------------------------------
+
+
+def _kill_scenario(ticks=10, kill_tick=4):
+    return SIM.Scenario(
+        name="determinism", seed=17, ticks=ticks, tick_ms=60_000,
+        num_brokers=5, partitions_per_topic=4, warmup_ticks=2,
+        faults=FaultSchedule(events=(
+            FaultEvent(tick=kill_tick, kind="kill_broker", broker_id=2),
+            FaultEvent(tick=kill_tick + 2, kind="latency_storm",
+                       duration_ticks=2, rate=0.5, latency_s=5.0),
+        ), seed=17))
+
+
+def test_same_seed_scenarios_are_byte_identical():
+    c1 = SIM.run_scenario(_kill_scenario())
+    c2 = SIM.run_scenario(_kill_scenario())
+    assert c1.canonical_json() == c2.canonical_json()
+    # and the core is actually describing the faults it injected
+    assert c1.core["faultsInjected"]["latency"] > 0
+    assert c1.core["selfHeal"][0]["brokerId"] == 2
+    assert c1.core["engines"] == ["anneal"]
+    assert c1.core["fallbackEvents"] == 0
+
+
+def test_scenario_self_heals_and_reports_state():
+    card = SIM.run_scenario(_kill_scenario())
+    heal = card.core["selfHeal"][0]
+    assert heal["evacuatedTick"] is not None, "broker 2 never evacuated"
+    assert heal["withinTickBudget"], heal
+    assert card.core["offlineTicks"] == 0 or (
+        heal["evacuatedTick"] > heal["faultTick"])
+    # the scorecard JSON is self-contained and serializable
+    blob = json.dumps(card.to_json())
+    assert "selfHeal" in blob and "tickWallMsP99" in blob
+
+
+@pytest.mark.slow
+def test_latency_storm_starvation_degrades_gracefully():
+    """A 30 s virtual latency per guarded call jumps the clock past whole
+    metric windows, so the monitor legitimately starves (0 valid
+    partitions). The loop must skip those ticks — NotEnoughValidWindows,
+    not a zero-partition model crashing the analyzer — and the scorecard
+    must stay deterministic with the starved ticks visible as unscored."""
+    def mk():
+        return SIM.Scenario(
+            name="starve", seed=7, ticks=10, num_brokers=4,
+            faults=FaultSchedule(events=(
+                FaultEvent(tick=3, kind="kill_broker", broker_id=2),
+                FaultEvent(tick=5, kind="latency_storm", latency_s=30.0,
+                           duration_ticks=2),), seed=7))
+    c1 = SIM.run_scenario(mk())
+    c2 = SIM.run_scenario(mk())
+    assert c1.canonical_json() == c2.canonical_json()
+    assert c1.core["scoredTicks"] < c1.core["ticks"], "storm never starved"
+    assert c1.core["engines"] == ["anneal"]
+    assert c1.core["fallbackEvents"] == 0
+    assert c1.core["selfHeal"][0]["withinTickBudget"]
+
+
+def test_scorecard_surfaces_in_app_state():
+    clock_cluster_wrapper_app = SIM.build_app(
+        SIM.Scenario(name="state", seed=1, ticks=2, warmup_ticks=1))
+    app = clock_cluster_wrapper_app[3]
+    assert "SimulatorState" not in app.state()
+    app.record_simulation_scorecard({"scenario": "state", "ticks": 2})
+    st = app.state()
+    assert st["SimulatorState"]["scenario"] == "state"
+
+
+@pytest.mark.slow
+def test_200_tick_diurnal_with_broker_death_e2e():
+    """ISSUE 9 acceptance: 200 diurnal ticks, broker death at tick 100,
+    under the retrace sentinel — deterministic scorecard, no fallback off
+    the anneal engine, self-heal within the scenario SLO budget, zero
+    uncovered retraces after warmup."""
+    def mk():
+        return SIM.Scenario(
+            name="diurnal-death-200", seed=23, ticks=200, tick_ms=60_000,
+            num_brokers=5, partitions_per_topic=4, warmup_ticks=6,
+            workload=SIM.DiurnalWorkload(seed=23, period_ms=6_000_000),
+            faults=FaultSchedule(events=(
+                FaultEvent(tick=100, kind="kill_broker", broker_id=3),),
+                seed=23))
+
+    c1 = SIM.run_scenario(mk(), use_sentinel=True)
+    c2 = SIM.run_scenario(mk())
+    assert c1.canonical_json() == c2.canonical_json(), (
+        "same-seed 200-tick scenarios diverged")
+    core = c1.core
+    assert core["computeTicks"] == 200
+    assert core["engines"] == ["anneal"], core["engines"]
+    assert core["fallbackEvents"] == 0, core["fallbackReasons"]
+    heal = core["selfHeal"][0]
+    assert heal["evacuatedTick"] is not None
+    assert heal["withinTickBudget"], heal
+    assert c1.wall["uncoveredRetraces"] == [], c1.wall["uncoveredRetraces"]
