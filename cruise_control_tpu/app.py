@@ -82,6 +82,17 @@ class CruiseControlApp:
         self._sleep_fn = sleep_fn or time.sleep
         _now_s = self._now_s
         self._now_ms_fn = lambda: int(_now_s() * 1000)
+        # thread watchdog: every background loop checks a heartbeat in; the
+        # watchdog's own monitor thread (or the simulator tick loop) polls
+        # for stalls and restarts restartable threads with bounded backoff
+        from cruise_control_tpu.common.watchdog import Watchdog
+        self.watchdog = Watchdog(
+            now_ms=self._now_ms_fn,
+            stall_ms=config.get("watchdog.stall.ms"),
+            max_restarts=config.get("watchdog.max.restarts"),
+            backoff_ms=config.get("watchdog.backoff.ms"))
+        self._watchdog_thread: Optional[threading.Thread] = None
+        self._watchdog_shutdown = threading.Event()
         self.constraint = config.balancing_constraint()
         self.default_goals = tuple(config.get("default.goals"))
         if mesh is None:
@@ -166,9 +177,19 @@ class CruiseControlApp:
                 "partition.metric.sample.aggregator.completeness.cache.size"),
             broker_completeness_cache_size=config.get(
                 "broker.metric.sample.aggregator.completeness.cache.size"),
-            now_fn=self._now_ms_fn if now_fn is not None else None)
+            now_fn=self._now_ms_fn if now_fn is not None else None,
+            heartbeat=lambda: self.watchdog.beat("load-monitor-sampler"),
+            store_heartbeat=lambda: self.watchdog.beat("sample-store-flush"))
         self._metadata_source = metadata_source
         adapter = cluster_adapter or FakeClusterAdapter({})
+        # write-ahead execution journal (executor.journal.path; empty =
+        # disabled): every task transition is durable before its cluster
+        # effect, and startup() reconciles whatever the journal left open
+        from cruise_control_tpu.executor.journal import ExecutionJournal
+        _journal_path = config.get("executor.journal.path")
+        self.journal = (ExecutionJournal(
+            _journal_path, fsync=config.get("executor.journal.fsync"),
+            now_ms=self._now_ms_fn) if _journal_path else None)
         check_ms = config.get("execution.progress.check.interval.ms")
         # default.replica.movement.strategies: the strategy chain used when
         # a request names none
@@ -185,6 +206,8 @@ class CruiseControlApp:
             strategy=_chain,
             clock=self._now_s,
             sleep=self._sleep_fn,
+            journal=self.journal,
+            heartbeat=lambda: self.watchdog.beat("executor-progress"),
             notifier=resolve_pluggable(
                 config.get("executor.notifier.class"),
                 EXECUTOR_NOTIFIER_REGISTRY, base=ExecutorNotifier)(),
@@ -323,7 +346,25 @@ class CruiseControlApp:
             },
             recheck_delay_ms=config.get("anomaly.detection.recheck.delay.ms"),
             num_cached_states=config.get("num.cached.recent.anomaly.states"),
-            now_fn=self._now_ms_fn)
+            now_fn=self._now_ms_fn,
+            heartbeat=lambda: self.watchdog.beat("anomaly-detector"))
+        # heartbeat registry: stall detection is gated on each thread's
+        # active predicate, so an idle executor or a not-yet-started (or
+        # deliberately paused) loop never reads as stalled
+        self.watchdog.register(
+            "load-monitor-sampler",
+            restart_fn=self.load_monitor.restart_sampler,
+            active_fn=lambda: self.load_monitor.sampler_supervised)
+        self.watchdog.register(
+            "sample-store-flush",
+            active_fn=lambda: self.load_monitor.sampler_supervised)
+        self.watchdog.register(
+            "anomaly-detector",
+            restart_fn=self.anomaly_detector.restart,
+            active_fn=lambda: self.anomaly_detector.supervised)
+        self.watchdog.register(
+            "executor-progress",
+            active_fn=lambda: self.executor.has_ongoing_execution)
         self._proposal_cache: Optional[CachedProposals] = None
         self._cache_lock = threading.Lock()
         #: one-shot: escape kernels warmed after the first default-goal
@@ -378,6 +419,13 @@ class CruiseControlApp:
                 self, self.executor, self.load_monitor,
                 self.anomaly_detector, self.load_monitor.partition_aggregator,
                 self.load_monitor.broker_aggregator)
+        # restart reconciliation BEFORE any background thread can start an
+        # execution: replay the journal, fence out zombies, and resolve
+        # whatever the previous incarnation left in flight
+        if self.journal is not None:
+            recovery = self.executor.recover()
+            if recovery.get("openExecution"):
+                logger.warning("restart reconciliation: %s", recovery)
         self.load_monitor.startup(
             load_stored_samples=not self.config.get("skip.loading.samples"))
         self.anomaly_detector.start()
@@ -396,13 +444,48 @@ class CruiseControlApp:
                 target=self._precompute_loop, daemon=True,
                 name="proposal-precompute")
             self._precompute_thread.start()
+            self.watchdog.register(
+                "proposal-precompute",
+                restart_fn=self._restart_precompute,
+                active_fn=lambda: (
+                    self._precompute_thread is not None
+                    and not self._precompute_shutdown.is_set()))
+        # watchdog monitor thread (watchdog.interval.ms = 0 disables it;
+        # the scenario simulator drives poll() from its tick loop instead)
+        wd_interval_ms = self.config.get("watchdog.interval.ms")
+        if wd_interval_ms > 0:
+            self._watchdog_shutdown.clear()
+            self._watchdog_thread = threading.Thread(
+                target=self._watchdog_loop, args=(wd_interval_ms / 1000.0,),
+                daemon=True, name="watchdog")
+            self._watchdog_thread.start()
+
+    def _watchdog_loop(self, interval_s: float):
+        while not self._watchdog_shutdown.wait(interval_s):
+            self.watchdog.poll()
+
+    def _restart_precompute(self):
+        """Watchdog restart hook for the proposal-precompute thread."""
+        if (self._precompute_shutdown.is_set()
+                or self._precompute_thread is None
+                or self._precompute_thread.is_alive()):
+            return
+        self._precompute_thread = threading.Thread(
+            target=self._precompute_loop, daemon=True,
+            name="proposal-precompute")
+        self._precompute_thread.start()
 
     def shutdown(self):
+        self._watchdog_shutdown.set()
+        if self._watchdog_thread is not None:
+            self._watchdog_thread.join(timeout=5)
         self._precompute_shutdown.set()
         if self._precompute_thread is not None:
             self._precompute_thread.join(timeout=5)
         self.anomaly_detector.shutdown()
         self.load_monitor.shutdown()
+        if self.journal is not None:
+            self.journal.close()
         san = getattr(self, "_lock_sanitizer", None)
         if san is not None:
             logger.info("GRAFT_TSAN report: %s", san.dump())
@@ -431,6 +514,7 @@ class CruiseControlApp:
 
         Tries the incremental path first: a tick whose load deltas flip no
         goal verdict re-arms the cached proposal without annealing."""
+        self.watchdog.beat("proposal-precompute")
         if self._cache_is_fresh():
             return False
         if not self._compute_gate.acquire(blocking=False):
@@ -1448,6 +1532,12 @@ class CruiseControlApp:
         self.executor.stop_execution(forced)
         return {"stopRequested": True, "forced": forced}
 
+    @property
+    def is_reconciling(self) -> bool:
+        """True while startup restart-reconciliation is resolving journaled
+        tasks; the REST layer answers mutating requests 503 meanwhile."""
+        return self.executor.recovering
+
     def set_self_healing(self, anomaly_type: Optional[str], enabled: bool) -> dict:
         types = ([AnomalyType[anomaly_type]] if anomaly_type
                  else list(AnomalyType))
@@ -1494,6 +1584,7 @@ class CruiseControlApp:
                 **mesh_state(self.mesh),
             },
             "AnomalyDetectorState": self.anomaly_detector.state_snapshot(),
+            "WatchdogState": self.watchdog.snapshot(),
         }
         if last_simulation is not None:
             out["SimulatorState"] = last_simulation

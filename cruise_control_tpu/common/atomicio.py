@@ -1,0 +1,109 @@
+"""Crash-safe file primitives shared by the execution journal and the
+sample store.
+
+Two durability idioms live here:
+
+* :func:`atomic_replace` — full-file replacement via write-to-temp +
+  ``os.replace`` + fsync.  Readers observe either the old or the new
+  complete file, never a torn write.
+* :func:`fsync_file` / :func:`fsync_dir` — flush helpers for appenders
+  that keep a long-lived fd (the journal) and need each record durable
+  before acting on it.
+
+Plus :func:`iter_jsonl`, a tolerant JSONL reader that skips corrupt or
+truncated lines (a crash mid-append may leave a partial final line; the
+write-ahead contract only requires the *prefix* to be replayable).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+from typing import Iterator, Optional
+
+LOG = logging.getLogger("cruise-control.atomicio")
+
+
+def fsync_file(f) -> None:
+    """Flush user-space buffers and fsync an open file object."""
+    f.flush()
+    os.fsync(f.fileno())
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so a rename/creation inside it is durable.
+
+    Best-effort: some filesystems/platforms refuse O_RDONLY dir fds.
+    """
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # pragma: no cover - platform dependent
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover - platform dependent
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_replace(path: str, data: bytes, fsync: bool = True) -> None:
+    """Atomically replace ``path`` with ``data``.
+
+    Writes to a temp file in the same directory, fsyncs it, then
+    ``os.replace``s over the target and fsyncs the directory.  A crash
+    at any point leaves either the complete old file or the complete
+    new file — never a truncated hybrid.
+    """
+    directory = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=directory,
+                               prefix=os.path.basename(path) + ".",
+                               suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            if fsync:
+                fsync_file(f)
+        os.replace(tmp, path)
+        if fsync:
+            fsync_dir(directory)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def iter_jsonl(path: str) -> Iterator[dict]:
+    """Yield parsed objects from a JSONL file, skipping corrupt lines.
+
+    A truncated trailing line (crash mid-append) is skipped with a
+    warning rather than raised, so any durable prefix replays cleanly.
+    Missing file yields nothing.
+    """
+    if not os.path.exists(path):
+        return
+    with open(path, "r", encoding="utf-8") as f:
+        for lineno, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except (json.JSONDecodeError, ValueError):
+                LOG.warning("Skipping corrupt line %d in %s", lineno, path)
+                continue
+            if isinstance(obj, dict):
+                yield obj
+
+
+def read_file(path: str) -> Optional[bytes]:
+    """Read a whole file, returning ``None`` if it does not exist."""
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except FileNotFoundError:
+        return None

@@ -338,6 +338,23 @@ def _service_config_def() -> ConfigDef:
     d.define("executor.task.stuck.deadline.ms", T.LONG, 300_000, I.MEDIUM,
              "Abort an in-flight task whose cluster-observed progress has "
              "not changed for this long.", at_least(1))
+    d.define("executor.journal.path", T.STRING, "", I.MEDIUM,
+             "Write-ahead execution journal file (JSONL). Empty disables "
+             "journaling and restart reconciliation.")
+    d.define("executor.journal.fsync", T.BOOLEAN, True, I.LOW,
+             "fsync the journal on every append (and its epoch sidecar on "
+             "every replace). Disable only for tests/benchmarks.")
+    d.define("watchdog.stall.ms", T.LONG, 30_000, I.MEDIUM,
+             "A background thread whose heartbeat is older than this is "
+             "considered stalled.", at_least(1))
+    d.define("watchdog.max.restarts", T.INT, 3, I.LOW,
+             "Restart budget per supervised thread; past it the thread is "
+             "reported degraded instead.", at_least(0))
+    d.define("watchdog.backoff.ms", T.LONG, 1_000, I.LOW,
+             "Initial restart backoff; doubles per restart.", at_least(1))
+    d.define("watchdog.interval.ms", T.LONG, 5_000, I.LOW,
+             "Watchdog poll period. 0 disables the monitor thread (the "
+             "scenario simulator polls explicitly instead).", at_least(0))
     d.define("logdir.response.timeout.ms", T.LONG, 10_000, I.LOW,
              "DescribeLogDirs request timeout.", at_least(1))
     d.define("inter.broker.replica.movement.rate.alerting.threshold",

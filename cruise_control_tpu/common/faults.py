@@ -69,6 +69,17 @@ class AdapterTransientError(RuntimeError):
     NOT_CONTROLLER / disconnect class the reference retries)."""
 
 
+class ProcessCrashed(BaseException):
+    """Simulated control-plane process death (the ``process_crash``
+    simulator fault).
+
+    Deliberately a ``BaseException``: a real crash is not containable,
+    so it must blow through every ``except Exception`` containment layer
+    (executor task containment, detector fix handling) and reach the
+    scenario runner, which then rebuilds the app and exercises restart
+    reconciliation."""
+
+
 @dataclasses.dataclass(frozen=True)
 class FaultPlan:
     """Seeded schedule of fault events for :class:`FaultyClusterAdapter`.
@@ -103,6 +114,9 @@ class FaultPlan:
     fail_disk_broker_id: Optional[int] = None
     fail_disk_logdir: str = "/data/d0"
     fail_disk_after_calls: Optional[int] = None
+    #: raise :class:`ProcessCrashed` once the guarded-call counter passes
+    #: the threshold — simulated control-plane death mid-execution
+    process_crash_after_calls: Optional[int] = None
 
 
 class FaultyClusterAdapter:
@@ -124,6 +138,11 @@ class FaultyClusterAdapter:
         self._stuck_submitted: Set[str] = set()
         self._forced_dead: Set[int] = set()
         self._forced_bad_disks: Dict[int, Dict[str, bool]] = {}
+        #: invoked once, just before ProcessCrashed is raised — the scenario
+        #: runner freezes the execution journal here so the "dead" process
+        #: writes nothing more (a real kill would not run finally blocks)
+        self.on_crash: Optional[Callable[[], None]] = None
+        self._crashed = False
 
     def set_plan(self, plan: FaultPlan) -> None:
         """Swap the active fault plan. ``self.plan`` is read per guarded
@@ -138,6 +157,16 @@ class FaultyClusterAdapter:
     def _guard(self, method: str) -> None:
         plan = self.plan
         self.calls += 1
+        if (plan.process_crash_after_calls is not None
+                and self.calls >= plan.process_crash_after_calls
+                and not self._crashed):
+            self._crashed = True
+            self.injected["process_crash"] = (
+                self.injected.get("process_crash", 0) + 1)
+            if self.on_crash is not None:
+                self.on_crash()
+            raise ProcessCrashed(
+                f"injected process crash in {method} (call {self.calls})")
         if (plan.kill_broker_after_calls is not None
                 and plan.kill_broker_id is not None
                 and self.calls >= plan.kill_broker_after_calls

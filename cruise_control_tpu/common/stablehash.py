@@ -1,0 +1,29 @@
+"""Process-stable hashing for deterministic seeds and bucketing.
+
+Python's builtin ``hash()`` is randomized per process for ``str`` and
+``bytes`` (PYTHONHASHSEED), so anything derived from
+``hash((seed, topic, partition))`` — synthetic load rates, hot-group
+assignment — silently differs between interpreter invocations.  Within
+one process everything stays self-consistent, which is why the bug only
+shows up when two runs of the *same seed* in *different processes* are
+compared: the byte-identical-journal and bit-identical-convergence
+guarantees (docs/operations.md) are cross-process statements, so they
+must not depend on interpreter hash randomization.
+
+:func:`stable_hash32` is the drop-in replacement: a CRC-32 over the
+``repr`` of the parts, stable across processes, platforms, and Python
+versions for the primitive types used here (ints and strings).
+"""
+
+from __future__ import annotations
+
+import zlib
+
+__all__ = ["stable_hash32"]
+
+
+def stable_hash32(*parts) -> int:
+    """A stable 32-bit hash of ``parts`` (ints/strings), suitable as an
+    RNG seed or modulo bucket.  NOT cryptographic."""
+    payload = "\x1f".join(repr(p) for p in parts).encode("utf-8")
+    return zlib.crc32(payload) & 0xFFFFFFFF

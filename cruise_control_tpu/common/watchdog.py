@@ -1,0 +1,151 @@
+"""Thread watchdog: heartbeat registry + bounded restart supervision.
+
+Every long-lived background thread (monitor sampler, detector sweep,
+proposal precompute, executor progress loop, sample-store flusher)
+registers a named heartbeat and calls :meth:`Watchdog.beat` from its
+loop.  :meth:`Watchdog.poll` — driven either by the watchdog's own
+monitor thread (wall-clock deployments) or by the simulator tick loop
+(virtual time) — flags heartbeats older than ``stall_ms`` and, for
+threads registered with a ``restart_fn``, restarts them with
+exponential backoff, up to ``max_restarts`` times.  A thread that
+exhausts its restart budget is surfaced as degraded in ``/state``
+rather than silently dead.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class _Heartbeat:
+    name: str
+    last_beat_ms: int
+    restart_fn: Optional[Callable[[], None]] = None
+    #: stall detection applies only while this returns True (e.g. the
+    #: executor progress heartbeat is only live during an execution)
+    active_fn: Optional[Callable[[], bool]] = None
+    restarts: int = 0
+    next_restart_ms: int = 0
+    degraded: bool = False
+    beats: int = 0
+    last_error: str = ""
+
+
+class Watchdog:
+    """Heartbeat registry with stall detection and bounded restarts."""
+
+    def __init__(self, now_ms: Callable[[], int] = None,
+                 stall_ms: int = 30_000, max_restarts: int = 3,
+                 backoff_ms: int = 1_000):
+        self._now_ms = now_ms or (lambda: int(time.time() * 1000))
+        self.stall_ms = int(stall_ms)
+        self.max_restarts = int(max_restarts)
+        self.backoff_ms = int(backoff_ms)
+        self._lock = threading.Lock()
+        self._threads: Dict[str, _Heartbeat] = {}
+        self.total_restarts = 0
+
+    # ------------------------------------------------------- registry
+
+    def register(self, name: str,
+                 restart_fn: Optional[Callable[[], None]] = None,
+                 active_fn: Optional[Callable[[], bool]] = None) -> None:
+        with self._lock:
+            self._threads[name] = _Heartbeat(
+                name=name, last_beat_ms=int(self._now_ms()),
+                restart_fn=restart_fn, active_fn=active_fn)
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._threads.pop(name, None)
+
+    def beat(self, name: str) -> None:
+        now = int(self._now_ms())
+        with self._lock:
+            hb = self._threads.get(name)
+            if hb is None:
+                return
+            hb.last_beat_ms = now
+            hb.beats += 1
+
+    # ----------------------------------------------------- supervision
+
+    def poll(self) -> List[str]:
+        """Check all heartbeats; restart stalled restartable threads.
+
+        Returns the names restarted this poll.
+        """
+        now = int(self._now_ms())
+        restarted: List[str] = []
+        with self._lock:
+            candidates = list(self._threads.values())
+        stalled = []
+        for hb in candidates:
+            if hb.active_fn is not None and not hb.active_fn():
+                # idle: the stall clock starts when the thread goes active
+                hb.last_beat_ms = now
+                continue
+            if now - hb.last_beat_ms > self.stall_ms and not hb.degraded:
+                stalled.append(hb)
+        for hb in stalled:
+            if hb.restart_fn is None:
+                continue
+            if now < hb.next_restart_ms:
+                continue
+            if hb.restarts >= self.max_restarts:
+                hb.degraded = True
+                logger.error("Thread %s exhausted %d restarts; degraded",
+                             hb.name, self.max_restarts)
+                continue
+            try:
+                hb.restart_fn()
+                hb.last_error = ""
+            except Exception as exc:  # noqa: BLE001 - supervision boundary
+                hb.last_error = f"{type(exc).__name__}: {exc}"
+                logger.error("Restart of %s failed: %s",
+                             hb.name, hb.last_error)
+            hb.restarts += 1
+            self.total_restarts += 1
+            # exponential backoff: 1x, 2x, 4x ... of backoff_ms
+            hb.next_restart_ms = now + self.backoff_ms * (
+                2 ** (hb.restarts - 1))
+            hb.last_beat_ms = now  # grace period after restart
+            restarted.append(hb.name)
+            logger.warning("Watchdog restarted stalled thread %s "
+                           "(restart %d/%d)", hb.name, hb.restarts,
+                           self.max_restarts)
+        return restarted
+
+    def snapshot(self) -> dict:
+        """State for ``/state``: per-thread heartbeat age and health."""
+        now = int(self._now_ms())
+        with self._lock:
+            entries = list(self._threads.values())
+        threads = {}
+        for hb in entries:
+            active = hb.active_fn is None or bool(hb.active_fn())
+            threads[hb.name] = {
+                "ageMs": max(0, now - hb.last_beat_ms),
+                "beats": hb.beats,
+                "active": active,
+                "stalled": (active
+                            and now - hb.last_beat_ms > self.stall_ms),
+                "restarts": hb.restarts,
+                "restartable": hb.restart_fn is not None,
+                "degraded": hb.degraded,
+                **({"lastError": hb.last_error} if hb.last_error else {}),
+            }
+        return {
+            "stallMs": self.stall_ms,
+            "maxRestarts": self.max_restarts,
+            "totalRestarts": self.total_restarts,
+            "degraded": any(t["degraded"] for t in threads.values()),
+            "threads": threads,
+        }

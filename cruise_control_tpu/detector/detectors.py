@@ -385,9 +385,14 @@ class AnomalyDetectorService:
                  interval_ms: int = 300_000,
                  intervals_ms: Optional[Dict[str, int]] = None,
                  recheck_delay_ms: Optional[int] = None,
-                 num_cached_states: int = 20, now_fn=_now_ms):
+                 num_cached_states: int = 20, now_fn=_now_ms,
+                 heartbeat: Optional[Callable[[], None]] = None):
         self.notifier = notifier
         self.context = context
+        #: watchdog heartbeat: checked into on every sweep so a wedged or
+        #: dead detector loop is restartable by the supervisor
+        self._heartbeat = heartbeat or (lambda: None)
+        self._started = False
         self._has_exec = has_ongoing_execution
         self.detectors = detectors or {}
         self.interval_ms = interval_ms
@@ -457,6 +462,7 @@ class AnomalyDetectorService:
         runs at its override interval when configured, else every
         ``interval_ms`` (due-tracked, so the loop may tick faster)."""
         n = 0
+        self._heartbeat()
         now = self._now()
         for name, det in self.detectors.items():
             interval = self.intervals_ms.get(name, self.interval_ms)
@@ -551,14 +557,33 @@ class AnomalyDetectorService:
 
     # -- service loop --
     def start(self):
+        self._started = True
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="anomaly-detector")
         self._thread.start()
 
     def shutdown(self):
+        self._started = False
         self._shutdown.set()
         if self._thread:
             self._thread.join(timeout=5)
+
+    @property
+    def supervised(self) -> bool:
+        """True while the service loop is supposed to be running — the
+        watchdog only judges (and restarts) the thread in this window."""
+        return self._started and not self._shutdown.is_set()
+
+    def restart(self) -> None:
+        """Watchdog restart hook: re-spawn the service loop if its thread
+        died (an escaped exception) while the service should be running."""
+        if not self.supervised:
+            return
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="anomaly-detector")
+        self._thread.start()
 
     def _run(self):
         # wake at the FASTEST configured cadence so a per-detector interval

@@ -23,6 +23,7 @@ from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tupl
 logger = logging.getLogger(__name__)
 
 from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.executor.journal import ExecutionJournal, StaleEpochError
 from cruise_control_tpu.executor.tasks import (
     ExecutionTask,
     ExecutionTaskPlanner,
@@ -31,6 +32,10 @@ from cruise_control_tpu.executor.tasks import (
     TaskState,
     TaskType,
 )
+
+#: journal states that need no reconciliation on restart
+_TERMINAL_TASK_STATES = frozenset({
+    TaskState.COMPLETED.value, TaskState.ABORTED.value, TaskState.DEAD.value})
 
 
 class ExecutorState(enum.Enum):
@@ -399,11 +404,19 @@ class Executor:
                  notifier: Optional[ExecutorNotifier] = None,
                  strategy: Optional[ReplicaMovementStrategy] = None,
                  clock: Callable[[], float] = time.time,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 journal: Optional[ExecutionJournal] = None,
+                 heartbeat: Optional[Callable[[], None]] = None):
         self.adapter = adapter
         self.config = config or ExecutorConfig()
         self.notifier = notifier or ExecutorNotifier()
         self._strategy = strategy
+        # write-ahead execution journal (None = journaling disabled) and the
+        # watchdog heartbeat the progress loop checks into every poll round
+        self._journal = journal
+        self._beat = heartbeat or (lambda: None)
+        self.recovering = False
+        self._last_recovery: Optional[dict] = None
         # virtual-time seam: every deadline/timestamp decision (stuck tasks,
         # alerting thresholds, history retention) reads ``clock``; every
         # poll-interval and retry-backoff wait goes through ``sleep``. A
@@ -497,13 +510,152 @@ class Executor:
             return self._state != ExecutorState.NO_TASK_IN_PROGRESS
 
     def state_snapshot(self) -> dict:
-        return {
+        out = {
             "state": self.state.value,
             "taskCounts": self.tracker.snapshot(),
             "finishedDataMovementMB": self.tracker.finished_data_movement_mb,
             "recentlyRemovedBrokers": sorted(self.recently_removed_brokers),
             "recentlyDemotedBrokers": sorted(self.recently_demoted_brokers),
+            "executorRecovery": {
+                "recovering": self.recovering,
+                "lastRecovery": self._last_recovery,
+            },
         }
+        if self._journal is not None:
+            out["journalPath"] = self._journal.path
+            out["journalEntries"] = self._journal.entries
+            last = self._journal.last_append_ms
+            out["journalLagMs"] = (
+                max(0, int(self._clock() * 1000) - last)
+                if last is not None else None)
+        if self._last_recovery is not None:
+            out["lastRecovery"] = self._last_recovery
+        return out
+
+    # -- write-ahead journal --
+    def _journal_task(self, task: ExecutionTask) -> None:
+        """Append a task transition — BEFORE the corresponding cluster
+        effect (write-ahead). A :class:`StaleEpochError` here means this
+        process has been superseded; it propagates and aborts the
+        execution before any further adapter mutation."""
+        if self._journal is not None:
+            self._journal.log_task(task.execution_id, task.task_type.value,
+                                   task.proposal.topic_partition,
+                                   task.state.value)
+
+    # -- restart reconciliation --
+    def _proposal_finished(self, p: ExecutionProposal) -> bool:
+        tp = p.topic_partition
+        if p.has_replica_action and not p.is_completed(
+                self._adapter.current_replicas(tp)):
+            return False
+        if (p.has_leader_action
+                and self._adapter.current_leader(tp) != p.new_replicas[0]):
+            return False
+        return True
+
+    def recover(self) -> dict:
+        """Restart reconciliation (Executor.java onActivation semantics).
+
+        Replays the write-ahead journal, claims a new execution epoch
+        (fencing out any zombie pre-crash incarnation), classifies each
+        journaled open task against live cluster metadata —
+
+        ========================  =================================
+        observation               action
+        ========================  =================================
+        terminal in journal       nothing (already resolved)
+        target reached            completed — finish tracking
+        adapter still moving it   still-moving — resume in new epoch
+        journaled in-progress,    orphaned — cancel any stray move,
+        neither of the above      then roll forward in new epoch
+        journaled pending only    pending — re-execute
+        ========================  =================================
+
+        — then synchronously re-executes every unfinished proposal
+        through the normal execution path (the adapters converge on
+        re-submission). Returns (and stores for ``/state``) a summary.
+        """
+        if self._journal is None:
+            return {"performed": False}
+        t0 = self._clock()
+        replay = self._journal.replay()
+        self.recovering = True
+        try:
+            new_epoch = self._journal.advance_epoch()
+            counts = {"completed": 0, "stillMoving": 0, "orphaned": 0,
+                      "pending": 0}
+            unfinished: List[ExecutionProposal] = []
+            rolled_back = 0
+            open_exec = replay.open_execution
+            if open_exec is not None:
+                try:
+                    in_prog = set(self._adapter.in_progress_reassignments())
+                except NotImplementedError:
+                    in_prog = set()
+                for p in open_exec.proposals:
+                    tp = p.topic_partition
+                    states = {
+                        open_exec.task_states.get(
+                            (TaskType.INTER_BROKER_REPLICA_ACTION.value, tp)),
+                        open_exec.task_states.get(
+                            (TaskType.LEADER_ACTION.value, tp))}
+                    states.discard(None)
+                    if states and states <= _TERMINAL_TASK_STATES:
+                        # every journaled leg reached a terminal state
+                        # before the crash; nothing to reconcile
+                        continue
+                    if self._proposal_finished(p):
+                        counts["completed"] += 1
+                        continue
+                    if tp in in_prog:
+                        counts["stillMoving"] += 1
+                    elif TaskState.IN_PROGRESS.value in states:
+                        # submitted (journal says so) but the cluster shows
+                        # neither progress nor completion: orphaned. Cancel
+                        # any stray reassignment, then roll forward below.
+                        counts["orphaned"] += 1
+                        rolled_back += 1
+                        orphan = ExecutionTask(
+                            0, p, TaskType.INTER_BROKER_REPLICA_ACTION)
+                        try:
+                            self._adapter.cancel_reassignments([orphan])
+                        except NotImplementedError:
+                            pass
+                        except Exception:
+                            logger.exception(
+                                "rollback of orphaned reassignment %s "
+                                "failed; re-executing anyway", tp)
+                    else:
+                        counts["pending"] += 1
+                    unfinished.append(p)
+            resume_summary = None
+            if unfinished:
+                resume_summary = self.execute_proposals(
+                    unfinished,
+                    removed_brokers=open_exec.removed_brokers,
+                    demoted_brokers=open_exec.demoted_brokers)
+            remaining = [p for p in unfinished
+                         if not self._proposal_finished(p)]
+            summary = {
+                "performed": True,
+                "epoch": new_epoch,
+                "journalEntries": replay.entries,
+                "openExecution": open_exec is not None,
+                "classified": counts,
+                "resumed": len(unfinished),
+                "rolledBack": rolled_back,
+                "orphanedRemaining": len(remaining),
+                "durationMs": round((self._clock() - t0) * 1000.0, 3),
+            }
+            if resume_summary is not None:
+                summary["resumeStopped"] = resume_summary.get("stopped", False)
+            self._last_recovery = summary
+            from cruise_control_tpu.common.metrics import REGISTRY
+            REGISTRY.counter("executor-recovery-rate")
+            return summary
+        finally:
+            self.recovering = False
 
     def stop_execution(self, forced: bool = False):
         """Stop the ongoing execution (Executor.java:94-99 stopExecution):
@@ -575,7 +727,10 @@ class Executor:
             self._exec_stuck = 0
             t0 = self._clock()
             self._interval_override_ms = progress_check_interval_ms
-            planner = ExecutionTaskPlanner(strategy)
+            # epoch-fenced task IDs: epoch << 32 | seq (journal.py fencing)
+            id_start = (self._journal.epoch << 32
+                        if self._journal is not None else 0)
+            planner = ExecutionTaskPlanner(strategy, id_start=id_start)
             planner.add_proposals(proposals)
             with self._lock:
                 self._planner = planner
@@ -583,6 +738,12 @@ class Executor:
             self.tracker.register(planner.replica_tasks)
             self.tracker.register(planner.leadership_tasks)
             self.record_history(removed_brokers, demoted_brokers)
+            # write-ahead: the full reassignment payload is durable before
+            # any cluster mutation, so a crash from here on is recoverable
+            if self._journal is not None:
+                self._journal.log_execution_start(
+                    proposals, removed_brokers, demoted_brokers,
+                    generation=getattr(self.adapter, "generation", -1))
 
             throttle = (replication_throttle
                         if replication_throttle is not None
@@ -674,6 +835,15 @@ class Executor:
                 summary["slowInterBrokerMovementRateMBps"] = round(
                     data_mb / duration_s, 6)
             self._execution_history.append(summary)
+            if self._journal is not None:
+                try:
+                    self._journal.log_execution_end(
+                        "crashed" if crashed
+                        else "stopped" if self._stop_requested.is_set()
+                        else "completed")
+                except StaleEpochError:
+                    # a fenced-out zombie must not mask the original error
+                    pass
             with self._lock:
                 self._state = ExecutorState.NO_TASK_IN_PROGRESS
                 self._planner = None
@@ -756,6 +926,7 @@ class Executor:
             now = int(self._clock() * 1000)
             for t in batch:
                 t.transition(TaskState.IN_PROGRESS, now)
+                self._journal_task(t)   # write-ahead: durable before submit
                 self.tracker.mark(t, TaskState.PENDING)
             batch = self._submit_contained(
                 batch, self._adapter.execute_replica_reassignments)
@@ -775,6 +946,7 @@ class Executor:
             now = int(self._clock() * 1000)
             for t in batch:
                 t.transition(TaskState.IN_PROGRESS, now)
+                self._journal_task(t)   # write-ahead: durable before submit
                 self.tracker.mark(t, TaskState.PENDING)
             batch = self._submit_contained(
                 batch, self._adapter.execute_preferred_leader_elections)
@@ -829,6 +1001,7 @@ class Executor:
         """Adapter-failure containment: this task dies, the run survives."""
         prev = task.state
         task.transition(TaskState.DEAD, now_ms)
+        self._journal_task(task)
         self.tracker.mark(task, prev)
         self._exec_task_failures += 1
         from cruise_control_tpu.common.metrics import REGISTRY
@@ -888,6 +1061,7 @@ class Executor:
         progress: Dict[int, Tuple[object, float]] = {
             id(t): (None, batch_t0) for t in open_tasks}
         while open_tasks and rounds < budget:
+            self._beat()    # executor-progress watchdog heartbeat
             if (not alerted and (self._clock() - batch_t0) * 1000
                     > self.config.task_execution_alerting_threshold_ms):
                 # task.execution.alerting.threshold.ms: surface slow batches
@@ -935,6 +1109,7 @@ class Executor:
                             self._adapter.current_replicas(
                                 t.proposal.topic_partition)):
                         t.transition(TaskState.ABORTING, now)
+                        self._journal_task(t)   # before the adapter cancel
                         self.tracker.mark(t, TaskState.IN_PROGRESS)
                         aborting.append(t)
                         continue
@@ -943,6 +1118,7 @@ class Executor:
                 else:
                     prev = t.state
                     t.transition(outcome, now)
+                    self._journal_task(t)
                     self.tracker.mark(t, prev)
             if stuck:
                 from cruise_control_tpu.common.metrics import REGISTRY
@@ -959,11 +1135,13 @@ class Executor:
                     aborting.extend(stuck)
                     for t in stuck:
                         t.transition(TaskState.ABORTING, now)
+                        self._journal_task(t)
                         self.tracker.mark(t, TaskState.IN_PROGRESS)
                 else:
                     for t in stuck:
                         prev = t.state
                         t.transition(TaskState.DEAD, now)
+                        self._journal_task(t)
                         self.tracker.mark(t, prev)
             if aborting:
                 # adapter-side cancel BEFORE marking ABORTED: a graceful
@@ -988,6 +1166,7 @@ class Executor:
                         len(aborting))
                 for t in aborting:
                     t.transition(TaskState.ABORTED, now)
+                    self._journal_task(t)
                     self.tracker.mark(t, TaskState.ABORTING)
             open_tasks = still
             if open_tasks:
@@ -998,4 +1177,5 @@ class Executor:
             for t in open_tasks:
                 prev = t.state
                 t.transition(TaskState.DEAD, now)
+                self._journal_task(t)
                 self.tracker.mark(t, prev)

@@ -1,0 +1,276 @@
+"""Write-ahead execution journal.
+
+Append-only JSONL record of everything the :class:`Executor` is about
+to do and has done: execution starts (with full reassignment payloads),
+per-task state transitions, and execution ends.  The journal is written
+*before* the corresponding cluster mutation (write-ahead discipline),
+flushed + fsynced per append, and is replayable after any prefix
+truncation — a torn final line is skipped, everything before it is
+authoritative.
+
+Epoch fencing
+-------------
+Each journal carries a monotonically increasing *execution epoch*
+persisted in an atomically-replaced sidecar file (``<path>.epoch``).  A
+restarted process calls :meth:`ExecutionJournal.advance_epoch` before
+acting; any zombie pre-crash process still holding the old epoch gets
+:class:`StaleEpochError` on its next append and therefore never submits
+another mutation (appends happen before effects).  The epoch is also
+fenced into task IDs (``execution_id = epoch << 32 | seq``) so journaled
+records from different incarnations can never collide.
+
+Record format (deterministic: sorted keys, compact separators, virtual
+timestamps only) — see docs/operations.md for the full table::
+
+    {"type": "epoch", "epoch": N, "ts": ms}
+    {"type": "execution_start", "epoch": N, "ts": ms, "generation": g,
+     "proposals": [...], "removedBrokers": [...], "demotedBrokers": [...]}
+    {"type": "task", "epoch": N, "ts": ms, "executionId": id,
+     "taskType": "INTER_BROKER_REPLICA_ACTION", "tp": "t-0",
+     "state": "IN_PROGRESS"}
+    {"type": "execution_end", "epoch": N, "ts": ms, "result": "completed"}
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..analyzer.proposals import ExecutionProposal
+from ..common.atomicio import atomic_replace, fsync_file, iter_jsonl
+
+LOG = logging.getLogger("cruise-control.journal")
+
+
+class StaleEpochError(RuntimeError):
+    """Raised when a journal writer's epoch has been superseded.
+
+    The holder is a zombie pre-crash incarnation; it must abandon the
+    operation without touching the cluster.
+    """
+
+
+def proposal_to_record(p: ExecutionProposal) -> dict:
+    return {
+        "topic": p.topic,
+        "partition": p.partition,
+        "oldLeader": p.old_leader,
+        "oldReplicas": list(p.old_replicas),
+        "newReplicas": list(p.new_replicas),
+        "dataSize": p.data_size,
+    }
+
+
+def proposal_from_record(r: dict) -> ExecutionProposal:
+    return ExecutionProposal(
+        topic=r["topic"],
+        partition=int(r["partition"]),
+        old_leader=int(r["oldLeader"]),
+        old_replicas=tuple(int(b) for b in r["oldReplicas"]),
+        new_replicas=tuple(int(b) for b in r["newReplicas"]),
+        data_size=float(r["dataSize"]),
+    )
+
+
+@dataclass
+class OpenExecution:
+    """An execution_start with no matching execution_end in the journal."""
+
+    epoch: int
+    generation: int
+    proposals: List[ExecutionProposal] = field(default_factory=list)
+    removed_brokers: Tuple[int, ...] = ()
+    demoted_brokers: Tuple[int, ...] = ()
+    #: latest journaled state keyed by (taskType, "topic-partition")
+    task_states: Dict[Tuple[str, str], str] = field(default_factory=dict)
+
+    def proposal_for(self, tp: str) -> Optional[ExecutionProposal]:
+        for p in self.proposals:
+            if p.topic_partition == tp:
+                return p
+        return None
+
+
+@dataclass
+class JournalReplay:
+    """Result of replaying a journal from disk."""
+
+    epoch: int = 0
+    entries: int = 0
+    open_execution: Optional[OpenExecution] = None
+
+
+class ExecutionJournal:
+    """Append-only, fsynced, epoch-fenced execution journal."""
+
+    def __init__(self, path: str, fsync: bool = True,
+                 now_ms: Callable[[], int] = None):
+        self._path = path
+        self._epoch_path = path + ".epoch"
+        self._fsync = fsync
+        self._now_ms = now_ms or (lambda: int(time.time() * 1000))
+        directory = os.path.dirname(os.path.abspath(path))
+        os.makedirs(directory, exist_ok=True)
+        self._epoch = self._read_epoch_file()
+        self._entries = sum(1 for _ in iter_jsonl(path))
+        self._fh = None
+        self._last_append_ms: Optional[int] = None
+        self._frozen = False
+
+    # ----------------------------------------------------------- epoch
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    @property
+    def entries(self) -> int:
+        return self._entries
+
+    @property
+    def last_append_ms(self) -> Optional[int]:
+        return self._last_append_ms
+
+    def _read_epoch_file(self) -> int:
+        try:
+            with open(self._epoch_path, "r", encoding="utf-8") as f:
+                return int(json.loads(f.read())["epoch"])
+        except (OSError, ValueError, KeyError, TypeError):
+            return 0
+
+    def advance_epoch(self) -> int:
+        """Claim the next execution epoch, fencing out all prior holders.
+
+        Persisted via atomic replace *before* the epoch record is
+        appended, so a crash between the two still leaves older
+        incarnations fenced.
+        """
+        self._epoch = self._read_epoch_file() + 1
+        payload = json.dumps({"epoch": self._epoch},
+                             sort_keys=True, separators=(",", ":"))
+        atomic_replace(self._epoch_path, payload.encode("utf-8"),
+                       fsync=self._fsync)
+        self._append({"type": "epoch"})
+        return self._epoch
+
+    def _check_epoch(self) -> None:
+        if self._read_epoch_file() != self._epoch:
+            raise StaleEpochError(
+                f"journal epoch {self._epoch} superseded "
+                f"(current {self._read_epoch_file()}); refusing to act")
+
+    def freeze(self) -> None:
+        """Simulate process death: refuse every subsequent append.
+
+        Used by the simulator's ``process_crash`` fault — a killed
+        process writes nothing more, including the ``finally``-path
+        execution_end a normal interpreter would still reach (the
+        executor swallows that one ``StaleEpochError`` so the original
+        crash propagates unmasked).  Appends after death *raise* rather
+        than silently succeed: a frozen journal no-op would let a dead
+        incarnation start a whole new execution without ever hitting the
+        epoch check — the write-ahead fence only works if every append
+        either lands or refuses.
+        """
+        self._frozen = True
+        self.close()
+
+    # ---------------------------------------------------------- append
+
+    def _append(self, record: dict) -> None:
+        if self._frozen:
+            raise StaleEpochError(
+                "journal frozen (process death); refusing to act")
+        self._check_epoch()
+        record = dict(record)
+        record["epoch"] = self._epoch
+        record["ts"] = int(self._now_ms())
+        line = json.dumps(record, sort_keys=True, separators=(",", ":"))
+        if self._fh is None:
+            self._fh = open(self._path, "a", encoding="utf-8")
+        self._fh.write(line + "\n")
+        if self._fsync:
+            fsync_file(self._fh)
+        else:
+            self._fh.flush()
+        self._entries += 1
+        self._last_append_ms = record["ts"]
+
+    def log_execution_start(self, proposals, removed_brokers=(),
+                            demoted_brokers=(), generation: int = -1) -> None:
+        self._append({
+            "type": "execution_start",
+            "generation": int(generation),
+            "proposals": [proposal_to_record(p) for p in proposals],
+            "removedBrokers": sorted(int(b) for b in removed_brokers),
+            "demotedBrokers": sorted(int(b) for b in demoted_brokers),
+        })
+
+    def log_task(self, execution_id: int, task_type: str, tp: str,
+                 state: str) -> None:
+        self._append({
+            "type": "task",
+            "executionId": int(execution_id),
+            "taskType": task_type,
+            "tp": tp,
+            "state": state,
+        })
+
+    def log_execution_end(self, result: str) -> None:
+        self._append({"type": "execution_end", "result": result})
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:  # pragma: no cover
+                pass
+            self._fh = None
+
+    # ---------------------------------------------------------- replay
+
+    def replay(self) -> JournalReplay:
+        """Parse the journal into its net effect.
+
+        Tolerates a torn trailing line; the durable prefix is
+        authoritative.  Only the *last* execution_start can be open —
+        an execution_start implicitly closes any predecessor (the
+        executor is single-flight).
+        """
+        out = JournalReplay(epoch=self._read_epoch_file())
+        open_exec: Optional[OpenExecution] = None
+        for rec in iter_jsonl(self._path):
+            out.entries += 1
+            rtype = rec.get("type")
+            if rtype == "epoch":
+                continue
+            if rtype == "execution_start":
+                try:
+                    props = [proposal_from_record(r)
+                             for r in rec.get("proposals", [])]
+                except (KeyError, ValueError, TypeError):
+                    LOG.warning("Unreadable execution_start in %s; skipping",
+                                self._path)
+                    continue
+                open_exec = OpenExecution(
+                    epoch=int(rec.get("epoch", 0)),
+                    generation=int(rec.get("generation", -1)),
+                    proposals=props,
+                    removed_brokers=tuple(rec.get("removedBrokers", ())),
+                    demoted_brokers=tuple(rec.get("demotedBrokers", ())),
+                )
+            elif rtype == "task" and open_exec is not None:
+                key = (str(rec.get("taskType")), str(rec.get("tp")))
+                open_exec.task_states[key] = str(rec.get("state"))
+            elif rtype == "execution_end":
+                open_exec = None
+        out.open_execution = open_exec
+        return out

@@ -149,9 +149,14 @@ class ExecutionTaskPlanner:
     """Splits proposals into replica-move / leadership task pools and hands
     out per-round batches honoring per-broker concurrency."""
 
-    def __init__(self, strategy: Optional[ReplicaMovementStrategy] = None):
+    def __init__(self, strategy: Optional[ReplicaMovementStrategy] = None,
+                 id_start: int = 0):
+        # ``id_start`` fences the execution epoch into every task ID
+        # (``epoch << 32 | seq``): journaled records from different process
+        # incarnations can never collide, and a zombie's stale IDs are
+        # recognizable on sight.
         self._strategy = strategy or BaseReplicaMovementStrategy()
-        self._id_gen = itertools.count()
+        self._id_gen = itertools.count(id_start)
         self.replica_tasks: List[ExecutionTask] = []
         self.leadership_tasks: List[ExecutionTask] = []
         self.intra_broker_tasks: List[ExecutionTask] = []

@@ -124,10 +124,16 @@ class LoadMonitor:
                  max_allowed_extrapolations_per_broker: Optional[int] = None,
                  partition_completeness_cache_size: int = 5,
                  broker_completeness_cache_size: int = 5,
-                 now_fn: Optional[Callable[[], int]] = None):
+                 now_fn: Optional[Callable[[], int]] = None,
+                 heartbeat: Optional[Callable[[], None]] = None,
+                 store_heartbeat: Optional[Callable[[], None]] = None):
         from cruise_control_tpu.monitor.fetcher import MetricFetcherManager
         self._metadata_source = metadata_source
         self._sampler = sampler
+        #: watchdog heartbeats: the sampling pass checks in on every
+        #: sample_once, the sample-store flusher after every store write
+        self._heartbeat = heartbeat or (lambda: None)
+        self._store_heartbeat = store_heartbeat or (lambda: None)
         self._fetchers = MetricFetcherManager(sampler,
                                               num_fetchers=num_metric_fetchers)
         self._capacity_resolver = capacity_resolver or StaticCapacityResolver(
@@ -307,6 +313,24 @@ class LoadMonitor:
             except Exception:       # sampling must never kill the loop
                 pass
 
+    @property
+    def sampler_supervised(self) -> bool:
+        """True while the sampling thread is supposed to be running and not
+        paused — the watchdog's stall window for the sampler heartbeat."""
+        return (self._thread is not None and not self._shutdown.is_set()
+                and self.state in (MonitorState.RUNNING,
+                                   MonitorState.SAMPLING))
+
+    def restart_sampler(self) -> None:
+        """Watchdog restart hook: re-spawn the sampling thread if it died."""
+        if self._shutdown.is_set() or self._thread is None:
+            return
+        if self._thread.is_alive():
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="load-monitor-sampler")
+        self._thread.start()
+
     # ---------------------------------------------------------------- sampling
 
     def _ingest_partition_sample(self, s):
@@ -362,6 +386,7 @@ class LoadMonitor:
         with self._lock:
             prev = self._state
             self._state = MonitorState.SAMPLING
+        self._heartbeat()
         try:
             metadata = self._metadata_source.get_metadata()
             ps, bs = self._fetchers.fetch(
@@ -375,6 +400,7 @@ class LoadMonitor:
             for s in bs:
                 self._ingest_broker_sample(s)
             self._store.store_samples(ps, bs)
+            self._store_heartbeat()
             return len(ps) + len(bs)
         finally:
             with self._lock:

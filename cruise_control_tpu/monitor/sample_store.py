@@ -226,23 +226,36 @@ def _new_topic(name: str, num_partitions: int, replication_factor: int,
 
 class FileSampleStore(SampleStore):
     """JSONL append-only shards under a directory (partition + broker files,
-    the analogue of the two Kafka sample topics)."""
+    the analogue of the two Kafka sample topics).
 
-    def __init__(self, directory: str):
+    Flushes are atomic: each one rewrites the shard through the shared
+    write-to-temp + rename + fsync helper (``common/atomicio.py``, the same
+    primitive the execution journal's epoch sidecar uses), so a crash
+    mid-flush can never leave the truncated JSONL lines the loader has to
+    tolerate — readers observe the old shard or the new one, whole.
+    """
+
+    def __init__(self, directory: str, fsync: bool = True):
         self._dir = directory
         os.makedirs(directory, exist_ok=True)
         self._ppath = os.path.join(directory, "partition_samples.jsonl")
         self._bpath = os.path.join(directory, "broker_samples.jsonl")
+        self._fsync = fsync
         self._lock = threading.Lock()
+
+    @staticmethod
+    def _append_atomic(path: str, samples, fsync: bool) -> None:
+        from cruise_control_tpu.common.atomicio import atomic_replace, read_file
+        if not samples:
+            return
+        new = "".join(json.dumps(s.to_json()) + "\n"
+                      for s in samples).encode("utf-8")
+        atomic_replace(path, (read_file(path) or b"") + new, fsync=fsync)
 
     def store_samples(self, partition_samples, broker_samples):
         with self._lock:
-            with open(self._ppath, "a") as f:
-                for s in partition_samples:
-                    f.write(json.dumps(s.to_json()) + "\n")
-            with open(self._bpath, "a") as f:
-                for s in broker_samples:
-                    f.write(json.dumps(s.to_json()) + "\n")
+            self._append_atomic(self._ppath, partition_samples, self._fsync)
+            self._append_atomic(self._bpath, broker_samples, self._fsync)
 
     def load_samples(self, on_partition_sample, on_broker_sample) -> int:
         """Replay both shards. Corrupt lines (truncated write, bit rot) are

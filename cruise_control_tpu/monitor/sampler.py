@@ -23,6 +23,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from cruise_control_tpu.common import resources as res
+from cruise_control_tpu.common.stablehash import stable_hash32
 from cruise_control_tpu.models import cluster as _cluster   # live CPU weights
 from cruise_control_tpu.monitor import metricdef as md
 
@@ -171,7 +172,7 @@ class SyntheticLoadSampler(MetricSampler):
         self._jitter = jitter
 
     def _base_rates(self, topic: str, partition: int) -> np.ndarray:
-        h = abs(hash((self._seed, topic, partition))) % (1 << 32)
+        h = stable_hash32(self._seed, topic, partition)
         rng = np.random.default_rng(h)
         nw_in = rng.exponential(self._means[0])
         nw_out = rng.exponential(self._means[1])

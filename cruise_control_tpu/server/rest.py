@@ -239,6 +239,13 @@ class RestApi:
         if method == "POST" and endpoint not in POST_ENDPOINTS:
             return 405, {"errorMessage": f"{endpoint} requires GET",
                          "validEndpoints": POST_ENDPOINTS}
+        # restart reconciliation in flight: the executor is still resolving
+        # journaled pre-crash tasks, so mutating requests must wait — 503
+        # (retryable, unlike a 500) while reads (/state etc.) stay served
+        if method == "POST" and getattr(self.app, "is_reconciling", False):
+            return 503, {"errorMessage":
+                         "restart reconciliation in progress; retry shortly",
+                         "reconciling": True}
         # two-step verification (Purgatory.java:116-166)
         consumed_review: Optional[int] = None
         if (method == "POST" and self.purgatory is not None

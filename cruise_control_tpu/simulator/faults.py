@@ -20,7 +20,7 @@ from cruise_control_tpu.common.faults import FaultPlan
 #: events the runner applies directly against the simulated cluster/app
 DIRECT_KINDS = frozenset({
     "kill_broker", "restore_broker", "fail_disk", "restore_disk",
-    "kill_broker_mid_execution", "stop_execution",
+    "kill_broker_mid_execution", "stop_execution", "process_crash",
 })
 
 #: events that open a [tick, tick+duration) window of per-call fault rates
@@ -40,7 +40,12 @@ class FaultEvent:
     ``duration_ticks``. ``kill_broker_mid_execution`` arms the chaos
     adapter to kill ``broker_id`` after ``calls_after`` more guarded
     adapter calls — landing the death inside that tick's execution batch
-    rather than between ticks.
+    rather than between ticks. ``process_crash`` arms the adapter the same
+    way but kills the *control plane*: after ``calls_after`` more guarded
+    calls the wrapper freezes the execution journal and raises
+    ``ProcessCrashed``; the runner tears the app down and rebuilds it
+    against the same simulated cluster, exercising restart reconciliation
+    (the Scorecard records the recovery tick).
     """
 
     tick: int

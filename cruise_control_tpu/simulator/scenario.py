@@ -24,8 +24,10 @@ The :class:`Scorecard` separates two channels:
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import logging
+import os
 import time as _time
 from contextlib import nullcontext
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -122,6 +124,9 @@ def _scenario_config(sc: Scenario):
         "broker.failure.alert.threshold.ms": W,
         "broker.failure.self.healing.threshold.ms": 2 * W,
         "broker.failure.detection.backoff.ms": W,
+        # no watchdog monitor thread under virtual time — the tick loop
+        # calls watchdog.poll() itself
+        "watchdog.interval.ms": 0,
     }
     base.update(dict(sc.config_overrides))
     return CruiseControlConfig(base)
@@ -147,22 +152,38 @@ def _apply_direct(ev: FaultEvent, cluster: SimulatedKafkaCluster,
             kill_broker_after_calls=wrapper.calls + ev.calls_after))
     elif ev.kind == "stop_execution":
         app.executor.stop_execution(forced=True)
+    elif ev.kind == "process_crash":
+        # arm the chaos adapter: ``calls_after`` guarded calls from now the
+        # wrapper freezes the execution journal (simulating kill -9 — no
+        # shutdown hooks run) and raises ProcessCrashed; the runner's tick
+        # loop catches it, rebuilds the app against the same simulated
+        # cluster, and runs restart reconciliation
+        wrapper._crashed = False
+        wrapper.on_crash = (app.journal.freeze
+                            if app.journal is not None else None)
+        wrapper.set_plan(dataclasses.replace(
+            wrapper.plan,
+            process_crash_after_calls=wrapper.calls + ev.calls_after))
 
 
-def build_app(sc: Scenario):
+def build_app(sc: Scenario, clock=None, cluster=None, wrapper=None,
+              sampler=None):
     """Construct (clock, cluster, chaos wrapper, app) for a scenario —
-    exposed separately so tests can drive partial loops."""
+    exposed separately so tests can drive partial loops. Pass existing
+    ``clock``/``cluster``/``wrapper``/``sampler`` to rebuild only the app
+    (the ``process_crash`` restart path: same simulated world, fresh
+    control plane)."""
     from cruise_control_tpu.app import CruiseControlApp
     from cruise_control_tpu.common.faults import FaultyClusterAdapter
 
-    clock = VirtualClock()
-    cluster = SimulatedKafkaCluster.build(
+    clock = clock or VirtualClock()
+    cluster = cluster or SimulatedKafkaCluster.build(
         num_brokers=sc.num_brokers, num_racks=sc.num_racks,
         topics=sc.topics, partitions_per_topic=sc.partitions_per_topic,
         rf=sc.rf, latency_polls=sc.latency_polls)
-    wrapper = FaultyClusterAdapter(cluster, sc.faults.plan_for_tick(-1),
-                                   sleep=clock.sleep)
-    workload = sc.workload or DiurnalWorkload(
+    wrapper = wrapper or FaultyClusterAdapter(
+        cluster, sc.faults.plan_for_tick(-1), sleep=clock.sleep)
+    workload = sampler or sc.workload or DiurnalWorkload(
         seed=sc.seed, period_ms=max(sc.ticks * sc.tick_ms // 2, sc.tick_ms))
     app = CruiseControlApp(_scenario_config(sc), metadata_source=cluster,
                            sampler=workload, cluster_adapter=wrapper,
@@ -181,8 +202,23 @@ def run_scenario(sc: Scenario, use_sentinel: bool = False,
     convergence/churn).
     """
     from cruise_control_tpu.common import sentinels as SENT
+    from cruise_control_tpu.common.faults import ProcessCrashed
     from cruise_control_tpu.monitor.load_monitor import (
         NotEnoughValidWindowsError)
+
+    # a process_crash scenario needs a journal to reconcile from; provision
+    # a temp one when the scenario doesn't pin its own path (no fsync — the
+    # crash is simulated above the filesystem, and virtual time shouldn't
+    # pay real disk latency)
+    auto_journal_dir = None
+    if (any(e.kind == "process_crash" for e in sc.faults.events)
+            and "executor.journal.path" not in dict(sc.config_overrides)):
+        import tempfile
+        auto_journal_dir = tempfile.mkdtemp(prefix="cc-scenario-journal-")
+        sc = dataclasses.replace(sc, config_overrides=sc.config_overrides + (
+            ("executor.journal.path",
+             os.path.join(auto_journal_dir, "execution.journal")),
+            ("executor.journal.fsync", False)))
 
     clock, cluster, wrapper, app = build_app(sc)
     W = sc.tick_ms
@@ -274,6 +310,8 @@ def run_scenario(sc: Scenario, use_sentinel: bool = False,
     fallback_events = 0
     fallback_reasons: List[str] = []
     direct_fired = 0
+    crash_recoveries: List[dict] = []
+    recovery_walls: List[float] = []
 
     ctx = SENT.retrace_sentinel() if use_sentinel else nullcontext()
     with ctx as rlog:
@@ -284,14 +322,43 @@ def run_scenario(sc: Scenario, use_sentinel: bool = False,
             if not sc.faults.direct_at(tick):
                 # per-tick transient windows (a mid-execution kill armed
                 # above must not be clobbered by the window plan this tick)
-                wrapper.set_plan(sc.faults.plan_for_tick(tick))
+                plan = sc.faults.plan_for_tick(tick)
+                if (wrapper.plan.process_crash_after_calls is not None
+                        and not wrapper._crashed):
+                    # an armed-but-unfired process crash persists across
+                    # window swaps: the process dies at its Nth guarded
+                    # call whichever tick that lands in
+                    plan = dataclasses.replace(
+                        plan, process_crash_after_calls=(
+                            wrapper.plan.process_crash_after_calls))
+                wrapper.set_plan(plan)
             ingest()
             m0 = cluster.moves_applied
             l0 = cluster.leadership_moves_applied
             t0 = _time.perf_counter()
-            computed = app.precompute_tick()
-            app.anomaly_detector.sweep()
-            app.anomaly_detector.handle_pending()
+            try:
+                computed = app.precompute_tick()
+                app.anomaly_detector.sweep()
+                app.anomaly_detector.handle_pending()
+            except ProcessCrashed:
+                # the control plane just died mid-tick (journal frozen at
+                # the instant of death). Rebuild the app against the SAME
+                # simulated cluster/clock/chaos wrapper — a new process on
+                # the same host — and run restart reconciliation.
+                computed = False
+                rec_t0 = _time.perf_counter()
+                _, _, _, app = build_app(
+                    sc, clock=clock, cluster=cluster, wrapper=wrapper,
+                    sampler=app.load_monitor._sampler)
+                wrapper.on_crash = (app.journal.freeze
+                                    if app.journal is not None else None)
+                recovery = (app.executor.recover()
+                            if app.journal is not None
+                            else {"performed": False})
+                recovery_walls.append(
+                    round((_time.perf_counter() - rec_t0) * 1000.0, 3))
+                crash_recoveries.append({"tick": tick, **recovery})
+            app.watchdog.poll()
             wall_ms = (_time.perf_counter() - t0) * 1000.0
             tick_walls.append(wall_ms)
             with app._cache_lock:
@@ -401,6 +468,17 @@ def run_scenario(sc: Scenario, use_sentinel: bool = False,
         "provisionStatuses": provision_statuses,
         "expectedProvision": sc.expected_provision,
         "provisionAccurate": provision_accurate,
+        "processCrashes": len(crash_recoveries),
+        "recoveryTick": (crash_recoveries[0]["tick"]
+                         if crash_recoveries else None),
+        "crashRecoveries": crash_recoveries,
+        "watchdogRestarts": app.watchdog.total_restarts,
+        # digest of the final replica assignment + leaders: the crash-
+        # recovery acceptance check compares this across a crashing run and
+        # its uninterrupted twin (bit-identical convergence)
+        "finalAssignmentDigest": hashlib.sha256(json.dumps(
+            {"replicas": cluster.replicas, "leaders": cluster.leaders},
+            sort_keys=True, separators=(",", ":")).encode()).hexdigest(),
     }
     walls = np.asarray(tick_walls) if tick_walls else np.zeros(1)
     with app._cache_lock:
@@ -419,8 +497,15 @@ def run_scenario(sc: Scenario, use_sentinel: bool = False,
             self_heal_wall is not None
             and self_heal_wall > sc.slo.self_heal_wall_ms),
     }
+    if recovery_walls:
+        wall["recoveryWallMs"] = recovery_walls
     if uncovered is not None:
         wall["uncoveredRetraces"] = [str(u) for u in uncovered]
     card = Scorecard(core=core, wall=wall)
     app.record_simulation_scorecard(card.to_json())
+    if auto_journal_dir is not None:
+        if app.journal is not None:
+            app.journal.close()
+        import shutil
+        shutil.rmtree(auto_journal_dir, ignore_errors=True)
     return card

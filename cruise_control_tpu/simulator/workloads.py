@@ -31,6 +31,7 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
+from cruise_control_tpu.common.stablehash import stable_hash32
 from cruise_control_tpu.monitor import metricdef as md
 from cruise_control_tpu.monitor.sampler import (
     BrokerMetricSample,
@@ -65,7 +66,7 @@ class WorkloadGenerator(MetricSampler):
 
     # -- SyntheticLoadSampler recipe --------------------------------------
     def _base_rates(self, topic: str, partition: int) -> np.ndarray:
-        h = abs(hash((self._seed, topic, partition))) % (1 << 32)
+        h = stable_hash32(self._seed, topic, partition)
         rng = np.random.default_rng(h)
         return np.array([rng.exponential(self._means[0]),
                          rng.exponential(self._means[1]),
@@ -207,7 +208,7 @@ class HotspotDriftWorkload(WorkloadGenerator):
         self._multiplier = multiplier
 
     def intensity(self, t_ms, topic, partition):
-        group = abs(hash((topic, partition))) % self._groups
+        group = stable_hash32(topic, partition) % self._groups
         hot = (t_ms // self._rotation) % self._groups
         return self._multiplier if group == hot else 1.0
 

@@ -360,6 +360,69 @@ def test_g010_inline_suppression():
     assert "G010" not in _codes(src)
 
 
+# -- G011: raw wall-clock in control-plane paths ---------------------------
+
+CONTROL = "cruise_control_tpu/executor/somefile.py"
+
+
+def test_g011_triggers_on_raw_time_and_sleep_in_control_path():
+    src = """
+    import time
+
+    def poll():
+        t = time.time()
+        time.sleep(1.0)
+        return t
+    """
+    assert _codes(src, path=CONTROL).count("G011") == 2
+
+
+def test_g011_scopes_to_control_plane_paths():
+    src = """
+    import time
+
+    def poll():
+        return time.time()
+    """
+    # analyzer/ (and anything outside app/executor/monitor/detector) is
+    # out of scope — the clock seam contract covers the control loop only
+    assert "G011" not in _codes(
+        src, path="cruise_control_tpu/analyzer/somefile.py")
+    assert "G011" in _codes(src, path="cruise_control_tpu/app.py")
+    assert "G011" in _codes(
+        src, path="cruise_control_tpu/monitor/somefile.py")
+    assert "G011" in _codes(
+        src, path="cruise_control_tpu/detector/somefile.py")
+
+
+def test_g011_clean_on_seam_references_and_injected_clock():
+    src = """
+    import time
+
+    class Executor:
+        def __init__(self, clock=time.time, sleep=time.sleep):
+            self._clock = clock
+            self._sleep = sleep
+
+        def poll(self):
+            t = self._clock()
+            self._sleep(0.1)
+            return t
+    """
+    # references plumb the seam; only raw CALLS bypass it
+    assert "G011" not in _codes(src, path=CONTROL)
+
+
+def test_g011_inline_suppression():
+    src = """
+    import time
+
+    def wall_deadline():
+        return time.time() + 5  # graftlint: disable=G011
+    """
+    assert "G011" not in _codes(src, path=CONTROL)
+
+
 # -- G008: forbidden impurity inside jit -----------------------------------
 
 def test_g008_triggers_on_host_rng_time_and_print():

@@ -1,4 +1,4 @@
-"""graftlint — AST-based static analyzer for JAX/XLA hazards (G001-G010)."""
+"""graftlint — AST-based static analyzer for JAX/XLA hazards (G001-G011)."""
 
 from tools.graftlint.engine import (  # noqa: F401
     Finding, apply_baseline, lint, lint_source, load_baseline, main,

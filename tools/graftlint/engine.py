@@ -270,7 +270,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
         description="JAX/XLA hazard + concurrency static analyzer "
-                    "(rules G001-G010, G101-G105)")
+                    "(rules G001-G011, G101-G105)")
     parser.add_argument("paths", nargs="*",
                         default=["cruise_control_tpu", "bench.py"],
                         help="files/directories to lint "

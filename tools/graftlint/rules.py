@@ -1,4 +1,4 @@
-"""graftlint rules G001-G010: JAX/XLA hazard AST passes.
+"""graftlint rules G001-G011: JAX/XLA hazard AST passes.
 
 Each rule is registered with the engine and yields :class:`engine.Finding`s.
 The rules are deliberately heuristic — a static pass cannot prove an array is
@@ -40,6 +40,14 @@ G010  Fresh-wrapper-per-call retrace hazard: ``jax.jit(...)`` or
       The static twin of ``retrace_sentinel()``
       (cruise_control_tpu/common/sentinels.py): the sentinel catches the
       storm at runtime, this rule catches it in review.
+G011  Raw wall-clock in control-plane paths: direct ``time.time()`` /
+      ``time.sleep()`` calls in ``app.py``, ``executor/``, ``monitor/``
+      or ``detector/`` bypass the injected ``now_fn``/``sleep_fn`` clock
+      seams, so the virtual-time simulator (and any deterministic replay)
+      silently reads the host clock. References like ``clock=time.time``
+      in a default argument ARE the seam and are not flagged — only
+      calls. Deliberate wall-clock sites carry a baseline entry with a
+      justification.
 
 Concurrency family (G101-G105) — lock discipline over the service's daemon
 threads and pools, paired with the runtime sanitizer in
@@ -871,6 +879,48 @@ def check_jit_wrapper_in_body(ctx: ModuleContext) -> Iterator[Finding]:
             f"{what} wrapper created inside a function body — a fresh "
             f"callable per call never hits the jit cache (one full "
             f"trace+compile per invocation); hoist to module level")
+
+
+# ---------------------------------------------------------------------------
+# G011 — raw wall-clock call in a control-plane path
+# ---------------------------------------------------------------------------
+
+#: paths whose time flow must route through the injected now_fn/sleep_fn
+#: seams (the virtual-time simulator drives exactly these modules)
+_G011_PATHS = ("cruise_control_tpu/executor/", "cruise_control_tpu/monitor/",
+               "cruise_control_tpu/detector/")
+_G011_FILES = ("cruise_control_tpu/app.py",)
+
+
+@file_rule("G011", "raw-wall-clock")
+def check_raw_wall_clock(ctx: ModuleContext) -> Iterator[Finding]:
+    """Direct ``time.time()`` / ``time.sleep()`` CALLS in the control-plane
+    modules the virtual-time simulator drives (app, executor, monitor,
+    detector).  Those paths take injected ``now_fn``/``sleep_fn`` seams; a
+    raw call reads the host clock even under a ``VirtualClock``, breaking
+    deterministic scenario replay.  References (``clock=time.time`` as a
+    default argument) are how the seam is *plumbed* and are not flagged;
+    the handful of deliberate wall-clock sites live in the baseline with
+    justifications."""
+    if not (ctx.path in _G011_FILES
+            or any(ctx.path.startswith(p) for p in _G011_PATHS)):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute)
+                and fn.attr in ("time", "sleep")
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "time"):
+            continue
+        if _suppressed(ctx, node, "G011"):
+            continue
+        yield ctx.finding(
+            "G011", node,
+            f"raw `time.{fn.attr}()` in a control-plane path — route "
+            f"through the injected now_fn/sleep_fn clock seam so virtual-"
+            f"time simulation and deterministic replay stay exact")
 
 
 @file_rule("G008", "impure-jit")
