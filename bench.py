@@ -826,6 +826,91 @@ def _bench_recovery(seed: int):
         "device": str(jax.devices()[0].platform),
     }))
 
+    # ---- warm-standby failover leg: the same 5000-task journal left by a
+    # dead leader, with the cluster already AT TARGET (no resume work), so
+    # the timed quantity is "takeover to back-in-charge" — cold pays the
+    # full-journal replay inside recover(); a standby that tailed the
+    # journal reconciles from its accumulated state and skips the parse
+    from cruise_control_tpu.replication import (
+        JournalShipper, JournalTailer, LeaderLease, WarmStandby)
+
+    def at_target_adapter():
+        return FakeClusterAdapter(
+            {p.topic_partition: p.new_replicas for p in proposals},
+            latency_polls=1)
+
+    def full_crashed_journal(path):
+        # every task journaled IN_PROGRESS, no execution_end: maximal
+        # replay surface, classification-only reconciliation
+        j = ExecutionJournal(path, fsync=False)
+        j.log_execution_start(proposals, [], [], generation=1)
+        for p in proposals:
+            j.log_task(0, TaskType.INTER_BROKER_REPLICA_ACTION.value,
+                       p.topic_partition, TaskState.IN_PROGRESS.value)
+        j.freeze()
+
+    def takeover_pair(d):
+        path = os.path.join(d, "execution.journal")
+        full_crashed_journal(path)
+        # cold: fresh process — replay from disk inside recover()
+        clock = VirtualClock()
+        journal = ExecutionJournal(path, fsync=False)
+        ex = Executor(at_target_adapter(),
+                      config=ExecutorConfig(task_stuck_deadline_ms=None),
+                      clock=clock.now_s, sleep=clock.sleep, journal=journal)
+        t0 = time.perf_counter()
+        ex.recover()
+        cold_s = time.perf_counter() - t0
+        journal.close()
+        # warm: a standby tailed the journal while the leader lived
+        # (untimed), then promotes from its accumulated replay state
+        clock = VirtualClock()
+        leader_journal = ExecutionJournal(path, fsync=False)
+        standby = WarmStandby(
+            JournalShipper(leader_journal),
+            JournalTailer(os.path.join(d, "replica.journal")),
+            LeaderLease(leader_journal.epoch_path, "standby",
+                        now_ms=clock.now_ms, fsync=False),
+            now_ms=clock.now_ms)
+        while standby.poll():
+            pass
+        ex2 = Executor(at_target_adapter(),
+                       config=ExecutorConfig(task_stuck_deadline_ms=None),
+                       clock=clock.now_s, sleep=clock.sleep)
+        t0 = time.perf_counter()
+        summary = standby.promote(executor=ex2)
+        warm_s = time.perf_counter() - t0
+        standby.journal.close()
+        standby.stop()
+        return warm_s, cold_s, summary
+
+    fo_results = []
+    for it in range(3):
+        d = tempfile.mkdtemp(prefix="bench-failover-")
+        try:
+            fo_results.append(takeover_pair(d))
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+    failover_s = min(r[0] for r in fo_results[1:])
+    cold_s = min(r[1] for r in fo_results[1:])
+    fo_summary = fo_results[-1][2]
+    print(json.dumps({
+        "metric": "failover_time_s",
+        "value": round(failover_s, 4), "unit": "s",
+        # vs_baseline: the cold restart of the same journal — warm takeover
+        # must be strictly faster (it skips the full-journal replay)
+        "vs_baseline": round(cold_s / max(failover_s, 1e-9), 2),
+        "tasks": n_tasks,
+        "cold_recovery_s": round(cold_s, 4),
+        "classified": fo_summary["classified"],
+        "resumed": fo_summary["resumed"],
+        "orphaned_remaining": fo_summary["orphanedRemaining"],
+        "device": str(jax.devices()[0].platform),
+    }))
+    assert failover_s < cold_s, (
+        f"warm takeover ({failover_s:.4f}s) must beat the cold restart "
+        f"({cold_s:.4f}s)")
+
 
 def _measure_whatif_grid(topo, assign):
     """Provisioner what-if: 64 scenarios (baseline + 31 broker adds + 32

@@ -189,7 +189,14 @@ class CruiseControlApp:
         _journal_path = config.get("executor.journal.path")
         self.journal = (ExecutionJournal(
             _journal_path, fsync=config.get("executor.journal.fsync"),
-            now_ms=self._now_ms_fn) if _journal_path else None)
+            now_ms=self._now_ms_fn,
+            epoch_path=config.get("executor.journal.epoch.path") or None,
+            compact_records=config.get("executor.journal.compact.records"))
+            if _journal_path else None)
+        #: replication role (ReplicationController / WarmStandby),
+        #: attached by the deployment or the scenario runner; surfaced
+        #: in /state as ReplicationState
+        self.replication = None
         check_ms = config.get("execution.progress.check.interval.ms")
         # default.replica.movement.strategies: the strategy chain used when
         # a request names none
@@ -982,6 +989,28 @@ class CruiseControlApp:
         with self._cache_lock:
             self._last_simulation = dict(scorecard)
 
+    def attach_replication(self, controller) -> None:
+        """Attach this app's replication role (a ``ReplicationController``
+        for the leader, a ``WarmStandby`` for the follower); its
+        ``state_snapshot()`` backs ``/state``'s ReplicationState."""
+        self.replication = controller
+
+    def replication_state(self) -> dict:
+        """ReplicationState for /state: role, lease expiry, follower lag.
+
+        Unreplicated deployments report role "standalone" (with the
+        journal epoch when journaling is on) so the field set is stable
+        across topologies."""
+        if self.replication is not None:
+            return self.replication.state_snapshot()
+        return {
+            "role": "standalone",
+            "holder": None,
+            "epoch": self.journal.epoch if self.journal is not None else 0,
+            "leaseExpiryMs": None,
+            "followerLagRecords": None,
+        }
+
     def what_if(self, add_broker_counts: Sequence[int] = (),
                 add_broker_rack: Optional[str] = None,
                 remove_broker_ids: Sequence[int] = (),
@@ -1585,6 +1614,7 @@ class CruiseControlApp:
             },
             "AnomalyDetectorState": self.anomaly_detector.state_snapshot(),
             "WatchdogState": self.watchdog.snapshot(),
+            "ReplicationState": self.replication_state(),
         }
         if last_simulation is not None:
             out["SimulatorState"] = last_simulation

@@ -344,6 +344,25 @@ def _service_config_def() -> ConfigDef:
     d.define("executor.journal.fsync", T.BOOLEAN, True, I.LOW,
              "fsync the journal on every append (and its epoch sidecar on "
              "every replace). Disable only for tests/benchmarks.")
+    d.define("executor.journal.epoch.path", T.STRING, "", I.LOW,
+             "Override for the epoch/lease sidecar location (empty = "
+             "'<executor.journal.path>.epoch'). A warm standby points its "
+             "tailed replica journal at the leader's sidecar on shared "
+             "storage so both incarnations fence against the same leased "
+             "claim.")
+    d.define("executor.journal.compact.records", T.LONG, 0, I.LOW,
+             "Auto-compact the execution journal (fold history into one "
+             "checkpoint record and truncate behind it) whenever the entry "
+             "count reaches this. 0 disables compaction.", at_least(0))
+    d.define("replication.lease.ms", T.LONG, 30_000, I.MEDIUM,
+             "Leadership lease duration stamped into the epoch sidecar. A "
+             "standby may only take over (advancing the epoch, fencing the "
+             "ex-leader) once the expiry passes on its clock.", at_least(1))
+    d.define("replication.lease.renew.ms", T.LONG, 10_000, I.LOW,
+             "How often the leader re-stamps the lease expiry (atomic "
+             "sidecar replace, same epoch). Must be well under "
+             "replication.lease.ms to ride out transient stalls.",
+             at_least(1))
     d.define("watchdog.stall.ms", T.LONG, 30_000, I.MEDIUM,
              "A background thread whose heartbeat is older than this is "
              "considered stalled.", at_least(1))

@@ -554,7 +554,12 @@ class Executor:
             return False
         return True
 
-    def recover(self) -> dict:
+    def attach_journal(self, journal) -> None:
+        """Swap in a write-ahead journal (warm-standby promotion: the
+        follower's tailed replica becomes the authoritative journal)."""
+        self._journal = journal
+
+    def recover(self, advance: bool = True, replay=None) -> dict:
         """Restart reconciliation (Executor.java onActivation semantics).
 
         Replays the write-ahead journal, claims a new execution epoch
@@ -575,14 +580,23 @@ class Executor:
         — then synchronously re-executes every unfinished proposal
         through the normal execution path (the adapters converge on
         re-submission). Returns (and stores for ``/state``) a summary.
+
+        The warm-standby takeover path passes ``advance=False`` (the
+        replication lease already advanced the epoch when it fenced the
+        ex-leader — the journal *adopts* that epoch instead of double-
+        fencing) and ``replay=<tailed state>`` (the follower accumulated
+        the replay incrementally while tailing, so takeover skips the
+        full-journal parse a cold restart pays).
         """
         if self._journal is None:
             return {"performed": False}
         t0 = self._clock()
-        replay = self._journal.replay()
+        if replay is None:
+            replay = self._journal.replay()
         self.recovering = True
         try:
-            new_epoch = self._journal.advance_epoch()
+            new_epoch = (self._journal.advance_epoch() if advance
+                         else self._journal.adopt_epoch())
             counts = {"completed": 0, "stillMoving": 0, "orphaned": 0,
                       "pending": 0}
             unfinished: List[ExecutionProposal] = []
@@ -639,6 +653,7 @@ class Executor:
                          if not self._proposal_finished(p)]
             summary = {
                 "performed": True,
+                "mode": "cold" if advance else "warm",
                 "epoch": new_epoch,
                 "journalEntries": replay.entries,
                 "openExecution": open_exec is not None,

@@ -19,6 +19,23 @@ another mutation (appends happen before effects).  The epoch is also
 fenced into task IDs (``execution_id = epoch << 32 | seq``) so journaled
 records from different incarnations can never collide.
 
+The sidecar doubles as the replication lease (see
+:mod:`cruise_control_tpu.replication.lease`): a leased holder writes
+``{"epoch": N, "holder": id, "leaseExpiryMs": ms}`` — this module only
+ever reads the ``epoch`` key, so legacy and leased sidecars are
+interchangeable.  A warm standby that tailed the journal takes over with
+:meth:`adopt_epoch` (the lease manager already advanced the epoch;
+re-advancing would double-fence).
+
+Compaction
+----------
+:meth:`compact` folds the journal's durable prefix into one
+``checkpoint`` record (the reconciled snapshot of the open execution, if
+any) and atomically truncates behind it, bounding both the replay cost
+and the tail a replication shipper must stream.  Replaying a compacted
+journal is *classification-equivalent* to replaying the full history by
+construction: both feed the same :class:`ReplayAccumulator`.
+
 Record format (deterministic: sorted keys, compact separators, virtual
 timestamps only) — see docs/operations.md for the full table::
 
@@ -29,6 +46,10 @@ timestamps only) — see docs/operations.md for the full table::
      "taskType": "INTER_BROKER_REPLICA_ACTION", "tp": "t-0",
      "state": "IN_PROGRESS"}
     {"type": "execution_end", "epoch": N, "ts": ms, "result": "completed"}
+    {"type": "checkpoint", "epoch": N, "ts": ms, "entriesFolded": k,
+     "open": null | {"generation": g, "epoch": e, "proposals": [...],
+                     "removedBrokers": [...], "demotedBrokers": [...],
+                     "taskStates": {"TYPE|t-0": "IN_PROGRESS", ...}}}
 """
 
 from __future__ import annotations
@@ -95,6 +116,46 @@ class OpenExecution:
         return None
 
 
+def open_execution_to_record(oe: Optional[OpenExecution]) -> Optional[dict]:
+    """Checkpoint payload for an open execution (``None`` stays ``None``).
+
+    Task-state keys are flattened to ``"TYPE|tp"`` strings so the record
+    round-trips through JSON deterministically."""
+    if oe is None:
+        return None
+    return {
+        "epoch": int(oe.epoch),
+        "generation": int(oe.generation),
+        "proposals": [proposal_to_record(p) for p in oe.proposals],
+        "removedBrokers": sorted(int(b) for b in oe.removed_brokers),
+        "demotedBrokers": sorted(int(b) for b in oe.demoted_brokers),
+        "taskStates": {f"{t}|{tp}": s
+                       for (t, tp), s in sorted(oe.task_states.items())},
+    }
+
+
+def open_execution_from_record(rec: Optional[dict]) -> Optional[OpenExecution]:
+    if rec is None:
+        return None
+    try:
+        props = [proposal_from_record(r) for r in rec.get("proposals", [])]
+        states = {}
+        for key, state in rec.get("taskStates", {}).items():
+            task_type, _, tp = str(key).partition("|")
+            states[(task_type, tp)] = str(state)
+        return OpenExecution(
+            epoch=int(rec.get("epoch", 0)),
+            generation=int(rec.get("generation", -1)),
+            proposals=props,
+            removed_brokers=tuple(rec.get("removedBrokers", ())),
+            demoted_brokers=tuple(rec.get("demotedBrokers", ())),
+            task_states=states,
+        )
+    except (KeyError, ValueError, TypeError, AttributeError):
+        LOG.warning("Unreadable checkpoint open-execution payload; skipping")
+        return None
+
+
 @dataclass
 class JournalReplay:
     """Result of replaying a journal from disk."""
@@ -104,28 +165,96 @@ class JournalReplay:
     open_execution: Optional[OpenExecution] = None
 
 
+class ReplayAccumulator:
+    """Incremental journal replay: feed records one at a time.
+
+    The single classification authority for journal contents —
+    :meth:`ExecutionJournal.replay` folds a file through it, a
+    replication tailer feeds it shipped records as they arrive, and
+    :meth:`ExecutionJournal.compact` serializes its state into a
+    checkpoint record.  Because every consumer shares this accumulator,
+    replay-from-checkpoint is classification-equivalent to full replay
+    by construction.
+    """
+
+    def __init__(self) -> None:
+        self.entries = 0
+        self.open_execution: Optional[OpenExecution] = None
+
+    def feed(self, rec: dict) -> None:
+        self.entries += 1
+        rtype = rec.get("type")
+        if rtype == "epoch":
+            return
+        if rtype == "checkpoint":
+            self.open_execution = open_execution_from_record(rec.get("open"))
+        elif rtype == "execution_start":
+            try:
+                props = [proposal_from_record(r)
+                         for r in rec.get("proposals", [])]
+            except (KeyError, ValueError, TypeError):
+                LOG.warning("Unreadable execution_start record; skipping")
+                return
+            self.open_execution = OpenExecution(
+                epoch=int(rec.get("epoch", 0)),
+                generation=int(rec.get("generation", -1)),
+                proposals=props,
+                removed_brokers=tuple(rec.get("removedBrokers", ())),
+                demoted_brokers=tuple(rec.get("demotedBrokers", ())),
+            )
+        elif rtype == "task" and self.open_execution is not None:
+            key = (str(rec.get("taskType")), str(rec.get("tp")))
+            self.open_execution.task_states[key] = str(rec.get("state"))
+        elif rtype == "execution_end":
+            self.open_execution = None
+
+    def result(self, epoch: int = 0) -> JournalReplay:
+        return JournalReplay(epoch=epoch, entries=self.entries,
+                             open_execution=self.open_execution)
+
+
 class ExecutionJournal:
-    """Append-only, fsynced, epoch-fenced execution journal."""
+    """Append-only, fsynced, epoch-fenced execution journal.
+
+    ``epoch_path`` overrides the fencing-sidecar location (default
+    ``<path>.epoch``): a standby's tailed replica journal points it at
+    the *leader's* sidecar on shared storage so both incarnations fence
+    against the same leased claim.  ``entries_hint`` skips the initial
+    entry count for a caller that already knows it (a tailer hands its
+    replica over at takeover without re-parsing the file).
+    ``compact_records`` > 0 auto-compacts whenever the entry count
+    reaches the threshold.
+    """
 
     def __init__(self, path: str, fsync: bool = True,
-                 now_ms: Callable[[], int] = None):
+                 now_ms: Callable[[], int] = None,
+                 epoch_path: Optional[str] = None,
+                 entries_hint: Optional[int] = None,
+                 compact_records: int = 0):
         self._path = path
-        self._epoch_path = path + ".epoch"
+        self._epoch_path = epoch_path or (path + ".epoch")
         self._fsync = fsync
         self._now_ms = now_ms or (lambda: int(time.time() * 1000))
+        self._compact_records = int(compact_records or 0)
         directory = os.path.dirname(os.path.abspath(path))
         os.makedirs(directory, exist_ok=True)
         self._epoch = self._read_epoch_file()
-        self._entries = sum(1 for _ in iter_jsonl(path))
+        self._entries = (int(entries_hint) if entries_hint is not None
+                         else sum(1 for _ in iter_jsonl(path)))
         self._fh = None
         self._last_append_ms: Optional[int] = None
         self._frozen = False
+        self._compactions = 0
 
     # ----------------------------------------------------------- epoch
 
     @property
     def path(self) -> str:
         return self._path
+
+    @property
+    def epoch_path(self) -> str:
+        return self._epoch_path
 
     @property
     def epoch(self) -> int:
@@ -136,8 +265,21 @@ class ExecutionJournal:
         return self._entries
 
     @property
+    def compactions(self) -> int:
+        """Times this incarnation truncated behind a checkpoint — a
+        replication shipper includes it so tailers detect the rewrite
+        and re-sync from offset 0."""
+        return self._compactions
+
+    @property
     def last_append_ms(self) -> Optional[int]:
         return self._last_append_ms
+
+    def size_bytes(self) -> int:
+        try:
+            return os.path.getsize(self._path)
+        except OSError:
+            return 0
 
     def _read_epoch_file(self) -> int:
         try:
@@ -158,6 +300,19 @@ class ExecutionJournal:
                              sort_keys=True, separators=(",", ":"))
         atomic_replace(self._epoch_path, payload.encode("utf-8"),
                        fsync=self._fsync)
+        self._append({"type": "epoch"})
+        return self._epoch
+
+    def adopt_epoch(self) -> int:
+        """Adopt the epoch already claimed in the sidecar without
+        advancing it.
+
+        The warm-takeover path: the replication lease manager advanced
+        the epoch when it acquired leadership (fencing the ex-leader),
+        so the promoted incarnation must append under *that* epoch —
+        advancing again here would fence the lease itself out.
+        """
+        self._epoch = self._read_epoch_file()
         self._append({"type": "epoch"})
         return self._epoch
 
@@ -203,6 +358,8 @@ class ExecutionJournal:
             self._fh.flush()
         self._entries += 1
         self._last_append_ms = record["ts"]
+        if self._compact_records and self._entries >= self._compact_records:
+            self.compact()
 
     def log_execution_start(self, proposals, removed_brokers=(),
                             demoted_brokers=(), generation: int = -1) -> None:
@@ -235,6 +392,41 @@ class ExecutionJournal:
                 pass
             self._fh = None
 
+    # --------------------------------------------------------- compact
+
+    def compact(self) -> dict:
+        """Fold the durable prefix into one checkpoint record and
+        atomically truncate behind it.
+
+        The checkpoint carries the reconciled snapshot of the open
+        execution (full proposals + latest task states), so replaying
+        the compacted journal classifies identically to replaying the
+        full history — and a replication shipper only ever has a bounded
+        tail to stream.  Refuses (like any append) when frozen or
+        fenced.
+        """
+        if self._frozen:
+            raise StaleEpochError(
+                "journal frozen (process death); refusing to compact")
+        self._check_epoch()
+        replay = self.replay()
+        record = {
+            "type": "checkpoint",
+            "epoch": self._epoch,
+            "ts": int(self._now_ms()),
+            "entriesFolded": replay.entries,
+            "open": open_execution_to_record(replay.open_execution),
+        }
+        line = json.dumps(record, sort_keys=True, separators=(",", ":"))
+        self.close()
+        atomic_replace(self._path, (line + "\n").encode("utf-8"),
+                       fsync=self._fsync)
+        self._entries = 1
+        self._compactions += 1
+        self._last_append_ms = record["ts"]
+        return {"entriesFolded": replay.entries,
+                "openExecution": replay.open_execution is not None}
+
     # ---------------------------------------------------------- replay
 
     def replay(self) -> JournalReplay:
@@ -243,34 +435,10 @@ class ExecutionJournal:
         Tolerates a torn trailing line; the durable prefix is
         authoritative.  Only the *last* execution_start can be open —
         an execution_start implicitly closes any predecessor (the
-        executor is single-flight).
+        executor is single-flight).  A leading checkpoint record seeds
+        the state that the truncated history folded into.
         """
-        out = JournalReplay(epoch=self._read_epoch_file())
-        open_exec: Optional[OpenExecution] = None
+        acc = ReplayAccumulator()
         for rec in iter_jsonl(self._path):
-            out.entries += 1
-            rtype = rec.get("type")
-            if rtype == "epoch":
-                continue
-            if rtype == "execution_start":
-                try:
-                    props = [proposal_from_record(r)
-                             for r in rec.get("proposals", [])]
-                except (KeyError, ValueError, TypeError):
-                    LOG.warning("Unreadable execution_start in %s; skipping",
-                                self._path)
-                    continue
-                open_exec = OpenExecution(
-                    epoch=int(rec.get("epoch", 0)),
-                    generation=int(rec.get("generation", -1)),
-                    proposals=props,
-                    removed_brokers=tuple(rec.get("removedBrokers", ())),
-                    demoted_brokers=tuple(rec.get("demotedBrokers", ())),
-                )
-            elif rtype == "task" and open_exec is not None:
-                key = (str(rec.get("taskType")), str(rec.get("tp")))
-                open_exec.task_states[key] = str(rec.get("state"))
-            elif rtype == "execution_end":
-                open_exec = None
-        out.open_execution = open_exec
-        return out
+            acc.feed(rec)
+        return acc.result(epoch=self._read_epoch_file())

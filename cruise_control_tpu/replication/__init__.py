@@ -1,0 +1,38 @@
+"""Replicated control plane: leased leadership + journal shipping.
+
+Active/standby replication built on the PR 10 crash-safety primitives
+(write-ahead journal, epoch fencing, restart reconciliation):
+
+- :mod:`.lease` — the ``<journal>.epoch`` sidecar extended from a plain
+  fencing token into a *leased leadership claim* (holder id + epoch +
+  lease expiry on the injected clock, renewed atomically).  Exactly one
+  incarnation may append and mutate; ``StaleEpochError`` remains the
+  zombie kill-path.
+- :mod:`.shipper` — leader-side :class:`JournalShipper` streams journal
+  appends as length-prefixed records resumable by byte offset;
+  follower-side :class:`JournalTailer` tails them into a byte-identical
+  replica plus an incrementally reconciled replay state.
+- :mod:`.standby` — :class:`ReplicationController` (leader: acquire +
+  renew the lease) and :class:`WarmStandby` (follower: tail, pre-warm
+  kernels, and on lease expiry advance the epoch and take over from the
+  already-tailed state — strictly faster than a cold ``recover()``).
+
+See docs/operations.md ("Replication and failover") for the operational
+walk-through.
+"""
+
+from .lease import LeaderLease, LeaseHeldError, LeaseState, read_lease
+from .shipper import JournalShipper, JournalTailer, ShipBatch
+from .standby import ReplicationController, WarmStandby
+
+__all__ = [
+    "JournalShipper",
+    "JournalTailer",
+    "LeaderLease",
+    "LeaseHeldError",
+    "LeaseState",
+    "ReplicationController",
+    "ShipBatch",
+    "WarmStandby",
+    "read_lease",
+]

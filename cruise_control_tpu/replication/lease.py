@@ -1,0 +1,200 @@
+"""Leased leadership over the journal epoch sidecar.
+
+The ``<journal>.epoch`` sidecar has been a fencing token since PR 10:
+whoever atomically bumps ``{"epoch": N}`` fences every older holder
+(:class:`~cruise_control_tpu.executor.journal.StaleEpochError` on their
+next append).  This module extends the same file into a *leased
+leadership claim*::
+
+    {"epoch": N, "holder": "cc-host-a", "leaseExpiryMs": 1234567}
+
+- ``epoch`` stays the fencing token — the journal only ever reads this
+  key, so legacy sidecars and leased sidecars are interchangeable.
+- ``holder`` + ``leaseExpiryMs`` make leadership *time-bounded*: the
+  leader re-stamps the expiry (same epoch, same holder) every
+  ``replication.lease.renew.ms``; a standby may only claim once the
+  expiry passes on its clock.
+- Acquisition advances the epoch, so taking over and fencing the
+  ex-leader are one atomic sidecar replace — there is no window in
+  which both incarnations may append.
+
+All timing flows through the injected ``now_ms`` seam (graftlint G011:
+no raw wall-clock in replication paths), so leases behave identically
+under the virtual-time simulator.  The sidecar lives on storage shared
+by both incarnations (the same property the journal itself needs for
+takeover); atomic replace makes each write all-or-nothing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..common.atomicio import atomic_replace
+from ..executor.journal import StaleEpochError
+
+
+class LeaseHeldError(RuntimeError):
+    """Raised when acquisition is attempted against an unexpired lease
+    held by someone else — the claimant must keep waiting."""
+
+
+@dataclass(frozen=True)
+class LeaseState:
+    """Decoded sidecar contents (legacy sidecars decode with no holder,
+    i.e. an expired lease at their recorded epoch)."""
+
+    epoch: int = 0
+    holder: Optional[str] = None
+    expiry_ms: int = 0
+
+    def expired(self, now_ms: int) -> bool:
+        return self.holder is None or int(now_ms) >= self.expiry_ms
+
+
+def read_lease(epoch_path: str) -> LeaseState:
+    """Parse the sidecar; unreadable/absent files decode as an expired,
+    epoch-0 claim (mirrors the journal's tolerant epoch read)."""
+    try:
+        with open(epoch_path, "r", encoding="utf-8") as f:
+            data = json.loads(f.read())
+        holder = data.get("holder")
+        return LeaseState(
+            epoch=int(data["epoch"]),
+            holder=str(holder) if holder is not None else None,
+            expiry_ms=int(data.get("leaseExpiryMs", 0)),
+        )
+    except (OSError, ValueError, KeyError, TypeError):
+        return LeaseState()
+
+
+class LeaderLease:
+    """One incarnation's handle on the leased leadership claim.
+
+    ``now_ms`` is required, not defaulted: lease timing must route
+    through the injected clock seam so virtual-time simulation and
+    deterministic replay stay exact.
+    """
+
+    def __init__(self, epoch_path: str, holder: str,
+                 now_ms: Callable[[], int],
+                 lease_ms: int = 30_000, renew_ms: int = 10_000,
+                 fsync: bool = True):
+        self._epoch_path = epoch_path
+        self._holder = str(holder)
+        self._now_ms = now_ms
+        self._lease_ms = int(lease_ms)
+        self._renew_ms = int(renew_ms)
+        self._fsync = fsync
+        self._epoch: Optional[int] = None
+        self._expiry_ms: int = 0
+        self._last_renew_ms: Optional[int] = None
+        directory = os.path.dirname(os.path.abspath(epoch_path))
+        os.makedirs(directory, exist_ok=True)
+
+    # ------------------------------------------------------------ state
+
+    @property
+    def path(self) -> str:
+        return self._epoch_path
+
+    @property
+    def holder_id(self) -> str:
+        return self._holder
+
+    @property
+    def lease_ms(self) -> int:
+        return self._lease_ms
+
+    @property
+    def renew_ms(self) -> int:
+        return self._renew_ms
+
+    @property
+    def epoch(self) -> Optional[int]:
+        """Epoch this handle claimed; ``None`` until :meth:`acquire`."""
+        return self._epoch
+
+    def read(self) -> LeaseState:
+        return read_lease(self._epoch_path)
+
+    def held(self) -> bool:
+        """Does the sidecar currently name *this* holder at the epoch we
+        claimed (regardless of expiry — an expired-but-unsuperseded
+        leader is still the only legal appender)?"""
+        st = self.read()
+        return st.holder == self._holder and st.epoch == self._epoch
+
+    # ---------------------------------------------------------- actions
+
+    def _write(self, epoch: int, expiry_ms: int) -> None:
+        payload = json.dumps(
+            {"epoch": int(epoch), "holder": self._holder,
+             "leaseExpiryMs": int(expiry_ms)},
+            sort_keys=True, separators=(",", ":"))
+        atomic_replace(self._epoch_path, payload.encode("utf-8"),
+                       fsync=self._fsync)
+
+    def acquire(self) -> int:
+        """Claim leadership: advance the epoch and stamp holder+expiry.
+
+        One atomic sidecar replace both grants the lease and fences
+        every prior epoch holder.  Raises :class:`LeaseHeldError` while
+        another holder's lease is unexpired — the claim must wait out
+        the lease, never race it.
+        """
+        st = self.read()
+        now = int(self._now_ms())
+        if st.holder not in (None, self._holder) and not st.expired(now):
+            raise LeaseHeldError(
+                f"lease held by {st.holder!r} (epoch {st.epoch}) until "
+                f"{st.expiry_ms} ms; now {now} ms")
+        self._epoch = st.epoch + 1
+        self._expiry_ms = now + self._lease_ms
+        self._last_renew_ms = now
+        self._write(self._epoch, self._expiry_ms)
+        return self._epoch
+
+    def renew(self) -> LeaseState:
+        """Re-stamp the expiry at the held epoch (atomic replace).
+
+        Raises :class:`~cruise_control_tpu.executor.journal.
+        StaleEpochError` if the sidecar no longer names this holder at
+        this epoch — the lease was taken over; the caller is a zombie
+        and must stop serving."""
+        st = self.read()
+        now = int(self._now_ms())
+        if st.epoch != self._epoch or st.holder != self._holder:
+            raise StaleEpochError(
+                f"lease superseded: sidecar holds {st.holder!r} at epoch "
+                f"{st.epoch}, this incarnation claimed epoch {self._epoch}")
+        self._expiry_ms = now + self._lease_ms
+        self._last_renew_ms = now
+        self._write(self._epoch, self._expiry_ms)
+        return LeaseState(self._epoch, self._holder, self._expiry_ms)
+
+    def renew_due(self) -> bool:
+        """True once ``renew_ms`` has elapsed since the last stamp."""
+        if self._last_renew_ms is None:
+            return True
+        return int(self._now_ms()) - self._last_renew_ms >= self._renew_ms
+
+    def maybe_renew(self) -> Optional[LeaseState]:
+        """Renew iff due; the leader's per-tick entry point."""
+        if not self.renew_due():
+            return None
+        return self.renew()
+
+    def state_snapshot(self) -> dict:
+        st = self.read()
+        return {
+            "holder": st.holder,
+            "epoch": st.epoch,
+            "leaseExpiryMs": st.expiry_ms,
+            "leaseMs": self._lease_ms,
+            "renewMs": self._renew_ms,
+            "expired": st.expired(int(self._now_ms())),
+            "heldByMe": st.holder == self._holder and st.epoch == self._epoch,
+        }

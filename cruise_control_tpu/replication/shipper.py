@@ -1,0 +1,235 @@
+"""Journal shipping: leader-side shipper, follower-side tailer.
+
+The leader streams journal appends to the standby as *length-prefixed
+records over the existing JSONL format*, resumable by byte offset::
+
+    [4-byte big-endian length][record line bytes, no newline] ...
+
+The shipper reads straight from the durable journal file (the write-
+ahead discipline means the file *is* the authoritative stream) and only
+ever ships complete lines — a torn tail stays on the leader until its
+newline lands.  The tailer appends each record to a byte-identical
+replica file and simultaneously feeds it through a
+:class:`~cruise_control_tpu.executor.journal.ReplayAccumulator`, so the
+follower's reconciled state is always current and takeover never pays a
+full-journal replay.
+
+Compaction resets: :meth:`ExecutionJournal.compact` atomically rewrites
+the source file, invalidating follower offsets.  The shipper detects
+this (compaction counter bump, or an offset past the new end of file)
+and flags ``reset`` — the tailer truncates its replica and re-syncs
+from offset 0 (cheap by construction: a compacted journal is one
+checkpoint record plus the tail written since).
+
+Transport is left to the caller: :class:`ShipBatch` is a plain value
+object, so the pair works in-process (tests, simulator, same-host warm
+standby) or across any byte channel that delivers batches in order.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import struct
+from dataclasses import dataclass
+from typing import Callable, Iterator, List, Optional
+
+from ..executor.journal import (ExecutionJournal, JournalReplay,
+                                ReplayAccumulator)
+
+logger = logging.getLogger("cruise-control.replication")
+
+#: 4-byte big-endian unsigned record-length prefix
+FRAME_HEADER = struct.Struct(">I")
+
+
+def frame_records(lines: List[bytes]) -> bytes:
+    """Length-prefix each record line (newline stripped by the caller)."""
+    return b"".join(FRAME_HEADER.pack(len(line)) + line for line in lines)
+
+
+def iter_frames(buf: bytes) -> Iterator[bytes]:
+    """Decode length-prefixed records; a torn trailing frame is an error
+    (the shipper only emits whole frames — truncation means transport
+    corruption, not a torn journal tail)."""
+    pos = 0
+    while pos < len(buf):
+        if pos + FRAME_HEADER.size > len(buf):
+            raise ValueError("torn frame header in shipped batch")
+        (length,) = FRAME_HEADER.unpack_from(buf, pos)
+        pos += FRAME_HEADER.size
+        if pos + length > len(buf):
+            raise ValueError("torn frame payload in shipped batch")
+        yield buf[pos:pos + length]
+        pos += length
+
+
+@dataclass(frozen=True)
+class ShipBatch:
+    """One shipper→tailer transfer."""
+
+    #: length-prefixed record lines
+    frames: bytes
+    #: source byte offset the frames start at
+    base_offset: int
+    #: source byte offset to resume from next time
+    next_offset: int
+    #: source was rewritten (compaction / fresh leader); tailer must
+    #: truncate its replica and apply from offset 0
+    reset: bool
+    #: leader's total journal entry count at ship time (lag accounting)
+    leader_entries: int
+    #: leader's compaction counter at ship time
+    compactions: int
+
+
+class JournalShipper:
+    """Leader side: serve journal bytes from a given offset."""
+
+    def __init__(self, journal: ExecutionJournal):
+        self._journal = journal
+
+    @property
+    def journal(self) -> ExecutionJournal:
+        return self._journal
+
+    def ship_since(self, offset: int, known_compactions: int = 0,
+                   max_bytes: int = 1 << 20) -> ShipBatch:
+        """Read complete record lines from ``offset``, framed.
+
+        ``known_compactions`` is the tailer's view of the leader's
+        compaction counter; a mismatch (or an offset past end-of-file)
+        means the source was rewritten underneath the stream and the
+        batch restarts from 0 with ``reset`` set.
+        """
+        path = self._journal.path
+        compactions = self._journal.compactions
+        size = self._journal.size_bytes()
+        reset = compactions != known_compactions or offset > size
+        base = 0 if reset else int(offset)
+        chunk = b""
+        if size > base:
+            try:
+                with open(path, "rb") as f:
+                    f.seek(base)
+                    chunk = f.read(max_bytes)
+                    # liveness: a single record longer than max_bytes must
+                    # still make progress — grow the read until its newline
+                    # lands (or EOF proves the tail torn)
+                    while b"\n" not in chunk and len(chunk) < size - base:
+                        chunk += f.read(max_bytes)
+            except OSError:
+                chunk = b""
+        # ship whole lines only: everything past the last newline is a
+        # potentially torn in-flight append
+        cut = chunk.rfind(b"\n")
+        chunk = chunk[:cut + 1] if cut >= 0 else b""
+        lines = chunk.split(b"\n")[:-1] if chunk else []
+        return ShipBatch(
+            frames=frame_records(lines),
+            base_offset=base,
+            next_offset=base + len(chunk),
+            reset=reset,
+            leader_entries=self._journal.entries,
+            compactions=compactions,
+        )
+
+
+class JournalTailer:
+    """Follower side: apply shipped batches into a warm replica.
+
+    Maintains (1) a byte-identical replica file of the leader journal's
+    shipped prefix and (2) an incrementally reconciled
+    :class:`ReplayAccumulator` — the takeover path reads the accumulated
+    state directly instead of replaying the replica from disk.
+    """
+
+    def __init__(self, replica_path: str,
+                 fsync: bool = False,
+                 on_record: Optional[Callable[[dict], None]] = None):
+        self._path = replica_path
+        self._fsync = fsync
+        self._on_record = on_record
+        directory = os.path.dirname(os.path.abspath(replica_path))
+        os.makedirs(directory, exist_ok=True)
+        self._fh = None
+        self.offset = 0
+        self.entries = 0
+        self.compactions = 0
+        self.resets = 0
+        self.leader_entries = 0
+        self._acc = ReplayAccumulator()
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    @property
+    def lag_records(self) -> int:
+        """Leader entries not yet tailed, per the last shipped batch."""
+        return max(self.leader_entries - self.entries, 0)
+
+    def _reset_replica(self) -> None:
+        self.close()
+        with open(self._path, "wb"):
+            pass
+        self.offset = 0
+        self.entries = 0
+        self.resets += 1
+        self._acc = ReplayAccumulator()
+
+    def apply(self, batch: ShipBatch) -> int:
+        """Append a shipped batch to the replica; returns records applied.
+
+        Corrupt frames are skipped with a warning (mirrors
+        ``iter_jsonl``'s tolerance) but still written to the replica so
+        the byte stream stays identical to the source.
+        """
+        if batch.reset and (self.offset != 0 or self.entries != 0
+                            or self.compactions != batch.compactions):
+            self._reset_replica()
+        applied = 0
+        if batch.frames:
+            if self._fh is None:
+                self._fh = open(self._path, "ab")
+            for line in iter_frames(batch.frames):
+                self._fh.write(line + b"\n")
+                try:
+                    rec = json.loads(line.decode("utf-8"))
+                except (ValueError, UnicodeDecodeError):
+                    logger.warning("Skipping unparsable shipped record")
+                    continue
+                self._acc.feed(rec)
+                self.entries += 1
+                applied += 1
+                if self._on_record is not None:
+                    self._on_record(rec)
+            self._fh.flush()
+            if self._fsync:
+                os.fsync(self._fh.fileno())
+        self.offset = batch.next_offset
+        self.compactions = batch.compactions
+        self.leader_entries = batch.leader_entries
+        return applied
+
+    def pull(self, shipper: JournalShipper, max_bytes: int = 1 << 20) -> int:
+        """One tail step: request the next batch and apply it."""
+        batch = shipper.ship_since(self.offset,
+                                   known_compactions=self.compactions,
+                                   max_bytes=max_bytes)
+        return self.apply(batch)
+
+    def replay_state(self, epoch: int = 0) -> JournalReplay:
+        """The incrementally accumulated replay — what a cold
+        ``journal.replay()`` of the replica would return, without
+        touching disk."""
+        return self._acc.result(epoch=epoch)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:  # pragma: no cover
+                pass
+            self._fh = None

@@ -1,0 +1,268 @@
+"""Leader/follower roles over the lease + shipper primitives.
+
+:class:`ReplicationController` is the leader half: acquire the lease,
+adopt the claimed epoch into the execution journal, and keep re-stamping
+the expiry.  :class:`WarmStandby` is the follower half: tail the
+leader's journal into a warm replica (pre-warming compiled kernels on
+first contact), watch the lease, and on expiry *take over* — advance the
+epoch via lease acquisition (one atomic sidecar replace that also fences
+the ex-leader), hand the already-tailed replica to the executor, and
+complete reconciliation from the accumulated state.  The takeover skips
+the full-journal replay a cold ``Executor.recover()`` pays, which is
+exactly the warm-vs-cold margin ``BENCH_SIZE=recovery`` measures.
+
+Both roles surface a ``state_snapshot()`` consumed by ``/state`` as
+``ReplicationState`` (role, lease expiry, follower lag).  The follower's
+tail loop registers with the PR 10
+:class:`~cruise_control_tpu.common.watchdog.Watchdog` (named heartbeat,
+``active_fn``-gated) so a stalled tailer is restarted with backoff and
+surfaced as degraded instead of silently falling behind.
+
+All timing is injected (``now_ms`` / ``sleep_s`` seams — graftlint G011
+holds for this package), so the whole failover dance runs under the
+virtual-time simulator.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional
+
+from ..executor.journal import ExecutionJournal
+from .lease import LeaderLease
+from .shipper import JournalShipper, JournalTailer
+
+logger = logging.getLogger("cruise-control.replication")
+
+#: watchdog heartbeat name for the follower tail loop
+TAILER_HEARTBEAT = "replication-tailer"
+
+
+class ReplicationController:
+    """Leader-side replication: hold and renew the leadership lease.
+
+    ``attach()`` is the promotion-to-leader handshake: acquire the lease
+    (advancing the epoch, fencing all priors) and have the journal adopt
+    that epoch so every subsequent append carries it.
+    """
+
+    def __init__(self, lease: LeaderLease,
+                 journal: Optional[ExecutionJournal] = None,
+                 shipper: Optional[JournalShipper] = None):
+        self._lease = lease
+        self._journal = journal
+        self._shipper = shipper or (JournalShipper(journal)
+                                    if journal is not None else None)
+        self.role = "leader"
+
+    @property
+    def lease(self) -> LeaderLease:
+        return self._lease
+
+    @property
+    def shipper(self) -> Optional[JournalShipper]:
+        return self._shipper
+
+    def attach(self) -> int:
+        """Acquire the lease and adopt its epoch into the journal."""
+        epoch = self._lease.acquire()
+        if self._journal is not None:
+            self._journal.adopt_epoch()
+        return epoch
+
+    def tick(self):
+        """Per-tick (or per-loop) leader duty: renew the lease when due.
+
+        Propagates ``StaleEpochError`` if the lease was taken over —
+        the caller is a zombie and must stop serving."""
+        return self._lease.maybe_renew()
+
+    def state_snapshot(self) -> dict:
+        out = {"role": self.role, **self._lease.state_snapshot(),
+               "followerLagRecords": None}
+        if self._journal is not None:
+            out["journalEntries"] = self._journal.entries
+            out["journalCompactions"] = self._journal.compactions
+        return out
+
+
+class WarmStandby:
+    """Follower-side replication: tail, stay warm, take over on expiry.
+
+    ``executor`` is the standby's (journal-less) executor; ``promote()``
+    builds an :class:`ExecutionJournal` over the tailed replica —
+    fencing against the *leader's* sidecar via ``epoch_path`` — attaches
+    it, and runs ``recover(advance=False, replay=<tailed state>)``.
+    ``warm_fn`` (called once, on first tailed records) is the hook into
+    the existing ``warm_kernels`` path so the anneal/heal programs are
+    compiled before they are ever needed.
+    """
+
+    def __init__(self, shipper: JournalShipper, tailer: JournalTailer,
+                 lease: LeaderLease, now_ms: Callable[[], int],
+                 executor=None, warm_fn: Optional[Callable[[], None]] = None,
+                 sleep_s: Optional[Callable[[float], None]] = None,
+                 poll_interval_ms: int = 1_000, fsync: bool = False):
+        self._shipper = shipper
+        self._tailer = tailer
+        self._lease = lease
+        self._now_ms = now_ms
+        self._executor = executor
+        self._warm_fn = warm_fn
+        self._sleep_s = sleep_s
+        self._poll_interval_ms = int(poll_interval_ms)
+        self._fsync = fsync
+        self.role = "follower"
+        self.warmed = False
+        self.takeovers = 0
+        self.journal: Optional[ExecutionJournal] = None
+        self.last_takeover: Optional[dict] = None
+        self._watchdog = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        #: test hook — makes the tail thread exit without clearing the
+        #: running flag, simulating a stalled loop for the watchdog
+        self._stall_for_test = False
+
+    # ------------------------------------------------------------- tail
+
+    @property
+    def tailer(self) -> JournalTailer:
+        return self._tailer
+
+    @property
+    def lease(self) -> LeaderLease:
+        return self._lease
+
+    @property
+    def lag_records(self) -> int:
+        return self._tailer.lag_records
+
+    def poll(self) -> int:
+        """One tail step: pull + apply the next batch, beat the
+        watchdog, fire the one-shot kernel pre-warm on first contact."""
+        applied = self._tailer.pull(self._shipper)
+        if self._watchdog is not None:
+            self._watchdog.beat(TAILER_HEARTBEAT)
+        if (applied and not self.warmed and self._warm_fn is not None
+                and self.role == "follower"):
+            self.warmed = True
+            try:
+                self._warm_fn()
+            except Exception:
+                logger.exception("standby kernel pre-warm failed; takeover "
+                                 "will compile on demand")
+        return applied
+
+    # --------------------------------------------------------- takeover
+
+    def lease_expired(self) -> bool:
+        return self._lease.read().expired(int(self._now_ms()))
+
+    def promote(self, executor=None) -> dict:
+        """Take over leadership from the already-tailed state.
+
+        Sequence (docs/operations.md "Replication and failover"):
+
+        1. ``lease.acquire()`` — advances the epoch and stamps this
+           holder in one atomic sidecar replace; the fenced ex-leader's
+           next append raises ``StaleEpochError``.
+        2. Build an :class:`ExecutionJournal` over the replica file,
+           fenced against the *shared* sidecar, seeded with the tailer's
+           entry count (no re-parse).
+        3. ``recover(advance=False, replay=<accumulated state>)`` —
+           adopt the claimed epoch and reconcile/resume the open
+           execution without replaying the journal from disk.
+        """
+        ex = executor or self._executor
+        if ex is None:
+            raise RuntimeError("WarmStandby.promote() needs an executor")
+        epoch = self._lease.acquire()
+        self.journal = ExecutionJournal(
+            self._tailer.path, fsync=self._fsync, now_ms=self._now_ms,
+            epoch_path=self._lease.path, entries_hint=self._tailer.entries)
+        ex.attach_journal(self.journal)
+        summary = ex.recover(advance=False,
+                             replay=self._tailer.replay_state(epoch=epoch))
+        self.role = "leader"
+        self.takeovers += 1
+        self.last_takeover = summary
+        return summary
+
+    def maybe_takeover(self, executor=None) -> Optional[dict]:
+        """Promote iff the leader's lease has expired; the follower's
+        per-tick entry point."""
+        if self.role != "follower" or not self.lease_expired():
+            return None
+        return self.promote(executor=executor)
+
+    # -------------------------------------------------- tail loop (S2)
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and not self._stop.is_set()
+
+    def register_watchdog(self, watchdog) -> None:
+        """Register the tail loop with the thread watchdog: heartbeat on
+        every poll, ``active_fn``-gated (an intentionally stopped
+        standby is idle, not stalled), restarted with the watchdog's
+        bounded backoff when the loop wedges."""
+        self._watchdog = watchdog
+        watchdog.register(TAILER_HEARTBEAT,
+                          restart_fn=self._restart_thread,
+                          active_fn=lambda: self.running)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if self._stall_for_test:
+                return  # thread dies with running still claimed
+            try:
+                self.poll()
+            except Exception:
+                logger.exception("standby tail step failed; retrying")
+            if self._sleep_s is not None:
+                self._sleep_s(self._poll_interval_ms / 1000.0)
+
+    def start(self) -> None:
+        """Spawn the tail loop thread (wall-clock deployments; the
+        simulator drives :meth:`poll` from its tick loop instead)."""
+        if self.running:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name=TAILER_HEARTBEAT, daemon=True)
+        self._thread.start()
+
+    def _restart_thread(self) -> None:
+        self._stall_for_test = False
+        self._thread = None
+        self.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+        self._thread = None
+        self._tailer.close()
+
+    # ------------------------------------------------------------ state
+
+    def state_snapshot(self) -> dict:
+        st = self._lease.read()
+        return {
+            "role": self.role,
+            "holder": st.holder,
+            "epoch": st.epoch,
+            "leaseExpiryMs": st.expiry_ms,
+            "leaseMs": self._lease.lease_ms,
+            "renewMs": self._lease.renew_ms,
+            "expired": st.expired(int(self._now_ms())),
+            "heldByMe": (st.holder == self._lease.holder_id
+                         and st.epoch == self._lease.epoch),
+            "followerLagRecords": self.lag_records,
+            "tailedRecords": self._tailer.entries,
+            "tailerResets": self._tailer.resets,
+            "takeovers": self.takeovers,
+            "warmedKernels": self.warmed,
+        }

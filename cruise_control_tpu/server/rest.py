@@ -371,7 +371,10 @@ class RestApi:
         whether optimize/warm-up run the sharded kernels) alongside the
         proposal/tick fields. SimulatorState (present after a scenario
         run — docs/simulation.md) carries the latest scorecard and is
-        addressable via ``substates=simulator``."""
+        addressable via ``substates=simulator``. ReplicationState (role:
+        leader/follower/standalone, lease holder + leaseExpiryMs,
+        followerLagRecords — docs/operations.md "Replication and
+        failover") is addressable via ``substates=replication``."""
         state = self.app.state(
             super_verbose=_parse_bool(params, "super_verbose", False))
         substates = _parse_csv(params, "substates")

@@ -45,7 +45,10 @@ class FaultEvent:
     calls the wrapper freezes the execution journal and raises
     ``ProcessCrashed``; the runner tears the app down and rebuilds it
     against the same simulated cluster, exercising restart reconciliation
-    (the Scorecard records the recovery tick).
+    (the Scorecard records the recovery tick). With a warm standby
+    attached (``Scenario.warm_standby``) the same event kills the
+    *leader*: the standby keeps tailing, the lease expires, and takeover
+    is scored instead of a cold rebuild.
     """
 
     tick: int
@@ -116,4 +119,12 @@ class FaultSchedule:
         return tuple(sorted(
             (e for e in self.events
              if e.kind in ("kill_broker", "kill_broker_mid_execution")),
+            key=lambda e: e.tick))
+
+    def process_crash_events(self) -> Tuple[FaultEvent, ...]:
+        """Control-plane death events, in tick order — the runner
+        provisions a journal (and, with ``Scenario.warm_standby``, the
+        standby pair) iff any are scheduled."""
+        return tuple(sorted(
+            (e for e in self.events if e.kind == "process_crash"),
             key=lambda e: e.tick))

@@ -79,6 +79,10 @@ class Scenario:
     expected_provision: Optional[str] = None
     config_overrides: Tuple[Tuple[str, object], ...] = ()
     latency_polls: int = 1
+    #: attach a live warm standby (leased leadership + journal tailing);
+    #: a ``process_crash`` then kills the *leader* and the scorecard
+    #: measures lease-expiry takeover instead of a cold restart
+    warm_standby: bool = False
 
 
 @dataclasses.dataclass
@@ -128,6 +132,12 @@ def _scenario_config(sc: Scenario):
         # calls watchdog.poll() itself
         "watchdog.interval.ms": 0,
     }
+    if sc.warm_standby:
+        # lease timing in tick units: the leader renews every tick, so a
+        # one-tick lease expires on the first tick it misses — takeover
+        # lands at crash tick + 1 without weakening the lease guarantee
+        base["replication.lease.ms"] = W
+        base["replication.lease.renew.ms"] = max(W // 4, 1)
     base.update(dict(sc.config_overrides))
     return CruiseControlConfig(base)
 
@@ -191,6 +201,50 @@ def build_app(sc: Scenario, clock=None, cluster=None, wrapper=None,
     return clock, cluster, wrapper, app
 
 
+def _build_standby(sc: Scenario, clock, cluster, wrapper, leader_app):
+    """Attach a replicated control plane to a scenario: the leader takes
+    the leadership lease over its journal's epoch sidecar, and a second
+    full app — own monitor windows, no journal until promotion — tails
+    the leader's journal on the same simulated world. Returns
+    ``(controller, standby, standby_app)``."""
+    from cruise_control_tpu.replication import (JournalTailer, LeaderLease,
+                                                ReplicationController,
+                                                WarmStandby)
+    config = leader_app.config
+    lease_ms = config.get("replication.lease.ms")
+    renew_ms = config.get("replication.lease.renew.ms")
+    epoch_path = leader_app.journal.epoch_path
+    controller = ReplicationController(
+        LeaderLease(epoch_path, holder="leader", now_ms=clock.now_ms,
+                    lease_ms=lease_ms, renew_ms=renew_ms, fsync=False),
+        journal=leader_app.journal)
+    controller.attach()
+    leader_app.attach_replication(controller)
+    overrides = dict(sc.config_overrides)
+    replica_path = overrides["executor.journal.path"] + ".standby"
+    overrides["executor.journal.path"] = ""
+    sc_follower = dataclasses.replace(
+        sc, config_overrides=tuple(overrides.items()))
+    standby_sampler = sc.workload or DiurnalWorkload(
+        seed=sc.seed, period_ms=max(sc.ticks * sc.tick_ms // 2, sc.tick_ms))
+    _, _, _, standby_app = build_app(
+        sc_follower, clock=clock, cluster=cluster, wrapper=wrapper,
+        sampler=standby_sampler)
+    standby = WarmStandby(
+        controller.shipper,
+        JournalTailer(replica_path),
+        LeaderLease(epoch_path, holder="standby", now_ms=clock.now_ms,
+                    lease_ms=lease_ms, renew_ms=renew_ms, fsync=False),
+        now_ms=clock.now_ms,
+        executor=standby_app.executor,
+        # the existing warm path: a precompute traces/compiles the
+        # anneal + escape kernels via OPT.warm_kernels before takeover
+        warm_fn=standby_app.precompute_tick)
+    standby.register_watchdog(standby_app.watchdog)
+    standby_app.attach_replication(standby)
+    return controller, standby, standby_app
+
+
 def run_scenario(sc: Scenario, use_sentinel: bool = False,
                  score_goals: bool = True) -> Scorecard:
     """Run one scenario end-to-end; returns its :class:`Scorecard`.
@@ -203,6 +257,7 @@ def run_scenario(sc: Scenario, use_sentinel: bool = False,
     """
     from cruise_control_tpu.common import sentinels as SENT
     from cruise_control_tpu.common.faults import ProcessCrashed
+    from cruise_control_tpu.executor.journal import StaleEpochError
     from cruise_control_tpu.monitor.load_monitor import (
         NotEnoughValidWindowsError)
 
@@ -211,7 +266,7 @@ def run_scenario(sc: Scenario, use_sentinel: bool = False,
     # crash is simulated above the filesystem, and virtual time shouldn't
     # pay real disk latency)
     auto_journal_dir = None
-    if (any(e.kind == "process_crash" for e in sc.faults.events)
+    if (sc.faults.process_crash_events()
             and "executor.journal.path" not in dict(sc.config_overrides)):
         import tempfile
         auto_journal_dir = tempfile.mkdtemp(prefix="cc-scenario-journal-")
@@ -224,12 +279,53 @@ def run_scenario(sc: Scenario, use_sentinel: bool = False,
     W = sc.tick_ms
     config = app.config
     goal_names = tuple(config.get("anomaly.detection.goals"))
+    full_windows = config.get("num.partition.metrics.windows")
+
+    def valid_windows(a) -> int:
+        """Monitoring completeness of one app's aggregator — a cold
+        restart refills from zero (one window per tick); a warm standby
+        sampled every tick, so its windows never emptied."""
+        from cruise_control_tpu.monitor.aggregator import (
+            ModelCompletenessRequirements)
+        try:
+            return int(a.load_monitor.partition_aggregator.completeness(
+                clock.now_ms(),
+                ModelCompletenessRequirements()).num_valid_windows)
+        except Exception:  # pragma: no cover  # graftlint: disable=G009 a starved aggregator (no samples yet) simply has zero valid windows
+            return 0
+
+    standby = standby_app = None
+    leader_dead = False
+    dead_app = None
+    dead_tick: Optional[int] = None
+    zombie_fenced: Optional[bool] = None
+    if sc.warm_standby and app.journal is not None:
+        _, standby, standby_app = _build_standby(sc, clock, cluster,
+                                                 wrapper, app)
 
     def ingest():
-        app.load_monitor.sample_once(now_ms=clock.now_ms() + W // 2)
+        if not leader_dead:
+            app.load_monitor.sample_once(now_ms=clock.now_ms() + W // 2)
+        if standby_app is not None and standby_app is not app:
+            standby_app.load_monitor.sample_once(
+                now_ms=clock.now_ms() + W // 2)
         clock.advance_ms(W)
 
+    def replication_tick():
+        """Leader renews its lease, follower tails the journal — the
+        per-tick replication duties while both incarnations live."""
+        if standby is None or standby.role != "follower":
+            return
+        if not leader_dead:
+            try:
+                app.replication.tick()
+            except StaleEpochError:  # pragma: no cover - superseded leader
+                pass
+        standby.poll()
+        standby_app.watchdog.poll()
+
     def loop_once():
+        replication_tick()
         app.precompute_tick()
         app.anomaly_detector.sweep()
         app.anomaly_detector.handle_pending()
@@ -333,31 +429,79 @@ def run_scenario(sc: Scenario, use_sentinel: bool = False,
                             wrapper.plan.process_crash_after_calls))
                 wrapper.set_plan(plan)
             ingest()
+            if not leader_dead:
+                replication_tick()
             m0 = cluster.moves_applied
             l0 = cluster.leadership_moves_applied
             t0 = _time.perf_counter()
-            try:
-                computed = app.precompute_tick()
-                app.anomaly_detector.sweep()
-                app.anomaly_detector.handle_pending()
-            except ProcessCrashed:
-                # the control plane just died mid-tick (journal frozen at
-                # the instant of death). Rebuild the app against the SAME
-                # simulated cluster/clock/chaos wrapper — a new process on
-                # the same host — and run restart reconciliation.
+            if leader_dead:
+                # the leader is down and a standby exists: no control
+                # plane serves this tick. The standby keeps tailing the
+                # (frozen) journal and watches the lease; once it expires
+                # the standby advances the epoch and takes over from its
+                # already-tailed state — no cold rebuild, no full replay.
                 computed = False
                 rec_t0 = _time.perf_counter()
-                _, _, _, app = build_app(
-                    sc, clock=clock, cluster=cluster, wrapper=wrapper,
-                    sampler=app.load_monitor._sampler)
-                wrapper.on_crash = (app.journal.freeze
-                                    if app.journal is not None else None)
-                recovery = (app.executor.recover()
-                            if app.journal is not None
-                            else {"performed": False})
-                recovery_walls.append(
-                    round((_time.perf_counter() - rec_t0) * 1000.0, 3))
-                crash_recoveries.append({"tick": tick, **recovery})
+                standby.poll()
+                takeover = standby.maybe_takeover()
+                if takeover is not None:
+                    app = standby_app
+                    app.journal = standby.journal
+                    wrapper.on_crash = standby.journal.freeze
+                    recovery_walls.append(
+                        round((_time.perf_counter() - rec_t0) * 1000.0, 3))
+                    crash_recoveries.append({
+                        **takeover, "tick": dead_tick, "takeoverTick": tick,
+                        "takeoverTicks": tick - dead_tick,
+                        "mode": "warm_takeover"})
+                    # the fenced ex-leader provably cannot mutate: its
+                    # next append refuses with StaleEpochError and its
+                    # held epoch predates the lease-claimed one
+                    try:
+                        dead_app.journal.log_execution_end("zombie-probe")
+                        zombie_fenced = False
+                    except StaleEpochError:
+                        zombie_fenced = (dead_app.journal.epoch
+                                         < standby.journal.epoch)
+                    leader_dead = False
+                    computed = bool(app.precompute_tick())
+                    app.anomaly_detector.sweep()
+                    app.anomaly_detector.handle_pending()
+            else:
+                try:
+                    computed = app.precompute_tick()
+                    app.anomaly_detector.sweep()
+                    app.anomaly_detector.handle_pending()
+                except ProcessCrashed:
+                    computed = False
+                    if standby is not None and standby.role == "follower":
+                        # leader killed with a live standby attached:
+                        # leave the corpse fenced and let the lease run
+                        # out (scored as takeoverTicks)
+                        leader_dead = True
+                        dead_tick = tick
+                        dead_app = app
+                    else:
+                        # no standby: the PR 10 path. Rebuild the app
+                        # against the SAME simulated cluster/clock/chaos
+                        # wrapper — a new process on the same host — and
+                        # run cold restart reconciliation (full replay).
+                        rec_t0 = _time.perf_counter()
+                        _, _, _, app = build_app(
+                            sc, clock=clock, cluster=cluster,
+                            wrapper=wrapper,
+                            sampler=app.load_monitor._sampler)
+                        wrapper.on_crash = (app.journal.freeze
+                                            if app.journal is not None
+                                            else None)
+                        recovery = (app.executor.recover()
+                                    if app.journal is not None
+                                    else {"performed": False})
+                        recovery_walls.append(round(
+                            (_time.perf_counter() - rec_t0) * 1000.0, 3))
+                        crash_recoveries.append(
+                            {**recovery, "tick": tick,
+                             "mode": "cold_restart"})
             app.watchdog.poll()
             wall_ms = (_time.perf_counter() - t0) * 1000.0
             tick_walls.append(wall_ms)
@@ -381,6 +525,7 @@ def run_scenario(sc: Scenario, use_sentinel: bool = False,
                 "engine": res.engine if res is not None else None,
                 "replicaMoves": cluster.moves_applied - m0,
                 "leadershipMoves": cluster.leadership_moves_applied - l0,
+                "validWindows": valid_windows(app),
             })
             for ev in kills:
                 if ev.broker_id in evac_tick or ev.tick > tick:
@@ -437,6 +582,20 @@ def run_scenario(sc: Scenario, use_sentinel: bool = False,
     engines = sorted({r["engine"] for r in records if r["engine"]})
     injected = {k: wrapper.injected[k] - base_injected.get(k, 0)
                 for k in wrapper.injected}
+    # recovery ticks: crash tick → first tick the control plane computes
+    # again at FULL monitoring completeness. A cold restart refills its
+    # metric windows from zero (one per tick); a warm standby's windows
+    # never emptied, so takeover + one tick suffices.
+    for entry in crash_recoveries:
+        rec_tick = next((r["tick"] for r in records
+                         if r["tick"] >= entry["tick"] and r["computed"]
+                         and r["validWindows"] >= full_windows),
+                        None)
+        entry["recoveryTicks"] = (rec_tick - entry["tick"]
+                                  if rec_tick is not None else None)
+    takeover_ticks = next(
+        (e["takeoverTicks"] for e in crash_recoveries
+         if e.get("mode") == "warm_takeover"), None)
     provision_accurate = (None if sc.expected_provision is None
                           else sc.expected_provision in provision_statuses)
     core = {
@@ -472,6 +631,11 @@ def run_scenario(sc: Scenario, use_sentinel: bool = False,
         "recoveryTick": (crash_recoveries[0]["tick"]
                          if crash_recoveries else None),
         "crashRecoveries": crash_recoveries,
+        "warmStandby": sc.warm_standby,
+        "takeoverTicks": takeover_ticks,
+        "zombieFenced": zombie_fenced,
+        "standbyLagRecords": (standby.lag_records
+                              if standby is not None else None),
         "watchdogRestarts": app.watchdog.total_restarts,
         # digest of the final replica assignment + leaders: the crash-
         # recovery acceptance check compares this across a crashing run and
@@ -503,9 +667,15 @@ def run_scenario(sc: Scenario, use_sentinel: bool = False,
         wall["uncoveredRetraces"] = [str(u) for u in uncovered]
     card = Scorecard(core=core, wall=wall)
     app.record_simulation_scorecard(card.to_json())
+    if standby is not None:
+        standby.stop()
+        if standby.journal is not None:
+            standby.journal.close()
     if auto_journal_dir is not None:
         if app.journal is not None:
             app.journal.close()
+        if dead_app is not None and dead_app.journal is not None:
+            dead_app.journal.close()
         import shutil
         shutil.rmtree(auto_journal_dir, ignore_errors=True)
     return card

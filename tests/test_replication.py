@@ -1,0 +1,608 @@
+"""Replicated control plane suite: leased leadership over the epoch
+sidecar, journal shipping into a warm standby, takeover on lease expiry,
+journal compaction, and the leader+standby crash-point matrix — the
+acceptance contract is that a leader killed at ANY adapter-call index
+hands over to a standby that converges bit-identically to an
+uninterrupted twin, with zero orphaned reassignments and the fenced
+ex-leader provably unable to mutate the cluster.
+"""
+
+import json
+import time as _time
+
+import pytest
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.common.faults import (
+    FaultPlan,
+    FaultyClusterAdapter,
+    ProcessCrashed,
+)
+from cruise_control_tpu.common.watchdog import Watchdog
+from cruise_control_tpu.executor.executor import (
+    Executor,
+    ExecutorConfig,
+    FakeClusterAdapter,
+)
+from cruise_control_tpu.executor.journal import (
+    ExecutionJournal,
+    ReplayAccumulator,
+    StaleEpochError,
+)
+from cruise_control_tpu.executor.tasks import TaskState, TaskType
+from cruise_control_tpu.replication import (
+    JournalShipper,
+    JournalTailer,
+    LeaderLease,
+    LeaseHeldError,
+    ReplicationController,
+    WarmStandby,
+    read_lease,
+)
+from cruise_control_tpu.replication.standby import TAILER_HEARTBEAT
+from cruise_control_tpu.simulator.clock import VirtualClock
+
+pytestmark = pytest.mark.replication
+
+W = 60_000
+
+
+def _proposal(topic, part, old, new, size=10.0):
+    return ExecutionProposal(topic=topic, partition=part, old_leader=old[0],
+                             old_replicas=tuple(old), new_replicas=tuple(new),
+                             data_size=size)
+
+
+def _proposals():
+    return [
+        _proposal("t", 0, [0, 1], [2, 1]),
+        _proposal("t", 1, [1, 2], [3, 2]),
+        _proposal("t", 2, [2, 0], [0, 2]),     # leadership-only
+        _proposal("u", 0, [3, 0], [1, 0]),
+    ]
+
+
+def _executor(adapter, journal=None, clock=None):
+    clock = clock or VirtualClock()
+    return Executor(adapter,
+                    config=ExecutorConfig(task_stuck_deadline_ms=None),
+                    clock=clock.now_s, sleep=clock.sleep,
+                    journal=journal), clock
+
+
+def _lease(path, holder, clock, lease_ms=W, renew_ms=W // 4):
+    return LeaderLease(path, holder, now_ms=clock.now_ms,
+                       lease_ms=lease_ms, renew_ms=renew_ms, fsync=False)
+
+
+# ------------------------------------------------------------------ lease
+
+
+def test_lease_acquire_claims_epoch_and_fences_journal(tmp_path):
+    """One atomic sidecar replace both grants the lease and fences every
+    prior epoch holder — there is no window with two legal appenders."""
+    path = str(tmp_path / "execution.journal")
+    clock = VirtualClock()
+    old = ExecutionJournal(path, fsync=False, now_ms=clock.now_ms)  # epoch 0
+    lease = _lease(old.epoch_path, "cc-a", clock)
+    assert lease.acquire() == 1
+    st = read_lease(old.epoch_path)
+    assert st.holder == "cc-a" and st.epoch == 1
+    assert st.expiry_ms == clock.now_ms() + W
+    assert not st.expired(clock.now_ms())
+    assert lease.held()
+    with pytest.raises(StaleEpochError):
+        old.log_execution_end("completed")       # pre-lease holder: fenced
+
+
+def test_lease_acquire_waits_out_unexpired_holder(tmp_path):
+    epoch_path = str(tmp_path / "execution.journal.epoch")
+    clock = VirtualClock()
+    a = _lease(epoch_path, "cc-a", clock)
+    b = _lease(epoch_path, "cc-b", clock)
+    assert a.acquire() == 1
+    with pytest.raises(LeaseHeldError):
+        b.acquire()                              # lease unexpired: wait
+    clock.advance_ms(W)                          # expiry is inclusive (>=)
+    assert b.acquire() == 2
+    assert read_lease(epoch_path).holder == "cc-b"
+
+
+def test_lease_renew_restamps_and_supersede_raises(tmp_path):
+    epoch_path = str(tmp_path / "execution.journal.epoch")
+    clock = VirtualClock()
+    a = _lease(epoch_path, "cc-a", clock)
+    a.acquire()
+    assert not a.renew_due()                     # just stamped
+    assert a.maybe_renew() is None
+    clock.advance_ms(W // 4)
+    assert a.renew_due()
+    st = a.maybe_renew()
+    assert st.expiry_ms == clock.now_ms() + W    # re-stamped, same epoch
+    assert st.epoch == 1
+    # a standby takes over after expiry: the old holder's next renewal
+    # must refuse — it is a zombie and stops serving
+    clock.advance_ms(2 * W)
+    b = _lease(epoch_path, "cc-b", clock)
+    assert b.acquire() == 2
+    with pytest.raises(StaleEpochError):
+        a.renew()
+
+
+def test_legacy_epoch_sidecar_interoperates(tmp_path):
+    """Pre-replication sidecars ({"epoch": N} only) decode as an expired
+    claim at their epoch; journals read leased sidecars transparently."""
+    path = str(tmp_path / "execution.journal")
+    epoch_path = path + ".epoch"
+    with open(epoch_path, "w", encoding="utf-8") as f:
+        f.write(json.dumps({"epoch": 3}))
+    st = read_lease(epoch_path)
+    assert st.epoch == 3 and st.holder is None
+    assert st.expired(0)                         # holderless: claimable now
+    clock = VirtualClock()
+    lease = _lease(epoch_path, "cc-a", clock)
+    assert lease.acquire() == 4                  # advances the legacy epoch
+    # the journal reads only the "epoch" key of the leased sidecar
+    j = ExecutionJournal(path, fsync=False, now_ms=clock.now_ms)
+    assert j.epoch == 4
+    j.log_execution_end("completed")             # appends fine at epoch 4
+    # and a journal-side advance writes a legacy sidecar the lease can
+    # still decode (as an expired holderless claim)
+    assert j.advance_epoch() == 5
+    assert read_lease(epoch_path) == type(st)(epoch=5)
+
+
+# -------------------------------------------------------- shipper / tailer
+
+
+def _journal_with_execution(tmp_path, name="leader"):
+    props = _proposals()
+    base = FakeClusterAdapter(
+        {p.topic_partition: p.old_replicas for p in props}, latency_polls=2)
+    clock = VirtualClock()
+    path = str(tmp_path / name / "execution.journal")
+    journal = ExecutionJournal(path, fsync=False, now_ms=clock.now_ms)
+    ex, _ = _executor(base, journal=journal, clock=clock)
+    ex.execute_proposals(props)
+    return journal, clock
+
+
+def test_shipper_tailer_replica_byte_identical(tmp_path):
+    """Resumable length-prefixed streaming: small-chunk pulls produce a
+    replica byte-identical to the source, and the tailer's incrementally
+    accumulated replay classifies identically to a cold file replay."""
+    journal, _ = _journal_with_execution(tmp_path)
+    shipper = JournalShipper(journal)
+    tailer = JournalTailer(str(tmp_path / "replica.journal"))
+    pulls = 0
+    while tailer.pull(shipper, max_bytes=128) or tailer.lag_records:
+        pulls += 1
+        assert pulls < 10_000
+    assert pulls > 1                             # genuinely chunked
+    assert tailer.entries == journal.entries
+    assert tailer.lag_records == 0
+    with open(journal.path, "rb") as f:
+        src = f.read()
+    with open(tailer.path, "rb") as f:
+        replica = f.read()
+    assert replica == src and len(src) > 0
+    cold = journal.replay()
+    warm = tailer.replay_state()
+    assert warm.entries == cold.entries
+    assert warm.open_execution is None and cold.open_execution is None
+
+
+def test_shipper_withholds_torn_tail(tmp_path):
+    """Only whole lines ship: a torn in-flight append stays on the leader
+    until its newline lands (mirrors the journal's own WAL contract)."""
+    path = str(tmp_path / "execution.journal")
+    clock = VirtualClock()
+    journal = ExecutionJournal(path, fsync=False, now_ms=clock.now_ms)
+    journal.log_execution_start(_proposals(), generation=1)
+    journal.close()
+    with open(path, "rb") as f:
+        durable = f.read()
+    with open(path, "ab") as f:
+        f.write(b'{"type":"task","epo')         # torn mid-append
+    shipper = JournalShipper(journal)
+    tailer = JournalTailer(str(tmp_path / "replica.journal"))
+    tailer.pull(shipper)
+    with open(tailer.path, "rb") as f:
+        assert f.read() == durable               # torn bytes withheld
+    assert tailer.entries == 1
+    # once the line completes, the remainder ships from the same offset
+    with open(path, "ab") as f:
+        f.write(b'ch":0}\n')
+    tailer.pull(shipper)
+    assert tailer.entries == 2
+
+
+def test_tailer_resyncs_after_compaction(tmp_path):
+    """Compaction rewrites the source under the stream; the shipper flags
+    the reset and the tailer truncates + re-syncs from offset 0."""
+    journal, _ = _journal_with_execution(tmp_path)
+    shipper = JournalShipper(journal)
+    tailer = JournalTailer(str(tmp_path / "replica.journal"))
+    tailer.pull(shipper)
+    assert tailer.entries == journal.entries and tailer.resets == 0
+    journal.compact()
+    applied = tailer.pull(shipper)
+    assert applied == 1 and tailer.resets == 1
+    assert tailer.entries == journal.entries == 1
+    with open(journal.path, "rb") as f:
+        src = f.read()
+    with open(tailer.path, "rb") as f:
+        assert f.read() == src
+    assert tailer.replay_state().open_execution is None
+
+
+# ------------------------------------------------------------- compaction
+
+
+def test_compact_open_execution_classifies_identically(tmp_path):
+    """Checkpoint + truncate-behind: replaying the compacted journal is
+    classification-equivalent to replaying the full history — identical
+    open-execution payload, task states and all."""
+    path = str(tmp_path / "execution.journal")
+    clock = VirtualClock()
+    j = ExecutionJournal(path, fsync=False, now_ms=clock.now_ms)
+    props = _proposals()
+    j.log_execution_start(props, removed_brokers=[3], generation=7)
+    j.log_task(0, TaskType.INTER_BROKER_REPLICA_ACTION.value, "t-0",
+               TaskState.IN_PROGRESS.value)
+    j.log_task(0, TaskType.INTER_BROKER_REPLICA_ACTION.value, "t-0",
+               TaskState.COMPLETED.value)
+    j.log_task(0, TaskType.INTER_BROKER_REPLICA_ACTION.value, "t-1",
+               TaskState.IN_PROGRESS.value)
+    before = j.replay()
+    out = j.compact()
+    assert out == {"entriesFolded": 4, "openExecution": True}
+    assert j.entries == 1 and j.compactions == 1
+    with open(path, "rb") as f:
+        lines = f.read().splitlines()
+    assert len(lines) == 1
+    assert json.loads(lines[0])["type"] == "checkpoint"
+    after = ExecutionJournal(path, fsync=False, now_ms=clock.now_ms).replay()
+    a, b = before.open_execution, after.open_execution
+    assert b is not None
+    assert (a.epoch, a.generation) == (b.epoch, b.generation)
+    assert a.proposals == b.proposals
+    assert a.removed_brokers == b.removed_brokers
+    assert a.task_states == b.task_states
+    # appends after compaction fold on top of the checkpoint
+    j.log_execution_end("completed")
+    assert j.replay().open_execution is None
+
+
+def test_compact_closed_execution_folds_to_null(tmp_path):
+    path = str(tmp_path / "execution.journal")
+    clock = VirtualClock()
+    j = ExecutionJournal(path, fsync=False, now_ms=clock.now_ms)
+    j.log_execution_start(_proposals(), generation=1)
+    j.log_execution_end("completed")
+    j.compact()
+    rec = json.loads(open(path, "rb").read())
+    assert rec["open"] is None and rec["entriesFolded"] == 2
+    assert j.replay().open_execution is None
+
+
+def test_auto_compaction_bounds_journal_entries(tmp_path):
+    """executor.journal.compact.records: the journal self-compacts at the
+    threshold, so replay cost and shipped tail stay bounded while the
+    open execution's classification survives every fold."""
+    path = str(tmp_path / "execution.journal")
+    clock = VirtualClock()
+    j = ExecutionJournal(path, fsync=False, now_ms=clock.now_ms,
+                         compact_records=5)
+    j.log_execution_start(_proposals(), generation=3)
+    for i in range(40):
+        j.log_task(0, TaskType.INTER_BROKER_REPLICA_ACTION.value, "t-0",
+                   TaskState.IN_PROGRESS.value)
+        assert j.entries <= 5
+    assert j.compactions >= 7
+    oe = j.replay().open_execution
+    assert oe is not None and oe.generation == 3
+    assert len(oe.proposals) == 4
+    assert oe.task_states[(TaskType.INTER_BROKER_REPLICA_ACTION.value,
+                           "t-0")] == TaskState.IN_PROGRESS.value
+
+
+def test_frozen_journal_refuses_compaction(tmp_path):
+    path = str(tmp_path / "execution.journal")
+    j = ExecutionJournal(path, fsync=False, now_ms=VirtualClock().now_ms)
+    j.log_execution_start(_proposals(), generation=1)
+    j.freeze()
+    with pytest.raises(StaleEpochError):
+        j.compact()
+
+
+def test_replay_accumulator_folds_checkpoint_plus_tail():
+    """The single classification authority: a checkpoint record seeds the
+    state the truncated history folded into, and subsequent records fold
+    on top exactly as they would have on the full history."""
+    acc = ReplayAccumulator()
+    acc.feed({"type": "checkpoint", "epoch": 2, "ts": 0, "entriesFolded": 9,
+              "open": {"epoch": 2, "generation": 5, "proposals": [],
+                       "removedBrokers": [1], "demotedBrokers": [],
+                       "taskStates": {"LEADER_ACTION|t-0": "IN_PROGRESS"}}})
+    oe = acc.open_execution
+    assert oe.generation == 5 and oe.removed_brokers == (1,)
+    assert oe.task_states[("LEADER_ACTION", "t-0")] == "IN_PROGRESS"
+    acc.feed({"type": "task", "epoch": 2, "ts": 1, "executionId": 1,
+              "taskType": "LEADER_ACTION", "tp": "t-0", "state": "COMPLETED"})
+    assert acc.open_execution.task_states[("LEADER_ACTION", "t-0")] == (
+        "COMPLETED")
+    acc.feed({"type": "execution_end", "epoch": 2, "ts": 2,
+              "result": "completed"})
+    assert acc.open_execution is None
+    assert acc.result(epoch=2).entries == 3
+
+
+# --------------------------------------------------------------- takeover
+
+
+def test_paused_leader_is_fenced_by_epoch_not_freeze(tmp_path):
+    """A leader that merely STOPS RENEWING (GC pause, partition) — its
+    journal never froze — must still be fenced the moment a standby's
+    lease acquisition advances the epoch: the next append refuses with
+    zero cluster mutations."""
+    props = _proposals()
+    base = FakeClusterAdapter(
+        {p.topic_partition: p.old_replicas for p in props}, latency_polls=2)
+    clock = VirtualClock()
+    path = str(tmp_path / "leader" / "execution.journal")
+    journal = ExecutionJournal(path, fsync=False, now_ms=clock.now_ms)
+    controller = ReplicationController(
+        _lease(journal.epoch_path, "leader", clock), journal=journal)
+    assert controller.attach() == 1
+    assert journal.epoch == 1                    # adopted, not re-advanced
+    ex, _ = _executor(base, journal=journal, clock=clock)
+    ex.execute_proposals(props)
+    snap = controller.state_snapshot()
+    assert snap["role"] == "leader" and snap["heldByMe"]
+
+    tailer = JournalTailer(str(tmp_path / "replica.journal"))
+    standby = WarmStandby(controller.shipper, tailer,
+                          _lease(journal.epoch_path, "standby", clock),
+                          now_ms=clock.now_ms)
+    while standby.poll():
+        pass
+    assert standby.lag_records == 0
+    assert standby.maybe_takeover(executor=object()) is None  # lease alive
+    clock.advance_ms(2 * W)                      # leader silent past expiry
+    ex2, _ = _executor(base, journal=None, clock=clock)
+    takeover = standby.maybe_takeover(executor=ex2)
+    assert takeover is not None and takeover["mode"] == "warm"
+    assert takeover["epoch"] == 2 and takeover["resumed"] == 0
+    assert standby.role == "leader" and standby.takeovers == 1
+    # the paused ex-leader wakes up: fenced before any adapter call
+    before = dict(base.replicas)
+    with pytest.raises(StaleEpochError):
+        ex.execute_proposals(props)
+    assert base.replicas == before
+    assert not base.in_progress_reassignments()
+    # the promoted journal appends fine under the leased epoch
+    standby.journal.log_execution_end("post-takeover")
+    assert standby.journal.epoch == 2
+
+
+# ----------------------------------------- leader+standby crash matrix
+
+
+def _run_pair_with_crash_at(tmp_path, k):
+    """Leader (lease + shipped journal) executes the canonical proposal
+    set and is killed at the k-th guarded adapter call; the standby tails
+    the corpse's durable journal, waits out the lease, and takes over.
+    Returns (crashed, takeover_summary, adapter, zombie_epoch_gap)."""
+    props = _proposals()
+    base = FakeClusterAdapter(
+        {p.topic_partition: p.old_replicas for p in props}, latency_polls=2)
+    clock = VirtualClock()
+    dirp = tmp_path / f"crash{k}"
+    journal = ExecutionJournal(str(dirp / "execution.journal"), fsync=False,
+                               now_ms=clock.now_ms)
+    controller = ReplicationController(
+        _lease(journal.epoch_path, "leader", clock), journal=journal)
+    controller.attach()
+    wrapper = FaultyClusterAdapter(
+        base, FaultPlan(process_crash_after_calls=k), sleep=clock.sleep)
+    wrapper.on_crash = journal.freeze
+    ex, _ = _executor(wrapper, journal=journal, clock=clock)
+    standby = WarmStandby(
+        controller.shipper, JournalTailer(str(dirp / "replica.journal")),
+        _lease(journal.epoch_path, "standby", clock), now_ms=clock.now_ms)
+    crashed = False
+    try:
+        ex.execute_proposals(props)
+    except ProcessCrashed:
+        crashed = True
+    while standby.poll():                        # tail the durable journal
+        pass
+    assert standby.lag_records == 0
+    clock.advance_ms(2 * W)                      # lease runs out
+    ex2, _ = _executor(base, journal=None, clock=clock)
+    takeover = standby.maybe_takeover(executor=ex2)
+    assert takeover is not None and takeover["mode"] == "warm"
+    # zombie fenced: the corpse's next append refuses (frozen on crash,
+    # epoch-fenced on a clean finish) and its epoch predates the claim
+    with pytest.raises(StaleEpochError):
+        journal.log_execution_end("zombie-probe")
+    return crashed, takeover, base, standby.journal.epoch - journal.epoch
+
+
+def test_leader_crash_at_every_transition_point_fails_over(tmp_path):
+    """Kill the LEADER at every guarded adapter-call index with a live
+    standby tailing; the promoted standby must always converge to the
+    bit-identical assignment of an uninterrupted run, with zero orphaned
+    reassignments and the zombie provably fenced."""
+    props = _proposals()
+    ref = FakeClusterAdapter(
+        {p.topic_partition: p.old_replicas for p in props}, latency_polls=2)
+    ex, _ = _executor(ref, journal=None)
+    ex.execute_proposals(props)
+    expected_replicas = dict(ref.replicas)
+    expected_leaders = dict(ref.leaders)
+
+    saw_crash = saw_clean = False
+    for k in range(1, 40):
+        crashed, takeover, base, gap = _run_pair_with_crash_at(tmp_path, k)
+        saw_crash |= crashed
+        saw_clean |= not crashed
+        assert base.replicas == expected_replicas, f"crash point {k}"
+        assert base.leaders == expected_leaders, f"crash point {k}"
+        assert takeover["orphanedRemaining"] == 0, f"crash point {k}"
+        assert not base.in_progress_reassignments(), f"crash point {k}"
+        assert gap > 0, f"crash point {k}"       # claim advanced the epoch
+    assert saw_crash, "no crash point ever fired — matrix is vacuous"
+    assert saw_clean, "even the last crash point fired — raise the range"
+
+
+# ------------------------------------------------------- tailer watchdog
+
+
+def test_tailer_loop_registers_and_restarts_via_watchdog(tmp_path):
+    """Satellite contract: the follower's tail loop is a supervised
+    thread — named heartbeat, active_fn-gated, restarted with backoff
+    when it wedges, and the restarted loop actually tails again."""
+    clock = VirtualClock()
+    journal = ExecutionJournal(str(tmp_path / "execution.journal"),
+                               fsync=False, now_ms=clock.now_ms)
+    journal.log_execution_start(_proposals(), generation=1)
+    standby = WarmStandby(
+        JournalShipper(journal),
+        JournalTailer(str(tmp_path / "replica.journal")),
+        _lease(journal.epoch_path, "standby", clock),
+        now_ms=clock.now_ms, sleep_s=lambda s: _time.sleep(0.001))
+    wd = Watchdog(now_ms=clock.now_ms, stall_ms=100, max_restarts=3,
+                  backoff_ms=1)
+    standby.register_watchdog(wd)
+    assert TAILER_HEARTBEAT in wd.snapshot()["threads"]
+    assert wd.poll() == []                       # not started: idle, not
+    standby._stall_for_test = True               # stalled (active_fn gate)
+    standby.start()
+    standby._thread.join(timeout=5.0)            # loop wedges immediately
+    assert standby.running                       # ...still claiming to run
+    clock.advance_ms(1_000)
+    assert wd.poll() == [TAILER_HEARTBEAT]
+    for _ in range(2_000):                       # restarted loop tails
+        if standby.tailer.entries >= 1:
+            break
+        _time.sleep(0.002)
+    assert standby.tailer.entries == 1
+    snap = standby.state_snapshot()
+    assert snap["role"] == "follower"
+    assert snap["followerLagRecords"] == 0
+    standby.stop()
+    assert not standby.running
+    assert wd.total_restarts == 1
+
+
+# ------------------------------------------------------- REST surfacing
+
+
+def _mini_app(overrides=None):
+    from cruise_control_tpu.app import CruiseControlApp
+    from cruise_control_tpu.common.config import CruiseControlConfig
+    from cruise_control_tpu.monitor.load_monitor import StaticMetadataSource
+    from cruise_control_tpu.monitor.sampler import (
+        BrokerMetadata, ClusterMetadata, PartitionMetadata,
+        SyntheticLoadSampler)
+
+    brokers = [BrokerMetadata(i, rack=f"r{i % 2}", host=f"h{i}")
+               for i in range(4)]
+    parts = [PartitionMetadata("T", p, leader=p % 4,
+                               replicas=((p % 4), (p + 1) % 4))
+             for p in range(8)]
+    md = ClusterMetadata(brokers=brokers, partitions=parts, generation=1)
+    cfg = CruiseControlConfig({
+        "optimizer.engine": "greedy",
+        "partition.metrics.window.ms": W,
+        "num.partition.metrics.windows": 3,
+        "min.valid.partition.ratio": 0.0,
+        "execution.progress.check.interval.ms": 1,
+        "failed.brokers.file.path": "",
+        **(overrides or {})})
+    adapter = FakeClusterAdapter(
+        {f"{p.topic}-{p.partition}": tuple(p.replicas) for p in parts},
+        latency_polls=1)
+    return CruiseControlApp(cfg, StaticMetadataSource(md),
+                            SyntheticLoadSampler(seed=4),
+                            cluster_adapter=adapter)
+
+
+def test_state_surfaces_replication_role(tmp_path):
+    from cruise_control_tpu.server import rest
+    app = _mini_app(overrides={
+        "executor.journal.path": str(tmp_path / "execution.journal"),
+        "watchdog.interval.ms": 0})
+    try:
+        st = app.state()["ReplicationState"]
+        assert st["role"] == "standalone"
+        assert st["followerLagRecords"] is None
+        clock = VirtualClock()
+        controller = ReplicationController(
+            _lease(app.journal.epoch_path, "cc-a", clock),
+            journal=app.journal)
+        controller.attach()
+        app.attach_replication(controller)
+        st = app.state()["ReplicationState"]
+        assert st["role"] == "leader" and st["holder"] == "cc-a"
+        assert st["epoch"] == 1 and st["heldByMe"] is True
+        assert st["journalEntries"] == app.journal.entries
+        # addressable through the REST substates filter
+        api = rest.RestApi(app)
+        code, body = api.dispatch("GET", "STATE",
+                                  {"substates": "replication"})
+        assert code == 200, body
+        assert body["ReplicationState"]["role"] == "leader"
+        assert "ExecutorState" not in body
+    finally:
+        app.journal.close()
+
+
+# ----------------------------------------------------- scenario failover
+
+
+@pytest.mark.simulator
+def test_scenario_warm_takeover_beats_cold_restart():
+    """The acceptance scenario: the same leader-kill run once with a warm
+    standby and once without. The takeover must recover in strictly
+    fewer ticks than the cold restart (whose monitor windows refill from
+    zero), converge bit-identically, provably fence the zombie, and stay
+    byte-identically deterministic across repeats."""
+    from cruise_control_tpu.simulator.faults import (
+        FaultEvent, FaultSchedule)
+    from cruise_control_tpu.simulator.scenario import Scenario, run_scenario
+
+    def make(warm):
+        events = [FaultEvent(tick=2, kind="kill_broker", broker_id=2),
+                  FaultEvent(tick=5, kind="kill_broker", broker_id=1),
+                  FaultEvent(tick=5, kind="process_crash", calls_after=3)]
+        return Scenario(
+            name="failover", seed=7, ticks=14, tick_ms=W,
+            num_brokers=4, topics=("T0", "T1"), partitions_per_topic=4,
+            rf=2, faults=FaultSchedule(events=tuple(events)),
+            warmup_ticks=2, warm_standby=warm)
+
+    warm = run_scenario(make(True))
+    cold = run_scenario(make(False))
+
+    assert warm.core["processCrashes"] == 1
+    entry = warm.core["crashRecoveries"][0]
+    assert entry["mode"] == "warm_takeover"
+    assert entry["openExecution"] is True        # died mid-reassignment
+    assert entry["orphanedRemaining"] == 0
+    assert warm.core["takeoverTicks"] == entry["takeoverTicks"]
+    assert warm.core["zombieFenced"] is True
+    assert warm.core["standbyLagRecords"] == 0
+    cold_entry = cold.core["crashRecoveries"][0]
+    assert cold_entry["mode"] == "cold_restart"
+    # the acceptance margin: warm takeover recovers in strictly fewer
+    # ticks than the cold restart of the very same scenario
+    assert entry["recoveryTicks"] < cold_entry["recoveryTicks"]
+    # both topologies converge to the same final assignment
+    assert (warm.core["finalAssignmentDigest"]
+            == cold.core["finalAssignmentDigest"])
+    # replication leaves the determinism contract intact
+    repeat = run_scenario(make(True))
+    assert warm.canonical_json() == repeat.canonical_json()

@@ -888,7 +888,8 @@ def check_jit_wrapper_in_body(ctx: ModuleContext) -> Iterator[Finding]:
 #: paths whose time flow must route through the injected now_fn/sleep_fn
 #: seams (the virtual-time simulator drives exactly these modules)
 _G011_PATHS = ("cruise_control_tpu/executor/", "cruise_control_tpu/monitor/",
-               "cruise_control_tpu/detector/")
+               "cruise_control_tpu/detector/",
+               "cruise_control_tpu/replication/")
 _G011_FILES = ("cruise_control_tpu/app.py",)
 
 
