@@ -79,6 +79,47 @@ class AnnealConfig:
     cold_fraction: float = 0.25
 
 
+class WarmStart(NamedTuple):
+    """Previous accepted assignment used to seed a fraction of the chains.
+
+    ``optimize_anneal`` initializes ``round(C * fraction)`` chains — the
+    COLDEST temperature-ladder slots, where exploitation lives — from this
+    assignment instead of the current one; the remaining chains keep the
+    status-quo init (the current assignment) so exploration is never
+    forfeited to a stale optimum. ``dirty_partitions`` (the PR 6 dirty-mask
+    delta: partition indices whose loads/placement moved since this
+    assignment was accepted) perturbs the warm state back toward reality:
+    dirty partitions take the CURRENT assignment's rows. The mix is
+    whole-partition — every partition's replica set comes wholly from one
+    individually-legal assignment, so the mixed state carries no
+    duplicate-sibling placements.
+
+    Contracts:
+
+    - ``fraction <= 0`` (or ``warm_start=None``) takes EXACTLY the
+      status-quo code path — the warm base state is never built, so the
+      result is bit-identical to a run without warm start.
+    - RNG is untouched: per-step chain keys still split from the final
+      chain count, so warm start changes only chain INITIAL STATES, never
+      proposal draws.
+    - The caller owns structural continuity: ``broker_of``/``leader_of``
+      must index the CURRENT model's replica/partition axes and the
+      per-partition replica membership must be unchanged since the warm
+      assignment was accepted (the app gates on the monitor's structural
+      digest). Broker-axis growth (add_broker) is fine — old placements
+      stay legal.
+    - ``fraction`` lives here and NOT on :class:`AnnealConfig` on purpose:
+      the config is a static key of the compiled PT scan, so a
+      fraction-knob there would retrace the whole scan every time the knob
+      moved; here it only selects between tiny init programs.
+    """
+
+    broker_of: jax.Array                    # i32[R] previous accepted
+    leader_of: jax.Array                    # i32[P]
+    dirty_partitions: Optional[np.ndarray] = None   # i32[K] moved partitions
+    fraction: float = 0.5
+
+
 class ChainState(NamedTuple):
     broker_of: jax.Array         # i32[R]
     leader_of: jax.Array         # i32[P]
@@ -652,6 +693,34 @@ def _broadcast_chains(base, num_chains: int):
         lambda x: jnp.broadcast_to(x, (num_chains,) + x.shape), base)
 
 
+@jax.jit
+def _mix_dirty(partition_of_replica, cur_bo, cur_lo, warm_bo, warm_lo,
+               dirty_mask):
+    """Perturb the warm assignment along the dirty-mask delta: dirty
+    partitions take the CURRENT assignment's rows (their placement/load
+    moved since the warm state was accepted), clean partitions keep the
+    previous accepted placement. Whole-partition granularity keeps each
+    partition's replica set from ONE legal assignment — no mixed state can
+    introduce a duplicate-sibling placement."""
+    rep_dirty = dirty_mask[partition_of_replica]
+    return (jnp.where(rep_dirty, cur_bo, warm_bo),
+            jnp.where(dirty_mask, cur_lo, warm_lo))
+
+
+@partial(jax.jit, static_argnames=("num_chains", "n_warm"))
+def _broadcast_chains_warm(base_cur, base_warm, num_chains: int, n_warm: int):
+    """Seed the first ``n_warm`` chains (the coldest temperature-ladder
+    slots) from the warm base state and the rest from the current one.
+    Like ``_broadcast_chains``, the output is a fresh buffer the PT run may
+    donate."""
+    def pick(c, w):
+        return jnp.concatenate([
+            jnp.broadcast_to(w, (n_warm,) + w.shape),
+            jnp.broadcast_to(c, (num_chains - n_warm,) + c.shape)], axis=0)
+
+    return jax.tree.map(pick, base_cur, base_warm)
+
+
 @partial(jax.jit, static_argnames=("out_s",))
 def _take_chain(chains, best, out_s=None):
     """One program for the winning chain's (broker_of, leader_of) rows.
@@ -674,9 +743,16 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
                     config: Optional[AnnealConfig] = None, seed: int = 0,
                     goal_names: Sequence[str] = G.DEFAULT_GOALS,
                     initial_broker_of: Optional[jax.Array] = None,
-                    mesh: Optional[jax.sharding.Mesh] = None) -> AnnealResult:
+                    mesh: Optional[jax.sharding.Mesh] = None,
+                    warm_start: Optional[WarmStart] = None) -> AnnealResult:
     """Parallel-tempering anneal; with ``mesh`` the chain axis shards over
     it (the production multi-device path).
+
+    ``warm_start`` seeds ``round(C * warm_start.fraction)`` chains — the
+    coldest ladder slots — from a previous accepted assignment perturbed
+    along the dirty-mask delta (see :class:`WarmStart` for the legality and
+    bit-identity contracts). ``None`` (or fraction <= 0) is the status-quo
+    cold init, bit for bit.
 
     Chain round-up + RNG contract: the chain count rounds UP to the next
     multiple of the mesh size so the chain axis tiles the mesh evenly —
@@ -784,7 +860,37 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
                             use_topic)
     e0 = _chain_energy_jit(dt, th, weights, base, initial_broker_of,
                            topic_mode, num_topics)
-    chains = _broadcast_chains(base._replace(energy=e0), C)
+    n_warm = 0
+    if warm_start is not None:
+        n_warm = int(np.clip(round(C * float(warm_start.fraction)), 0, C))
+    if n_warm > 0:
+        wbo = jnp.asarray(warm_start.broker_of, jnp.int32)
+        wlo = jnp.asarray(warm_start.leader_of, jnp.int32)
+        if wbo.shape[0] != R or wlo.shape[0] != P:
+            raise ValueError(
+                f"warm_start shapes {wbo.shape[0]}/{wlo.shape[0]} do not "
+                f"match the model's replica/partition axes {R}/{P} — the "
+                "caller must gate warm starts on structural continuity")
+        dirty = warm_start.dirty_partitions
+        if dirty is not None and len(dirty) > 0:
+            dirty_mask = np.zeros(P, bool)
+            dirty_mask[np.asarray(dirty, np.int64)] = True
+            wbo, wlo = _mix_dirty(dt.partition_of_replica, base.broker_of,
+                                  base.leader_of, wbo, wlo,
+                                  jax.device_put(dirty_mask))
+        agg_w = compute_aggregates(dt, Assignment(broker_of=wbo,
+                                                  leader_of=wlo),
+                                   num_topics if use_topic else 1)
+        base_w = _make_base_state(agg_w, wbo, wlo, use_topic)
+        e0_w = _chain_energy_jit(dt, th, weights, base_w, initial_broker_of,
+                                 topic_mode, num_topics)
+        chains = _broadcast_chains_warm(base._replace(energy=e0),
+                                        base_w._replace(energy=e0_w),
+                                        C, n_warm)
+    else:
+        # fraction <= 0 / no warm start: EXACTLY the historical init path
+        # (the warm base state is never even built) — bit-identical output
+        chains = _broadcast_chains(base._replace(energy=e0), C)
 
     # temperature ladder: a cold block at ~0 (pure descent) + geometric ladder
     n_cold = max(1, int(C * cfg.cold_fraction))

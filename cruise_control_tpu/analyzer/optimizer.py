@@ -162,7 +162,11 @@ class GoalSummary:
 class OptimizerResult:
     """Mirror of OptimizerResult.java:41-53."""
 
-    proposals: List[PR.ExecutionProposal]
+    #: list on the host decode path; a lazily-materializing
+    #: :class:`~cruise_control_tpu.analyzer.proposals.LazyProposals` view on
+    #: the device path (len/iter/index work either way; iteration is what
+    #: pays host materialization)
+    proposals: Sequence[PR.ExecutionProposal]
     goal_summaries: List[GoalSummary]
     stats_before: dict
     stats_after: dict
@@ -190,6 +194,13 @@ class OptimizerResult:
     #: replicas / exclusion-restricted destinations), None for a plain
     #: rebalance
     heal_path: Optional[str] = None
+    #: which proposal-decode path produced ``proposals``: "host" (numpy
+    #: diff) or "device" (compiled diff kernel + lazy view)
+    decode_path: str = "host"
+    #: wall seconds spent emitting the device diff + compact movement stats
+    #: (0.0 on the host path); host materialization is NOT included — it is
+    #: lazy and attributed to whoever iterates
+    decode_device_s: float = 0.0
 
     @property
     def violated_goals_before(self) -> List[str]:
@@ -473,7 +484,9 @@ def optimize(topo: ClusterTopology, assign: Assignment,
              mesh: Optional["jax.sharding.Mesh"] = None,
              repair_config=None, polish_cycles: int = 2,
              balancedness_weights=None,
-             bucketing: Optional[bool] = None) -> OptimizerResult:
+             bucketing: Optional[bool] = None,
+             warm_start=None,
+             proposal_decode: str = "auto") -> OptimizerResult:
     """Full optimization pass. ``engine``: auto | greedy | anneal.
     ``repair_config``: RepairConfig override for the MAIN repair pass (the
     hard-violation backstop always runs with its own defaults).
@@ -484,7 +497,18 @@ def optimize(topo: ClusterTopology, assign: Assignment,
     ``bucketing``: pad the model to geometric bucket shapes so cluster
     drift reuses compiled programs (see engages_bucketing for the None =
     auto policy). Proposals are identical either way — the padded ==
-    unpadded contract of tests/test_bucketing.py."""
+    unpadded contract of tests/test_bucketing.py.
+    ``warm_start``: annealer.WarmStart carrying the PREVIOUS accepted
+    assignment at REAL shapes — seeds a fraction of the PT chains from it
+    (main anneal pass only; polish/basin restarts keep their historical
+    inits). Shape-mismatched warm starts are dropped silently: drift that
+    changed the replica count means the carried assignment no longer
+    describes this cluster. The CALLER owns structural continuity (the app
+    gates on the monitor digest).
+    ``proposal_decode``: "host" | "device" | "auto" — auto picks the device
+    diff kernel exactly where the anneal engine routes (R*B beyond
+    GREEDY_LIMIT): small models would pay a per-shape kernel compile for a
+    sub-millisecond numpy diff."""
     mesh = _collapse_trivial_mesh(mesh)
     if _routes_to_tiny_cpu(topo, mesh, options):
         try:
@@ -496,10 +520,12 @@ def optimize(topo: ClusterTopology, assign: Assignment,
                 return _optimize_impl(topo, assign, goal_names, constraint,
                                       options, engine, anneal_config, seed,
                                       mesh, repair_config, polish_cycles,
-                                      balancedness_weights, bucketing)
+                                      balancedness_weights, bucketing,
+                                      warm_start, proposal_decode)
     return _optimize_impl(topo, assign, goal_names, constraint, options,
                           engine, anneal_config, seed, mesh, repair_config,
-                          polish_cycles, balancedness_weights, bucketing)
+                          polish_cycles, balancedness_weights, bucketing,
+                          warm_start, proposal_decode)
 
 
 def healing_context(topo, opts: G.DeviceOptions) -> bool:
@@ -521,7 +547,8 @@ def healing_context(topo, opts: G.DeviceOptions) -> bool:
 def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
                    anneal_config, seed, mesh, repair_config,
                    polish_cycles, balancedness_weights=None,
-                   bucketing: Optional[bool] = None
+                   bucketing: Optional[bool] = None,
+                   warm_start=None, proposal_decode: str = "auto"
                    ) -> OptimizerResult:
     from cruise_control_tpu.analyzer import annealer as AN  # cycle-free import
 
@@ -561,6 +588,26 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
      agg0, th, weights) = _setup_model(topo_model, assign, goal_names,
                                        constraint, options, mesh)
     _mark("setup")
+    # warm start arrives at REAL shapes; validate against the real topology
+    # (a mismatch means the carried assignment describes a different
+    # cluster — drop it, the cold path is always correct) and splice into
+    # the padded tail when bucketing engaged, so the annealer sees model
+    # shapes. Drift WITHIN a bucket therefore still warms: real prefix from
+    # the carried assignment, sentinel tail from the current padded one.
+    if warm_start is not None:
+        w_bo = np.asarray(jax.device_get(warm_start.broker_of), np.int32)
+        w_lo = np.asarray(jax.device_get(warm_start.leader_of), np.int32)
+        if (w_bo.shape[0] != topo.num_replicas
+                or w_lo.shape[0] != topo.num_partitions):
+            warm_start = None
+        elif pad_info is not None:
+            bo = np.asarray(jax.device_get(assign.broker_of), np.int32).copy()
+            lo = np.asarray(jax.device_get(assign.leader_of), np.int32).copy()
+            bo[:pad_info.num_replicas] = w_bo
+            lo[:pad_info.num_partitions] = w_lo
+            warm_start = warm_start._replace(
+                broker_of=jnp.asarray(bo, jnp.int32),
+                leader_of=jnp.asarray(lo, jnp.int32))
     before = OBJ.evaluate_objective(dt, assign, th, weights, goal_names,
                                     num_topics, init_broker, agg0,
                                     sparse_topic=sparse_topic)
@@ -602,7 +649,7 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
                                       num_topics, config=anneal_config,
                                       seed=seed, goal_names=goal_names,
                                       initial_broker_of=init_broker,
-                                      mesh=mesh)
+                                      mesh=mesh, warm_start=warm_start)
             final = ares.assignment
             _mark("anneal")
             # targeted repair (analyzer/repair.py): walk exactly the
@@ -826,16 +873,41 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
                               sparse_topic=sparse_topic, agg=agg_after)
     _mark("eval+stats after")
     report_progress("Decoding execution proposals")
-    # decode at REAL shapes: padded sentinel rows never move (immovable +
-    # zero weight), so slicing them off cannot drop a proposal
     final_real = (unpad_assignment(final, pad_info) if pad_info is not None
                   else final)
-    # movement counts derived from the proposal diff so both engines report
-    # the same thing the executor will do; the vectorized stats avoid the
-    # ~150K per-proposal set-differences of the property accessors
-    props, n_moves, n_lead, data_to_move = PR.diff(topo, orig_assign,
-                                                   final_real,
-                                                   with_stats=True)
+    decode_path = proposal_decode
+    if decode_path == "auto":
+        # the device kernel earns its compile exactly where the anneal
+        # engine routes; below the limit the numpy diff is sub-millisecond
+        decode_path = ("device" if topo.num_replicas * topo.num_brokers
+                       > GREEDY_LIMIT else "host")
+    decode_device_s = 0.0
+    props = None
+    if decode_path == "device":
+        try:
+            t_dec = time.time()
+            # diff at MODEL shapes: a bucket-padded model's sentinel tail
+            # never moves, so the kernel stays bucket-stable across drift;
+            # LazyProposals slices the real prefix off host-side
+            dd = PR.device_diff(dt, assign, final,
+                                PR._broker_ids(topo_model))
+            props = PR.LazyProposals(topo, dd)
+            n_moves, n_lead, data_to_move = props.stats
+            decode_device_s = time.time() - t_dec
+        except (RuntimeError, ValueError) as e:
+            logger.warning("device proposal decode failed (%s); "
+                           "falling back to host diff", e)
+            decode_path, props = "host", None
+    if props is None:
+        # host path: decode at REAL shapes — padded sentinel rows never
+        # move (immovable + zero weight), so slicing them off cannot drop
+        # a proposal. Movement counts derive from the proposal diff so both
+        # engines report the same thing the executor will do; the
+        # vectorized stats avoid the ~150K per-proposal set-differences of
+        # the property accessors
+        props, n_moves, n_lead, data_to_move = PR.diff(topo, orig_assign,
+                                                       final_real,
+                                                       with_stats=True)
 
     _mark("proposal diff")
     names_ext = goal_names + (G.SELF_HEALING_TERM,)
@@ -879,4 +951,6 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
         fallback_reason=fallback_reason,
         heal_path=("masked" if opts.propose_dest_mask is not None
                    else "full" if healing_context(topo, opts) else None),
+        decode_path=decode_path,
+        decode_device_s=decode_device_s,
     )

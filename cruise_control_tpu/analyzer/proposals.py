@@ -6,12 +6,26 @@ for every partition whose replica set or leader changed between the initial
 and optimized assignments, emit old/new replica broker lists (leader first),
 the partition's data size (DISK load), and the derived add/remove/move sets
 the executor batches on.
+
+Two decode paths share one materialization:
+
+- :func:`diff` — the historical host path: numpy over the whole id matrix.
+- :func:`device_diff` + :class:`LazyProposals` — the final-vs-initial diff
+  emitted as DEVICE arrays by one compiled kernel (changed mask,
+  leader-first old/new broker-id matrices, per-partition add counts, leader
+  flips, movement totals). The executor consumes the device-resident masks
+  and counts directly; the JSON/``ExecutionProposal`` view materializes
+  lazily on first iteration (the REST path), through the SAME constructor
+  helper the host path uses — so device-decode == host-decode is equality
+  by construction, pinned by tests/test_rawspeed.py.
 """
 
 from __future__ import annotations
 
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from cruise_control_tpu.common import resources as res
@@ -129,16 +143,37 @@ def diff(topo: ClusterTopology, initial: Assignment, final: Assignment,
 
     old_mat = leader_first(ib_ids, init_l[idxs])             # [N, m]
     new_mat = leader_first(fb_ids, fin_l[idxs])
+    old_leader = ids[init_b[init_l[idxs]]]
+    props = _materialize(topo, idxs, old_mat, new_mat, old_leader, disk[idxs])
+    if not with_stats:
+        return props
+    # movement stats vectorized over the leader-first id matrices computed
+    # above — the same numbers `replicas_to_add`/`has_leader_action` yield
+    # per proposal, but without ~150K python set-differences at scale
+    in_old = (new_mat[:, :, None] == old_mat[:, None, :]).any(axis=2)
+    adds = ((~in_old) & (new_mat != -1)).sum(axis=1)         # [N]
+    n_moves = int(adds.sum())
+    n_lead = int((new_mat[:, 0] != old_leader).sum())
+    data_to_move = float((disk[idxs] * adds).sum())
+    return props, n_moves, n_lead, data_to_move
+
+
+def _materialize(topo: ClusterTopology, idxs: np.ndarray, old_mat: np.ndarray,
+                 new_mat: np.ndarray, old_leader_ids: np.ndarray,
+                 disk_c: np.ndarray) -> List[ExecutionProposal]:
+    """ExecutionProposal objects from leader-first EXTERNAL-id matrices for
+    the changed partitions ``idxs`` — the ONE constructor both decode paths
+    (host :func:`diff`, device :class:`LazyProposals`) share, so their
+    outputs can only differ if the matrices themselves differ."""
     old_sorted = old_mat.tolist()
     new_sorted = new_mat.tolist()
-    old_leader = ids[init_b[init_l[idxs]]].tolist()
-    disk_c = disk[idxs].astype(float).tolist()
+    old_leader = old_leader_ids.tolist()
+    disk_l = disk_c.astype(float).tolist()
     t_of_p = np.asarray(topo.topic_of_partition)[idxs].tolist()
     tnames = topo.topic_names
     pidx = (np.asarray(topo.partition_index)[idxs].tolist()
             if topo.partition_index is not None else idxs.tolist())
-
-    props = [
+    return [
         ExecutionProposal(
             topic=tnames[t] if tnames else str(t),
             partition=pi,
@@ -148,15 +183,184 @@ def diff(topo: ClusterTopology, initial: Assignment, final: Assignment,
             data_size=dz,
         )
         for t, pi, ol, olist, nlist, dz in zip(
-            t_of_p, pidx, old_leader, old_sorted, new_sorted, disk_c)]
-    if not with_stats:
-        return props
-    # movement stats vectorized over the leader-first id matrices computed
-    # above — the same numbers `replicas_to_add`/`has_leader_action` yield
-    # per proposal, but without ~150K python set-differences at scale
-    in_old = (new_mat[:, :, None] == old_mat[:, None, :]).any(axis=2)
-    adds = ((~in_old) & (new_mat != -1)).sum(axis=1)         # [N]
-    n_moves = int(adds.sum())
-    n_lead = int((new_mat[:, 0] != np.asarray(old_leader)).sum())
-    data_to_move = float((disk[idxs] * adds).sum())
-    return props, n_moves, n_lead, data_to_move
+            t_of_p, pidx, old_leader, old_sorted, new_sorted, disk_l)]
+
+
+# --------------------------------------------------------------- device path
+
+
+class DeviceDiff(NamedTuple):
+    """The final-vs-initial assignment diff as DEVICE arrays (one compiled
+    kernel, :func:`device_diff`). Shapes follow the MODEL the optimization
+    ran at (bucket-padded models keep bucket shapes, so cluster drift
+    within a bucket reuses the compiled kernel); padded partitions are
+    sentinel rows whose replicas never move, hence ``changed`` False."""
+
+    changed: jax.Array      # bool[P] replica set or leader changed
+    old_mat: jax.Array      # i32[P, m] external ids, leader first, -1 pad
+    new_mat: jax.Array      # i32[P, m]
+    old_leader: jax.Array   # i32[P] external id of the initial leader
+    disk: jax.Array         # f32[P] partition DISK footprint
+    adds: jax.Array         # i32[P] replicas entering the set (0 unchanged)
+    replica_action: jax.Array   # bool[P] set(old) != set(new)
+    leader_action: jax.Array    # bool[P] new head != old leader
+    n_moves: jax.Array      # i32[] total replica movements
+    n_lead: jax.Array       # i32[] total leadership movements
+
+
+@jax.jit
+def _diff_kernel(reps, init_b, fin_b, init_l, fin_l, ids, replica_base_load,
+                 leader_extra):
+    """AnalyzerUtils.getDiff as one device program: changed mask,
+    leader-first old/new external-id matrices (same stable (valid, leader
+    slot) sort key as the host path), per-partition add/remove counts, and
+    the movement totals. O(P·m²) elementwise — no host loop, no
+    per-proposal Python."""
+    valid = reps >= 0
+    safe = jnp.maximum(reps, 0)
+    ib = jnp.where(valid, init_b[safe], -1)
+    fb = jnp.where(valid, fin_b[safe], -1)
+    changed = jnp.any(ib != fb, axis=1) | (init_l != fin_l)
+    disk = (replica_base_load[init_l, res.DISK]
+            + leader_extra[:, res.DISK])                     # f32[P]
+
+    def leader_first(mat, leader_replica):
+        is_lead = reps == leader_replica[:, None]
+        key = (2 * (~valid).astype(jnp.int8)
+               + (~is_lead).astype(jnp.int8))
+        order = jnp.argsort(key, axis=1, stable=True)
+        return jnp.take_along_axis(mat, order, axis=1)
+
+    old_mat = leader_first(jnp.where(valid, ids[jnp.maximum(ib, 0)], -1),
+                           init_l)
+    new_mat = leader_first(jnp.where(valid, ids[jnp.maximum(fb, 0)], -1),
+                           fin_l)
+    old_leader = ids[init_b[init_l]]
+    in_old = jnp.any(new_mat[:, :, None] == old_mat[:, None, :], axis=2)
+    in_new = jnp.any(old_mat[:, :, None] == new_mat[:, None, :], axis=2)
+    adds = jnp.sum((~in_old) & (new_mat != -1), axis=1).astype(jnp.int32)
+    removes = jnp.sum((~in_new) & (old_mat != -1), axis=1).astype(jnp.int32)
+    adds = jnp.where(changed, adds, 0)
+    lead_flip = changed & (new_mat[:, 0] != old_leader)
+    return DeviceDiff(
+        changed=changed,
+        old_mat=old_mat,
+        new_mat=new_mat,
+        old_leader=old_leader,
+        disk=disk,
+        adds=adds,
+        replica_action=changed & ((adds > 0) | (removes > 0)),
+        leader_action=lead_flip,
+        n_moves=jnp.sum(adds),
+        n_lead=jnp.sum(lead_flip).astype(jnp.int32),
+    )
+
+
+def device_diff(dt, initial: Assignment, final: Assignment,
+                broker_ids: Optional[np.ndarray] = None) -> DeviceDiff:
+    """Emit the assignment diff as device arrays via the compiled kernel.
+
+    ``dt`` is the :class:`~cruise_control_tpu.ops.aggregates.DeviceTopology`
+    the optimization ran at (possibly bucket-padded — the kernel's shapes
+    then stay bucket-stable across cluster drift, the zero-retrace
+    contract). ``broker_ids`` maps internal broker indices to external ids;
+    None means identity (internal == external)."""
+    if broker_ids is None:
+        ids = np.arange(dt.num_brokers, dtype=np.int32)
+    else:
+        ids = np.asarray(broker_ids, np.int32)
+    return _diff_kernel(dt.replicas_of_partition,
+                        jnp.asarray(initial.broker_of, jnp.int32),
+                        jnp.asarray(final.broker_of, jnp.int32),
+                        jnp.asarray(initial.leader_of, jnp.int32),
+                        jnp.asarray(final.leader_of, jnp.int32),
+                        jax.device_put(ids), dt.replica_base_load,
+                        dt.leader_extra)
+
+
+class LazyProposals(Sequence):
+    """Sequence view over a :class:`DeviceDiff` that materializes
+    :class:`ExecutionProposal` objects only when iterated/indexed (the REST
+    JSON path). Length, movement stats, and the per-proposal action masks
+    come from the device diff through ONE compact transfer — the executor
+    ingests those directly and only pays host materialization when it
+    builds its per-partition task objects.
+
+    Host-fetched arrays are sliced to the REAL partition axis
+    (``topo.num_partitions``): on a bucket-padded model the sentinel tail
+    never changes, so the slice cannot drop a proposal."""
+
+    def __init__(self, topo: ClusterTopology, dd: DeviceDiff):
+        self._topo = topo
+        self._dd = dd
+        self._compact = None      # (changed, adds, disk, old_leader) on host
+        self._scalar = None       # (n_moves, n_lead)
+        self._props: Optional[List[ExecutionProposal]] = None
+
+    # -------------------------------------------------- compact host views
+    def _fetch_compact(self):
+        if self._compact is None:
+            P = self._topo.num_partitions
+            changed, adds, disk, old_leader, rep_act, lead_act, n_m, n_l = (
+                jax.device_get((self._dd.changed, self._dd.adds,
+                                self._dd.disk, self._dd.old_leader,
+                                self._dd.replica_action,
+                                self._dd.leader_action,
+                                self._dd.n_moves, self._dd.n_lead)))
+            idxs = np.flatnonzero(np.asarray(changed)[:P])
+            self._compact = (idxs, np.asarray(adds)[:P],
+                             np.asarray(disk)[:P],
+                             np.asarray(old_leader)[:P],
+                             np.asarray(rep_act)[:P],
+                             np.asarray(lead_act)[:P])
+            self._scalar = (int(n_m), int(n_l))
+        return self._compact
+
+    @property
+    def stats(self):
+        """(n_replica_moves, n_leadership_moves, inter_broker_data_to_move)
+        — exactly ``diff(with_stats=True)``'s numbers: counts are integer
+        sums computed on device, the data volume re-accumulates on host in
+        f64 like the host path (a device f32 sum would drift)."""
+        idxs, adds, disk, _, _, _ = self._fetch_compact()
+        n_moves, n_lead = self._scalar
+        data_to_move = float((disk[idxs] * adds[idxs].astype(np.int64)).sum())
+        return n_moves, n_lead, data_to_move
+
+    @property
+    def replica_action_mask(self) -> np.ndarray:
+        """bool per proposal (changed-partition order): replica set changed
+        — ``ExecutionProposal.has_replica_action`` without materializing."""
+        idxs, _, _, _, rep_act, _ = self._fetch_compact()
+        return rep_act[idxs]
+
+    @property
+    def leader_action_mask(self) -> np.ndarray:
+        idxs, _, _, _, _, lead_act = self._fetch_compact()
+        return lead_act[idxs]
+
+    # ------------------------------------------------------ materialization
+    def _materialized(self) -> List[ExecutionProposal]:
+        if self._props is None:
+            idxs, _, disk, old_leader, _, _ = self._fetch_compact()
+            P = self._topo.num_partitions
+            old_mat, new_mat = jax.device_get((self._dd.old_mat,
+                                               self._dd.new_mat))
+            self._props = _materialize(
+                self._topo, idxs, np.asarray(old_mat)[:P][idxs],
+                np.asarray(new_mat)[:P][idxs], old_leader[idxs], disk[idxs])
+        return self._props
+
+    def __len__(self) -> int:
+        return len(self._fetch_compact()[0])
+
+    def __iter__(self):
+        return iter(self._materialized())
+
+    def __getitem__(self, i):
+        return self._materialized()[i]
+
+    def __repr__(self) -> str:
+        n = "?" if self._compact is None else len(self)
+        state = "materialized" if self._props is not None else "device"
+        return f"LazyProposals({n} proposals, {state})"

@@ -479,6 +479,12 @@ def _topic_pair_candidates(dt, th, st, movable, en, t, b,
     brokers below t's lower band — sources are t's replicas on brokers
     above the band, partners are replicas living on the under brokers.
     Returns (src [n_src], partners [n_src, k], valid [n_src, k])."""
+    # toy models can have fewer replicas than the configured candidate
+    # counts; top_k requires k <= the searched axis. Static args, so the
+    # clamp resolves at trace time and callers read shapes off the results.
+    R = dt.partition_of_replica.shape[0]
+    n_src = min(n_src, R)
+    k = min(k, R)
     t_of_r = dt.topic_of_partition[dt.partition_of_replica]
     cnt_t = st.topic_count[:, t]
     bo = st.broker_of

@@ -377,6 +377,11 @@ class CruiseControlApp:
         #: one-shot: escape kernels warmed after the first default-goal
         #: computation (see _compute_and_cache)
         self._escape_kernels_warmed = False
+        #: previous accepted assignment for anneal warm starts:
+        #: {"broker_of", "leader_of" (host np arrays), "digest"} — consumed
+        #: by the NEXT default-goal computation iff the monitor's structural
+        #: digest is unchanged (guarded by _cache_lock)
+        self._warm_proposal: Optional[dict] = None
         self._precompute_thread: Optional[threading.Thread] = None
         self._precompute_shutdown = threading.Event()
         #: serializes the default-goal cacheable computation
@@ -637,10 +642,39 @@ class CruiseControlApp:
         mode = str(self.config.get("optimizer.bucketing") or "auto").lower()
         return None if mode == "auto" else mode in ("on", "true", "1")
 
+    def _warm_start_for(self, topo: ClusterTopology):
+        """WarmStart for the default-goal computation, or None.
+
+        Engages only when (a) anneal.warm.fraction > 0, (b) a previous
+        accepted assignment was recorded, (c) the monitor's STRUCTURAL
+        digest is unchanged since then (the legality gate: same partitions,
+        replica sets, racks — only loads moved), and (d) the shapes still
+        match the freshly-built model (belt-and-braces; the optimizer
+        re-checks). Splice/refresh builds also carry the dirty partition
+        index, so warm chains keep the dirty partitions' CURRENT rows and
+        only the untouched remainder starts from the carried optimum."""
+        frac = float(self.config.get("anneal.warm.fraction") or 0.0)
+        if frac <= 0:
+            return None
+        with self._cache_lock:
+            prev = self._warm_proposal
+        info = self.load_monitor.last_build_info()
+        if (prev is None or not info or not info.get("digest")
+                or info["digest"] != prev["digest"]
+                or prev["broker_of"].shape[0] != topo.num_replicas
+                or prev["leader_of"].shape[0] != topo.num_partitions):
+            return None
+        dirty = (info.get("dirtyPartitionIndex")
+                 if info.get("kind") in ("splice", "refresh") else None)
+        from cruise_control_tpu.analyzer.annealer import WarmStart
+        return WarmStart(broker_of=prev["broker_of"],
+                         leader_of=prev["leader_of"],
+                         dirty_partitions=dirty, fraction=frac)
+
     def _optimize(self, topo: ClusterTopology, assign: Assignment,
                   goal_names: Optional[Sequence[str]] = None,
                   options: Optional[G.DeviceOptions] = None,
-                  ) -> OPT.OptimizerResult:
+                  warm_start=None) -> OPT.OptimizerResult:
         res = OPT.optimize(
             topo, assign,
             goal_names=tuple(goal_names or self.default_goals),
@@ -650,7 +684,8 @@ class CruiseControlApp:
             anneal_config=self._anneal_config(),
             balancedness_weights=self._balancedness_weights,
             mesh=self.mesh,
-            bucketing=self._bucketing())
+            bucketing=self._bucketing(),
+            warm_start=warm_start)
         if res.fallback_reason:
             # degraded mode: remember the most recent fallback for /state
             # (read by the REST thread, so it shares the cache lock)
@@ -856,7 +891,23 @@ class CruiseControlApp:
                    if self.config.get(
                        "topics.excluded.from.partition.movement")
                    else None)
-        result = self._optimize(topo, assign, None, options)
+        result = self._optimize(topo, assign, None, options,
+                                warm_start=self._warm_start_for(topo))
+        if result.final_assignment is not None:
+            # record the accepted assignment for the NEXT tick's warm start
+            # (host copies: the next computation may run after these device
+            # buffers are donated). Keyed to the STRUCTURAL digest — stable
+            # across splice/refresh, changed by any topology change — so a
+            # stale carry can never seed chains on a different cluster.
+            info0 = self.load_monitor.last_build_info()
+            if info0 and info0.get("digest"):
+                with self._cache_lock:
+                    self._warm_proposal = {
+                        "broker_of": np.asarray(
+                            result.final_assignment.broker_of, np.int32),
+                        "leader_of": np.asarray(
+                            result.final_assignment.leader_of, np.int32),
+                        "digest": info0["digest"]}
         # goal-verdict baseline for the incremental tick path: scored on the
         # same model the proposal was computed from; only digest-carrying
         # (warm-cacheable) builds can ever splice, so skip the rest

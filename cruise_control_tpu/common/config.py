@@ -316,6 +316,15 @@ def _service_config_def() -> ConfigDef:
     d.define("anneal.tries.move", T.INT, 32, I.LOW, "Move proposals/step.")
     d.define("anneal.tries.lead", T.INT, 8, I.LOW, "Leadership proposals/step.")
     d.define("anneal.tries.swap", T.INT, 16, I.LOW, "Swap proposals/step.")
+    d.define("anneal.warm.fraction", T.DOUBLE, 0.0, I.MEDIUM,
+             "Fraction of PT chains seeded from the previous accepted "
+             "assignment on the cached default-goal computation (the rest "
+             "stay cold for exploration). Engages only when the monitor's "
+             "structural digest is unchanged since that assignment was "
+             "accepted. 0 (the default) disables warm starts — chain inits "
+             "then take the exact historical path; steady-state services "
+             "should enable it (0.5 is the benched setting).",
+             between(0.0, 1.0))
     # executor (Executor.java config surface)
     d.define("num.concurrent.partition.movements.per.broker", T.INT, 5,
              I.MEDIUM, "Per-broker reassignment concurrency.", at_least(1))

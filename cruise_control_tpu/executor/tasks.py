@@ -164,8 +164,19 @@ class ExecutionTaskPlanner:
     def add_proposals(self, proposals: Iterable[ExecutionProposal],
                       urp: Optional[Set[str]] = None):
         urp = urp or set()
-        for p in proposals:
-            if p.has_replica_action:
+        # device-decoded proposal sets (analyzer.proposals.LazyProposals)
+        # carry per-proposal action masks computed by the diff kernel in the
+        # same compact transfer as the movement stats — consume those
+        # instead of re-deriving has_replica_action / has_leader_action as
+        # ~150K Python set comparisons. Duck-typed so the executor layer
+        # stays import-free of the analyzer.
+        rep_mask = lead_mask = None
+        if hasattr(proposals, "replica_action_mask"):
+            rep_mask = proposals.replica_action_mask
+            lead_mask = proposals.leader_action_mask
+        for i, p in enumerate(proposals):
+            if (p.has_replica_action if rep_mask is None
+                    else bool(rep_mask[i])):
                 self.replica_tasks.append(ExecutionTask(
                     next(self._id_gen), p, TaskType.INTER_BROKER_REPLICA_ACTION))
             # A leadership task is created for EVERY proposal with a leader
@@ -173,7 +184,8 @@ class ExecutionTaskPlanner:
             # alone does not transfer leadership while the old leader remains
             # in the replica set (ExecutionTaskPlanner.java:250-258,
             # maybeAddLeaderChangeTasks).
-            if p.has_leader_action:
+            if (p.has_leader_action if lead_mask is None
+                    else bool(lead_mask[i])):
                 self.leadership_tasks.append(ExecutionTask(
                     next(self._id_gen), p, TaskType.LEADER_ACTION))
         self.replica_tasks.sort(

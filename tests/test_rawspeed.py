@@ -1,0 +1,296 @@
+"""Anneal hot-path suite: warm-start parity, device decode, bucketing drift.
+
+Locks the three raw-speed contracts the sub-2s headline rests on:
+
+- WARM-START PARITY: seeding chains from a previous accepted assignment
+  (annealer.WarmStart) must never cost quality — at an equal step budget
+  the warm run reaches the same violated-goal set with soft cost no worse
+  than cold, and ``fraction=0`` is BIT-IDENTICAL to no warm start at all
+  (the historical code path, not a near-copy of it).
+- DEVICE DECODE EQUALITY: ``proposal_decode="device"`` (one compiled diff
+  kernel + lazy host materialization) produces EXACTLY the proposals and
+  movement stats of the historical host diff, padded or not.
+- DRIFT SURVIVAL: a warm start carried across an add-broker drift within
+  one shape bucket still engages — and the drifted tick reuses the
+  compiled programs (zero uncovered retraces under the sentinel).
+
+Budget: polish_cycles=0 throughout, and the AnnealConfig deliberately
+MATCHES test_bucketing/test_warm_path (8 chains × 128 steps, tries 8/4/4)
+so in a one-process tier-1 run every compiled program is already loaded
+by the time this suite starts — warm start and device decode add data,
+not programs.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import annealer as AN
+from cruise_control_tpu.analyzer import optimizer as OPT
+from cruise_control_tpu.analyzer import proposals as PR
+from cruise_control_tpu.analyzer.annealer import AnnealConfig, WarmStart
+from cruise_control_tpu.common.sentinels import (
+    check_steady_state, retrace_sentinel)
+from cruise_control_tpu.models import fixtures
+
+pytestmark = pytest.mark.rawspeed
+
+CFG = AnnealConfig(num_chains=8, steps=128, swap_interval=32,
+                   tries_move=8, tries_lead=4, tries_swap=4)
+
+
+def _optimize(topo, assign, **kw):
+    kw.setdefault("engine", "anneal")
+    kw.setdefault("anneal_config", CFG)
+    kw.setdefault("seed", 5)
+    kw.setdefault("polish_cycles", 0)
+    return OPT.optimize(topo, assign, **kw)
+
+
+def _warm_from(result):
+    return WarmStart(
+        broker_of=np.asarray(result.final_assignment.broker_of, np.int32),
+        leader_of=np.asarray(result.final_assignment.leader_of, np.int32),
+        fraction=0.5)
+
+
+def _soft_cost(result):
+    return sum(s.cost_after for s in result.goal_summaries if not s.hard)
+
+
+# One optimize per (fixture, kind), shared across tests — the suite asserts
+# DIFFERENT contracts against the SAME runs (seed fixed, results
+# deterministic), so recomputing them per test would only burn fast-tier
+# budget. "cold" uses decode auto (resolves to host at these sizes).
+_MEMO = {}
+
+
+def _cold(name):
+    if ("cold", name) not in _MEMO:
+        topo, assign = getattr(fixtures, name)()
+        _MEMO[("cold", name)] = (topo, assign, _optimize(topo, assign))
+    return _MEMO[("cold", name)]
+
+
+def _device(name):
+    if ("dev", name) not in _MEMO:
+        topo, assign = getattr(fixtures, name)()
+        _MEMO[("dev", name)] = (topo, assign,
+                                _optimize(topo, assign,
+                                          proposal_decode="device"))
+    return _MEMO[("dev", name)]
+
+
+# -- warm-start quality parity ----------------------------------------------
+
+@pytest.mark.parametrize("fixture", ["unbalanced", "small_cluster_model",
+                                     "dead_broker"])
+def test_warm_parity_no_worse_than_cold(fixture):
+    """Warm chains seeded from the cold run's own accepted assignment must
+    keep its violated-goal set and not regress soft cost at equal steps
+    (the coldest ladder slots hold the optimum they were seeded with)."""
+    topo, assign, cold = _cold(fixture)
+    warm = _optimize(topo, assign, warm_start=_warm_from(cold))
+    assert set(warm.violated_goals_after) == set(cold.violated_goals_after)
+    assert _soft_cost(warm) <= _soft_cost(cold) + 1e-6
+
+
+def test_warm_fraction_zero_bit_identical_to_cold():
+    """``fraction=0`` must take EXACTLY the historical path — same arrays,
+    not merely same quality."""
+    topo, assign, base = _cold("unbalanced")
+    frozen = WarmStart(
+        broker_of=np.asarray(base.final_assignment.broker_of, np.int32),
+        leader_of=np.asarray(base.final_assignment.leader_of, np.int32),
+        fraction=0.0)
+    redo = _optimize(topo, assign, warm_start=frozen)
+    np.testing.assert_array_equal(
+        np.asarray(redo.final_assignment.broker_of),
+        np.asarray(base.final_assignment.broker_of))
+    np.testing.assert_array_equal(
+        np.asarray(redo.final_assignment.leader_of),
+        np.asarray(base.final_assignment.leader_of))
+
+
+def test_warm_start_bad_shape_silently_dropped():
+    """A stale warm start whose axes no longer match the model must be
+    ignored, not crash — the result equals a cold run bit-for-bit."""
+    topo, assign, cold = _cold("unbalanced")
+    stale = WarmStart(
+        broker_of=np.zeros(topo.num_replicas + 7, np.int32),
+        leader_of=np.zeros(topo.num_partitions, np.int32),
+        fraction=0.5)
+    dropped = _optimize(topo, assign, warm_start=stale)
+    np.testing.assert_array_equal(
+        np.asarray(dropped.final_assignment.broker_of),
+        np.asarray(cold.final_assignment.broker_of))
+    np.testing.assert_array_equal(
+        np.asarray(dropped.final_assignment.leader_of),
+        np.asarray(cold.final_assignment.leader_of))
+
+
+def test_warm_start_dirty_partitions_accepted():
+    """Dirty-mask perturbation (PR 6 delta) composes with warm start and
+    keeps the parity contract."""
+    topo, assign, cold = _cold("unbalanced")
+    ws = _warm_from(cold)._replace(
+        dirty_partitions=np.arange(min(3, topo.num_partitions), dtype=np.int32))
+    warm = _optimize(topo, assign, warm_start=ws)
+    assert set(warm.violated_goals_after) == set(cold.violated_goals_after)
+
+
+# -- device decode == host decode -------------------------------------------
+
+def _proposal_key(p):
+    return (p.topic, p.partition, p.old_leader, p.old_replicas,
+            p.new_replicas)
+
+
+@pytest.mark.parametrize("fixture,bucketing", [
+    ("unbalanced", False), ("unbalanced", True), ("dead_broker", False)])
+def test_device_decode_equals_host_decode(fixture, bucketing):
+    """The compiled diff kernel + lazy materialization must reproduce the
+    host diff EXACTLY: same proposal list (order included — both sort
+    leader-first stably), same movement stats, same action masks.
+
+    Fixtures deliberately reuse the parity tests' shapes (compile-cache
+    sharing keeps the fast tier fast); the odd shapes — dead brokers,
+    sentinel rows — are covered kernel-level below without an anneal."""
+    if bucketing:
+        topo, assign = getattr(fixtures, fixture)()
+        r_host = _optimize(topo, assign, bucketing=True,
+                           proposal_decode="host")
+        r_dev = _optimize(topo, assign, bucketing=True,
+                          proposal_decode="device")
+    else:
+        topo, assign, r_host = _cold(fixture)
+        _, _, r_dev = _device(fixture)
+    assert r_host.decode_path == "host"
+    assert r_dev.decode_path == "device"
+    host_props = list(r_host.proposals)
+    dev_props = list(r_dev.proposals)
+    assert [_proposal_key(p) for p in dev_props] == \
+        [_proposal_key(p) for p in host_props]
+    assert dev_props == host_props
+    assert r_dev.num_replica_movements == r_host.num_replica_movements
+    assert r_dev.num_leadership_movements == r_host.num_leadership_movements
+    assert r_dev.inter_broker_data_to_move == pytest.approx(
+        r_host.inter_broker_data_to_move)
+    # action masks drive the executor fast path — they must agree with the
+    # per-proposal flags the host path derives
+    rep = r_dev.proposals.replica_action_mask
+    lead = r_dev.proposals.leader_action_mask
+    assert len(rep) == len(dev_props) and len(lead) == len(dev_props)
+    for i, p in enumerate(host_props):
+        assert bool(rep[i]) == p.has_replica_action
+        assert bool(lead[i]) == p.has_leader_action
+
+
+@pytest.mark.parametrize("fixture", ["unbalanced", "dead_broker",
+                                     "rack_aware_satisfiable"])
+def test_device_diff_kernel_equals_host_diff(fixture):
+    """Kernel-level equality on hand-perturbed assignments — covers the
+    odd shapes (dead brokers, mixed RF sentinel rows) without paying an
+    anneal per fixture. Every proposal, leader flip, and stat must match
+    the host diff bitwise."""
+    from cruise_control_tpu.ops.aggregates import device_topology
+    topo, assign = getattr(fixtures, fixture)()
+    bo = np.array(assign.broker_of, np.int32).copy()
+    lo = np.array(assign.leader_of, np.int32).copy()
+    # move a few replicas to the next broker and flip a couple of leaders
+    rng = np.random.RandomState(7)
+    for i in rng.choice(topo.num_replicas, size=min(5, topo.num_replicas),
+                        replace=False):
+        bo[i] = (bo[i] + 1) % topo.num_brokers
+    for p in rng.choice(topo.num_partitions,
+                        size=min(3, topo.num_partitions), replace=False):
+        reps = np.asarray(topo.replicas_of_partition[p])
+        reps = reps[reps >= 0]
+        if len(reps) > 1:
+            lo[p] = reps[-1]
+    final = dataclasses.replace(assign, broker_of=bo, leader_of=lo)
+    host = PR.diff(topo, assign, final, with_stats=True)
+    h_props, h_moves, h_lead, h_data = host
+    lazy = PR.LazyProposals(topo, PR.device_diff(
+        device_topology(topo), assign, final, topo.broker_ids))
+    d_moves, d_lead, d_data = lazy.stats
+    assert (d_moves, d_lead) == (h_moves, h_lead)
+    assert d_data == pytest.approx(h_data)
+    assert list(lazy) == h_props
+
+
+def test_device_decode_stats_before_materialization():
+    """LazyProposals must answer len/stats from the compact fetch alone —
+    and materialize identically afterwards (a FRESH view over the shared
+    device diff, so earlier tests' iteration can't pre-materialize it)."""
+    topo, assign, r = _device("unbalanced")
+    assert isinstance(r.proposals, PR.LazyProposals)
+    lazy = PR.LazyProposals(topo, r.proposals._dd)
+    n = len(lazy)                      # compact path only
+    assert lazy._props is None
+    host = PR.diff(topo, assign, r.final_assignment)
+    assert n == len(host)
+    assert list(lazy) == host          # first materialization
+
+
+def test_decode_auto_policy_small_model_stays_host():
+    """Small models must not pay device-kernel compiles: auto resolves to
+    host below the greedy limit."""
+    topo, assign, r = _cold("unbalanced")   # cold runs decode on auto
+    assert topo.num_replicas * topo.num_brokers <= OPT.GREEDY_LIMIT
+    assert r.decode_path == "host"
+    assert r.decode_device_s == 0.0
+
+
+# -- drift within a bucket: warm start survives, zero retraces --------------
+
+def _grow_one_broker(topo):
+    """Append one alive broker (same rack/host layout, median capacity) —
+    R and P unchanged, so a carried WarmStart stays shape-valid."""
+    cap = np.concatenate(
+        [topo.capacity, np.median(topo.capacity, axis=0)[None]]).astype(
+            np.float32)
+    app = lambda a, v: np.concatenate([np.asarray(a), np.asarray([v], a.dtype)])
+    kw = dict(
+        rack_of_broker=app(topo.rack_of_broker, topo.rack_of_broker[-1]),
+        host_of_broker=app(topo.host_of_broker,
+                           topo.host_of_broker.max() + 1),
+        capacity=cap,
+        broker_alive=app(topo.broker_alive, True),
+        broker_new=app(topo.broker_new, True),
+        broker_demoted=app(topo.broker_demoted, False))
+    if topo.broker_ids is not None:
+        kw["broker_ids"] = app(topo.broker_ids, topo.broker_ids.max() + 1)
+    return dataclasses.replace(topo, **kw)
+
+
+def test_warm_start_survives_add_broker_drift_in_bucket():
+    """The steady-state story: optimize bucketed, carry the result as a
+    warm start, add a broker WITHIN the bucket — the next tick must reuse
+    the compiled programs (zero uncovered retraces) AND still accept the
+    warm start (broker-axis growth keeps old placements legal)."""
+    from cruise_control_tpu.models.cluster import (
+        BROKER_BUCKET_FLOOR, bucket_size)
+    topo, assign = fixtures.unbalanced()
+    grown = _grow_one_broker(topo)
+    # precondition: the drift stays inside one broker bucket (pad_topology
+    # reserves one slot of headroom, so +1 broker never crosses)
+    assert bucket_size(grown.num_brokers + 1, BROKER_BUCKET_FLOOR) == \
+        bucket_size(topo.num_brokers + 1, BROKER_BUCKET_FLOOR)
+
+    r0 = _optimize(topo, assign, bucketing=True)
+    ws = _warm_from(r0)
+    # a steady-state service runs warm ticks BEFORE drift — compile the
+    # warm-init program at the bucket shapes so the sentinel scopes only
+    # the drifted tick
+    _optimize(topo, assign, bucketing=True, warm_start=ws)
+    with retrace_sentinel() as log:
+        r1 = _optimize(grown, assign, bucketing=True, warm_start=ws)
+    uncovered = check_steady_state(log, strict=False)
+    assert uncovered == [], log.summary()
+    # the warm run still lands a valid result on the grown topology
+    assert np.asarray(r1.final_assignment.broker_of).shape == (
+        topo.num_replicas,)
+    assert not [s.name for s in r1.goal_summaries
+                if s.hard and s.violated_after]

@@ -10,6 +10,18 @@ and prints one JSON line per seed plus a summary row:
 Quality contract being hardened: violations -> 0, balancedness 100, and the
 soft-cost channel at 0 across seeds — the "equal-or-better OptimizerResult"
 claim (OptimizerResult.java:44-53) as a property, not two data points.
+
+--warm-curve runs the warm-vs-cold steps-to-quality sweep instead: for each
+seed, a deep reference anneal produces the "previous accepted assignment",
+then every steps level runs twice — cold (historical random chain inits)
+and warm (half the chains seeded from the reference assignment,
+annealer.WarmStart) — recording violations and soft cost at equal step
+budgets. Results merge into --out under the "warm_vs_cold" key
+(fixture-digest- and platform-stamped, docs/PERF.md accounting; the
+existing LinkedIn rows are left untouched). Runs at the CPU-feasible
+medium scale (300 brokers / 10K replicas) so the curve is reproducible
+without an accelerator. Deliberately NOT re-probed here (ROUND5_NOTES
+dead ends): add_broker step-binding and basin restarts in healing.
 """
 
 import argparse
@@ -34,6 +46,9 @@ def main():
                     help="lead_uphill_steps for the repair passes")
     ap.add_argument("--polish", type=int, default=-1,
                     help="override polish cycle count (-1 = default)")
+    ap.add_argument("--warm-curve", action="store_true",
+                    help="run the warm-vs-cold steps-to-quality sweep "
+                         "(merges into --out under 'warm_vs_cold')")
     args = ap.parse_args()
 
     import jax
@@ -43,6 +58,9 @@ def main():
     from cruise_control_tpu.analyzer import annealer as AN
     from cruise_control_tpu.analyzer import optimizer as OPT
     from cruise_control_tpu.models import fixtures
+
+    if args.warm_curve:
+        return _warm_curve(args, jax, AN, OPT, fixtures)
 
     cfg = AN.AnnealConfig(num_chains=16, steps=192, swap_interval=64,
                           tries_move=384, tries_lead=64, tries_swap=192)
@@ -115,6 +133,110 @@ def main():
     if args.out:
         with open(args.out, "w") as f:
             json.dump({"rows": rows, "summary": summary}, f, indent=2)
+
+
+#: step budgets for the warm-vs-cold curve; the reference anneal providing
+#: the warm source runs at REF_STEPS
+CURVE_STEPS = (32, 64, 128, 256)
+REF_STEPS = 512
+
+
+def _warm_curve(args, jax, AN, OPT, fixtures):
+    """Warm-vs-cold steps-to-quality curve at the CPU-feasible medium scale.
+
+    Per seed: a REF_STEPS cold anneal produces the warm source (the
+    previous accepted assignment a steady-state service carries), then each
+    CURVE_STEPS level runs cold and warm at the SAME seed and step budget
+    (polish cycles off, so the curve measures the anneal itself, not the
+    repair machinery absorbing the difference). Every (steps, warm) pair is
+    a distinct static anneal program; the compiled-program cache makes
+    seeds after the first steady-state."""
+    import numpy as np
+
+    swap = 16          # uniform across levels so steps=32 still swaps
+    base = dict(num_chains=32, tries_move=48, tries_lead=8, tries_swap=24)
+
+    def run(topo, assign, steps, seed, warm_start=None):
+        cfg = AN.AnnealConfig(steps=steps, swap_interval=swap, **base)
+        t0 = time.time()
+        r = OPT.optimize(topo, assign, engine="anneal", anneal_config=cfg,
+                         seed=seed, polish_cycles=0, warm_start=warm_start)
+        return r, round(time.time() - t0, 3)
+
+    curve = []
+    digest = None
+    for seed in range(args.seeds):
+        topo, assign = fixtures.random_cluster(
+            fixtures.ClusterProperties(num_racks=10, num_brokers=300,
+                                       num_replicas=10_000, num_topics=500),
+            seed=3140 + seed)
+        if digest is None:
+            digest = fixtures.fixture_digest(topo, assign)
+        ref, ref_wall = run(topo, assign, REF_STEPS, seed)
+        ws = AN.WarmStart(
+            broker_of=np.asarray(
+                jax.device_get(ref.final_assignment.broker_of), np.int32),
+            leader_of=np.asarray(
+                jax.device_get(ref.final_assignment.leader_of), np.int32),
+            fraction=0.5)
+
+        def row_of(r, wall):
+            return {
+                "violations_after": len(r.violated_goals_after),
+                "hard_violations_after": sum(
+                    1 for s in r.goal_summaries if s.hard and s.violated_after),
+                "soft_cost_after": round(sum(
+                    s.cost_after for s in r.goal_summaries if not s.hard), 3),
+                "balancedness_after": round(r.balancedness_after, 2),
+                "wall_s": wall,
+            }
+
+        for steps in CURVE_STEPS:
+            cold, cw = run(topo, assign, steps, seed)
+            warm, ww = run(topo, assign, steps, seed, warm_start=ws)
+            row = {"seed": seed, "steps": steps,
+                   "cold": row_of(cold, cw), "warm": row_of(warm, ww)}
+            curve.append(row)
+            print(json.dumps(row), flush=True)
+        curve.append({"seed": seed, "steps": REF_STEPS, "reference": True,
+                      "cold": row_of(ref, ref_wall)})
+        print(json.dumps(curve[-1]), flush=True)
+
+    # per-level aggregation: is warm at this budget no worse than cold?
+    levels = {}
+    for steps in CURVE_STEPS:
+        rs = [c for c in curve if c["steps"] == steps and "warm" in c]
+        levels[str(steps)] = {
+            "warm_soft_cost_max": max(c["warm"]["soft_cost_after"] for c in rs),
+            "cold_soft_cost_max": max(c["cold"]["soft_cost_after"] for c in rs),
+            "warm_violations_max": max(c["warm"]["violations_after"]
+                                       for c in rs),
+            "cold_violations_max": max(c["cold"]["violations_after"]
+                                       for c in rs),
+            "warm_no_worse_all_seeds": all(
+                c["warm"]["violations_after"] <= c["cold"]["violations_after"]
+                and c["warm"]["soft_cost_after"]
+                <= c["cold"]["soft_cost_after"] + 1e-9 for c in rs),
+        }
+    out = {
+        "fixture": {"kind": "random_cluster", "num_brokers": 300,
+                    "num_replicas": 10_000, "num_topics": 500,
+                    "seed_base": 3140, "digest": digest},
+        "platform": jax.default_backend(),
+        "config": dict(base, swap_interval=swap, ref_steps=REF_STEPS,
+                       warm_fraction=0.5, polish_cycles=0),
+        "curve": curve,
+        "levels": levels,
+    }
+    print(json.dumps({"summary": True, "levels": levels}), flush=True)
+    if args.out:
+        data = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                data = json.load(f)
+        data["warm_vs_cold"] = out
+        with open(args.out, "w") as f:
+            json.dump(data, f, indent=2)
 
 
 if __name__ == "__main__":
