@@ -222,6 +222,22 @@ def main():
             "cold_steady_state_retraces": len(cold_uncovered),
             "speedup_warm_vs_cold": round(cold_elapsed / elapsed, 2),
         }
+        # ---- tracing-overhead leg: the identical cold-schedule proposal
+        # (same seed, same compiled programs) with a live span tracer
+        # bracketing goal-eval/anneal/repair/decode. Spans are host-side
+        # brackets on an unchanged program — the observability contract is
+        # < 2% overhead on this leg (docs/observability.md).
+        from cruise_control_tpu.obs.tracing import Tracer
+        tr = Tracer()
+        t0 = time.time()
+        OPT.optimize(topo, assign, goal_names=goal_names, engine=engine,
+                     anneal_config=cfg, seed=seed + 1, mesh=mesh, tracer=tr)
+        traced_elapsed = time.time() - t0
+        warm_extra["cold_full_proposal_traced_s"] = round(traced_elapsed, 3)
+        warm_extra["cold_tracing_overhead_pct"] = round(
+            100.0 * (traced_elapsed - cold_elapsed) / max(cold_elapsed,
+                                                          1e-9), 2)
+        warm_extra["cold_traced_span_count"] = len(tr.finished())
 
     # ---- cluster-model-creation at bench scale (LoadMonitor.java:178
     # cluster-model-creation-timer): windowed aggregation result + cluster
@@ -1388,7 +1404,28 @@ def _measure_end_to_end_tick(topo, assign):
     if uncovered:
         print(f"bench: WARNING end-to-end tick retraced: {rl.summary()}",
               file=sys.stderr)
+    # ---- tracing-overhead leg: the same five ticks with a live span
+    # tracer on the monitor seam (fetch/aggregate/model-build spans under
+    # a tick umbrella). Host-side brackets only — the observability
+    # contract is < 2% overhead on this leg (docs/observability.md).
+    from cruise_control_tpu.obs.tracing import NOOP_TRACER, Tracer
+    tr = Tracer()
+    lm._tracer = tr
+    lat_traced = []
+    try:
+        for k in range(5):
+            with tr.span("tick", tick=k):
+                tick_s, _, _ = one_tick(101 + k)
+            lat_traced.append(tick_s)
+    finally:
+        lm._tracer = NOOP_TRACER
+    traced_med = float(np.median(lat_traced))
+    base_med = float(np.median(lat))
     return {
+        "end_to_end_tick_traced_s": round(traced_med, 3),
+        "end_to_end_tick_tracing_overhead_pct": round(
+            100.0 * (traced_med - base_med) / max(base_med, 1e-9), 2),
+        "end_to_end_tick_traced_span_count": len(tr.finished()),
         "end_to_end_tick_s": round(float(np.median(lat)), 3),
         "end_to_end_tick_max_s": round(float(max(lat)), 3),
         "end_to_end_tick_dirty_partitions": dirty_n,

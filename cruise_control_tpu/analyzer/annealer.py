@@ -141,6 +141,10 @@ class AnnealResult(NamedTuple):
     assignment: Assignment
     energy: jax.Array
     chain_energies: jax.Array
+    #: JSON-able ladder telemetry (None unless requested): per-ladder-slot
+    #: proposal acceptance rates by family, PT exchange rates, and the
+    #: per-round best-energy descent curve — the autotuner's signals
+    telemetry: Optional[dict] = None
 
 
 _band_cost = G.band_cost
@@ -487,7 +491,8 @@ def _apply_leads(dt: DeviceTopology, st: ChainState, p_vec, new_leader_vec
 
 def make_step_fn(dt: DeviceTopology, th, weights, opts, cfg: AnnealConfig,
                  movable_idx, dest_idx, initial_broker_of, topic_mode: str,
-                 topic_reps=None, n_movable=None, n_dest=None):
+                 topic_reps=None, n_movable=None, n_dest=None,
+                 telemetry: bool = False):
     """Build the per-chain annealer step (module-level for profiling/tests).
 
     ``n_movable`` / ``n_dest``: traced scalar sampling bounds over the
@@ -496,7 +501,12 @@ def make_step_fn(dt: DeviceTopology, th, weights, opts, cfg: AnnealConfig,
     real pool sizes so pool drift within a bucket changes only these scalar
     *values* — no retrace — while ``jax.random.randint`` draws stay
     identical to an unpadded run's (equal bound values ⇒ equal draws, the
-    padded == unpadded proposal contract)."""
+    padded == unpadded proposal contract).
+
+    ``telemetry`` makes the step ALSO return i32[3] accepted-proposal
+    counts (move, lead, swap) folded from the already-computed ``accept``
+    mask — no extra RNG draws, no change to the accept decision itself,
+    so the walked state sequence is identical either way."""
     R, P, B = dt.num_replicas, dt.num_partitions, dt.num_brokers
     Km, Kl, Ks = cfg.tries_move, cfg.tries_lead, cfg.tries_swap
     m = dt.max_rf
@@ -658,6 +668,10 @@ def make_step_fn(dt: DeviceTopology, th, weights, opts, cfg: AnnealConfig,
         st = _apply_leads(dt, st, p_c, new_leader)
         st = st._replace(energy=st.energy + jnp.sum(
             jnp.where(accept[:, None], deltas2, 0.0), axis=0))
+        if telemetry:
+            counts = jnp.stack([jnp.sum(acc_mv), jnp.sum(acc_ld),
+                                jnp.sum(acc_sw)]).astype(jnp.int32)
+            return st, counts
         return st
 
     return step
@@ -744,7 +758,8 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
                     goal_names: Sequence[str] = G.DEFAULT_GOALS,
                     initial_broker_of: Optional[jax.Array] = None,
                     mesh: Optional[jax.sharding.Mesh] = None,
-                    warm_start: Optional[WarmStart] = None) -> AnnealResult:
+                    warm_start: Optional[WarmStart] = None,
+                    telemetry: bool = False) -> AnnealResult:
     """Parallel-tempering anneal; with ``mesh`` the chain axis shards over
     it (the production multi-device path).
 
@@ -928,11 +943,17 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
     # else the broadcast seed state is donated — it is a fresh buffer no
     # caller reuses, and donating halves the chain-state HBM footprint.
     run_pt = _run_pt if jax.default_backend() == "cpu" else _run_pt_donated
+    tel_dev = None
     with SENT.no_implicit_transfers():
-        chains, temps = run_pt(chains, temps0, keys, dt, th, weights, opts,
-                               movable_idx, dest_idx, initial_broker_of,
-                               topic_reps, cfg, topic_mode, n_rounds,
-                               n_movable=n_mov_dev, n_dest=n_dst_dev)
+        out = run_pt(chains, temps0, keys, dt, th, weights, opts,
+                     movable_idx, dest_idx, initial_broker_of,
+                     topic_reps, cfg, topic_mode, n_rounds,
+                     n_movable=n_mov_dev, n_dest=n_dst_dev,
+                     telemetry=telemetry)
+        if telemetry:
+            chains, temps, tel_dev = out
+        else:
+            chains, temps = out
     chain_rows = None
     if mesh is not None and topic_mode in ("dense", "off"):
         # replica-sharded exact rescore (parallel/sharding.py): the per-chain
@@ -956,8 +977,15 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
             topic_mode, num_topics)                              # f32[C, 2]
         chain_rows = (bo_all, lo_all)
     # lexicographic best chain, combined in f64 on host — the f32 combined
-    # scalar would absorb the cost channel under any hard violation
-    e2 = np.asarray(jax.device_get(energies), np.float64)
+    # scalar would absorb the cost channel under any hard violation.
+    # Telemetry rides the same fetch (the "one extra fetch" contract is
+    # actually zero extra round-trips: one device_get either way).
+    tel_host = None
+    if tel_dev is not None:
+        e2_raw, tel_host = jax.device_get((energies, tel_dev))
+    else:
+        e2_raw = jax.device_get(energies)
+    e2 = np.asarray(e2_raw, np.float64)
     comb = e2[:, 0] * OBJ.VIOL_SCALE + e2[:, 1]
     best = int(np.argmin(comb))
     out_s = None
@@ -969,10 +997,38 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
     else:
         best_bo, best_lo = _take_chain_rows(chain_rows[0], chain_rows[1],
                                             best, out_s=out_s)
+    telemetry_out = None
+    if tel_host is not None:
+        slot_acc, exch_att, exch_acc, best_curve = (
+            np.asarray(t) for t in tel_host)
+        # attempts per family per slot are static: every step proposes the
+        # full candidate batch at every ladder slot
+        steps_total = n_rounds * cfg.swap_interval
+        tries = np.array([cfg.tries_move, cfg.tries_lead, cfg.tries_swap],
+                         np.float64) * steps_total
+
+        def rates(col):
+            return [round(float(v), 6)
+                    for v in slot_acc[:, col] / max(tries[col], 1.0)]
+        telemetry_out = {
+            "rounds": int(n_rounds),
+            "stepsPerRound": int(cfg.swap_interval),
+            "numChains": int(C),
+            "ladderTemps": [round(float(t), 6) for t in ladder.tolist()],
+            "acceptRates": {"move": rates(0), "lead": rates(1),
+                            "swap": rates(2)},
+            "exchangeAttempts": [int(v) for v in exch_att.tolist()],
+            "exchangeAcceptRates": [
+                round(float(a) / max(float(t), 1.0), 6)
+                for a, t in zip(exch_acc.tolist(), exch_att.tolist())],
+            "bestEnergyCurve": [round(float(v), 3)
+                                for v in best_curve.tolist()],
+        }
     return AnnealResult(
         assignment=Assignment(broker_of=best_bo, leader_of=best_lo),
         energy=jnp.float32(comb[best]),
         chain_energies=energies,
+        telemetry=telemetry_out,
     )
 
 
@@ -985,7 +1041,7 @@ _chain_energy_jit = jax.jit(_chain_energy,
 def _run_pt_impl(chains, temps, keys, dt, th, weights, opts, movable_idx,
                  dest_idx, initial_broker_of, topic_reps, cfg: AnnealConfig,
                  topic_mode: str, n_rounds: int,
-                 n_movable=None, n_dest=None):
+                 n_movable=None, n_dest=None, telemetry: bool = False):
     """The whole parallel-tempering run as ONE module-level jit.
 
     Module-level matters: a jit wrapper created inside ``optimize_anneal``
@@ -1005,10 +1061,18 @@ def _run_pt_impl(chains, temps, keys, dt, th, weights, opts, movable_idx,
     C = temps.shape[0]
     step = make_step_fn(dt, th, weights, opts, cfg, movable_idx, dest_idx,
                         initial_broker_of, topic_mode, topic_reps,
-                        n_movable=n_movable, n_dest=n_dest)
+                        n_movable=n_movable, n_dest=n_dest,
+                        telemetry=telemetry)
 
     def chain_round(st: ChainState, temp, key):
         ks = jax.random.split(key, cfg.swap_interval)
+
+        if telemetry:
+            # ys are the per-step accept counts; summed here so the round
+            # hands one i32[3] per chain up to the PT carry
+            st, counts = jax.lax.scan(
+                lambda s, k: step(s, temp, k), st, ks)
+            return st, jnp.sum(counts, axis=0)
 
         def body(s, k):
             return step(s, temp, k), None
@@ -1017,10 +1081,18 @@ def _run_pt_impl(chains, temps, keys, dt, th, weights, opts, movable_idx,
         return st
 
     def pt_round(carry, inp):
-        chains, temps = carry
+        if telemetry:
+            chains, temps, slot_acc, exch_att, exch_acc = carry
+        else:
+            chains, temps = carry
         rnd, key = inp
         kc = jax.random.split(jax.random.fold_in(key, 1), C)
-        chains = jax.vmap(chain_round, in_axes=(0, 0, 0))(chains, temps, kc)
+        if telemetry:
+            chains, counts = jax.vmap(
+                chain_round, in_axes=(0, 0, 0))(chains, temps, kc)
+        else:
+            chains = jax.vmap(chain_round,
+                              in_axes=(0, 0, 0))(chains, temps, kc)
         # temperature swap between ladder-adjacent chains (even/odd
         # alternation); energies combine AFTER differencing the channels
         order = jnp.argsort(temps)
@@ -1040,14 +1112,32 @@ def _run_pt_impl(chains, temps, keys, dt, th, weights, opts, movable_idx,
         do = do & do[partner]
         new_t_sorted = jnp.where(do, t_sorted[partner], t_sorted)
         temps = temps.at[order].set(new_t_sorted)
+        if telemetry:
+            # ladder-slot attribution: ``order`` maps slot -> chain for the
+            # round the counts were earned in (temps only change after)
+            slot_acc = slot_acc + counts[order]
+            exch_att = exch_att + (partner != i).astype(jnp.int32)
+            exch_acc = exch_acc + do.astype(jnp.int32)
+            # per-round best combined energy (descent curve). f32 combine
+            # is lossy under a hard violation — fine for a trend signal;
+            # the authoritative winner is still picked in f64 on host.
+            best_e = jnp.min(OBJ.combine(e_sorted))
+            return (chains, temps, slot_acc, exch_att, exch_acc), best_e
         return (chains, temps), None
 
+    if telemetry:
+        z3 = jnp.zeros((C, 3), jnp.int32)
+        z1 = jnp.zeros((C,), jnp.int32)
+        (chains, temps, slot_acc, exch_att, exch_acc), best_curve = \
+            jax.lax.scan(pt_round, (chains, temps, z3, z1, z1),
+                         (jnp.arange(n_rounds), keys))
+        return chains, temps, (slot_acc, exch_att, exch_acc, best_curve)
     (chains, temps), _ = jax.lax.scan(
         pt_round, (chains, temps), (jnp.arange(n_rounds), keys))
     return chains, temps
 
 
-_RUN_PT_STATICS = ("cfg", "topic_mode", "n_rounds")
+_RUN_PT_STATICS = ("cfg", "topic_mode", "n_rounds", "telemetry")
 _run_pt = _partial(jax.jit, static_argnames=_RUN_PT_STATICS)(_run_pt_impl)
 _run_pt_donated = _partial(jax.jit, static_argnames=_RUN_PT_STATICS,
                            donate_argnums=(0,))(_run_pt_impl)

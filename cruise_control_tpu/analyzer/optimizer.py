@@ -201,6 +201,10 @@ class OptimizerResult:
     #: (0.0 on the host path); host materialization is NOT included — it is
     #: lazy and attributed to whoever iterates
     decode_device_s: float = 0.0
+    #: annealer ladder telemetry (per-slot acceptance rates, PT exchange
+    #: rates, best-energy descent curve) — None unless the anneal engine
+    #: ran with anneal_telemetry requested (see annealer.AnnealResult)
+    anneal_telemetry: Optional[dict] = None
 
     @property
     def violated_goals_before(self) -> List[str]:
@@ -232,6 +236,8 @@ class OptimizerResult:
             out["fallbackReason"] = self.fallback_reason
         if self.heal_path:
             out["selfHealPath"] = self.heal_path
+        if self.anneal_telemetry is not None:
+            out["annealTelemetry"] = self.anneal_telemetry
         if verbose:
             # servlet/response/stats BrokerStats "Statistics" payloads:
             # the full ClusterModelStats before and after optimization,
@@ -486,7 +492,9 @@ def optimize(topo: ClusterTopology, assign: Assignment,
              balancedness_weights=None,
              bucketing: Optional[bool] = None,
              warm_start=None,
-             proposal_decode: str = "auto") -> OptimizerResult:
+             proposal_decode: str = "auto",
+             anneal_telemetry: bool = False,
+             tracer=None) -> OptimizerResult:
     """Full optimization pass. ``engine``: auto | greedy | anneal.
     ``repair_config``: RepairConfig override for the MAIN repair pass (the
     hard-violation backstop always runs with its own defaults).
@@ -508,7 +516,12 @@ def optimize(topo: ClusterTopology, assign: Assignment,
     ``proposal_decode``: "host" | "device" | "auto" — auto picks the device
     diff kernel exactly where the anneal engine routes (R*B beyond
     GREEDY_LIMIT): small models would pay a per-shape kernel compile for a
-    sub-millisecond numpy diff."""
+    sub-millisecond numpy diff.
+    ``anneal_telemetry``: collect per-ladder-slot acceptance/exchange rates
+    and the best-energy descent curve from the MAIN anneal pass (device-side
+    aggregates in the PT carry — zero retraces, bit-identical proposals).
+    ``tracer``: an obs.tracing.Tracer; the big phases (goal eval, anneal,
+    repair, decode) record spans on it. None = no-op."""
     mesh = _collapse_trivial_mesh(mesh)
     if _routes_to_tiny_cpu(topo, mesh, options):
         try:
@@ -521,11 +534,13 @@ def optimize(topo: ClusterTopology, assign: Assignment,
                                       options, engine, anneal_config, seed,
                                       mesh, repair_config, polish_cycles,
                                       balancedness_weights, bucketing,
-                                      warm_start, proposal_decode)
+                                      warm_start, proposal_decode,
+                                      anneal_telemetry, tracer)
     return _optimize_impl(topo, assign, goal_names, constraint, options,
                           engine, anneal_config, seed, mesh, repair_config,
                           polish_cycles, balancedness_weights, bucketing,
-                          warm_start, proposal_decode)
+                          warm_start, proposal_decode, anneal_telemetry,
+                          tracer)
 
 
 def healing_context(topo, opts: G.DeviceOptions) -> bool:
@@ -548,12 +563,15 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
                    anneal_config, seed, mesh, repair_config,
                    polish_cycles, balancedness_weights=None,
                    bucketing: Optional[bool] = None,
-                   warm_start=None, proposal_decode: str = "auto"
+                   warm_start=None, proposal_decode: str = "auto",
+                   anneal_telemetry: bool = False, tracer=None
                    ) -> OptimizerResult:
     from cruise_control_tpu.analyzer import annealer as AN  # cycle-free import
 
     from cruise_control_tpu.common.metrics import REGISTRY
+    from cruise_control_tpu.obs.tracing import NOOP_TRACER
     from cruise_control_tpu.server.async_ops import report_progress
+    tracer = tracer or NOOP_TRACER
     proposal_timer = REGISTRY.timer("proposal-computation-timer")
     t0 = time.time()
     _tp = [t0]
@@ -608,11 +626,12 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
             warm_start = warm_start._replace(
                 broker_of=jnp.asarray(bo, jnp.int32),
                 leader_of=jnp.asarray(lo, jnp.int32))
-    before = OBJ.evaluate_objective(dt, assign, th, weights, goal_names,
-                                    num_topics, init_broker, agg0,
-                                    sparse_topic=sparse_topic)
-    stats_before = _stats_dict(dt, assign, constraint, num_topics,
-                               sparse_topic=sparse_topic, agg=agg0)
+    with tracer.span("goal-eval", phase="before"):
+        before = OBJ.evaluate_objective(dt, assign, th, weights, goal_names,
+                                        num_topics, init_broker, agg0,
+                                        sparse_topic=sparse_topic)
+        stats_before = _stats_dict(dt, assign, constraint, num_topics,
+                                   sparse_topic=sparse_topic, agg=agg0)
 
     _mark("eval+stats before")
     report_progress(f"Optimizing goals with the {engine} engine")
@@ -632,6 +651,8 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
             raise DegradedModeError(
                 f"{eng} engine produced a non-finite penalty total ({total})")
 
+    anneal_tel = [None]   # main-pass ladder telemetry, set by _run_engine
+
     def _run_engine(eng: str):
         """One rung of the fallback chain: run ``eng`` end to end (including
         the anneal-only polish/backstop passes) and return
@@ -645,11 +666,15 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
                                              opts, num_topics)
             final = gres.assignment
         elif eng == "anneal":
-            ares = AN.optimize_anneal(dt, assign, th, weights, opts,
-                                      num_topics, config=anneal_config,
-                                      seed=seed, goal_names=goal_names,
-                                      initial_broker_of=init_broker,
-                                      mesh=mesh, warm_start=warm_start)
+            with tracer.span("anneal", warm=warm_start is not None,
+                             sharded=mesh is not None):
+                ares = AN.optimize_anneal(dt, assign, th, weights, opts,
+                                          num_topics, config=anneal_config,
+                                          seed=seed, goal_names=goal_names,
+                                          initial_broker_of=init_broker,
+                                          mesh=mesh, warm_start=warm_start,
+                                          telemetry=anneal_telemetry)
+            anneal_tel[0] = ares.telemetry
             final = ares.assignment
             _mark("anneal")
             # targeted repair (analyzer/repair.py): walk exactly the
@@ -657,10 +682,12 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
             # the reference's per-goal violation walks, at any scale
             report_progress("Repairing residual goal violations")
             from cruise_control_tpu.analyzer import repair as REP
-            final, _, _ = REP.repair(dt, final, th, weights, opts,
-                                     num_topics, initial_broker_of=init_broker,
-                                     seed=seed, mesh=mesh,
-                                     config=repair_config)
+            with tracer.span("repair"):
+                final, _, _ = REP.repair(dt, final, th, weights, opts,
+                                         num_topics,
+                                         initial_broker_of=init_broker,
+                                         seed=seed, mesh=mesh,
+                                         config=repair_config)
             _mark("repair")
         else:
             # last rung: the host-side sequential oracle — no stochastic
@@ -692,10 +719,12 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
         # with both call sites shaped identically they share one compiled
         # program — an eval that computes aggregates internally is a second
         # full trace+compile (~55 s of the cold start for nothing)
-        agg_after = _agg(final)
-        after = OBJ.evaluate_objective(dt, final, th, weights, goal_names,
-                                       num_topics, init_broker, agg_after,
-                                       sparse_topic=sparse_topic)
+        with tracer.span("goal-eval", phase="after"):
+            agg_after = _agg(final)
+            after = OBJ.evaluate_objective(dt, final, th, weights,
+                                           goal_names, num_topics,
+                                           init_broker, agg_after,
+                                           sparse_topic=sparse_topic)
         _check_finite(eng, after)
         if eng == "anneal":
             # polish cycles: repair converges to SINGLE-action local optima, and
@@ -858,6 +887,13 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
             # RuntimeError covers XlaRuntimeError (device/compile failures)
             # and DegradedModeError; anything else (bad arguments, bugs)
             # should propagate, not silently degrade
+            if "transfer" in str(e).lower():
+                # an implicit transfer inside a no_implicit_transfers
+                # scope: the silent-degradation class the observatory
+                # exists to surface (PR 8's 45-minute greedy fallback)
+                from cruise_control_tpu.obs.observatory import OBSERVATORY
+                OBSERVATORY.record_transfer_guard_violation(
+                    f"optimizer.{eng}")
             if i == len(attempts) - 1:
                 raise
             logger.warning("%s engine failed (%s); falling back to %s",
@@ -883,31 +919,34 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
                        > GREEDY_LIMIT else "host")
     decode_device_s = 0.0
     props = None
-    if decode_path == "device":
-        try:
-            t_dec = time.time()
-            # diff at MODEL shapes: a bucket-padded model's sentinel tail
-            # never moves, so the kernel stays bucket-stable across drift;
-            # LazyProposals slices the real prefix off host-side
-            dd = PR.device_diff(dt, assign, final,
-                                PR._broker_ids(topo_model))
-            props = PR.LazyProposals(topo, dd)
-            n_moves, n_lead, data_to_move = props.stats
-            decode_device_s = time.time() - t_dec
-        except (RuntimeError, ValueError) as e:
-            logger.warning("device proposal decode failed (%s); "
-                           "falling back to host diff", e)
-            decode_path, props = "host", None
-    if props is None:
-        # host path: decode at REAL shapes — padded sentinel rows never
-        # move (immovable + zero weight), so slicing them off cannot drop
-        # a proposal. Movement counts derive from the proposal diff so both
-        # engines report the same thing the executor will do; the
-        # vectorized stats avoid the ~150K per-proposal set-differences of
-        # the property accessors
-        props, n_moves, n_lead, data_to_move = PR.diff(topo, orig_assign,
-                                                       final_real,
-                                                       with_stats=True)
+    with tracer.span("decode") as _dec_sp:
+        if decode_path == "device":
+            try:
+                t_dec = time.time()
+                # diff at MODEL shapes: a bucket-padded model's sentinel
+                # tail never moves, so the kernel stays bucket-stable
+                # across drift; LazyProposals slices the real prefix off
+                # host-side
+                dd = PR.device_diff(dt, assign, final,
+                                    PR._broker_ids(topo_model))
+                props = PR.LazyProposals(topo, dd)
+                n_moves, n_lead, data_to_move = props.stats
+                decode_device_s = time.time() - t_dec
+            except (RuntimeError, ValueError) as e:
+                logger.warning("device proposal decode failed (%s); "
+                               "falling back to host diff", e)
+                decode_path, props = "host", None
+        if props is None:
+            # host path: decode at REAL shapes — padded sentinel rows never
+            # move (immovable + zero weight), so slicing them off cannot
+            # drop a proposal. Movement counts derive from the proposal
+            # diff so both engines report the same thing the executor will
+            # do; the vectorized stats avoid the ~150K per-proposal
+            # set-differences of the property accessors
+            props, n_moves, n_lead, data_to_move = PR.diff(topo, orig_assign,
+                                                           final_real,
+                                                           with_stats=True)
+        _dec_sp.set("decode_path", decode_path)
 
     _mark("proposal diff")
     names_ext = goal_names + (G.SELF_HEALING_TERM,)
@@ -953,4 +992,7 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
                    else "full" if healing_context(topo, opts) else None),
         decode_path=decode_path,
         decode_device_s=decode_device_s,
+        # only the engine that PRODUCED the result may claim telemetry —
+        # a failed anneal rung's partial ladder stats would misattribute
+        anneal_telemetry=anneal_tel[0] if engine_used == "anneal" else None,
     )

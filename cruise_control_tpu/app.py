@@ -52,6 +52,7 @@ from cruise_control_tpu.monitor.load_monitor import (
     NotEnoughValidWindowsError,
 )
 from cruise_control_tpu.monitor.sampler import MetricSampler
+from cruise_control_tpu.obs.tracing import Tracer
 from cruise_control_tpu.parallel.mesh import mesh_from_config, mesh_state
 
 
@@ -93,6 +94,21 @@ class CruiseControlApp:
             backoff_ms=config.get("watchdog.backoff.ms"))
         self._watchdog_thread: Optional[threading.Thread] = None
         self._watchdog_shutdown = threading.Event()
+        # graftscope span tracer (obs.tracing.*): spans over the virtual-
+        # time seam (deterministic timelines under the simulator), wall
+        # durations into the per-stage registry timers. Disabled it hands
+        # out the shared no-op span — bit-identical behavior.
+        self.tracer = Tracer(
+            now_fn=self._now_s,
+            capacity=config.get("obs.tracing.buffer.spans"),
+            enabled=bool(config.get("obs.tracing.enable")),
+            registry=REGISTRY)
+        # compile/retrace observatory (obs.observatory.enable): installed
+        # once per process (module singleton) — per-function compile
+        # accounting for /observatory and the metrics registry
+        if config.get("obs.observatory.enable"):
+            from cruise_control_tpu.obs.observatory import OBSERVATORY
+            OBSERVATORY.install()
         self.constraint = config.balancing_constraint()
         self.default_goals = tuple(config.get("default.goals"))
         if mesh is None:
@@ -179,7 +195,8 @@ class CruiseControlApp:
                 "broker.metric.sample.aggregator.completeness.cache.size"),
             now_fn=self._now_ms_fn if now_fn is not None else None,
             heartbeat=lambda: self.watchdog.beat("load-monitor-sampler"),
-            store_heartbeat=lambda: self.watchdog.beat("sample-store-flush"))
+            store_heartbeat=lambda: self.watchdog.beat("sample-store-flush"),
+            tracer=self.tracer)
         self._metadata_source = metadata_source
         adapter = cluster_adapter or FakeClusterAdapter({})
         # write-ahead execution journal (executor.journal.path; empty =
@@ -215,6 +232,7 @@ class CruiseControlApp:
             sleep=self._sleep_fn,
             journal=self.journal,
             heartbeat=lambda: self.watchdog.beat("executor-progress"),
+            tracer=self.tracer,
             notifier=resolve_pluggable(
                 config.get("executor.notifier.class"),
                 EXECUTOR_NOTIFIER_REGISTRY, base=ExecutorNotifier)(),
@@ -532,34 +550,47 @@ class CruiseControlApp:
         if not self._compute_gate.acquire(blocking=False):
             return False         # a request thread is already computing
         t0 = time.monotonic()
-        try:
-            if self._cache_is_fresh():
-                return False
-            if self._try_incremental_refresh():
+        # the precompute span is also the tick's AMBIENT parent: spans
+        # opened on background threads meanwhile (escape-kernel warm,
+        # executor progress) join this tick's tree
+        with self.tracer.span("precompute-tick") as _sp:
+            self.tracer.set_ambient(_sp)
+            try:
+                if self._cache_is_fresh():
+                    _sp.set("outcome", "fresh")
+                    return False
+                if self._try_incremental_refresh():
+                    self._precompute_failures = 0
+                    with self._cache_lock:
+                        self.last_tick_ms = (time.monotonic() - t0) * 1000.0
+                    _sp.set("outcome", "incremental")
+                    return True
+                self._compute_and_cache()
                 self._precompute_failures = 0
                 with self._cache_lock:
                     self.last_tick_ms = (time.monotonic() - t0) * 1000.0
+                _sp.set("outcome", "computed")
                 return True
-            self._compute_and_cache()
-            self._precompute_failures = 0
-            with self._cache_lock:
-                self.last_tick_ms = (time.monotonic() - t0) * 1000.0
-            return True
-        except NotEnoughValidWindowsError:
-            return False         # monitor not ready yet: expected at startup
-        except Exception:
-            # a permanently-broken precompute loop must stay visible without
-            # flooding the log: warn on the first few consecutive failures,
-            # then only every 10th, and count every one in the registry
-            self._precompute_failures += 1
-            REGISTRY.counter("proposal.precompute.failures")
-            n = self._precompute_failures
-            if n <= 3 or n % 10 == 0:
-                logger.warning("proposal precompute failed (%d consecutive)",
-                               n, exc_info=True)
-            return False
-        finally:
-            self._compute_gate.release()
+            except NotEnoughValidWindowsError:
+                _sp.set("outcome", "not-ready")
+                return False     # monitor not ready yet: expected at startup
+            except Exception:
+                # a permanently-broken precompute loop must stay visible
+                # without flooding the log: warn on the first few consecutive
+                # failures, then only every 10th, and count every one in the
+                # registry
+                self._precompute_failures += 1
+                REGISTRY.counter("proposal.precompute.failures")
+                n = self._precompute_failures
+                if n <= 3 or n % 10 == 0:
+                    logger.warning(
+                        "proposal precompute failed (%d consecutive)",
+                        n, exc_info=True)
+                _sp.set("outcome", "failed")
+                return False
+            finally:
+                self.tracer.clear_ambient()
+                self._compute_gate.release()
 
     def _precompute_loop(self):
         # re-check at a fraction of the expiration so a generation change is
@@ -608,7 +639,8 @@ class CruiseControlApp:
             return False
         try:
             from cruise_control_tpu.analyzer import rescore as RS
-            out = RS.rescore_deltas(rs, topo, info["dirtyPartitionIndex"])
+            with self.tracer.span("dirty-diff", dirtyPartitions=int(dirty)):
+                out = RS.rescore_deltas(rs, topo, info["dirtyPartitionIndex"])
         except Exception:
             logger.warning("incremental rescore failed; falling back to "
                            "full computation", exc_info=True)
@@ -685,7 +717,10 @@ class CruiseControlApp:
             balancedness_weights=self._balancedness_weights,
             mesh=self.mesh,
             bucketing=self._bucketing(),
-            warm_start=warm_start)
+            warm_start=warm_start,
+            anneal_telemetry=bool(
+                self.config.get("anneal.telemetry.enable")),
+            tracer=self.tracer)
         if res.fallback_reason:
             # degraded mode: remember the most recent fallback for /state
             # (read by the REST thread, so it shares the cache lock)
@@ -962,22 +997,37 @@ class CruiseControlApp:
 
             def _warm():
                 try:
-                    OPT.warm_kernels(topo, assign,
-                                     goal_names=tuple(self.default_goals),
-                                     constraint=self.constraint,
-                                     options=options,
-                                     anneal_config=(self._anneal_config()
-                                                    if routes_anneal
-                                                    else None),
-                                     mesh=self.mesh,
-                                     bucketing=self._bucketing())
+                    with self.tracer.span("escape-kernel-warm"):
+                        OPT.warm_kernels(topo, assign,
+                                         goal_names=tuple(self.default_goals),
+                                         constraint=self.constraint,
+                                         options=options,
+                                         anneal_config=(self._anneal_config()
+                                                        if routes_anneal
+                                                        else None),
+                                         mesh=self.mesh,
+                                         bucketing=self._bucketing())
                 except Exception:
                     logger.warning("escape-kernel warm failed",
                                    exc_info=True)
+                finally:
+                    # warming compiles on purpose: only after it completes
+                    # do further traces count as steady-state retraces
+                    self._mark_observatory_steady()
 
             threading.Thread(target=_warm, daemon=True,
                              name="escape-kernel-warm").start()
+        else:
+            self._mark_observatory_steady()
         return result
+
+    def _mark_observatory_steady(self):
+        """First successful default-goal computation (plus any escape-kernel
+        warm it spawned) ⇒ the service is steady: jit traces from here on
+        are retraces the observatory flags and /metrics counts."""
+        if self.config.get("obs.observatory.enable"):
+            from cruise_control_tpu.obs.observatory import OBSERVATORY
+            OBSERVATORY.mark_steady()
 
     # ----------------------------------------------- operations (runnables)
 
@@ -1629,12 +1679,22 @@ class CruiseControlApp:
 
     # ----------------------------------------------------------------- state
 
+    def observability_state(self) -> dict:
+        """Graftscope view: the tracer's summary + the compile/retrace
+        observatory snapshot (ObservabilityState in /state and the body of
+        GET /observatory)."""
+        from cruise_control_tpu.obs.observatory import OBSERVATORY
+        return {"tracing": self.tracer.summary(),
+                "observatory": OBSERVATORY.snapshot()}
+
     def state(self, super_verbose: bool = False) -> dict:
         """CruiseControlState for the STATE endpoint. ``super_verbose``
         (CruiseControlState.writeSuperVerbose): adds the extrapolated
         metric-sample flaws and the linear-regression model state."""
         with self._cache_lock:
             proposal_ready = self._proposal_cache is not None
+            anneal_telemetry = (self._proposal_cache.result.anneal_telemetry
+                                if self._proposal_cache is not None else None)
             last_fallback = self._last_fallback
             last_provision = self._last_provision_recommendation
             cache_hits = self.proposal_cache_hits
@@ -1661,11 +1721,13 @@ class CruiseControlApp:
                 "lastTickMs": last_tick_ms,
                 "lastSelfHealMs": last_self_heal_ms,
                 "selfHealPath": self_heal_path,
+                "annealTelemetry": anneal_telemetry,
                 **mesh_state(self.mesh),
             },
             "AnomalyDetectorState": self.anomaly_detector.state_snapshot(),
             "WatchdogState": self.watchdog.snapshot(),
             "ReplicationState": self.replication_state(),
+            "ObservabilityState": self.observability_state(),
         }
         if last_simulation is not None:
             out["SimulatorState"] = last_simulation

@@ -325,6 +325,26 @@ def _service_config_def() -> ConfigDef:
              "then take the exact historical path; steady-state services "
              "should enable it (0.5 is the benched setting).",
              between(0.0, 1.0))
+    d.define("anneal.telemetry.enable", T.BOOLEAN, False, I.LOW,
+             "Collect per-ladder-slot acceptance rates, exchange rates and "
+             "the best-energy descent curve as device-side aggregates in "
+             "the annealer's scan carry (one extra fetch per run, zero "
+             "retraces). Off (the default) runs the exact historical "
+             "program — bit-identical proposals.")
+    # observability (graftscope: docs/observability.md)
+    d.define("obs.tracing.enable", T.BOOLEAN, False, I.LOW,
+             "Span tracing of the control loop (tick stages, executor task "
+             "lifecycle, recovery) into a bounded in-memory ring exported "
+             "as Chrome-trace JSON. Disabled, the tracer is a shared no-op "
+             "and behavior is bit-identical.")
+    d.define("obs.tracing.buffer.spans", T.INT, 4096, I.LOW,
+             "Capacity of the tracer's completed-span ring buffer; the "
+             "oldest spans are dropped (and counted) past it.", at_least(1))
+    d.define("obs.observatory.enable", T.BOOLEAN, True, I.LOW,
+             "Always-on compile/retrace observatory: per-function jit "
+             "trace/compile counts and compile wall time, steady-state "
+             "retrace accounting and transfer-guard violation counters, "
+             "surfaced in the metrics registry and GET /observatory.")
     # executor (Executor.java config surface)
     d.define("num.concurrent.partition.movements.per.broker", T.INT, 5,
              I.MEDIUM, "Per-broker reassignment concurrency.", at_least(1))

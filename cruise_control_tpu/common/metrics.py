@@ -1,26 +1,123 @@
-"""Metrics registry: counters, gauges, timers (dropwizard → JMX parity).
+"""Metrics registry: counters, gauges, timers, histograms (dropwizard parity).
 
 The reference exports dropwizard meters/timers via JMX under
 ``kafka.cruisecontrol`` (``KafkaCruiseControlMain.java:71-73``; sensor table
 ``docs/wiki/User Guide/Sensors.md``). Here the registry is in-process and
-exported through the REST ``/metrics`` route in Prometheus text format —
-the observability fabric this ecosystem actually scrapes.
+exported two ways through the REST server:
+
+- ``GET /metrics`` — flat JSON snapshot (:meth:`MetricsRegistry.snapshot`),
+  the shape the tests and ad-hoc curl debugging read;
+- ``GET /metrics?format=prometheus`` — spec-conformant Prometheus text
+  exposition (:meth:`MetricsRegistry.prometheus`): ``# HELP``/``# TYPE``
+  headers, ``_total`` counter suffix, timers as cumulative fixed-bucket
+  histograms (``_bucket{le=...}``/``_sum``/``_count``), stable label
+  ordering and escaped label values, deterministic line order.
+
+Timers measure durations on an *injectable monotonic* clock — never
+``time.time()``, whose NTP/virtual-clock steps corrupt deltas (the same
+hazard graftlint G011 bans on control-plane paths) — and fold every
+observation into a fixed-bucket :class:`Histogram` so p50/p99 are
+deterministic functions of the bucket counts (no reservoir sampling).
 """
 
 from __future__ import annotations
 
+import bisect
+import logging
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+LOG = logging.getLogger(__name__)
+
+#: label set normalized to a hashable, deterministically-ordered key
+LabelKey = Tuple[Tuple[str, str], ...]
+
+#: fixed histogram bucket upper bounds (seconds) — spans sub-ms span
+#: overhead through multi-minute greedy fallbacks; one implicit +Inf
+DEFAULT_BUCKETS_S: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
+
+
+def _label_key(labels: Optional[Dict[str, object]]) -> LabelKey:
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _escape_label_value(value: str) -> str:
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+class Histogram:
+    """Fixed-bucket histogram with deterministic quantiles.
+
+    Bucket counts are non-cumulative internally; quantiles report the
+    upper bound of the bucket where the cumulative count crosses the
+    rank — a deterministic, merge-friendly estimate (exactly what the
+    Prometheus exposition encodes), not a sampled one.
+    """
+
+    def __init__(self, bounds: Sequence[float] = DEFAULT_BUCKETS_S):
+        self.bounds: Tuple[float, ...] = tuple(float(b) for b in bounds)
+        self.counts: List[int] = [0] * (len(self.bounds) + 1)  # [-1]=+Inf
+        self.total = 0
+        self.sum = 0.0
+
+    def update(self, value: float) -> None:
+        # caller (Timer) holds its lock; bare Histogram is single-writer
+        idx = bisect.bisect_left(self.bounds, value)
+        self.counts[idx] += 1
+        self.total += 1
+        self.sum += value
+
+    def quantile(self, q: float) -> float:
+        """Upper bound of the bucket containing quantile ``q`` (0..1).
+        The +Inf bucket reports the largest finite bound."""
+        if self.total == 0:
+            return 0.0
+        rank = q * self.total
+        cum = 0
+        for i, c in enumerate(self.counts):
+            cum += c
+            if cum >= rank:
+                return self.bounds[i] if i < len(self.bounds) \
+                    else self.bounds[-1]
+        return self.bounds[-1]
+
+    def cumulative(self) -> List[Tuple[float, int]]:
+        """``(upper_bound, cumulative_count)`` pairs, +Inf last — the
+        Prometheus ``_bucket{le=...}`` series."""
+        out: List[Tuple[float, int]] = []
+        cum = 0
+        for bound, c in zip(self.bounds, self.counts):
+            cum += c
+            out.append((bound, cum))
+        out.append((float("inf"), cum + self.counts[-1]))
+        return out
 
 
 class Timer:
-    """Wall-clock timer with count/total/max (dropwizard Timer parity)."""
+    """Duration metric: count/total/max plus a fixed-bucket histogram.
 
-    def __init__(self):
+    Deltas come from an injectable *monotonic* clock (default
+    ``time.monotonic``) so a wall-clock step — NTP slew in prod, the
+    virtual clock jumping in tests — can't corrupt a measurement.
+    """
+
+    def __init__(self, clock: Optional[Callable[[], float]] = None,
+                 bounds: Sequence[float] = DEFAULT_BUCKETS_S):
         self.count = 0
         self.total_s = 0.0
         self.max_s = 0.0
+        self.hist = Histogram(bounds)
+        self._clock = clock or time.monotonic
         self._lock = threading.Lock()
 
     def update(self, seconds: float):
@@ -28,17 +125,19 @@ class Timer:
             self.count += 1
             self.total_s += seconds
             self.max_s = max(self.max_s, seconds)
+            self.hist.update(seconds)
 
     def time(self):
         timer = self
+        clock = self._clock
 
         class _Ctx:
             def __enter__(self):
-                self.t0 = time.time()
+                self.t0 = clock()
                 return self
 
             def __exit__(self, *exc):
-                timer.update(time.time() - self.t0)
+                timer.update(max(clock() - self.t0, 0.0))
 
         return _Ctx()
 
@@ -47,52 +146,242 @@ class Timer:
         with self._lock:
             return self.total_s / self.count if self.count else 0.0
 
+    @property
+    def p50_s(self) -> float:
+        with self._lock:
+            return self.hist.quantile(0.50)
+
+    @property
+    def p99_s(self) -> float:
+        with self._lock:
+            return self.hist.quantile(0.99)
+
+
+#: ``# HELP`` strings for the sensors this codebase emits, keyed by the
+#: registry name (pre-sanitization).  ``tools/gen_docs.py`` regenerates
+#: ``docs/sensors.md`` from this table, so docs can't drift from code.
+SENSOR_DOCS: Dict[str, str] = {
+    "proposal-computation-timer":
+        "Wall time of one full proposal computation (optimize() call).",
+    "proposal-computation-fallback-rate":
+        "Engine fallbacks taken (anneal -> greedy -> sequential).",
+    "proposal.precompute.failures":
+        "Background proposal precompute attempts that raised.",
+    "proposal.incremental.refresh":
+        "Warm proposal refreshes served from the incremental path.",
+    "cluster-model-creation-timer":
+        "Wall time to build or splice the cluster model.",
+    "cluster-model-cache-hit-rate": "Cluster model cache hits.",
+    "cluster-model-cache-miss-rate": "Cluster model cache misses.",
+    "partition-samples-fetcher-timer":
+        "Wall time of one partition metric sample fetch.",
+    "partition-samples-fetcher-failure-rate":
+        "Partition metric sample fetches that failed.",
+    "adapter-call-retry-rate": "Executor adapter calls that were retried.",
+    "executor-recovery-rate": "Executor journal recoveries performed.",
+    "execution-finished-rate": "Proposal executions finished cleanly.",
+    "execution-failed-rate": "Proposal executions that failed.",
+    "execution-stopped-rate": "Proposal executions stopped by request.",
+    "throttle-clear-failed-rate":
+        "Replication throttle clears that failed.",
+    "task-stuck-rate": "Executor tasks declared stuck past the timeout.",
+    "task-dead-on-adapter-failure-rate":
+        "Executor tasks killed by repeated adapter failures.",
+    "anomaly-detector-error-rate": "Anomaly detector sweeps that raised.",
+    "self-healing-fix-rate": "Self-healing fixes dispatched.",
+    "gauge-errors": "Registered gauge callbacks that raised on read.",
+    "observatory-jit-traces":
+        "Jit traces observed by the compile observatory, per function.",
+    "observatory-xla-compiles":
+        "XLA compiles observed by the compile observatory, per function.",
+    "observatory-steady-state-retraces":
+        "Jit traces after the loop declared steady state, per function.",
+    "observatory-compile-timer":
+        "XLA compile wall time, per function.",
+    "observatory-device-dispatches":
+        "Device dispatches of jitted entry points, per callsite.",
+    "observatory-transfer-guard-violations":
+        "Implicit-transfer violations surfaced, per callsite.",
+}
+
 
 class MetricsRegistry:
-    """Named counters / gauges / timers, snapshot-able and scrapable."""
+    """Named counters / gauges / timers, labeled, snapshot-able, scrapable."""
 
-    def __init__(self, prefix: str = "kafka_cruisecontrol"):
+    #: failures logged per gauge before going quiet (the capped rate)
+    GAUGE_ERROR_LOG_CAP = 1
+
+    def __init__(self, prefix: str = "kafka_cruisecontrol",
+                 clock: Optional[Callable[[], float]] = None):
         self.prefix = prefix
-        self._counters: Dict[str, float] = {}
-        self._gauges: Dict[str, Callable[[], float]] = {}
-        self._timers: Dict[str, Timer] = {}
-        self._lock = threading.Lock()
+        self._clock = clock or time.monotonic
+        self._counters: Dict[str, Dict[LabelKey, float]] = {}
+        self._gauges: Dict[str, Dict[LabelKey, Callable[[], float]]] = {}
+        self._timers: Dict[str, Dict[LabelKey, Timer]] = {}
+        self._gauge_error_logs: Dict[Tuple[str, LabelKey], int] = {}
+        # RLock: snapshot() increments the gauge-errors counter while
+        # already holding the lock (gauge callback raised mid-walk)
+        self._lock = threading.RLock()
 
-    def counter(self, name: str, inc: float = 1.0):
+    def counter(self, name: str, inc: float = 1.0,
+                labels: Optional[Dict[str, object]] = None):
+        key = _label_key(labels)
         with self._lock:
-            self._counters[name] = self._counters.get(name, 0.0) + inc
+            series = self._counters.setdefault(name, {})
+            series[key] = series.get(key, 0.0) + inc
 
-    def gauge(self, name: str, fn: Callable[[], float]):
+    def gauge(self, name: str, fn: Callable[[], float],
+              labels: Optional[Dict[str, object]] = None):
         with self._lock:
-            self._gauges[name] = fn
+            self._gauges.setdefault(name, {})[_label_key(labels)] = fn
 
-    def timer(self, name: str) -> Timer:
+    def timer(self, name: str,
+              labels: Optional[Dict[str, object]] = None) -> Timer:
+        key = _label_key(labels)
         with self._lock:
-            t = self._timers.get(name)
+            series = self._timers.setdefault(name, {})
+            t = series.get(key)
             if t is None:
-                t = self._timers[name] = Timer()
+                t = series[key] = Timer(clock=self._clock)
             return t
 
+    # ------------------------------------------------------------ reads
+    def _read_gauge(self, name: str, key: LabelKey,
+                    fn: Callable[[], float]) -> Optional[float]:
+        """Read one gauge; on failure count it, warn (capped), skip it.
+        Caller holds ``self._lock`` (RLock — the counter bump re-enters)."""
+        try:
+            return float(fn())
+        except Exception:
+            self.counter("gauge-errors")
+            logged = self._gauge_error_logs.get((name, key), 0)
+            self._gauge_error_logs[(name, key)] = logged + 1
+            if logged < self.GAUGE_ERROR_LOG_CAP:
+                LOG.warning("gauge %r%s raised; excluded from snapshot "
+                            "(logged once, counted in gauge-errors)",
+                            name, dict(key) if key else "", exc_info=True)
+            return None
+
+    @staticmethod
+    def _suffix(key: LabelKey) -> str:
+        if not key:
+            return ""
+        return "{" + ",".join(f"{k}={v}" for k, v in key) + "}"
+
     def snapshot(self) -> dict:
+        """Flat JSON view. Unlabeled series keep their bare name (the
+        pre-labels format); labeled series append ``{k=v,...}``."""
         with self._lock:
-            out = {f"{k}": v for k, v in self._counters.items()}
-            for k, fn in self._gauges.items():
-                try:
-                    out[k] = float(fn())
-                except Exception:
-                    pass
-            for k, t in self._timers.items():
-                out[f"{k}-count"] = t.count
-                out[f"{k}-mean-s"] = round(t.mean_s, 6)
-                out[f"{k}-max-s"] = round(t.max_s, 6)
+            out: Dict[str, float] = {}
+            # gauges first: a failure bumps gauge-errors, which the
+            # counter walk below then reports in THIS snapshot
+            gauge_vals: List[Tuple[str, LabelKey, float]] = []
+            for name, series in self._gauges.items():
+                for key, fn in list(series.items()):
+                    val = self._read_gauge(name, key, fn)
+                    if val is not None:
+                        gauge_vals.append((name, key, val))
+            for name, series in self._counters.items():
+                for key, v in series.items():
+                    out[f"{name}{self._suffix(key)}"] = v
+            for name, key, val in gauge_vals:
+                out[f"{name}{self._suffix(key)}"] = val
+            for name, series in self._timers.items():
+                for key, t in series.items():
+                    base = f"{name}{self._suffix(key)}"
+                    out[f"{base}-count"] = t.count
+                    out[f"{base}-mean-s"] = round(t.mean_s, 6)
+                    out[f"{base}-max-s"] = round(t.max_s, 6)
+                    out[f"{base}-p50-s"] = round(t.p50_s, 6)
+                    out[f"{base}-p99-s"] = round(t.p99_s, 6)
             return out
 
+    # ------------------------------------------------------ prometheus
+    def _metric_name(self, name: str) -> str:
+        return f"{self.prefix}_{name}".replace(".", "_").replace("-", "_")
+
+    @staticmethod
+    def _render_labels(key: LabelKey, extra: str = "") -> str:
+        parts = [f'{k}="{_escape_label_value(v)}"' for k, v in key]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    def _header(self, lines: List[str], metric: str, name: str,
+                mtype: str) -> None:
+        help_text = SENSOR_DOCS.get(name)
+        if help_text:
+            lines.append(f"# HELP {metric} {_escape_help(help_text)}")
+        lines.append(f"# TYPE {metric} {mtype}")
+
+    @staticmethod
+    def _fmt(value: float) -> str:
+        if value == int(value) and abs(value) < 1e15:
+            return str(int(value))
+        return repr(float(value))
+
     def prometheus(self) -> str:
+        """Prometheus text exposition (``text/plain; version=0.0.4``).
+
+        Deterministic: families sorted by name, series by label key,
+        label names pre-sorted, values escaped. Counters get the
+        ``_total`` suffix; timers render as cumulative histograms.
+        """
         lines: List[str] = []
-        for k, v in sorted(self.snapshot().items()):
-            metric = f"{self.prefix}_{k}".replace(".", "_").replace("-", "_")
-            lines.append(f"{metric} {v}")
+        with self._lock:
+            # read gauges first so a failure's gauge-errors bump lands
+            # in this scrape's counter section
+            gauge_vals: Dict[str, List[Tuple[LabelKey, float]]] = {}
+            for name in sorted(self._gauges):
+                for key in sorted(self._gauges[name]):
+                    val = self._read_gauge(name, key,
+                                           self._gauges[name][key])
+                    if val is not None:
+                        gauge_vals.setdefault(name, []).append((key, val))
+            for name in sorted(self._counters):
+                metric = self._metric_name(name) + "_total"
+                self._header(lines, metric, name, "counter")
+                for key in sorted(self._counters[name]):
+                    lines.append(f"{metric}{self._render_labels(key)} "
+                                 f"{self._fmt(self._counters[name][key])}")
+            for name in sorted(gauge_vals):
+                metric = self._metric_name(name)
+                self._header(lines, metric, name, "gauge")
+                for key, val in gauge_vals[name]:
+                    lines.append(f"{metric}{self._render_labels(key)} "
+                                 f"{self._fmt(val)}")
+            for name in sorted(self._timers):
+                metric = self._metric_name(name) + "_seconds"
+                self._header(lines, metric, name, "histogram")
+                for key in sorted(self._timers[name]):
+                    t = self._timers[name][key]
+                    with t._lock:
+                        buckets = t.hist.cumulative()
+                        total_s, count = t.total_s, t.count
+                    for bound, cum in buckets:
+                        le = "+Inf" if bound == float("inf") \
+                            else self._fmt(bound)
+                        labels = self._render_labels(key, f'le="{le}"')
+                        lines.append(f"{metric}_bucket{labels} {cum}")
+                    suffix = self._render_labels(key)
+                    lines.append(f"{metric}_sum{suffix} "
+                                 f"{repr(round(total_s, 9))}")
+                    lines.append(f"{metric}_count{suffix} {count}")
         return "\n".join(lines) + "\n"
+
+    def sensor_rows(self) -> List[dict]:
+        """One row per registered sensor family (for docs generation)."""
+        with self._lock:
+            rows = []
+            for name in sorted(self._counters):
+                rows.append({"name": name, "kind": "counter"})
+            for name in sorted(self._gauges):
+                rows.append({"name": name, "kind": "gauge"})
+            for name in sorted(self._timers):
+                rows.append({"name": name, "kind": "timer"})
+        for row in rows:
+            row["help"] = SENSOR_DOCS.get(row["name"], "")
+        return sorted(rows, key=lambda r: r["name"])
 
 
 #: process-wide default registry (the reference's singleton MetricRegistry)

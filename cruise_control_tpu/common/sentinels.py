@@ -29,7 +29,7 @@ import contextlib
 import json
 import logging
 import os
-from typing import Iterator, List, Optional
+from typing import Iterator, List, Optional, Tuple
 
 import jax
 
@@ -48,6 +48,32 @@ def no_implicit_transfers():
     make its transfers explicit via device_put/device_get.
     """
     return jax.transfer_guard("disallow")
+
+
+def parse_compile_log(msg: str) -> Optional[Tuple[str, str, Optional[float]]]:
+    """Classify one ``jax_log_compiles`` message.
+
+    Returns ``(kind, function_name, seconds)`` where kind is ``"trace"``
+    (tracing + transforming finished), ``"compile"`` (XLA compile
+    started), or ``"compile_done"`` (XLA compile finished; ``seconds`` is
+    the reported wall time) — or ``None`` for anything else.  Shared by
+    the test-scoped :func:`retrace_sentinel` and the always-on
+    production observatory (``cruise_control_tpu.obs.observatory``).
+    """
+    try:
+        if msg.startswith("Finished tracing + transforming"):
+            return "trace", msg.split()[4], None
+        if msg.startswith("Compiling") and "with global shapes" in msg:
+            return "compile", msg.split()[1], None
+        if msg.startswith("Finished XLA compilation of"):
+            parts = msg.split()
+            fn = parts[4]
+            if fn.startswith("jit(") and fn.endswith(")"):
+                fn = fn[4:-1]      # "jit(f)" -> "f": match the trace name
+            return "compile_done", fn, float(parts[6])
+    except (IndexError, ValueError):
+        return None
+    return None
 
 
 class RetraceLog:
@@ -75,11 +101,14 @@ class _CaptureHandler(logging.Handler):
         self._log = log
 
     def emit(self, record: logging.LogRecord) -> None:
-        msg = record.getMessage()
-        if msg.startswith("Finished tracing + transforming"):
-            self._log.traces.append(msg.split()[4])
-        elif msg.startswith("Compiling") and "with global shapes" in msg:
-            self._log.compiles.append(msg.split()[1])
+        parsed = parse_compile_log(record.getMessage())
+        if parsed is None:
+            return
+        kind, fn, _ = parsed
+        if kind == "trace":
+            self._log.traces.append(fn)
+        elif kind == "compile":
+            self._log.compiles.append(fn)
 
 
 @contextlib.contextmanager

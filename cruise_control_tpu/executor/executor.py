@@ -406,11 +406,15 @@ class Executor:
                  clock: Callable[[], float] = time.time,
                  sleep: Callable[[float], None] = time.sleep,
                  journal: Optional[ExecutionJournal] = None,
-                 heartbeat: Optional[Callable[[], None]] = None):
+                 heartbeat: Optional[Callable[[], None]] = None,
+                 tracer=None):
+        from cruise_control_tpu.obs.tracing import NOOP_TRACER
         self.adapter = adapter
         self.config = config or ExecutorConfig()
         self.notifier = notifier or ExecutorNotifier()
         self._strategy = strategy
+        # graftscope spans: execution phases + restart reconciliation
+        self._tracer = tracer or NOOP_TRACER
         # write-ahead execution journal (None = journaling disabled) and the
         # watchdog heartbeat the progress loop checks into every poll round
         self._journal = journal
@@ -590,6 +594,13 @@ class Executor:
         """
         if self._journal is None:
             return {"performed": False}
+        with self._tracer.span("recover",
+                               mode="cold" if advance else "warm") as _sp:
+            summary = self._recover_impl(advance, replay)
+            _sp.set("resumed", summary.get("resumed", 0))
+            return summary
+
+    def _recover_impl(self, advance: bool, replay) -> dict:
         t0 = self._clock()
         if replay is None:
             replay = self._journal.replay()
@@ -776,33 +787,45 @@ class Executor:
             # inside the try: a partial throttle-set failure must still clear
             # what was applied and release the executor state
             from cruise_control_tpu.server.async_ops import report_progress
-            if helper is not None:
-                helper.set_throttles([t.proposal for t in planner.replica_tasks])
-            with self._lock:
-                self._state = \
-                    ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
-            report_progress(
-                f"Executing {len(planner.replica_tasks)} inter-broker "
-                f"replica movements")
-            self._move_replicas(planner, concurrency)
-            if logdir_moves and not self._stop_requested.is_set():
+            with self._tracer.span(
+                    "execute", numProposals=len(proposals),
+                    numLogdirMoves=len(logdir_moves)) as _exec_sp:
+                if helper is not None:
+                    helper.set_throttles(
+                        [t.proposal for t in planner.replica_tasks])
                 with self._lock:
                     self._state = ExecutorState.\
-                        INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
-                report_progress(f"Executing {len(logdir_moves)} intra-broker "
-                                f"logdir movements")
-                for lb in self._logdir_batches(logdir_moves):
-                    self._adapter.alter_replica_logdirs(lb)
-                    intra_moves_applied += len(lb)
-                    if self._stop_requested.is_set():
-                        break
-            with self._lock:
-                self._state = ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS
-            report_progress(
-                f"Executing {len(planner.leadership_tasks)} leadership "
-                f"movements")
-            self._move_leadership(planner, leader_concurrency)
-            crashed = False
+                        INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
+                report_progress(
+                    f"Executing {len(planner.replica_tasks)} inter-broker "
+                    f"replica movements")
+                with self._tracer.span("execute-replica-moves",
+                                       tasks=len(planner.replica_tasks)):
+                    self._move_replicas(planner, concurrency)
+                if logdir_moves and not self._stop_requested.is_set():
+                    with self._lock:
+                        self._state = ExecutorState.\
+                            INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
+                    report_progress(f"Executing {len(logdir_moves)} "
+                                    f"intra-broker logdir movements")
+                    with self._tracer.span("execute-logdir-moves",
+                                           moves=len(logdir_moves)):
+                        for lb in self._logdir_batches(logdir_moves):
+                            self._adapter.alter_replica_logdirs(lb)
+                            intra_moves_applied += len(lb)
+                            if self._stop_requested.is_set():
+                                break
+                with self._lock:
+                    self._state = ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS
+                report_progress(
+                    f"Executing {len(planner.leadership_tasks)} leadership "
+                    f"movements")
+                with self._tracer.span(
+                        "execute-leader-moves",
+                        tasks=len(planner.leadership_tasks)):
+                    self._move_leadership(planner, leader_concurrency)
+                _exec_sp.set("stopped", self._stop_requested.is_set())
+                crashed = False
         finally:
             from cruise_control_tpu.common.metrics import REGISTRY
             if helper is not None:

@@ -126,10 +126,15 @@ class LoadMonitor:
                  broker_completeness_cache_size: int = 5,
                  now_fn: Optional[Callable[[], int]] = None,
                  heartbeat: Optional[Callable[[], None]] = None,
-                 store_heartbeat: Optional[Callable[[], None]] = None):
+                 store_heartbeat: Optional[Callable[[], None]] = None,
+                 tracer=None):
         from cruise_control_tpu.monitor.fetcher import MetricFetcherManager
+        from cruise_control_tpu.obs.tracing import NOOP_TRACER
         self._metadata_source = metadata_source
         self._sampler = sampler
+        #: graftscope spans (fetch / aggregate / model-build); the default
+        #: no-op tracer keeps the uninstrumented path allocation-free
+        self._tracer = tracer or NOOP_TRACER
         #: watchdog heartbeats: the sampling pass checks in on every
         #: sample_once, the sample-store flusher after every store write
         self._heartbeat = heartbeat or (lambda: None)
@@ -388,19 +393,22 @@ class LoadMonitor:
             self._state = MonitorState.SAMPLING
         self._heartbeat()
         try:
-            metadata = self._metadata_source.get_metadata()
-            ps, bs = self._fetchers.fetch(
-                metadata, now_ms - self.sampling_interval_ms, now_ms)
-            # chaos-harness seam: fault plans can delay or truncate the
-            # fetched batch right before ingest (tests/test_incremental.py
-            # drives the high-frequency ingest path through this site)
-            ps, bs = _faults.chaos("monitor.ingest", (ps, bs))
-            for s in ps:
-                self._ingest_partition_sample(s)
-            for s in bs:
-                self._ingest_broker_sample(s)
-            self._store.store_samples(ps, bs)
-            self._store_heartbeat()
+            with self._tracer.span("fetch") as _sp:
+                metadata = self._metadata_source.get_metadata()
+                ps, bs = self._fetchers.fetch(
+                    metadata, now_ms - self.sampling_interval_ms, now_ms)
+                # chaos-harness seam: fault plans can delay or truncate the
+                # fetched batch right before ingest (tests/test_incremental.py
+                # drives the high-frequency ingest path through this site)
+                ps, bs = _faults.chaos("monitor.ingest", (ps, bs))
+                for s in ps:
+                    self._ingest_partition_sample(s)
+                for s in bs:
+                    self._ingest_broker_sample(s)
+                self._store.store_samples(ps, bs)
+                self._store_heartbeat()
+                _sp.set("partitionSamples", len(ps))
+                _sp.set("brokerSamples", len(bs))
             return len(ps) + len(bs)
         finally:
             with self._lock:
@@ -553,8 +561,9 @@ class LoadMonitor:
             # update_dirty: this is THE model-build tick — advance the
             # aggregator's dirty baseline and get the per-entity mask the
             # load-column splice and the analyzer rescore key off
-            result = self.partition_aggregator.aggregate(now_ms, requirements,
-                                                         update_dirty=True)
+            with self._tracer.span("aggregate"):
+                result = self.partition_aggregator.aggregate(
+                    now_ms, requirements, update_dirty=True)
             if result.completeness.num_valid_windows < requirements.min_required_num_windows:
                 raise NotEnoughValidWindowsError(
                     f"{result.completeness.num_valid_windows} valid windows, "
@@ -573,9 +582,14 @@ class LoadMonitor:
                 # caller and crashes the analyzer, so refuse to build it
                 raise NotEnoughValidWindowsError(
                     "0 valid partitions in the aggregation windows")
-            return self._build_model(
-                metadata, result,
-                include_all_topics=requirements.include_all_topics)
+            with self._tracer.span("model-build") as _sp:
+                built = self._build_model(
+                    metadata, result,
+                    include_all_topics=requirements.include_all_topics)
+                info = self.last_build_info()
+                if info is not None:
+                    _sp.set("lastModelBuildKind", info.get("kind"))
+            return built
 
     #: partition count above which model build switches to the vectorized
     #: bulk path (same semantics, locked by a parity test)

@@ -1,0 +1,22 @@
+"""graftscope: end-to-end control-loop tracing and a compile observatory.
+
+Two always-available primitives (docs/observability.md):
+
+- :mod:`~cruise_control_tpu.obs.tracing` — lightweight spans over an
+  injected clock (wall or the simulator's virtual clock), a bounded ring
+  buffer of completed spans, and Chrome-trace/Perfetto JSON export.  A
+  disabled tracer is a shared no-op: zero records, zero behavior change
+  (the bit-parity contract the fixture tests pin).
+- :mod:`~cruise_control_tpu.obs.observatory` — the production promotion of
+  the test-only retrace sentinels (common/sentinels.py): per-callsite jit
+  trace/compile counts and compile wall-time, steady-state retrace
+  accounting, transfer-guard violation and device-dispatch counters,
+  surfaced through the metrics registry and ``GET /observatory``.
+"""
+
+from cruise_control_tpu.obs.observatory import OBSERVATORY, Observatory
+from cruise_control_tpu.obs.tracing import (NOOP_SPAN, NOOP_TRACER, Span,
+                                            Tracer)
+
+__all__ = ["Tracer", "Span", "NOOP_SPAN", "NOOP_TRACER", "Observatory",
+           "OBSERVATORY"]

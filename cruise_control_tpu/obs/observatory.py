@@ -1,0 +1,242 @@
+"""Compile/retrace observatory: always-on jit compile accounting.
+
+``common/sentinels.py`` counts retraces inside a test-scoped context
+manager; that catches regressions in CI but says nothing about a
+production control loop that starts retracing at 3am because a topic's
+partition count drifted past a bucket boundary.  The observatory is the
+production promotion: one log handler installed for the process lifetime
+that attributes every jit trace / XLA compile to the function it came
+from, accumulates compile wall-time, and — once the loop declares itself
+*steady* (first successful proposal computed) — counts further traces as
+steady-state retraces.  A steady-state retrace in prod is the PR 8
+silent-degradation class: each one is a multi-second stall on the tick
+path, and enough of them turn a 2-second anneal into a 45-minute greedy
+fallback.  The counters surface through the metrics registry (Prometheus
+``/metrics``) and ``GET /observatory``.
+
+The observatory also owns two host-side tallies the log can't see:
+device-dispatch counts per callsite (how often each jitted entry point
+actually runs) and transfer-guard violations per callsite (an implicit
+host↔device transfer attempted inside a ``no_implicit_transfers`` scope
+— surfaced by the optimizer's engine-fallback handler).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from cruise_control_tpu.common.metrics import REGISTRY
+from cruise_control_tpu.common.sentinels import parse_compile_log
+
+
+class _ObservatoryHandler(logging.Handler):
+    """Routes jax compile-log records into the owning observatory."""
+
+    def __init__(self, obs: "Observatory") -> None:
+        super().__init__(level=logging.DEBUG)
+        self._obs = obs
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            self._obs._on_message(record.getMessage())
+        except Exception:  # graftlint: disable=G009 — surfaced as the
+            # handlerErrors counter in snapshot(); a broken metric must
+            # never break jax logging
+            self._obs._emit_errors += 1
+
+
+class _CompileLogSpamFilter(logging.Filter):
+    """Drops ``jax_log_compiles`` chatter from jax's own stderr handler
+    while the observatory is installed — the observatory consumes those
+    records; one WARNING line per trace/compile would otherwise flood the
+    log for the process lifetime. Non-compile jax messages pass through."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        try:
+            msg = record.getMessage()
+            if parse_compile_log(msg) is not None:
+                return False
+            # intermediate lowering stage the observatory doesn't count,
+            # but still jax_log_compiles chatter
+            return "jaxpr to MLIR module conversion" not in msg
+        except Exception:  # graftlint: disable=G009 — a filter must never
+            # break logging; failing open just re-admits one log line
+            return True
+
+
+class Observatory:
+    """Per-function jit compile accounting for the process lifetime."""
+
+    def __init__(self, registry=REGISTRY,
+                 now_fn: Callable[[], float] = time.monotonic):
+        self._registry = registry
+        self._now = now_fn
+        self._lock = threading.Lock()
+        self._handler: Optional[_ObservatoryHandler] = None
+        self._prev_log_compiles: Optional[bool] = None
+        self._prev_propagate = True
+        self._filtered_handlers: list = []
+        self._installed_at_s: Optional[float] = None
+        self._emit_errors = 0
+        # per-function accounting (fn name -> count / seconds)
+        self._traces: Dict[str, int] = {}
+        self._compiles: Dict[str, int] = {}
+        self._compile_s: Dict[str, float] = {}
+        self._steady_retraces: Dict[str, int] = {}
+        self._steady = False
+        # host-side tallies (callsite label -> count)
+        self._dispatches: Dict[str, int] = {}
+        self._transfer_violations: Dict[str, int] = {}
+
+    # -------------------------------------------------------- lifecycle
+    @property
+    def installed(self) -> bool:
+        with self._lock:
+            return self._handler is not None
+
+    def install(self) -> None:
+        """Attach the compile-log handler (idempotent, process-wide).
+
+        ``jax_log_compiles`` emits at WARNING, so an always-on observatory
+        would spam one stderr line per trace/compile for the process
+        lifetime: jax attaches its own ``StreamHandler`` directly to the
+        ``jax`` logger, which child-logger records reach regardless of
+        ``propagate``.  A :class:`_CompileLogSpamFilter` is therefore
+        attached to every handler already present on ``jax`` (never to the
+        observatory's own), and propagation to any root sinks is stopped;
+        genuine jax warnings still flow everywhere.  Both are undone by
+        :meth:`uninstall`.
+        """
+        import jax
+        jax_logger = logging.getLogger("jax")
+        with self._lock:
+            if self._handler is not None:
+                return
+            handler = self._handler = _ObservatoryHandler(self)
+            self._prev_log_compiles = bool(jax.config.jax_log_compiles)
+            self._prev_propagate = jax_logger.propagate
+            self._installed_at_s = self._now()
+            spam_filter = _CompileLogSpamFilter()
+            filtered = self._filtered_handlers = [
+                (h, spam_filter) for h in list(jax_logger.handlers)
+                if not isinstance(h, _ObservatoryHandler)]
+        jax.config.update("jax_log_compiles", True)
+        for h, f in filtered:
+            h.addFilter(f)
+        jax_logger.addHandler(handler)
+        jax_logger.propagate = False
+
+    def uninstall(self) -> None:
+        import jax
+        with self._lock:
+            handler, self._handler = self._handler, None
+            prev, self._prev_log_compiles = self._prev_log_compiles, None
+            prev_prop = getattr(self, "_prev_propagate", True)
+            filtered = getattr(self, "_filtered_handlers", [])
+            self._filtered_handlers = []
+        if handler is not None:
+            for h, f in filtered:
+                h.removeFilter(f)
+            logging.getLogger("jax").removeHandler(handler)
+            logging.getLogger("jax").propagate = prev_prop
+            jax.config.update("jax_log_compiles", bool(prev))
+
+    # ------------------------------------------------------- accounting
+    def _on_message(self, msg: str) -> None:
+        parsed = parse_compile_log(msg)
+        if parsed is None:
+            return
+        kind, fn, seconds = parsed
+        with self._lock:
+            if kind == "trace":
+                self._traces[fn] = self._traces.get(fn, 0) + 1
+                if self._steady:
+                    self._steady_retraces[fn] = \
+                        self._steady_retraces.get(fn, 0) + 1
+            elif kind == "compile":
+                self._compiles[fn] = self._compiles.get(fn, 0) + 1
+            elif kind == "compile_done" and seconds is not None:
+                self._compile_s[fn] = self._compile_s.get(fn, 0.0) + seconds
+            steady = self._steady
+        if self._registry is not None:
+            if kind == "trace":
+                self._registry.counter("observatory-jit-traces",
+                                       labels={"function": fn})
+                if steady:
+                    self._registry.counter(
+                        "observatory-steady-state-retraces",
+                        labels={"function": fn})
+            elif kind == "compile":
+                self._registry.counter("observatory-xla-compiles",
+                                       labels={"function": fn})
+            elif kind == "compile_done" and seconds is not None:
+                self._registry.timer("observatory-compile-timer",
+                                     labels={"function": fn}).update(seconds)
+
+    def mark_steady(self) -> None:
+        """Declare warmup over: traces from now on are steady-state
+        retraces (the app calls this after its first full proposal)."""
+        with self._lock:
+            self._steady = True
+
+    def mark_warming(self) -> None:
+        """Re-enter warmup (topology change, standby takeover): expected
+        recompiles stop counting against the steady-state budget."""
+        with self._lock:
+            self._steady = False
+
+    def record_dispatch(self, site: str) -> None:
+        """Count one device dispatch of a jitted entry point."""
+        with self._lock:
+            self._dispatches[site] = self._dispatches.get(site, 0) + 1
+        if self._registry is not None:
+            self._registry.counter("observatory-device-dispatches",
+                                   labels={"site": site})
+
+    def record_transfer_guard_violation(self, site: str) -> None:
+        """Count an implicit-transfer violation surfaced at ``site``."""
+        with self._lock:
+            self._transfer_violations[site] = \
+                self._transfer_violations.get(site, 0) + 1
+        if self._registry is not None:
+            self._registry.counter("observatory-transfer-guard-violations",
+                                   labels={"site": site})
+
+    # ---------------------------------------------------------- reading
+    def steady_retrace_count(self) -> int:
+        with self._lock:
+            return sum(self._steady_retraces.values())
+
+    def snapshot(self) -> dict:
+        """JSON view for ``GET /observatory`` (deterministic ordering)."""
+        with self._lock:
+            fns = sorted(set(self._traces) | set(self._compiles)
+                         | set(self._compile_s) | set(self._steady_retraces))
+            per_fn = {fn: {
+                "traces": self._traces.get(fn, 0),
+                "compiles": self._compiles.get(fn, 0),
+                "compileSeconds": round(self._compile_s.get(fn, 0.0), 3),
+                "steadyStateRetraces": self._steady_retraces.get(fn, 0),
+            } for fn in fns}
+            return {
+                "installed": self._handler is not None,
+                "steady": self._steady,
+                "totalTraces": sum(self._traces.values()),
+                "totalCompiles": sum(self._compiles.values()),
+                "totalCompileSeconds": round(
+                    sum(self._compile_s.values()), 3),
+                "steadyStateRetraces": sum(self._steady_retraces.values()),
+                "perFunction": per_fn,
+                "deviceDispatches": dict(sorted(self._dispatches.items())),
+                "transferGuardViolations": dict(
+                    sorted(self._transfer_violations.items())),
+                "handlerErrors": self._emit_errors,
+            }
+
+
+#: process-wide observatory (installed by the app when
+#: ``obs.observatory.enable`` is true; host-side tallies always count)
+OBSERVATORY = Observatory()

@@ -1,0 +1,304 @@
+"""Span tracer: the control-loop timeline over an injected clock.
+
+A :class:`Tracer` produces *spans* — named, attributed intervals arranged
+in a tree — and keeps the completed ones in a bounded ring buffer.  Two
+clocks run side by side:
+
+- ``now_fn`` (injected; the app passes its virtual-time seam) stamps span
+  start/duration — under a ``VirtualClock`` the exported timeline is a
+  pure function of the scenario, byte-identical across same-seed runs;
+- ``time.monotonic`` measures the span's *wall* duration, which feeds the
+  per-stage timers in the metrics registry (``stage-<name>-timer``) — the
+  operational signal Prometheus scrapes.
+
+Context propagation is thread-safe: each thread keeps its own open-span
+stack, and a tracer-level *ambient* parent (set by the app around each
+control-loop tick) lets spans opened on background threads — executor
+progress polling, detector fixes, the escape-kernel warm thread — parent
+to the tick span that caused them.  Explicit ``parent=`` wins over both.
+
+A disabled tracer returns the shared :data:`NOOP_SPAN` from every call:
+no allocation, no records, no timing — the bit-parity contract (tracing
+off ⇒ behavior identical) that the fixture parity tests pin.
+
+Spans MUST be used as context managers (``with tracer.span(...) as sp:``);
+graftlint G012 flags bare ``span()``/``start_span()`` calls that could
+leak an open span on an exception path.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class Span:
+    """One completed span (immutable record in the tracer's ring buffer)."""
+
+    __slots__ = ("name", "span_id", "parent_id", "thread", "start_s",
+                 "dur_s", "wall_dur_s", "attrs")
+
+    def __init__(self, name: str, span_id: int, parent_id: Optional[int],
+                 thread: str, start_s: float, dur_s: float,
+                 wall_dur_s: float, attrs: Dict[str, Any]):
+        self.name = name
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.thread = thread
+        self.start_s = start_s
+        self.dur_s = dur_s
+        self.wall_dur_s = wall_dur_s
+        self.attrs = attrs
+
+    def to_json(self) -> dict:
+        """Deterministic dict: clock fields are now_fn units only (the
+        wall duration is host-dependent and stays out on purpose)."""
+        return {
+            "name": self.name,
+            "spanId": self.span_id,
+            "parentId": self.parent_id,
+            "thread": self.thread,
+            "startS": round(self.start_s, 6),
+            "durS": round(self.dur_s, 6),
+            "attrs": {k: self.attrs[k] for k in sorted(self.attrs)},
+        }
+
+
+class _NoopSpan:
+    """Shared do-nothing span: what a disabled tracer hands out."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def set(self, key: str, value: Any) -> None:
+        pass
+
+    @property
+    def span_id(self) -> None:
+        return None
+
+
+#: the one no-op span instance (identity-comparable in tests)
+NOOP_SPAN = _NoopSpan()
+
+
+class _ActiveSpan:
+    """An open span; records itself into the tracer on ``__exit__``."""
+
+    __slots__ = ("_tracer", "name", "span_id", "parent_id", "attrs",
+                 "_start_s", "_wall_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, span_id: int,
+                 parent_id: Optional[int], attrs: Dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.attrs = attrs
+        self._start_s = 0.0
+        self._wall_t0 = 0.0
+
+    def set(self, key: str, value: Any) -> None:
+        """Attach/overwrite one attribute while the span is open."""
+        self.attrs[key] = value
+
+    def __enter__(self) -> "_ActiveSpan":
+        self._start_s = self._tracer._now()
+        self._wall_t0 = time.monotonic()
+        self._tracer._push(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is not None:
+            self.attrs.setdefault("error", exc_type.__name__)
+        self._tracer._pop(self)
+        self._tracer._record(Span(
+            self.name, self.span_id, self.parent_id,
+            threading.current_thread().name, self._start_s,
+            max(self._tracer._now() - self._start_s, 0.0),
+            max(time.monotonic() - self._wall_t0, 0.0),
+            self.attrs))
+        return False
+
+
+class Tracer:
+    """Bounded-buffer span tracer with cross-thread context propagation."""
+
+    def __init__(self, now_fn: Optional[Callable[[], float]] = None,
+                 capacity: int = 4096, enabled: bool = True,
+                 registry=None):
+        self._now = now_fn or time.monotonic
+        self.enabled = bool(enabled)
+        self.capacity = max(int(capacity), 1)
+        #: metrics registry the per-stage timers derive into (None = off)
+        self._registry = registry
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+        self._ring: List[Span] = []
+        self._ring_start = 0          # index of the oldest retained span
+        self._dropped = 0
+        self._local = threading.local()
+        self._ambient: Optional[int] = None
+
+    # ------------------------------------------------------------ spans
+    def span(self, name: str, parent: Optional[object] = None,
+             **attrs: Any):
+        """Open a span (context manager).  Parent resolution: explicit
+        ``parent`` (an open span or a span id) > this thread's innermost
+        open span > the tracer's ambient parent."""
+        if not self.enabled:
+            return NOOP_SPAN
+        if parent is not None:
+            parent_id = parent if isinstance(parent, int) \
+                else getattr(parent, "span_id", None)
+        else:
+            stack = getattr(self._local, "stack", None)
+            parent_id = stack[-1].span_id if stack else self._ambient
+        with self._lock:
+            span_id = next(self._ids)
+        return _ActiveSpan(self, name, span_id, parent_id, dict(attrs))
+
+    def current_id(self) -> Optional[int]:
+        """Id of this thread's innermost open span (None outside any)."""
+        stack = getattr(self._local, "stack", None)
+        return stack[-1].span_id if stack else None
+
+    # ambient parent: the cross-thread handoff. The app sets it to the
+    # open tick span so background threads' spans join the tick's tree.
+    def set_ambient(self, span: Optional[object]) -> None:
+        self._ambient = span if isinstance(span, (int, type(None))) \
+            else getattr(span, "span_id", None)
+
+    def clear_ambient(self) -> None:
+        self._ambient = None
+
+    # ------------------------------------------------------- internals
+    def _push(self, span: _ActiveSpan) -> None:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        stack.append(span)
+
+    def _pop(self, span: _ActiveSpan) -> None:
+        stack = getattr(self._local, "stack", None)
+        if stack and stack[-1] is span:
+            stack.pop()
+        elif stack and span in stack:     # exited out of order: drop above
+            del stack[stack.index(span):]
+
+    def _record(self, span: Span) -> None:
+        with self._lock:
+            self._ring.append(span)
+            if len(self._ring) > self.capacity:
+                # amortized ring: drop the oldest half in one slice
+                drop = len(self._ring) - self.capacity
+                del self._ring[:drop]
+                self._dropped += drop
+                self._ring_start += drop
+        if self._registry is not None:
+            self._registry.timer(f"stage-{span.name}-timer").update(
+                span.wall_dur_s)
+
+    # --------------------------------------------------------- reading
+    def finished(self) -> List[Span]:
+        """Completed spans, oldest first (bounded by ``capacity``)."""
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._dropped = 0
+
+    def summary(self) -> dict:
+        """Cheap JSON-able view for /observatory and /state."""
+        with self._lock:
+            spans = list(self._ring)
+            dropped = self._dropped
+        by_name: Dict[str, int] = {}
+        for s in spans:
+            by_name[s.name] = by_name.get(s.name, 0) + 1
+        return {
+            "enabled": self.enabled,
+            "capacity": self.capacity,
+            "bufferedSpans": len(spans),
+            "droppedSpans": dropped,
+            "spanCounts": {k: by_name[k] for k in sorted(by_name)},
+        }
+
+    # ---------------------------------------------------------- export
+    def chrome_trace(self) -> dict:
+        """Chrome-trace (``chrome://tracing`` / Perfetto) JSON object.
+
+        Timestamps/durations are ``now_fn`` microseconds, so a virtual-
+        clock run exports a deterministic timeline.  Thread ids are
+        assigned by first appearance (stable for a deterministic run).
+        """
+        spans = self.finished()
+        tids: Dict[str, int] = {}
+        events: List[dict] = []
+        for s in spans:
+            if s.thread not in tids:
+                tids[s.thread] = len(tids)
+                events.append({
+                    "name": "thread_name", "ph": "M", "pid": 0,
+                    "tid": tids[s.thread], "args": {"name": s.thread}})
+            args = {k: s.attrs[k] for k in sorted(s.attrs)}
+            args["spanId"] = s.span_id
+            if s.parent_id is not None:
+                args["parentId"] = s.parent_id
+            events.append({
+                "name": s.name, "ph": "X", "pid": 0, "tid": tids[s.thread],
+                "ts": round(s.start_s * 1e6, 3),
+                "dur": round(s.dur_s * 1e6, 3),
+                "args": args})
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def chrome_trace_json(self) -> str:
+        """Canonical serialization of :meth:`chrome_trace` (byte-stable
+        for deterministic runs — the simulator determinism contract)."""
+        return json.dumps(self.chrome_trace(), sort_keys=True,
+                          separators=(",", ":"))
+
+
+#: shared disabled tracer: the default for every ``tracer=None`` seam —
+#: callers write ``tracer = tracer or NOOP_TRACER`` and instrument
+#: unconditionally; the disabled path allocates nothing
+NOOP_TRACER = Tracer(enabled=False)
+
+
+def stage_breakdown(spans: List[Span]) -> Dict[str, dict]:
+    """Fold span records into a per-stage table: count + total virtual
+    duration (deterministic — scorecard core) keyed by span name."""
+    out: Dict[str, Dict[str, float]] = {}
+    for s in spans:
+        ent = out.setdefault(s.name, {"count": 0, "virtualMsTotal": 0.0})
+        ent["count"] += 1
+        ent["virtualMsTotal"] += s.dur_s * 1000.0
+    return {name: {"count": ent["count"],
+                   "virtualMsTotal": round(ent["virtualMsTotal"], 3)}
+            for name, ent in sorted(out.items())}
+
+
+def stage_wall_percentiles(spans: List[Span]) -> Dict[str, dict]:
+    """Host-dependent per-stage wall percentiles (scorecard wall section)."""
+    by_name: Dict[str, List[float]] = {}
+    for s in spans:
+        by_name.setdefault(s.name, []).append(s.wall_dur_s * 1000.0)
+    out = {}
+    for name, vals in sorted(by_name.items()):
+        vals.sort()
+        def pct(p: float) -> float:
+            idx = min(int(len(vals) * p), len(vals) - 1)
+            return round(vals[idx], 3)
+        out[name] = {"wallMsP50": pct(0.50), "wallMsP99": pct(0.99),
+                     "wallMsMax": round(vals[-1], 3)}
+    return out

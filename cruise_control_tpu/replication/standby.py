@@ -178,13 +178,19 @@ class WarmStandby:
         ex = executor or self._executor
         if ex is None:
             raise RuntimeError("WarmStandby.promote() needs an executor")
-        epoch = self._lease.acquire()
-        self.journal = ExecutionJournal(
-            self._tailer.path, fsync=self._fsync, now_ms=self._now_ms,
-            epoch_path=self._lease.path, entries_hint=self._tailer.entries)
-        ex.attach_journal(self.journal)
-        summary = ex.recover(advance=False,
-                             replay=self._tailer.replay_state(epoch=epoch))
+        from cruise_control_tpu.obs.tracing import NOOP_TRACER
+        tracer = getattr(ex, "_tracer", None) or NOOP_TRACER
+        with tracer.span("standby-takeover",
+                         lagRecords=self._tailer.lag_records) as _sp:
+            epoch = self._lease.acquire()
+            self.journal = ExecutionJournal(
+                self._tailer.path, fsync=self._fsync, now_ms=self._now_ms,
+                epoch_path=self._lease.path,
+                entries_hint=self._tailer.entries)
+            ex.attach_journal(self.journal)
+            summary = ex.recover(advance=False,
+                                 replay=self._tailer.replay_state(epoch=epoch))
+            _sp.set("epoch", epoch)
         self.role = "leader"
         self.takeovers += 1
         self.last_takeover = summary

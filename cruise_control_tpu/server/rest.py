@@ -1,4 +1,4 @@
-"""REST API: the 23-endpoint servlet over the service facade.
+"""REST API: the 24-endpoint servlet over the service facade.
 
 Rebuild of ``servlet/KafkaCruiseControlServlet.java:95-135`` +
 ``servlet/CruiseControlEndPoint.java:16-36`` on the stdlib threading HTTP
@@ -53,6 +53,7 @@ _ENDPOINT_TABLE = (
     ("USER_TASKS", "GET", "CRUISE_CONTROL_MONITOR"),
     ("REVIEW_BOARD", "GET", "CRUISE_CONTROL_MONITOR"),
     ("METRICS", "GET", "CRUISE_CONTROL_MONITOR"),
+    ("OBSERVATORY", "GET", "CRUISE_CONTROL_MONITOR"),
     ("WHAT_IF", "GET", "KAFKA_MONITOR"),
     # -- POST -------------------------------------------------------------
     ("ADD_BROKER", "POST", "KAFKA_ADMIN"),
@@ -391,8 +392,21 @@ class RestApi:
                                            False))
 
     def _metrics(self, params, client_id, request_url):
+        """Metrics registry scrape. Default is the JSON snapshot;
+        ``format=prometheus`` returns the text exposition format (the
+        HTTP layer serves a str payload as
+        ``text/plain; version=0.0.4`` verbatim)."""
         from cruise_control_tpu.common.metrics import REGISTRY
+        if str(params.get("format", "")).strip().lower() == "prometheus":
+            return 200, REGISTRY.prometheus()
         return 200, REGISTRY.snapshot()
+
+    def _observatory(self, params, client_id, request_url):
+        """Compile/retrace observatory: per-function jit trace / XLA
+        compile counts, compile wall-time, steady-state retraces,
+        device dispatches, transfer-guard violations — plus the span
+        tracer summary (docs/observability.md)."""
+        return 200, self.app.observability_state()
 
     def _proposals(self, params, client_id, request_url):
         if _parse_bool(params, "kafka_assigner", False):
@@ -961,7 +975,11 @@ class _Handler(BaseHTTPRequestHandler):
         # json=false → text/plain rendering (the reference's default wire
         # format; ParameterUtils JSON_PARAM)
         as_json = str(params.get("json", "true")).strip().lower() != "false"
-        if as_json:
+        if isinstance(payload, str):
+            # pre-rendered text payload (/metrics?format=prometheus)
+            data = payload.encode()
+            ctype = "text/plain; version=0.0.4"
+        elif as_json:
             data = json.dumps(payload, indent=2, default=str).encode()
             ctype = "application/json"
         else:

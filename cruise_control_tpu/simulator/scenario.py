@@ -91,11 +91,21 @@ class Scorecard:
 
     core: dict
     wall: dict
+    #: Chrome-trace export of the measured ticks (virtual-clock µs);
+    #: carried out-of-band — not part of to_json()/the /state surface
+    trace: Optional[dict] = None
 
     def canonical_json(self) -> str:
         """Byte-stable serialization of the deterministic core — two runs
         of the same (seed, scenario) must produce identical strings."""
         return json.dumps(self.core, sort_keys=True, separators=(",", ":"))
+
+    def trace_json(self) -> Optional[str]:
+        """Canonical Chrome-trace JSON of the measured ticks (None when
+        tracing was disabled) — byte-stable for a deterministic run."""
+        if self.trace is None:
+            return None
+        return json.dumps(self.trace, sort_keys=True, separators=(",", ":"))
 
     def to_json(self) -> dict:
         return {**self.core, "wall": self.wall}
@@ -131,6 +141,10 @@ def _scenario_config(sc: Scenario):
         # no watchdog monitor thread under virtual time — the tick loop
         # calls watchdog.poll() itself
         "watchdog.interval.ms": 0,
+        # graftscope: span the measured ticks on the virtual clock so the
+        # scorecard's per-stage breakdown (and the exported Chrome trace)
+        # is a deterministic function of the scenario
+        "obs.tracing.enable": True,
     }
     if sc.warm_standby:
         # lease timing in tick units: the leader renews every tick, so a
@@ -390,6 +404,9 @@ def run_scenario(sc: Scenario, use_sentinel: bool = False,
         settle()
 
     # ---- measurement baselines (warmup movement must not count)
+    # warmup spans out of the ring: the scorecard's stage breakdown (and
+    # the exported trace) covers exactly the measured ticks
+    app.tracer.clear()
     base_moves = cluster.moves_applied
     base_lmoves = cluster.leadership_moves_applied
     base_churn = dict(cluster.move_count_by_tp)
@@ -412,143 +429,148 @@ def run_scenario(sc: Scenario, use_sentinel: bool = False,
     ctx = SENT.retrace_sentinel() if use_sentinel else nullcontext()
     with ctx as rlog:
         for tick in range(sc.ticks):
-            for ev in sc.faults.direct_at(tick):
-                _apply_direct(ev, cluster, wrapper, app)
-                direct_fired += 1
-            if not sc.faults.direct_at(tick):
-                # per-tick transient windows (a mid-execution kill armed
-                # above must not be clobbered by the window plan this tick)
-                plan = sc.faults.plan_for_tick(tick)
-                if (wrapper.plan.process_crash_after_calls is not None
-                        and not wrapper._crashed):
-                    # an armed-but-unfired process crash persists across
-                    # window swaps: the process dies at its Nth guarded
-                    # call whichever tick that lands in
-                    plan = dataclasses.replace(
-                        plan, process_crash_after_calls=(
-                            wrapper.plan.process_crash_after_calls))
-                wrapper.set_plan(plan)
-            ingest()
-            if not leader_dead:
-                replication_tick()
-            m0 = cluster.moves_applied
-            l0 = cluster.leadership_moves_applied
-            t0 = _time.perf_counter()
-            if leader_dead:
-                # the leader is down and a standby exists: no control
-                # plane serves this tick. The standby keeps tailing the
-                # (frozen) journal and watches the lease; once it expires
-                # the standby advances the epoch and takes over from its
-                # already-tailed state — no cold rebuild, no full replay.
-                computed = False
-                rec_t0 = _time.perf_counter()
-                standby.poll()
-                takeover = standby.maybe_takeover()
-                if takeover is not None:
-                    app = standby_app
-                    app.journal = standby.journal
-                    wrapper.on_crash = standby.journal.freeze
-                    recovery_walls.append(
-                        round((_time.perf_counter() - rec_t0) * 1000.0, 3))
-                    crash_recoveries.append({
-                        **takeover, "tick": dead_tick, "takeoverTick": tick,
-                        "takeoverTicks": tick - dead_tick,
-                        "mode": "warm_takeover"})
-                    # the fenced ex-leader provably cannot mutate: its
-                    # next append refuses with StaleEpochError and its
-                    # held epoch predates the lease-claimed one
-                    try:
-                        dead_app.journal.log_execution_end("zombie-probe")
-                        zombie_fenced = False
-                    except StaleEpochError:
-                        zombie_fenced = (dead_app.journal.epoch
-                                         < standby.journal.epoch)
-                    leader_dead = False
-                    computed = bool(app.precompute_tick())
-                    app.anomaly_detector.sweep()
-                    app.anomaly_detector.handle_pending()
-            else:
-                try:
-                    computed = app.precompute_tick()
-                    app.anomaly_detector.sweep()
-                    app.anomaly_detector.handle_pending()
-                except ProcessCrashed:
+            # one span per measured tick, opened BEFORE ingest (the virtual
+            # clock advances one window inside it) so the exported timeline
+            # covers the tick's full virtual duration
+            with app.tracer.span("tick", tick=tick) as _tick_sp:
+                for ev in sc.faults.direct_at(tick):
+                    _apply_direct(ev, cluster, wrapper, app)
+                    direct_fired += 1
+                if not sc.faults.direct_at(tick):
+                    # per-tick transient windows (a mid-execution kill armed
+                    # above must not be clobbered by the window plan this tick)
+                    plan = sc.faults.plan_for_tick(tick)
+                    if (wrapper.plan.process_crash_after_calls is not None
+                            and not wrapper._crashed):
+                        # an armed-but-unfired process crash persists across
+                        # window swaps: the process dies at its Nth guarded
+                        # call whichever tick that lands in
+                        plan = dataclasses.replace(
+                            plan, process_crash_after_calls=(
+                                wrapper.plan.process_crash_after_calls))
+                    wrapper.set_plan(plan)
+                ingest()
+                if not leader_dead:
+                    replication_tick()
+                m0 = cluster.moves_applied
+                l0 = cluster.leadership_moves_applied
+                t0 = _time.perf_counter()
+                if leader_dead:
+                    # the leader is down and a standby exists: no control
+                    # plane serves this tick. The standby keeps tailing the
+                    # (frozen) journal and watches the lease; once it expires
+                    # the standby advances the epoch and takes over from its
+                    # already-tailed state — no cold rebuild, no full replay.
                     computed = False
-                    if standby is not None and standby.role == "follower":
-                        # leader killed with a live standby attached:
-                        # leave the corpse fenced and let the lease run
-                        # out (scored as takeoverTicks)
-                        leader_dead = True
-                        dead_tick = tick
-                        dead_app = app
-                    else:
-                        # no standby: the PR 10 path. Rebuild the app
-                        # against the SAME simulated cluster/clock/chaos
-                        # wrapper — a new process on the same host — and
-                        # run cold restart reconciliation (full replay).
-                        rec_t0 = _time.perf_counter()
-                        _, _, _, app = build_app(
-                            sc, clock=clock, cluster=cluster,
-                            wrapper=wrapper,
-                            sampler=app.load_monitor._sampler)
-                        wrapper.on_crash = (app.journal.freeze
-                                            if app.journal is not None
-                                            else None)
-                        recovery = (app.executor.recover()
-                                    if app.journal is not None
-                                    else {"performed": False})
-                        recovery_walls.append(round(
-                            (_time.perf_counter() - rec_t0) * 1000.0, 3))
-                        crash_recoveries.append(
-                            {**recovery, "tick": tick,
-                             "mode": "cold_restart"})
-            app.watchdog.poll()
-            wall_ms = (_time.perf_counter() - t0) * 1000.0
-            tick_walls.append(wall_ms)
-            with app._cache_lock:
-                res = (app._proposal_cache.result
-                       if app._proposal_cache is not None else None)
-                fb = app._last_fallback
-                pr = app._last_provision_recommendation
-            if fb is not None and fb is not last_fb:
-                fallback_events += 1
-                if fb.get("reason") and fb["reason"] not in fallback_reasons:
-                    fallback_reasons.append(fb["reason"])
-            last_fb = fb
-            status = (pr or {}).get("status")
-            if status and (not provision_statuses
-                           or provision_statuses[-1] != status):
-                provision_statuses.append(status)
-            records.append({
-                "tick": tick,
-                "computed": bool(computed),
-                "engine": res.engine if res is not None else None,
-                "replicaMoves": cluster.moves_applied - m0,
-                "leadershipMoves": cluster.leadership_moves_applied - l0,
-                "validWindows": valid_windows(app),
-            })
-            for ev in kills:
-                if ev.broker_id in evac_tick or ev.tick > tick:
-                    continue
-                if not cluster.replicas_on_broker(ev.broker_id):
-                    evac_tick[ev.broker_id] = tick
-            if score_goals:
-                try:
-                    topo, assign = app._model()
-                    snap = SC.snapshot_model(topo, assign)
-                    if base_topo is None:
-                        base_topo = topo
-                        base_shapes = {k: v.shape for k, v in snap.items()}
-                    if {k: v.shape for k, v in snap.items()} == base_shapes:
-                        snapshots.append(snap)
-                    else:
-                        # the valid-partition set shrank this tick (e.g. the
-                        # monitor starved through a latency storm): a
-                        # different-shaped model cannot join the vmapped
-                        # timeline stack — count the tick as unscored
+                    rec_t0 = _time.perf_counter()
+                    standby.poll()
+                    takeover = standby.maybe_takeover()
+                    if takeover is not None:
+                        app = standby_app
+                        app.journal = standby.journal
+                        wrapper.on_crash = standby.journal.freeze
+                        recovery_walls.append(
+                            round((_time.perf_counter() - rec_t0) * 1000.0, 3))
+                        crash_recoveries.append({
+                            **takeover, "tick": dead_tick, "takeoverTick": tick,
+                            "takeoverTicks": tick - dead_tick,
+                            "mode": "warm_takeover"})
+                        # the fenced ex-leader provably cannot mutate: its
+                        # next append refuses with StaleEpochError and its
+                        # held epoch predates the lease-claimed one
+                        try:
+                            dead_app.journal.log_execution_end("zombie-probe")
+                            zombie_fenced = False
+                        except StaleEpochError:
+                            zombie_fenced = (dead_app.journal.epoch
+                                             < standby.journal.epoch)
+                        leader_dead = False
+                        computed = bool(app.precompute_tick())
+                        app.anomaly_detector.sweep()
+                        app.anomaly_detector.handle_pending()
+                else:
+                    try:
+                        computed = app.precompute_tick()
+                        app.anomaly_detector.sweep()
+                        app.anomaly_detector.handle_pending()
+                    except ProcessCrashed:
+                        computed = False
+                        if standby is not None and standby.role == "follower":
+                            # leader killed with a live standby attached:
+                            # leave the corpse fenced and let the lease run
+                            # out (scored as takeoverTicks)
+                            leader_dead = True
+                            dead_tick = tick
+                            dead_app = app
+                        else:
+                            # no standby: the PR 10 path. Rebuild the app
+                            # against the SAME simulated cluster/clock/chaos
+                            # wrapper — a new process on the same host — and
+                            # run cold restart reconciliation (full replay).
+                            rec_t0 = _time.perf_counter()
+                            _, _, _, app = build_app(
+                                sc, clock=clock, cluster=cluster,
+                                wrapper=wrapper,
+                                sampler=app.load_monitor._sampler)
+                            wrapper.on_crash = (app.journal.freeze
+                                                if app.journal is not None
+                                                else None)
+                            recovery = (app.executor.recover()
+                                        if app.journal is not None
+                                        else {"performed": False})
+                            recovery_walls.append(round(
+                                (_time.perf_counter() - rec_t0) * 1000.0, 3))
+                            crash_recoveries.append(
+                                {**recovery, "tick": tick,
+                                 "mode": "cold_restart"})
+                app.watchdog.poll()
+                wall_ms = (_time.perf_counter() - t0) * 1000.0
+                tick_walls.append(wall_ms)
+                with app._cache_lock:
+                    res = (app._proposal_cache.result
+                           if app._proposal_cache is not None else None)
+                    fb = app._last_fallback
+                    pr = app._last_provision_recommendation
+                if fb is not None and fb is not last_fb:
+                    fallback_events += 1
+                    if fb.get("reason") and fb["reason"] not in fallback_reasons:
+                        fallback_reasons.append(fb["reason"])
+                last_fb = fb
+                status = (pr or {}).get("status")
+                if status and (not provision_statuses
+                               or provision_statuses[-1] != status):
+                    provision_statuses.append(status)
+                records.append({
+                    "tick": tick,
+                    "computed": bool(computed),
+                    "engine": res.engine if res is not None else None,
+                    "replicaMoves": cluster.moves_applied - m0,
+                    "leadershipMoves": cluster.leadership_moves_applied - l0,
+                    "validWindows": valid_windows(app),
+                })
+                for ev in kills:
+                    if ev.broker_id in evac_tick or ev.tick > tick:
+                        continue
+                    if not cluster.replicas_on_broker(ev.broker_id):
+                        evac_tick[ev.broker_id] = tick
+                if score_goals:
+                    try:
+                        topo, assign = app._model()
+                        snap = SC.snapshot_model(topo, assign)
+                        if base_topo is None:
+                            base_topo = topo
+                            base_shapes = {k: v.shape for k, v in snap.items()}
+                        if {k: v.shape for k, v in snap.items()} == base_shapes:
+                            snapshots.append(snap)
+                        else:
+                            # the valid-partition set shrank this tick (e.g. the
+                            # monitor starved through a latency storm): a
+                            # different-shaped model cannot join the vmapped
+                            # timeline stack — count the tick as unscored
+                            snapshots.append(None)
+                    except NotEnoughValidWindowsError:
                         snapshots.append(None)
-                except NotEnoughValidWindowsError:
-                    snapshots.append(None)
+                _tick_sp.set("computed", bool(computed))
     uncovered = SENT.check_steady_state(rlog) if use_sentinel else None
 
     # ---- batched scoring of the whole timeline (outside the sentinel:
@@ -665,7 +687,18 @@ def run_scenario(sc: Scenario, use_sentinel: bool = False,
         wall["recoveryWallMs"] = recovery_walls
     if uncovered is not None:
         wall["uncoveredRetraces"] = [str(u) for u in uncovered]
-    card = Scorecard(core=core, wall=wall)
+    # per-stage breakdown from the measured ticks' spans: counts + virtual
+    # durations are deterministic (scorecard core); wall percentiles are
+    # host-dependent (wall section). The raw Chrome trace rides out-of-band
+    # on the Scorecard object.
+    trace = None
+    spans = app.tracer.finished()
+    if spans:
+        from cruise_control_tpu.obs import tracing as TR
+        core["stageBreakdown"] = TR.stage_breakdown(spans)
+        wall["stageWallPercentiles"] = TR.stage_wall_percentiles(spans)
+        trace = app.tracer.chrome_trace()
+    card = Scorecard(core=core, wall=wall, trace=trace)
     app.record_simulation_scorecard(card.to_json())
     if standby is not None:
         standby.stop()

@@ -1,0 +1,586 @@
+"""Observability suite: span tracing, compile/retrace observatory, anneal
+telemetry, and the tracing-off bit-parity contract.
+
+What this file pins:
+
+- TRACER SEMANTICS: nesting, cross-thread parenting via the ambient seam,
+  bounded ring buffer, error attribution, stage-timer derivation, and the
+  Chrome-trace export being a pure function of the injected clock.
+- OBSERVATORY: jax compile-log parsing into per-function trace/compile
+  accounting, the warming→steady transition, and a seeded steady-state
+  retrace surfacing through the REAL REST ``/observatory`` and Prometheus
+  ``/metrics`` endpoints — no test-scoped sentinel involved.
+- BIT-PARITY: running the optimizer with tracing + telemetry enabled
+  produces the same assignment, bit for bit, as with both disabled (the
+  telemetry rides the PT scan carry and folds existing accept masks — no
+  new RNG draws, no new host syncs).
+- SIMULATOR: a 50-tick scenario's span timeline is byte-identical across
+  same-seed runs and covers >= 95% of every measured tick's virtual
+  duration.
+- G012: the leaked-span lint rule, and the obs/ baseline-free gate.
+
+The anneal config deliberately MATCHES test_rawspeed/test_bucketing
+(8 chains x 128 steps, tries 8/4/4) so the parity tests reuse already-
+compiled programs in a one-process tier-1 run.
+"""
+
+import json
+import logging
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import optimizer as OPT
+from cruise_control_tpu.analyzer.annealer import AnnealConfig
+from cruise_control_tpu.common.metrics import MetricsRegistry
+from cruise_control_tpu.models import fixtures
+from cruise_control_tpu.obs import tracing as TR
+from cruise_control_tpu.obs.observatory import OBSERVATORY, Observatory
+from cruise_control_tpu.obs.tracing import NOOP_SPAN, NOOP_TRACER, Tracer
+
+pytestmark = pytest.mark.obs
+
+W = 60_000
+
+
+class _Clock:
+    """Deterministic injectable clock (seconds)."""
+
+    def __init__(self, t: float = 0.0, step: float = 0.0):
+        self.t = t
+        self.step = step
+
+    def __call__(self) -> float:
+        out = self.t
+        self.t += self.step
+        return out
+
+
+# --------------------------------------------------------------- tracer
+
+
+def test_span_nesting_and_attrs():
+    clk = _Clock(step=1.0)
+    tr = Tracer(now_fn=clk)
+    with tr.span("outer", a=1) as outer:
+        with tr.span("inner") as inner:
+            inner.set("k", "v")
+            assert tr.current_id() == inner.span_id
+        assert tr.current_id() == outer.span_id
+    assert tr.current_id() is None
+    spans = tr.finished()
+    assert [s.name for s in spans] == ["inner", "outer"]  # close order
+    by_name = {s.name: s for s in spans}
+    assert by_name["inner"].parent_id == by_name["outer"].span_id
+    assert by_name["outer"].parent_id is None
+    assert by_name["outer"].attrs == {"a": 1}
+    assert by_name["inner"].attrs == {"k": "v"}
+    # each __enter__/__exit__ reads the clock once -> deterministic durs
+    assert by_name["inner"].dur_s > 0
+    assert by_name["outer"].start_s < by_name["inner"].start_s
+
+
+def test_cross_thread_span_tree_via_ambient():
+    """A span opened on a worker thread parents to the tick span the app
+    published as ambient — the executor/detector/watchdog handoff."""
+    tr = Tracer(now_fn=_Clock(step=0.5))
+    seen = {}
+
+    def worker():
+        with tr.span("background") as sp:
+            seen["parent"] = sp.parent_id
+        with tr.span("explicit", parent=7) as sp2:
+            seen["explicit"] = sp2.parent_id
+
+    with tr.span("tick") as tick:
+        tr.set_ambient(tick)
+        t = threading.Thread(target=worker, name="bg-worker")
+        t.start()
+        t.join()
+        tr.clear_ambient()
+    assert seen["parent"] == tick.span_id       # ambient handoff
+    assert seen["explicit"] == 7                # explicit parent wins
+    by_name = {s.name: s for s in tr.finished()}
+    assert by_name["background"].thread == "bg-worker"
+    # after clear_ambient, a stackless thread's span is a root again
+    done = []
+    t2 = threading.Thread(
+        target=lambda: done.append(tr.span("late").__enter__().__exit__(
+            None, None, None)))
+    t2.start(); t2.join()
+    assert {s.name: s.parent_id for s in tr.finished()}["late"] is None
+
+
+def test_ring_buffer_bounds_and_drop_count():
+    tr = Tracer(now_fn=_Clock(step=0.1), capacity=4)
+    for i in range(10):
+        with tr.span(f"s{i}"):
+            pass
+    summ = tr.summary()
+    assert summ["bufferedSpans"] <= 4
+    assert summ["droppedSpans"] == 10 - summ["bufferedSpans"]
+    # the retained spans are the newest ones
+    assert tr.finished()[-1].name == "s9"
+    tr.clear()
+    assert tr.summary()["bufferedSpans"] == 0
+    assert tr.summary()["droppedSpans"] == 0
+
+
+def test_disabled_tracer_is_shared_noop():
+    tr = Tracer(enabled=False)
+    sp = tr.span("anything", key="val")
+    assert sp is NOOP_SPAN                    # shared instance, no alloc
+    assert NOOP_TRACER.span("x") is NOOP_SPAN
+    with sp as s:
+        s.set("k", 1)                          # all no-ops
+    assert tr.finished() == []
+    assert tr.summary()["enabled"] is False
+
+
+def test_span_error_attribution_and_propagation():
+    tr = Tracer(now_fn=_Clock(step=1.0))
+    with pytest.raises(ValueError):
+        with tr.span("boom"):
+            raise ValueError("nope")
+    (span,) = tr.finished()
+    assert span.attrs["error"] == "ValueError"
+    assert tr.current_id() is None             # stack balanced on error
+
+
+def test_stage_timers_derive_into_registry():
+    reg = MetricsRegistry()
+    tr = Tracer(now_fn=_Clock(step=1.0), registry=reg)
+    with tr.span("fetch"):
+        pass
+    with tr.span("fetch"):
+        pass
+    snap = reg.snapshot()
+    assert snap["stage-fetch-timer-count"] == 2
+
+
+def test_chrome_trace_export_is_deterministic_and_valid():
+    def run():
+        tr = Tracer(now_fn=_Clock(step=2.0))
+        with tr.span("tick", tick=0) as t:
+            with tr.span("fetch"):
+                pass
+            t.set("computed", True)
+        return tr
+
+    j1, j2 = run().chrome_trace_json(), run().chrome_trace_json()
+    assert j1 == j2                            # pure function of the clock
+    doc = json.loads(j1)
+    assert doc["displayTimeUnit"] == "ms"
+    events = doc["traceEvents"]
+    meta = [e for e in events if e["ph"] == "M"]
+    xs = [e for e in events if e["ph"] == "X"]
+    assert meta and meta[0]["name"] == "thread_name"
+    by_name = {e["name"]: e for e in xs}
+    # ts/dur are now_fn microseconds; the fake clock steps 2 s per read
+    assert by_name["fetch"]["dur"] == 2e6
+    assert by_name["fetch"]["args"]["parentId"] == \
+        by_name["tick"]["args"]["spanId"]
+    assert by_name["tick"]["args"]["computed"] is True
+
+
+def test_stage_breakdown_and_wall_percentiles():
+    tr = Tracer(now_fn=_Clock(step=1.0))
+    for _ in range(3):
+        with tr.span("decode"):
+            pass
+    spans = tr.finished()
+    bd = TR.stage_breakdown(spans)
+    assert bd["decode"]["count"] == 3
+    assert bd["decode"]["virtualMsTotal"] == 3000.0
+    wall = TR.stage_wall_percentiles(spans)
+    assert set(wall["decode"]) == {"wallMsP50", "wallMsP99", "wallMsMax"}
+
+
+# ----------------------------------------------------------- observatory
+
+
+def test_observatory_counts_traces_compiles_and_steady_retraces():
+    reg = MetricsRegistry()
+    obs = Observatory(registry=reg, now_fn=_Clock(step=1.0))
+    obs.install()
+    try:
+        assert obs.installed
+        obs.install()                          # idempotent
+        jlog = logging.getLogger("jax._src.dispatch")
+        jlog.warning(
+            "Finished tracing + transforming foo for pjit in 0.001 sec")
+        jlog.warning("Compiling foo with global shapes and types [f32[4]].")
+        jlog.warning("Finished XLA compilation of jit(foo) in 0.25 sec")
+        snap = obs.snapshot()
+        assert snap["perFunction"]["foo"] == {
+            "traces": 1, "compiles": 1, "compileSeconds": 0.25,
+            "steadyStateRetraces": 0}
+        assert snap["steady"] is False
+        # warming -> steady: the NEXT trace is a steady-state retrace
+        obs.mark_steady()
+        jlog.warning(
+            "Finished tracing + transforming foo for pjit in 0.001 sec")
+        assert obs.steady_retrace_count() == 1
+        # back to warming (topology change): expected recompiles are free
+        obs.mark_warming()
+        jlog.warning(
+            "Finished tracing + transforming foo for pjit in 0.001 sec")
+        assert obs.steady_retrace_count() == 1
+        # host-side tallies
+        obs.record_dispatch("anneal")
+        obs.record_dispatch("anneal")
+        obs.record_transfer_guard_violation("decode")
+        snap = obs.snapshot()
+        assert snap["deviceDispatches"] == {"anneal": 2}
+        assert snap["transferGuardViolations"] == {"decode": 1}
+        assert snap["totalTraces"] == 3
+        # counters surfaced in the registry with function labels
+        prom = reg.prometheus()
+        assert ('kafka_cruisecontrol_observatory_jit_traces_total'
+                '{function="foo"} 3') in prom
+        assert ('kafka_cruisecontrol_observatory_steady_state_retraces_total'
+                '{function="foo"} 1') in prom
+    finally:
+        obs.uninstall()
+    assert not obs.installed
+
+
+def test_observatory_suppresses_compile_spam_from_jax_stderr_handler():
+    """While installed, jax's own stderr handler must not re-print every
+    compile log line — but NON-compile jax warnings still pass."""
+    def _spam_filters():
+        return [f for h in logging.getLogger("jax").handlers
+                for f in h.filters
+                if f.__class__.__name__ == "_CompileLogSpamFilter"]
+
+    before = set(map(id, _spam_filters()))
+    obs = Observatory(registry=None)
+    obs.install()
+    try:
+        fresh = [f for f in _spam_filters() if id(f) not in before]
+        assert fresh, "spam filter not attached to jax's own handlers"
+        f = fresh[0]
+        rec = logging.LogRecord("jax._src.dispatch", logging.WARNING, "", 0,
+                                "Finished tracing + transforming foo for "
+                                "pjit in 0.001 sec", (), None)
+        assert f.filter(rec) is False          # compile chatter dropped
+        rec2 = logging.LogRecord("jax._src.dispatch", logging.WARNING, "", 0,
+                                 "Finished jaxpr to MLIR module conversion "
+                                 "jit(foo) in 0.1 sec", (), None)
+        assert f.filter(rec2) is False         # lowering chatter dropped
+        rec3 = logging.LogRecord("jax", logging.WARNING, "", 0,
+                                 "some genuine warning", (), None)
+        assert f.filter(rec3) is True          # real warnings pass
+    finally:
+        obs.uninstall()
+    # uninstall removed exactly the filters it added (a process-wide
+    # singleton installed by earlier tests keeps its own)
+    assert set(map(id, _spam_filters())) == before
+
+
+# ------------------------------------------------------------ bit-parity
+
+#: matches test_rawspeed/test_bucketing so programs are already compiled
+#: in a one-process tier-1 run
+CFG = AnnealConfig(num_chains=8, steps=128, swap_interval=32,
+                   tries_move=8, tries_lead=4, tries_swap=4)
+
+
+def _optimize(topo, assign, **kw):
+    kw.setdefault("engine", "anneal")
+    kw.setdefault("anneal_config", CFG)
+    kw.setdefault("seed", 5)
+    kw.setdefault("polish_cycles", 0)
+    return OPT.optimize(topo, assign, **kw)
+
+
+@pytest.mark.parametrize("fixture", ["unbalanced", "small_cluster_model",
+                                     "dead_broker"])
+def test_tracing_and_telemetry_off_is_bit_identical(fixture):
+    """The instrumentation contract: tracing + telemetry enabled must not
+    perturb the optimizer by one bit (telemetry folds the existing accept
+    masks in the scan carry; spans only bracket host code)."""
+    topo, assign = getattr(fixtures, fixture)()
+    plain = _optimize(topo, assign)
+    traced = _optimize(topo, assign, anneal_telemetry=True,
+                       tracer=Tracer(now_fn=_Clock(step=0.001)))
+    a, b = plain.final_assignment, traced.final_assignment
+    assert np.array_equal(np.asarray(a.broker_of), np.asarray(b.broker_of))
+    assert np.array_equal(np.asarray(a.leader_of), np.asarray(b.leader_of))
+    assert plain.violated_goals_after == traced.violated_goals_after
+    # telemetry is stamped only when requested
+    assert plain.anneal_telemetry is None
+    tel = traced.anneal_telemetry
+    assert tel is not None
+    assert tel["numChains"] == CFG.num_chains
+    assert len(tel["ladderTemps"]) == CFG.num_chains
+    for fam in ("move", "lead", "swap"):
+        rates = tel["acceptRates"][fam]
+        assert len(rates) == CFG.num_chains
+        assert all(0.0 <= r <= 1.0 for r in rates)
+    assert len(tel["exchangeAttempts"]) == CFG.num_chains
+    curve = tel["bestEnergyCurve"]
+    assert len(curve) == tel["rounds"]
+    assert all(np.isfinite(v) for v in curve)
+    # trend signal: the search never ends above where it started
+    assert curve[-1] <= curve[0]
+    assert "annealTelemetry" in traced.to_json()
+
+
+# ---------------------------------------------------- REST + observatory
+
+from cruise_control_tpu.app import CruiseControlApp
+from cruise_control_tpu.common.config import CruiseControlConfig
+from cruise_control_tpu.executor.executor import FakeClusterAdapter
+from cruise_control_tpu.monitor.load_monitor import StaticMetadataSource
+from cruise_control_tpu.monitor.sampler import (
+    BrokerMetadata,
+    ClusterMetadata,
+    PartitionMetadata,
+    SyntheticLoadSampler,
+)
+from cruise_control_tpu.server import rest
+
+
+def _metadata(num_brokers=6, num_parts=30, rf=2):
+    brokers = [BrokerMetadata(i, rack=f"r{i % 3}", host=f"h{i}")
+               for i in range(num_brokers)]
+    parts = []
+    for p in range(num_parts):
+        reps = tuple((p + j) % num_brokers for j in range(rf))
+        parts.append(PartitionMetadata("T", p, leader=reps[0],
+                                       replicas=reps))
+    return ClusterMetadata(brokers=brokers, partitions=parts, generation=1)
+
+
+def _obs_app():
+    cfg = CruiseControlConfig({
+        "optimizer.engine": "greedy",
+        "partition.metrics.window.ms": W,
+        "num.partition.metrics.windows": 3,
+        "min.valid.partition.ratio": 0.0,
+        "execution.progress.check.interval.ms": 1,
+        "failed.brokers.file.path": "",
+        "obs.tracing.enable": True,
+    })
+    md = _metadata()
+    adapter = FakeClusterAdapter(
+        {f"{p.topic}-{p.partition}": tuple(p.replicas)
+         for p in md.partitions}, latency_polls=1)
+    app = CruiseControlApp(cfg, StaticMetadataSource(md),
+                           SyntheticLoadSampler(seed=4),
+                           cluster_adapter=adapter)
+    app.load_monitor._now = lambda: 4 * W
+    for w in range(4):
+        app.load_monitor.sample_once(now_ms=w * W + 30_000)
+    return app
+
+
+@pytest.fixture(scope="module")
+def obs_server():
+    app = _obs_app()
+    app.precompute_tick()          # first proposal -> observatory steady
+    srv = rest.serve(app, port=0)
+    yield srv
+    srv.shutdown()
+
+
+def _get(srv, path):
+    port = srv.server_address[1]
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get_raw(srv, path):
+    port = srv.server_address[1]
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+        return r.status, r.headers.get("Content-Type"), r.read().decode()
+
+
+def test_rest_observatory_endpoint(obs_server):
+    code, body = _get(obs_server, "/kafkacruisecontrol/observatory")
+    assert code == 200
+    assert set(body) == {"tracing", "observatory"}
+    obs = body["observatory"]
+    assert obs["installed"] is True
+    assert obs["steady"] is True               # first proposal computed
+    assert obs["totalTraces"] >= 1
+    tracing = body["tracing"]
+    assert tracing["enabled"] is True
+    # the control-loop tick left real spans behind
+    assert "precompute-tick" in tracing["spanCounts"]
+
+
+def test_observatory_catches_seeded_steady_state_retrace(obs_server):
+    """The acceptance path: a jit trace AFTER the loop went steady is a
+    production incident, and it must surface through the real REST
+    surfaces — no retrace_sentinel anywhere."""
+    import jax
+    import jax.numpy as jnp
+    assert OBSERVATORY.snapshot()["steady"] is True
+
+    @jax.jit
+    def _seeded_steady_retrace(x):
+        return x * 2 + 1
+
+    _seeded_steady_retrace(jnp.arange(7))      # traces while steady
+    code, body = _get(obs_server, "/kafkacruisecontrol/observatory")
+    assert code == 200
+    per_fn = body["observatory"]["perFunction"]
+    hits = [fn for fn in per_fn if "_seeded_steady_retrace" in fn]
+    assert hits, f"seeded retrace not attributed: {sorted(per_fn)}"
+    assert per_fn[hits[0]]["steadyStateRetraces"] >= 1
+    assert body["observatory"]["steadyStateRetraces"] >= 1
+    # and through the Prometheus scrape, labeled by function
+    _, _, text = _get_raw(
+        obs_server, "/kafkacruisecontrol/metrics?format=prometheus")
+    line = next(
+        (ln for ln in text.splitlines()
+         if ln.startswith("kafka_cruisecontrol_observatory_steady_state_"
+                          "retraces_total")
+         and "_seeded_steady_retrace" in ln), None)
+    assert line is not None
+    assert float(line.rsplit(" ", 1)[1]) >= 1
+
+
+def test_rest_metrics_prometheus_scrape_is_spec_clean(obs_server):
+    """Live-scrape regression: the text exposition parses line by line."""
+    code, ctype, text = _get_raw(
+        obs_server, "/kafkacruisecontrol/metrics?format=prometheus")
+    assert code == 200
+    assert ctype == "text/plain; version=0.0.4"
+    assert text.endswith("\n")
+    families = set()
+    for ln in text.splitlines():
+        assert ln, "blank line in exposition"
+        if ln.startswith("# HELP ") or ln.startswith("# TYPE "):
+            families.add(ln.split(" ")[2])
+            continue
+        name_part, _, value = ln.rpartition(" ")
+        float(value)                           # every sample value parses
+        metric = name_part.split("{")[0]
+        assert metric.startswith("kafka_cruisecontrol_")
+        # every sample belongs to a declared family (histogram suffixes
+        # _bucket/_sum/_count hang off the family name)
+        assert any(metric == f or metric.startswith(f + "_")
+                   for f in families), metric
+    # counters end _total; stage timers render as histograms with +Inf
+    assert any("_total" in f for f in families)
+    hist = [ln for ln in text.splitlines() if "_bucket{" in ln]
+    assert hist and any('le="+Inf"' in ln for ln in hist)
+    # the JSON snapshot stays the default wire format
+    code, body = _get(obs_server, "/kafkacruisecontrol/metrics")
+    assert code == 200 and isinstance(body, dict)
+
+
+def test_state_carries_observability_and_telemetry_sections(obs_server):
+    code, body = _get(obs_server, "/kafkacruisecontrol/state")
+    assert code == 200
+    assert "ObservabilityState" in body
+    assert body["ObservabilityState"]["observatory"]["installed"] is True
+    assert "annealTelemetry" in body["AnalyzerState"]
+
+
+# -------------------------------------------------------------- simulator
+
+
+def _obs_scenario():
+    from cruise_control_tpu.simulator import scenario as SIM
+    return SIM.Scenario(name="obs50", seed=11, ticks=50, tick_ms=W,
+                        num_brokers=5, partitions_per_topic=4,
+                        warmup_ticks=2)
+
+
+_SCENARIO_MEMO = {}
+
+
+def _scenario_pair():
+    """Two same-seed 50-tick runs, shared by the tests below (the suite
+    asserts different contracts against the same deterministic runs)."""
+    if "pair" not in _SCENARIO_MEMO:
+        from cruise_control_tpu.simulator import scenario as SIM
+        _SCENARIO_MEMO["pair"] = (SIM.run_scenario(_obs_scenario()),
+                                  SIM.run_scenario(_obs_scenario()))
+    return _SCENARIO_MEMO["pair"]
+
+
+def test_fifty_tick_scenario_spans_byte_identical():
+    c1, c2 = _scenario_pair()
+    assert c1.trace_json() is not None
+    assert c1.trace_json() == c2.trace_json()
+    # per-stage scorecard rides the deterministic core
+    assert c1.canonical_json() == c2.canonical_json()
+    assert c1.core["stageBreakdown"] == c2.core["stageBreakdown"]
+
+
+def test_fifty_tick_scenario_trace_covers_ticks():
+    """Valid Chrome-trace JSON whose tick spans cover >= 95% of every
+    measured tick's virtual duration."""
+    c1, _ = _scenario_pair()
+    doc = json.loads(c1.trace_json())
+    xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    ticks = [e for e in xs if e["name"] == "tick"]
+    measured = c1.core["ticks"]
+    assert len(ticks) == measured == 50
+    for e in ticks:
+        assert e["dur"] >= 0.95 * W * 1000.0   # dur is microseconds
+    # spans nest under their tick: every non-tick event has a parent
+    tick_ids = {e["args"]["spanId"] for e in ticks}
+    parented = [e for e in xs if e["args"].get("parentId") in tick_ids]
+    assert parented, "no stage spans parented under tick spans"
+    # the breakdown agrees with the exported timeline
+    bd = c1.core["stageBreakdown"]
+    assert bd["tick"]["count"] == 50
+    assert bd["tick"]["virtualMsTotal"] == 50 * float(W)
+    assert {"fetch", "aggregate", "precompute-tick"} <= set(bd)
+
+
+def test_scenario_wall_section_has_stage_percentiles():
+    c1, _ = _scenario_pair()
+    pcts = c1.wall["stageWallPercentiles"]
+    assert "tick" in pcts and pcts["tick"]["wallMsP99"] >= 0
+
+
+# ------------------------------------------------------------------ lint
+
+
+@pytest.mark.lint
+def test_g012_flags_span_outside_with():
+    from tools.graftlint.engine import lint_source
+    bad = ("def f(tracer):\n"
+           "    sp = tracer.span('x')\n"
+           "    sp2 = tracer.start_span('y')\n"
+           "    return sp, sp2\n")
+    found = lint_source(bad, path="cruise_control_tpu/app.py",
+                        select=["G012"])
+    assert [f.code for f in found] == ["G012", "G012"]
+    good = ("def f(tracer):\n"
+            "    with tracer.span('x') as sp:\n"
+            "        sp.set('k', 1)\n")
+    assert not lint_source(good, path="cruise_control_tpu/app.py",
+                           select=["G012"])
+    # inline suppression still works (outside obs/)
+    waived = ("def f(tracer):\n"
+              "    sp = tracer.span('x')  # graftlint: disable=G012\n")
+    assert not lint_source(waived, path="cruise_control_tpu/app.py",
+                           select=["G012"])
+
+
+@pytest.mark.lint
+def test_obs_package_is_baseline_free():
+    """No baseline entry may suppress a finding under obs/ — the package
+    can only be fixed, never waived."""
+    from tools.graftlint import engine
+    f = engine.Finding(code="G012", path="cruise_control_tpu/obs/x.py",
+                       line=1, col=0, message="m", snippet="s")
+    baseline = {f.fingerprint: {"fingerprint": f.fingerprint, "count": 5}}
+    new, suppressed, _ = engine.apply_baseline([f], baseline)
+    assert new == [f] and not suppressed
+    # and the checked-in baseline carries no obs/ entries at all
+    for fp in engine.load_baseline():
+        assert "|cruise_control_tpu/obs/" not in fp

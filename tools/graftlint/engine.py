@@ -240,6 +240,13 @@ def prune_stale_baseline(findings: Sequence[Finding],
     return len(old) - len(dropped), dropped
 
 
+#: path prefixes the baseline may NOT suppress: findings here always fail
+#: the gate (the greenfield observability package starts — and must stay —
+#: hazard-free; inline ``# graftlint: disable=Gnnn`` markers still work,
+#: since those carry their justification in the source under review)
+BASELINE_FREE_PATHS = ("cruise_control_tpu/obs/",)
+
+
 def apply_baseline(findings: Sequence[Finding],
                    baseline: Dict[str, dict]
                    ) -> Tuple[List[Finding], List[Finding], List[str]]:
@@ -249,12 +256,16 @@ def apply_baseline(findings: Sequence[Finding],
     beyond that are new.  Baseline entries matching nothing are stale —
     reported so the baseline can shrink as hazards get fixed, but stale
     entries do not fail the gate (they'd make every fix a two-step dance).
+    Findings under :data:`BASELINE_FREE_PATHS` are never suppressed.
     """
     seen: Dict[str, int] = {}
     new: List[Finding] = []
     suppressed: List[Finding] = []
     for f in findings:
         seen[f.fingerprint] = seen.get(f.fingerprint, 0) + 1
+        if any(f.path.startswith(p) for p in BASELINE_FREE_PATHS):
+            new.append(f)
+            continue
         allowed = baseline.get(f.fingerprint, {}).get("count", 0)
         (suppressed if seen[f.fingerprint] <= allowed else new).append(f)
     stale = [fp for fp in baseline if fp not in seen]
@@ -270,7 +281,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
         description="JAX/XLA hazard + concurrency static analyzer "
-                    "(rules G001-G011, G101-G105)")
+                    "(rules G001-G012, G101-G105)")
     parser.add_argument("paths", nargs="*",
                         default=["cruise_control_tpu", "bench.py"],
                         help="files/directories to lint "

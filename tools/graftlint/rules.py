@@ -1,4 +1,4 @@
-"""graftlint rules G001-G011: JAX/XLA hazard AST passes.
+"""graftlint rules G001-G012: JAX/XLA hazard AST passes.
 
 Each rule is registered with the engine and yields :class:`engine.Finding`s.
 The rules are deliberately heuristic — a static pass cannot prove an array is
@@ -48,6 +48,12 @@ G011  Raw wall-clock in control-plane paths: direct ``time.time()`` /
       in a default argument ARE the seam and are not flagged — only
       calls. Deliberate wall-clock sites carry a baseline entry with a
       justification.
+G012  Unbalanced/leaked tracer span: ``tracer.span(...)`` or
+      ``start_span(...)`` called anywhere but as a ``with`` context item.
+      An unexited span never pops the tracer's thread-local parent stack
+      (every later span on the thread mis-parents under it) and never
+      records.  ``cruise_control_tpu/obs/`` is gated baseline-free: a
+      finding there can only be fixed, never suppressed.
 
 Concurrency family (G101-G105) — lock discipline over the service's daemon
 threads and pools, paired with the runtime sanitizer in
@@ -922,6 +928,41 @@ def check_raw_wall_clock(ctx: ModuleContext) -> Iterator[Finding]:
             f"raw `time.{fn.attr}()` in a control-plane path — route "
             f"through the injected now_fn/sleep_fn clock seam so virtual-"
             f"time simulation and deterministic replay stay exact")
+
+
+# ---------------------------------------------------------------------------
+# G012 — unbalanced / leaked tracer span
+# ---------------------------------------------------------------------------
+
+@file_rule("G012", "leaked-span")
+def check_leaked_span(ctx: ModuleContext) -> Iterator[Finding]:
+    """``tracer.span(...)`` / ``start_span(...)`` used anywhere except as a
+    ``with`` context item.  The tracer's thread-local parent stack is
+    balanced by ``__exit__``; a span opened without the context manager is
+    never popped, so every subsequent span on that thread silently parents
+    under it and the buffer leaks an open entry (it also never records, so
+    the stage timer misses the sample).  The obs/ package itself is
+    additionally gated baseline-free — a finding there can only be fixed,
+    never suppressed."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None)
+        if name not in ("span", "start_span"):
+            continue
+        parent = ctx.parents.get(node)
+        if isinstance(parent, ast.withitem) and parent.context_expr is node:
+            continue
+        if _suppressed(ctx, node, "G012"):
+            continue
+        yield ctx.finding(
+            "G012", node,
+            "span opened outside a `with` statement — an unexited span "
+            "never pops the thread-local parent stack (all later spans "
+            "mis-parent under it) and never records; use "
+            "`with tracer.span(...) as sp:`")
 
 
 @file_rule("G008", "impure-jit")
