@@ -238,6 +238,40 @@ def main():
             100.0 * (traced_elapsed - cold_elapsed) / max(cold_elapsed,
                                                           1e-9), 2)
         warm_extra["cold_traced_span_count"] = len(tr.finished())
+        # ---- explain-attribution leg: the identical cold-schedule proposal
+        # with per-move goal attribution ON (obs.provenance) — ONE extra
+        # batched vmap evaluation over the changed partitions, bucketed on
+        # the move axis so steady-state ticks reuse one compiled program.
+        # Contract: < 3% overhead on this leg and zero uncovered retraces
+        # (docs/observability.md). Non-fatal like the other extra legs.
+        try:
+            # compile pass for the attribution kernel at this move bucket,
+            # then the timed steady-state run under its own sentinel
+            OPT.optimize(topo, assign, goal_names=goal_names, engine=engine,
+                         anneal_config=cfg, seed=seed + 1, mesh=mesh,
+                         provenance=True)
+            t0 = time.time()
+            with SENT.retrace_sentinel() as expl_log:
+                r_expl = OPT.optimize(topo, assign, goal_names=goal_names,
+                                      engine=engine, anneal_config=cfg,
+                                      seed=seed + 1, mesh=mesh,
+                                      provenance=True)
+            expl_elapsed = time.time() - t0
+            expl_unc = SENT.check_steady_state(expl_log)
+            if expl_unc:
+                print(f"bench: WARNING explain leg retraced: "
+                      f"{expl_log.summary()}", file=sys.stderr)
+            warm_extra["cold_full_proposal_explained_s"] = round(
+                expl_elapsed, 3)
+            warm_extra["explain_overhead_pct"] = round(
+                100.0 * (expl_elapsed - cold_elapsed) / max(cold_elapsed,
+                                                            1e-9), 2)
+            warm_extra["explain_attributed_moves"] = (
+                (r_expl.move_attribution or {}).get("numMoves", 0))
+            warm_extra["explain_retraces"] = len(expl_unc)
+        except Exception:
+            import traceback
+            traceback.print_exc()
 
     # ---- cluster-model-creation at bench scale (LoadMonitor.java:178
     # cluster-model-creation-timer): windowed aggregation result + cluster

@@ -205,6 +205,10 @@ class OptimizerResult:
     #: rates, best-energy descent curve) — None unless the anneal engine
     #: ran with anneal_telemetry requested (see annealer.AnnealResult)
     anneal_telemetry: Optional[dict] = None
+    #: per-move per-goal penalty deltas (obs.provenance.AttributionResult
+    #: .to_json payload) — None unless optimize() ran with provenance
+    #: requested (``obs.provenance.enable``); served by ``GET /explain``
+    move_attribution: Optional[dict] = None
 
     @property
     def violated_goals_before(self) -> List[str]:
@@ -238,6 +242,8 @@ class OptimizerResult:
             out["selfHealPath"] = self.heal_path
         if self.anneal_telemetry is not None:
             out["annealTelemetry"] = self.anneal_telemetry
+        if self.move_attribution is not None:
+            out["moveAttribution"] = self.move_attribution
         if verbose:
             # servlet/response/stats BrokerStats "Statistics" payloads:
             # the full ClusterModelStats before and after optimization,
@@ -494,7 +500,8 @@ def optimize(topo: ClusterTopology, assign: Assignment,
              warm_start=None,
              proposal_decode: str = "auto",
              anneal_telemetry: bool = False,
-             tracer=None) -> OptimizerResult:
+             tracer=None,
+             provenance: bool = False) -> OptimizerResult:
     """Full optimization pass. ``engine``: auto | greedy | anneal.
     ``repair_config``: RepairConfig override for the MAIN repair pass (the
     hard-violation backstop always runs with its own defaults).
@@ -521,7 +528,11 @@ def optimize(topo: ClusterTopology, assign: Assignment,
     and the best-energy descent curve from the MAIN anneal pass (device-side
     aggregates in the PT carry — zero retraces, bit-identical proposals).
     ``tracer``: an obs.tracing.Tracer; the big phases (goal eval, anneal,
-    repair, decode) record spans on it. None = no-op."""
+    repair, decode) record spans on it. None = no-op.
+    ``provenance``: attribute each proposed move's per-goal penalty delta
+    (obs/provenance.py — one batched device evaluation over the changed
+    partitions) and stamp the payload onto ``move_attribution``. Off (the
+    default) runs the bit-identical historical program."""
     mesh = _collapse_trivial_mesh(mesh)
     if _routes_to_tiny_cpu(topo, mesh, options):
         try:
@@ -535,12 +546,12 @@ def optimize(topo: ClusterTopology, assign: Assignment,
                                       mesh, repair_config, polish_cycles,
                                       balancedness_weights, bucketing,
                                       warm_start, proposal_decode,
-                                      anneal_telemetry, tracer)
+                                      anneal_telemetry, tracer, provenance)
     return _optimize_impl(topo, assign, goal_names, constraint, options,
                           engine, anneal_config, seed, mesh, repair_config,
                           polish_cycles, balancedness_weights, bucketing,
                           warm_start, proposal_decode, anneal_telemetry,
-                          tracer)
+                          tracer, provenance)
 
 
 def healing_context(topo, opts: G.DeviceOptions) -> bool:
@@ -564,8 +575,8 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
                    polish_cycles, balancedness_weights=None,
                    bucketing: Optional[bool] = None,
                    warm_start=None, proposal_decode: str = "auto",
-                   anneal_telemetry: bool = False, tracer=None
-                   ) -> OptimizerResult:
+                   anneal_telemetry: bool = False, tracer=None,
+                   provenance: bool = False) -> OptimizerResult:
     from cruise_control_tpu.analyzer import annealer as AN  # cycle-free import
 
     from cruise_control_tpu.common.metrics import REGISTRY
@@ -949,6 +960,21 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
         _dec_sp.set("decode_path", decode_path)
 
     _mark("proposal diff")
+    move_attribution = None
+    if provenance:
+        # one batched device evaluation over the changed partitions: exact
+        # per-move, per-goal penalty deltas against the FINAL assignment
+        # (delta = doing the move, i.e. final minus final-with-move-reverted)
+        # at MODEL shapes with the same frozen thresholds the engines scored
+        # under. Gated: off is the bit-identical historical program.
+        from cruise_control_tpu.obs import provenance as PV
+        with tracer.span("explain-attribution") as _attr_sp:
+            attr = PV.attribute_proposal(dt, final, assign, th, agg_after,
+                                         init_broker, goal_names, num_topics,
+                                         sparse_topic)
+            move_attribution = attr.to_json(topo)
+            _attr_sp.set("num_moves", attr.num_moves)
+        _mark("explain attribution")
     names_ext = goal_names + (G.SELF_HEALING_TERM,)
     vb = np.asarray(before.penalties.violations)
     va = np.asarray(after.penalties.violations)
@@ -995,4 +1021,5 @@ def _optimize_impl(topo, assign, goal_names, constraint, options, engine,
         # only the engine that PRODUCED the result may claim telemetry —
         # a failed anneal rung's partial ladder stats would misattribute
         anneal_telemetry=anneal_tel[0] if engine_used == "anneal" else None,
+        move_attribution=move_attribution,
     )

@@ -278,6 +278,26 @@ def device_diff(dt, initial: Assignment, final: Assignment,
                         dt.leader_extra)
 
 
+@jax.jit
+def changed_partitions(dt, initial: Assignment, final: Assignment) -> jax.Array:
+    """bool[P] — partitions whose replica placement or leadership differs
+    between ``initial`` and ``final``, at MODEL shapes. Bucket-padded
+    sentinel partitions are masked False (weight 0), so the mask is exactly
+    the set of moves a decode would emit. The provenance attribution kernel
+    (obs/provenance.py) builds its move list from this mask; it stays a
+    separate tiny program from :func:`_diff_kernel` so attribution never
+    forces the full external-id matrix computation."""
+    reps = dt.replicas_of_partition
+    valid = reps >= 0
+    safe = jnp.maximum(reps, 0)
+    moved = jnp.any((initial.broker_of[safe] != final.broker_of[safe]) & valid,
+                    axis=1)
+    ch = moved | (initial.leader_of != final.leader_of)
+    if dt.partition_weight is not None:
+        ch = ch & (dt.partition_weight > 0)
+    return ch
+
+
 class LazyProposals(Sequence):
     """Sequence view over a :class:`DeviceDiff` that materializes
     :class:`ExecutionProposal` objects only when iterated/indexed (the REST

@@ -89,6 +89,55 @@ def _score_pipeline(dt: DeviceTopology, assign: Assignment,
                                  init_broker, agg, sparse_topic)
 
 
+def score_state(topo: ClusterTopology, assign: Assignment,
+                goal_names: Sequence[str], constraint,
+                initial_assign: Optional[Assignment] = None,
+                ) -> Tuple[Tuple[str, ...], np.ndarray, G.GoalPenalties]:
+    """Independently score an arbitrary ``(topo, assign)`` state.
+
+    The audit primitive behind ``tools/replay_tick.py``: it re-derives goal
+    verdicts for a replayed proposal from first principles — same aggregate →
+    threshold → penalty composition as :func:`_score_pipeline`, same topic
+    routing as ``optimizer._setup_model`` — without trusting the optimizer's
+    own ``violated_goals_after`` report.
+
+    When ``initial_assign`` is given, thresholds are frozen from ITS
+    aggregates and it supplies the self-healing reference placement — exactly
+    how the optimizer evaluates a proposal's *after* state — so the verdicts
+    are bit-comparable to a flight-recorded ``violatedGoalsAfter``. Without
+    it, the state is scored against its own aggregates (the rescore-baseline
+    semantics).
+
+    Returns ``(names_ext, violated, penalties)`` where ``names_ext`` is the
+    goal list extended with the self-healing term and ``violated`` is the
+    matching ``bool[G+1]`` verdict vector.
+    """
+    from cruise_control_tpu.analyzer.optimizer import TOPIC_DENSE_LIMIT
+    from cruise_control_tpu.common.resources import BalancingConstraint
+    constraint = constraint or BalancingConstraint()
+    dt = device_topology(topo)
+    num_topics = topo.num_topics
+    n_real_brokers = (int(np.asarray(topo.broker_present).sum())
+                      if getattr(topo, "broker_present", None) is not None
+                      else topo.num_brokers)
+    sparse_topic = n_real_brokers * num_topics > TOPIC_DENSE_LIMIT
+    goal_names = tuple(goal_names)
+    init = initial_assign if initial_assign is not None else assign
+    init_broker = jax.device_put(
+        np.asarray(jax.device_get(init.broker_of), np.int32))
+    tt = topic_totals(dt, num_topics) if sparse_topic else None
+    topics = 1 if sparse_topic else num_topics
+    th = G.compute_thresholds(dt, constraint,
+                              compute_aggregates(dt, init, topics),
+                              topic_total=tt)
+    pen = G.full_goal_penalties(dt, assign, th, num_topics, goal_names,
+                                init_broker,
+                                compute_aggregates(dt, assign, topics),
+                                sparse_topic)
+    names_ext = goal_names + (G.SELF_HEALING_TERM,)
+    return names_ext, np.asarray(pen.violations) > 0, pen
+
+
 def build_baseline(topo: ClusterTopology, assign: Assignment,
                    goal_names: Sequence[str], constraint,
                    digest: Optional[str] = None) -> RescoreBaseline:

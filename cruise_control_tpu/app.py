@@ -109,6 +109,17 @@ class CruiseControlApp:
         if config.get("obs.observatory.enable"):
             from cruise_control_tpu.obs.observatory import OBSERVATORY
             OBSERVATORY.install()
+        # tick flight recorder (obs.flightrec.*): bounded ring of decision
+        # records on the injected clock — what the loop decided and why
+        # (engine/heal/decode path, goal verdicts, top attributed moves,
+        # detector decisions). Canonical JSONL via GET /flightrecorder;
+        # tools/replay_tick.py replays any record bit-identically.
+        from cruise_control_tpu.obs.flightrec import FlightRecorder
+        self.flightrec = FlightRecorder(
+            now_fn=self._now_s,
+            capacity=config.get("obs.flightrec.ticks"),
+            enabled=bool(config.get("obs.flightrec.enable")),
+            top_moves=config.get("obs.flightrec.top.moves"))
         self.constraint = config.balancing_constraint()
         self.default_goals = tuple(config.get("default.goals"))
         if mesh is None:
@@ -302,7 +313,8 @@ class CruiseControlApp:
             headroom_margin=config.get("provision.headroom.margin"),
             max_added_brokers=config.get("provision.max.added.brokers"),
             max_removed_brokers=config.get("provision.max.removed.brokers"),
-            balancedness_weights=self._balancedness_weights)
+            balancedness_weights=self._balancedness_weights,
+            tracer=self.tracer)
         #: most recent rightsizing verdict (surfaced in /state; guarded by
         #: _cache_lock)
         self._last_provision_recommendation: Optional[dict] = None
@@ -372,7 +384,9 @@ class CruiseControlApp:
             recheck_delay_ms=config.get("anomaly.detection.recheck.delay.ms"),
             num_cached_states=config.get("num.cached.recent.anomaly.states"),
             now_fn=self._now_ms_fn,
-            heartbeat=lambda: self.watchdog.beat("anomaly-detector"))
+            heartbeat=lambda: self.watchdog.beat("anomaly-detector"),
+            decision_sink=lambda payload: self.flightrec.record(
+                "detector", payload))
         # heartbeat registry: stall detection is gated on each thread's
         # active predicate, so an idle executor or a not-yet-started (or
         # deliberately paused) loop never reads as stalled
@@ -654,6 +668,29 @@ class CruiseControlApp:
             self.incremental_refreshes += 1
             self.anneal_skips += 1
         REGISTRY.counter("proposal.incremental.refresh")
+        if self.flightrec.enabled:
+            from cruise_control_tpu.obs.flightrec import assignment_digest
+            payload = {
+                "outcome": "incremental",
+                "inputsDigest": rs.digest,
+                "buildTickId": info.get("tickId"),
+                "buildKind": info.get("kind"),
+                "dirtyPartitions": int(dirty),
+                "monitoredPartitions": int(monitored),
+                "engine": "cached",
+                "decodePath": c.result.decode_path,
+                "healPath": c.result.heal_path,
+                "fallbackReason": None,
+                "violatedGoalsBefore": c.result.violated_goals_before,
+                "violatedGoalsAfter": c.result.violated_goals_after,
+                "numReplicaMovements": c.result.num_replica_movements,
+                "numLeadershipMovements": c.result.num_leadership_movements,
+            }
+            if c.result.final_assignment is not None:
+                payload["proposalDigest"] = assignment_digest(
+                    np.asarray(c.result.final_assignment.broker_of),
+                    np.asarray(c.result.final_assignment.leader_of))
+            self.flightrec.record("tick", payload)
         logger.debug("incremental refresh: %d dirty partitions, no verdict "
                      "flip — anneal skipped", out.dirty_partitions)
         return True
@@ -720,7 +757,8 @@ class CruiseControlApp:
             warm_start=warm_start,
             anneal_telemetry=bool(
                 self.config.get("anneal.telemetry.enable")),
-            tracer=self.tracer)
+            tracer=self.tracer,
+            provenance=bool(self.config.get("obs.provenance.enable")))
         if res.fallback_reason:
             # degraded mode: remember the most recent fallback for /state
             # (read by the REST thread, so it shares the cache lock)
@@ -737,7 +775,47 @@ class CruiseControlApp:
             with self._cache_lock:
                 self.last_self_heal_ms = res.wall_time_s * 1000.0
                 self.self_heal_path = res.heal_path
+        self._flight_record_tick(res)
         return res
+
+    def _flight_record_tick(self, res: OPT.OptimizerResult,
+                            outcome: str = "computed") -> None:
+        """One flight-recorder record per proposal computation: what the
+        tick decided and why. Every value is a deterministic function of the
+        inputs (no wall-clock durations) — the byte-identical-log contract
+        of obs/flightrec.py."""
+        if not self.flightrec.enabled:
+            return
+        from cruise_control_tpu.obs.flightrec import assignment_digest
+        info = self.load_monitor.last_build_info() or {}
+        payload = {
+            "outcome": outcome,
+            # structural digest when the build is warm-cacheable
+            # (splice/refresh at scale); small models never carry one —
+            # the tick id still pins which aggregation the model came from
+            "inputsDigest": info.get("digest"),
+            "buildTickId": info.get("tickId"),
+            "buildKind": info.get("kind"),
+            "dirtyPartitions": info.get("dirtyPartitions"),
+            "monitoredPartitions": info.get("monitoredPartitions"),
+            "engine": res.engine,
+            "decodePath": res.decode_path,
+            "healPath": res.heal_path,
+            "fallbackReason": res.fallback_reason,
+            "violatedGoalsBefore": res.violated_goals_before,
+            "violatedGoalsAfter": res.violated_goals_after,
+            "numReplicaMovements": res.num_replica_movements,
+            "numLeadershipMovements": res.num_leadership_movements,
+        }
+        if res.final_assignment is not None:
+            payload["proposalDigest"] = assignment_digest(
+                np.asarray(res.final_assignment.broker_of),
+                np.asarray(res.final_assignment.leader_of))
+        if res.move_attribution is not None:
+            payload["numAttributedMoves"] = res.move_attribution["numMoves"]
+            payload["topMoves"] = (
+                res.move_attribution["moves"][:self.flightrec.top_moves])
+        self.flightrec.record("tick", payload)
 
     def _model(self, requirements=None, data_from: Optional[str] = None,
                now_ms: Optional[int] = None,
@@ -1685,7 +1763,37 @@ class CruiseControlApp:
         GET /observatory)."""
         from cruise_control_tpu.obs.observatory import OBSERVATORY
         return {"tracing": self.tracer.summary(),
-                "observatory": OBSERVATORY.snapshot()}
+                "observatory": OBSERVATORY.snapshot(),
+                "flightRecorder": self.flightrec.summary()}
+
+    def explain(self, partition: Optional[str] = None) -> dict:
+        """Per-move goal attribution of the cached default-goal proposal
+        (GET /explain). ``partition``: optional "topic-index" filter."""
+        with self._cache_lock:
+            c = self._proposal_cache
+        enabled = bool(self.config.get("obs.provenance.enable"))
+        out = {"provenanceEnabled": enabled,
+               "isProposalReady": c is not None}
+        if c is None:
+            return out
+        ma = c.result.move_attribution
+        if ma is None:
+            # a cached computation from before the flag flipped (or the
+            # flag is off): say why there is nothing to explain
+            out["moveAttribution"] = None
+            return out
+        if partition:
+            ma = {**ma, "moves": [m for m in ma["moves"]
+                                  if m["topicPartition"] == partition]}
+        out["moveAttribution"] = ma
+        out["engine"] = c.result.engine
+        out["computedAtMs"] = c.computed_at_ms
+        return out
+
+    def flightrecorder_jsonl(self) -> str:
+        """Canonical JSONL export of the flight-recorder ring
+        (GET /flightrecorder)."""
+        return self.flightrec.export_jsonl()
 
     def state(self, super_verbose: bool = False) -> dict:
         """CruiseControlState for the STATE endpoint. ``super_verbose``

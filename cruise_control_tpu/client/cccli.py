@@ -108,6 +108,14 @@ ENDPOINTS: List[Endpoint] = [
         Parameter("populate_disk_info", "populate-disk-info", "bool"),)),
     Endpoint("metrics", "GET",
              "Service sensors (timers/meters/gauges snapshot)"),
+    Endpoint("explain", "GET",
+             "Per-move goal attribution of the cached proposal", (
+        Parameter("partition", "partition", "string",
+                  "Filter to one topic-partition, e.g. topic3-14"),)),
+    Endpoint("flightrecorder", "GET", "Tick flight-recorder export", (
+        Parameter("format", "format", "string",
+                  "json = wrapped records + ring summary "
+                  "(default: canonical JSONL)"),)),
     Endpoint("load", "GET", "Per-broker load", (
         Parameter("time", "time", "int", "Load as of this epoch ms"),)),
     Endpoint("partition_load", "GET", "Top partition loads", (
@@ -272,7 +280,13 @@ class Responder:
                                      data=b"" if method == "POST" else None)
         try:
             with urllib.request.urlopen(req) as r:
-                return r.status, json.loads(r.read())
+                raw = r.read()
+                try:
+                    return r.status, json.loads(raw)
+                except ValueError:
+                    # text endpoints (/flightrecorder JSONL, prometheus
+                    # scrapes) — hand the body through verbatim
+                    return r.status, {"text": raw.decode()}
         except urllib.error.HTTPError as e:
             try:
                 return e.code, json.loads(e.read())
@@ -319,7 +333,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             params[name] = by_name[name].validate(value)
     responder = Responder(args.address, args.prefix, args.poll_interval)
     code, body = responder.run(ep, params)
-    print(json.dumps(body, indent=2, default=str))
+    if isinstance(body, dict) and set(body) == {"text"}:
+        print(body["text"], end="")
+    else:
+        print(json.dumps(body, indent=2, default=str))
     return 0 if code < 400 else 1
 
 

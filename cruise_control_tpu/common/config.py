@@ -345,6 +345,25 @@ def _service_config_def() -> ConfigDef:
              "trace/compile counts and compile wall time, steady-state "
              "retrace accounting and transfer-guard violation counters, "
              "surfaced in the metrics registry and GET /observatory.")
+    d.define("obs.provenance.enable", T.BOOLEAN, False, I.LOW,
+             "Per-move goal attribution on every proposal computation: one "
+             "batched device evaluation over the decoded diff stamps each "
+             "move's per-goal penalty delta onto the result (GET /explain). "
+             "Off (the default) runs the exact historical program — "
+             "bit-identical proposals.")
+    d.define("obs.flightrec.enable", T.BOOLEAN, True, I.LOW,
+             "Tick flight recorder: a bounded ring of decision records "
+             "(inputs digest, dirty-mask summary, goal verdicts, engine/"
+             "heal/decode path, fallback reason, top attributed moves, "
+             "anomaly-detector decisions) exported as canonical JSONL via "
+             "GET /flightrecorder. Pure observation on the injected clock; "
+             "same-seed simulator runs export byte-identical logs.")
+    d.define("obs.flightrec.ticks", T.INT, 256, I.LOW,
+             "Capacity of the flight-recorder ring; the oldest records are "
+             "dropped (and counted) past it.", at_least(1))
+    d.define("obs.flightrec.top.moves", T.INT, 8, I.LOW,
+             "How many of the most impactful attributed moves each tick "
+             "record keeps (requires obs.provenance.enable).", at_least(0))
     # executor (Executor.java config surface)
     d.define("num.concurrent.partition.movements.per.broker", T.INT, 5,
              I.MEDIUM, "Per-broker reassignment concurrency.", at_least(1))

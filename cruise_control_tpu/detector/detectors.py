@@ -386,9 +386,15 @@ class AnomalyDetectorService:
                  intervals_ms: Optional[Dict[str, int]] = None,
                  recheck_delay_ms: Optional[int] = None,
                  num_cached_states: int = 20, now_fn=_now_ms,
-                 heartbeat: Optional[Callable[[], None]] = None):
+                 heartbeat: Optional[Callable[[], None]] = None,
+                 decision_sink: Optional[Callable[[dict], None]] = None):
         self.notifier = notifier
         self.context = context
+        #: decision audit hook (the app routes this into the flight
+        #: recorder): called with one dict per detector decision — fired,
+        #: suppressed, deferred, re-check, or self-heal routed — carrying the
+        #: triggering anomaly summary. Must not raise; None = no-op.
+        self._decision_sink = decision_sink or (lambda payload: None)
         #: watchdog heartbeat: checked into on every sweep so a wedged or
         #: dead detector loop is restartable by the supervisor
         self._heartbeat = heartbeat or (lambda: None)
@@ -488,6 +494,8 @@ class AnomalyDetectorService:
                 continue
             for a in (found if isinstance(found, list) else [found]):
                 self.enqueue(a)
+                self._decision_sink({"decision": "fired", "detector": name,
+                                     "anomaly": a.summary()})
                 n += 1
         return n
 
@@ -518,6 +526,9 @@ class AnomalyDetectorService:
                                          "action": "DELAYED_ONGOING_EXECUTION"})
                 deferred.append(dataclasses.replace(
                     item, ready_at_ms=now + self.recheck_delay_ms))
+                self._decision_sink({"decision": "deferred",
+                                     "reason": "ongoing-execution",
+                                     "anomaly": a.summary()})
                 continue
             # the notifier callback and the fix itself run OUTSIDE the lock
             # (they hit the adapter); only the tally/history mutations — which
@@ -549,6 +560,12 @@ class AnomalyDetectorService:
                         item, ready_at_ms=now + result.delay_ms))
             with self._lock:
                 self.history.append(record)
+            # audit the verdict itself, not just the resulting optimization:
+            # FIX = self-heal routed, IGNORE = suppressed, CHECK = re-check
+            decision = {AnomalyAction.FIX: "self-heal",
+                        AnomalyAction.IGNORE: "suppressed"}.get(
+                            result.action, "recheck")
+            self._decision_sink({"decision": decision, **record})
             handled += 1
         with self._lock:
             for item in deferred:

@@ -1,6 +1,7 @@
-"""graftscope: end-to-end control-loop tracing and a compile observatory.
+"""graftscope: control-loop tracing, a compile observatory, and decision
+provenance (per-move goal attribution + the tick flight recorder).
 
-Two always-available primitives (docs/observability.md):
+Always-available primitives (docs/observability.md):
 
 - :mod:`~cruise_control_tpu.obs.tracing` — lightweight spans over an
   injected clock (wall or the simulator's virtual clock), a bounded ring
@@ -14,9 +15,15 @@ Two always-available primitives (docs/observability.md):
   surfaced through the metrics registry and ``GET /observatory``.
 """
 
+from cruise_control_tpu.obs.flightrec import (NOOP_FLIGHT_RECORDER,
+                                              FlightRecorder)
 from cruise_control_tpu.obs.observatory import OBSERVATORY, Observatory
 from cruise_control_tpu.obs.tracing import (NOOP_SPAN, NOOP_TRACER, Span,
                                             Tracer)
 
+# obs.provenance is imported lazily by its callers (the optimizer's gated
+# attribution block): it pulls in the analyzer/goal kernels, which this
+# package must not load eagerly.
+
 __all__ = ["Tracer", "Span", "NOOP_SPAN", "NOOP_TRACER", "Observatory",
-           "OBSERVATORY"]
+           "OBSERVATORY", "FlightRecorder", "NOOP_FLIGHT_RECORDER"]

@@ -1,0 +1,140 @@
+"""Tick flight recorder: a bounded, deterministic decision audit log.
+
+Span tracing (obs/tracing.py) answers *how long* each control-loop stage
+took; the flight recorder answers *what the loop decided and why*: one
+record per proposal tick (inputs digest, dirty-mask summary, per-goal
+verdicts before/after, engine / heal / decode path, fallback reason, top-k
+attributed moves) plus one record per anomaly-detector decision (fired /
+suppressed / self-heal routed, with the triggering context).
+
+Determinism is the contract that makes the log an *audit* log: timestamps
+come from the injected clock (the simulator's virtual clock in scenarios),
+sequence numbers are process-local counters, and every recorded value is a
+deterministic function of the scenario seed — so two same-seed runs export
+byte-identical JSONL (the PR 10 journal discipline), and
+``tools/replay_tick.py`` can re-run any recorded tick from its digest-pinned
+inputs and assert the proposal reproduces bit-identically.
+
+The ring is bounded (``obs.flightrec.ticks`` records); export is canonical
+JSONL — ``json.dumps(record, sort_keys=True, separators=(",", ":"))`` per
+line — served by ``GET /flightrecorder`` and attached (as a digest + record
+count) to the simulator scorecard.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+
+def canonical_record(record: dict) -> str:
+    """The one serialization every consumer (export, digest, replay
+    comparison) uses — key-sorted, no whitespace."""
+    return json.dumps(record, sort_keys=True, separators=(",", ":"))
+
+
+def assignment_digest(broker_of, leader_of) -> str:
+    """sha256 over the raw placement + leadership arrays — the bit-identity
+    pin for deterministic replay (two proposals match iff their digests
+    match)."""
+    import numpy as np
+    h = hashlib.sha256()
+    for arr in (broker_of, leader_of):
+        a = np.ascontiguousarray(np.asarray(arr, dtype=np.int64))
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+class FlightRecorder:
+    """Bounded ring of decision records on an injected clock.
+
+    ``record()`` stamps ``seq`` (monotonic, never reused even after ring
+    drops) and ``tsMs`` (from ``now_fn``) onto a copy of the payload.
+    A disabled recorder records nothing and exports an empty log — zero
+    behavior change, like the disabled tracer."""
+
+    def __init__(self, now_fn: Callable[[], float] = time.time,
+                 capacity: int = 256, enabled: bool = True,
+                 top_moves: int = 8):
+        self._now = now_fn
+        self._capacity = max(int(capacity), 1)
+        self.enabled = bool(enabled)
+        self.top_moves = int(top_moves)
+        self._lock = threading.Lock()
+        self._records: List[dict] = []
+        self._seq = 0
+        self._dropped = 0
+        #: static context merged into every record (e.g. the simulator sets
+        #: ``{"source": "scenario:<name>", "seed": <seed>}`` so replay knows
+        #: how to rebuild the inputs); None values are omitted
+        self._context: Dict[str, object] = {}
+
+    # ------------------------------------------------------------- recording
+    def set_context(self, **context) -> None:
+        with self._lock:
+            self._context = {k: v for k, v in context.items() if v is not None}
+
+    def record(self, kind: str, payload: dict) -> Optional[dict]:
+        """Append one record; returns it (with seq/ts stamped), or None when
+        disabled. ``payload`` must be JSON-serializable and deterministic —
+        no wall-clock durations, no host-dependent values."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            rec = {"seq": self._seq,
+                   "tsMs": int(round(self._now() * 1000.0)),
+                   "kind": kind, **self._context, **payload}
+            self._seq += 1
+            self._records.append(rec)
+            if len(self._records) > self._capacity:
+                drop = len(self._records) - self._capacity
+                del self._records[:drop]
+                self._dropped += drop
+            return rec
+
+    # --------------------------------------------------------------- reading
+    def records(self, kind: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            recs = list(self._records)
+        if kind is not None:
+            recs = [r for r in recs if r.get("kind") == kind]
+        return recs
+
+    def export_jsonl(self) -> str:
+        """Canonical JSONL of the ring, oldest first. Byte-identical across
+        same-seed runs on an injected clock — the determinism contract
+        tests/test_provenance.py pins across two processes."""
+        recs = self.records()
+        if not recs:
+            return ""
+        return "\n".join(canonical_record(r) for r in recs) + "\n"
+
+    def export_digest(self) -> str:
+        """sha256 of the canonical JSONL export (scorecard attachment)."""
+        return hashlib.sha256(self.export_jsonl().encode()).hexdigest()
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {"enabled": self.enabled, "capacity": self._capacity,
+                    "records": len(self._records), "dropped": self._dropped,
+                    "lastSeq": self._seq - 1}
+
+    def clear(self) -> None:
+        """Drop buffered records (seq keeps counting — cleared history must
+        not let two different ticks share a sequence number)."""
+        with self._lock:
+            self._records.clear()
+            self._dropped = 0
+
+
+def load_jsonl(text: str) -> List[dict]:
+    """Parse an exported flight-recorder log back into records."""
+    return [json.loads(line) for line in text.splitlines() if line.strip()]
+
+
+#: shared disabled recorder (the NOOP_TRACER idiom)
+NOOP_FLIGHT_RECORDER = FlightRecorder(enabled=False)

@@ -1,0 +1,309 @@
+"""Per-move goal attribution: why did the optimizer make each move?
+
+The optimizer's headline verdicts (``violatedGoalsBefore/After``,
+``GoalSummary`` rows) say *whether* the proposal helped each goal; they
+cannot say which of its ten thousand moves did the helping. This module
+answers that: for every move in a proposal — a partition whose replica set
+or leadership differs between the initial and final assignment — it computes
+the per-goal ``(violations, cost)`` delta the move contributes to the final
+objective, defined as::
+
+    delta(move) = penalties(final) - penalties(final with that move reverted)
+
+so a negative entry means the move *removed* penalty from that goal (the
+reason the optimizer chose it) and a positive entry means the move paid
+penalty there (collateral the other goals outvoted).
+
+Evaluating ``full_goal_penalties`` per reverted state would be O(moves x
+replicas) — hopeless at LinkedIn scale. Instead the kernel exploits the same
+decomposition the greedy engine's hypothetical evals use: every goal term is
+a sum over brokers, hosts, (broker, topic) cells, or the moved partition
+itself, and one move touches at most ``2 * max_rf`` brokers. One batched
+device evaluation vmaps the per-move local delta over all moves:
+
+- broker terms via :func:`analyzer.goals.broker_terms` on gathered
+  final-aggregate rows with the move's exact aggregate delta applied
+  (same accounting as :func:`ops.aggregates.compute_aggregates`);
+- host terms likewise on the touched hosts;
+- the topic band from exact per-cell counts answered by binary search over
+  one shared sort of (broker, topic) keys — the sort-based counting trick of
+  :func:`analyzer.goals.sparse_topic_penalty`, reused as a lookup structure
+  so neither mode materializes the [B, T] histogram;
+- rack, preferred-leader, and self-healing terms analytically for the moved
+  partition.
+
+The move axis is padded to power-of-two buckets (:func:`ops.windows.
+bucket_len`) with the partition-axis length as the drop sentinel — the same
+discipline as the rescore splice kernels — so steady-state drift in the move
+count reuses one compiled program per bucket and the retrace sentinel stays
+quiet. Attribution runs strictly *after* the proposal is final and touches no
+optimizer state: with ``obs.provenance.enable=false`` the code path is never
+entered and the historical program is bit-identical by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.analyzer import goals as G
+from cruise_control_tpu.analyzer import objective as OBJ
+from cruise_control_tpu.analyzer import proposals as PR
+from cruise_control_tpu.common import resources as res
+from cruise_control_tpu.ops import aggregates as AGG
+from cruise_control_tpu.ops.windows import bucket_len
+
+
+@dataclasses.dataclass(frozen=True)
+class AttributionResult:
+    """Host-side per-move attribution over ``goals`` (goal_names + the
+    synthetic self-healing term, the same [G+1] axis as GoalPenalties)."""
+
+    goals: Tuple[str, ...]
+    partitions: np.ndarray        # i32[M] model/real partition ids
+    violations_delta: np.ndarray  # f32[M, G+1]
+    cost_delta: np.ndarray        # f32[M, G+1]
+
+    @property
+    def num_moves(self) -> int:
+        return int(self.partitions.shape[0])
+
+    def scores(self) -> np.ndarray:
+        """f32[M] two-channel lexicographic impact (violations dominate via
+        VIOL_SCALE, the objective's own channel folding). More negative =
+        more beneficial move."""
+        return (OBJ.VIOL_SCALE * self.violations_delta.sum(axis=1)
+                + self.cost_delta.sum(axis=1))
+
+    def to_json(self, topo, top_k: Optional[int] = None) -> dict:
+        """JSON-ready attribution: every move (or the ``top_k`` most
+        impactful), most beneficial first, with per-goal deltas."""
+        order = np.argsort(self.scores(), kind="stable")
+        if top_k is not None:
+            order = order[:top_k]
+        t_of_p = np.asarray(topo.topic_of_partition)
+        p_index = np.asarray(topo.partition_index)
+        moves = []
+        for i in order:
+            p = int(self.partitions[i])
+            topic = topo.topic_names[int(t_of_p[p])]
+            moves.append({
+                "topicPartition": f"{topic}-{int(p_index[p])}",
+                "partition": p,
+                "violationsDelta": [round(float(v), 6)
+                                    for v in self.violations_delta[i]],
+                "costDelta": [round(float(c), 6)
+                              for c in self.cost_delta[i]],
+            })
+        return {"goals": list(self.goals), "numMoves": self.num_moves,
+                "moves": moves}
+
+
+@partial(jax.jit, static_argnames=("num_topics", "goal_names",
+                                   "sparse_topic", "has_init"))
+def _attribution_kernel(dt: AGG.DeviceTopology, final, base, th, agg,
+                        init_broker, pids, num_topics: int,
+                        goal_names: Tuple[str, ...], sparse_topic: bool,
+                        has_init: bool):
+    """[Mp] padded move pids -> ([Mp, G+1], [Mp, G+1]) per-goal deltas.
+
+    ``agg`` must be the FINAL state's aggregates and ``th`` the frozen
+    thresholds the optimization ran under. Sentinel pids (== num_partitions)
+    produce zero rows. ``sparse_topic`` only mirrors the caller's routing for
+    program identity — the cell-count lookup is mode-independent.
+    """
+    del sparse_topic  # counts come from the shared sort in both modes
+    P = dt.num_partitions
+    T = num_topics
+    live = (pids < P).astype(jnp.float32)
+    p_safe = jnp.minimum(pids, P - 1)
+
+    # shared lookup structure: sorted (broker, topic) keys of the FINAL
+    # placement; dead-broker / padding replicas park in the sentinel bin
+    # exactly as sparse_topic_penalty bins them. count(b, t) is then one
+    # binary-searched run length — no [B, T] histogram in either mode.
+    t_of_r = dt.topic_of_partition[dt.partition_of_replica]
+    countable = (dt.broker_alive[final.broker_of]
+                 & (AGG.replica_count_weights(dt) > 0))
+    BT = dt.num_brokers * T
+    sorted_keys = jnp.sort(jnp.where(countable,
+                                     final.broker_of * T + t_of_r, BT))
+
+    host_col = {g: i for i, g in enumerate(G.HOST_TERM_GOALS)}
+    bt_col = {g: i for i, g in enumerate(G.BROKER_TERM_GOALS)}
+
+    def one_move(p):
+        reps = dt.replicas_of_partition[p]                     # i32[m]
+        valid = reps >= 0
+        r = jnp.clip(reps, 0)
+        a = final.broker_of[r]             # chosen placement per slot
+        b = base.broker_of[r]              # placement if the move is reverted
+        lf = final.leader_of[p]
+        li = base.leader_of[p]
+        base_rows = dt.replica_base_load[r]                    # f32[m, 4]
+        ex = dt.leader_extra[p]                                # f32[4]
+        eff_fin = base_rows + jnp.where((r == lf)[:, None], ex[None, :], 0.0)
+        eff_rev = base_rows + jnp.where((r == li)[:, None], ex[None, :], 0.0)
+        # potentialLeadershipLoad rows follow the partition's CURRENT leader
+        pot_fin = ex[res.NW_OUT] + dt.replica_base_load[lf, res.NW_OUT]
+        pot_rev = ex[res.NW_OUT] + dt.replica_base_load[li, res.NW_OUT]
+        lbi = dt.leader_bytes_in[p]
+        lb_fin = final.broker_of[lf]
+        lb_rev = base.broker_of[li]
+
+        # touched brokers: current + reverted placement of every slot. A
+        # candidate's delta is a function of its broker id alone, so
+        # duplicate candidates compute identical deltas and the first-
+        # occurrence mask counts each broker (and host) exactly once.
+        cand = jnp.concatenate([a, b])                         # i32[2m]
+        m2 = cand.shape[0]
+        hits_a = (cand[:, None] == a[None, :]) & valid[None, :]
+        hits_b = (cand[:, None] == b[None, :]) & valid[None, :]
+        fa = hits_a.astype(jnp.float32)
+        fb = hits_b.astype(jnp.float32)
+        d_load = (fb[:, :, None] * eff_rev[None, :, :]
+                  - fa[:, :, None] * eff_fin[None, :, :]).sum(axis=1)
+        d_rc = (hits_b.astype(jnp.int32) - hits_a.astype(jnp.int32)).sum(axis=1)
+        d_lead = ((cand == lb_rev).astype(jnp.int32)
+                  - (cand == lb_fin).astype(jnp.int32))
+        d_pot = (fb * pot_rev - fa * pot_fin).sum(axis=1)
+        d_lbi = ((cand == lb_rev).astype(jnp.float32)
+                 - (cand == lb_fin).astype(jnp.float32)) * lbi
+
+        earlier = (jnp.arange(m2)[:, None] > jnp.arange(m2)[None, :])
+        uniq = (~jnp.any((cand[None, :] == cand[:, None]) & earlier,
+                         axis=1)).astype(jnp.float32)
+
+        th_c = OBJ.gather_thresholds(th, cand)
+        rows = (agg.broker_load[cand], agg.replica_count[cand],
+                agg.leader_count[cand], agg.potential_nw_out[cand],
+                agg.leader_bytes_in[cand])
+        bt_fin = G.broker_terms(th_c, *rows)
+        bt_rev = G.broker_terms(th_c, rows[0] + d_load, rows[1] + d_rc,
+                                rows[2] + d_lead, rows[3] + d_pot,
+                                rows[4] + d_lbi)
+        d_bt_v = (uniq[:, None] * (bt_rev.violations - bt_fin.violations)).sum(axis=0)
+        d_bt_c = (uniq[:, None] * (bt_rev.cost - bt_fin.cost)).sum(axis=0)
+
+        # host-scope capacity terms: fold the unique brokers' load deltas
+        # onto their hosts, then score each unique touched host once
+        hostc = dt.host_of_broker[cand]
+        same_host = (hostc[None, :] == hostc[:, None]).astype(jnp.float32)
+        d_host = jnp.matmul(same_host, uniq[:, None] * d_load)
+        uniq_h = (~jnp.any((hostc[None, :] == hostc[:, None]) & earlier,
+                           axis=1)).astype(jnp.float32)
+        th_h = th._replace(cap_limit_host=th.cap_limit_host[hostc])
+        hv_fin, hc_fin = G.host_terms(th_h, agg.host_load[hostc])
+        hv_rev, hc_rev = G.host_terms(th_h, agg.host_load[hostc] + d_host)
+        d_h_v = (uniq_h[:, None] * (hv_rev - hv_fin)).sum(axis=0)
+        d_h_c = (uniq_h[:, None] * (hc_rev - hc_fin)).sum(axis=0)
+
+        # topic band: only the (touched broker, this topic) cells change
+        t_p = dt.topic_of_partition[p]
+        key_c = cand * T + t_p
+        c_fin = (jnp.searchsorted(sorted_keys, key_c, side="right")
+                 - jnp.searchsorted(sorted_keys, key_c, side="left")
+                 ).astype(jnp.float32)
+        d_cnt = (fb - fa).sum(axis=1)
+        tu = th.topic_upper[t_p]
+        tl = th.topic_lower[t_p]
+        alive_c = th_c.alive.astype(jnp.float32)
+        band_fin = G.band_cost(c_fin, tu, tl)
+        band_rev = G.band_cost(c_fin + d_cnt, tu, tl)
+        d_topic_v = (uniq * alive_c
+                     * ((band_rev > 0).astype(jnp.float32)
+                        - (band_fin > 0).astype(jnp.float32))).sum()
+        d_topic_c = (uniq * alive_c * (band_rev - band_fin)).sum()
+
+        # rack excess for the moved partition (partition_rack_excess, one row)
+        def excess(rk):
+            same = rk[None, :] == rk[:, None]
+            ear = (jnp.arange(rk.shape[0])[:, None]
+                   > jnp.arange(rk.shape[0])[None, :])
+            dup = jnp.any(same & ear & valid[None, :], axis=1) & valid
+            return dup.astype(jnp.float32).sum()
+
+        d_rack = excess(dt.rack_of_broker[b]) - excess(dt.rack_of_broker[a])
+
+        head = dt.replicas_of_partition[p, 0]
+        d_ple = ((li != head).astype(jnp.float32)
+                 - (lf != head).astype(jnp.float32))
+
+        if has_init:
+            off = dt.replica_offline[r] & valid
+            ib = init_broker[r]
+            d_unmoved = (
+                (off & (b == ib) & dt.broker_alive[b]).astype(jnp.float32).sum()
+                - (off & (a == ib) & dt.broker_alive[a]).astype(jnp.float32).sum())
+        else:
+            d_unmoved = jnp.float32(0.0)
+
+        # assemble the [G+1] axis exactly as full_goal_penalties does
+        viols, costs = [], []
+        for g in goal_names:
+            if g == "RackAwareGoal":
+                v = c = d_rack
+            elif g == "TopicReplicaDistributionGoal":
+                v, c = d_topic_v, d_topic_c
+            elif g == "PreferredLeaderElectionGoal":
+                v = c = d_ple
+            elif g in bt_col:
+                v, c = d_bt_v[bt_col[g]], d_bt_c[bt_col[g]]
+                if g in host_col:
+                    v = v + d_h_v[host_col[g]]
+                    c = c + d_h_c[host_col[g]]
+            else:
+                raise ValueError(f"unknown goal {g}")
+            viols.append(v)
+            costs.append(c)
+        dead_v = d_bt_v[bt_col["_DeadBrokerPlacement"]] + d_unmoved
+        dead_c = d_bt_c[bt_col["_DeadBrokerPlacement"]] + d_unmoved
+        viols.append(dead_v)
+        costs.append(dead_c)
+        # deltas above are (reverted - final); the move's contribution to
+        # the final objective is the negation
+        return -jnp.stack(viols), -jnp.stack(costs)
+
+    vd, cd = jax.vmap(one_move)(p_safe)
+    return vd * live[:, None], cd * live[:, None]
+
+
+def attribute_proposal(dt: AGG.DeviceTopology, final, base, th, agg,
+                       init_broker, goal_names, num_topics: int,
+                       sparse_topic: bool) -> AttributionResult:
+    """Attribute every move of ``final`` (vs ``base``) at model shapes.
+
+    ``agg`` is the final state's aggregates, ``th`` the frozen thresholds —
+    both already on device from the optimizer's after-eval, so the only new
+    work is the one vmapped delta kernel (plus one [R] key sort) per padded
+    move-bucket size.
+    """
+    goal_names = tuple(goal_names)
+    names_ext = goal_names + (G.SELF_HEALING_TERM,)
+    changed = np.asarray(jax.device_get(
+        PR.changed_partitions(dt, final, base)))
+    pids = np.nonzero(changed)[0].astype(np.int32)
+    M = int(pids.shape[0])
+    gp1 = len(names_ext)
+    if M == 0:
+        return AttributionResult(
+            goals=names_ext, partitions=pids,
+            violations_delta=np.zeros((0, gp1), np.float32),
+            cost_delta=np.zeros((0, gp1), np.float32))
+    P = dt.num_partitions
+    padded = np.full(bucket_len(M), P, np.int32)
+    padded[:M] = pids
+    vd, cd = _attribution_kernel(
+        dt, final, base, th, agg,
+        init_broker if init_broker is not None else final.broker_of,
+        jnp.asarray(padded), num_topics, goal_names, sparse_topic,
+        init_broker is not None)
+    return AttributionResult(
+        goals=names_ext, partitions=pids,
+        violations_delta=np.asarray(jax.device_get(vd))[:M],
+        cost_delta=np.asarray(jax.device_get(cd))[:M])

@@ -101,7 +101,9 @@ class Provisioner:
                  max_added_brokers: int = 16,
                  max_removed_brokers: int = 8,
                  balancedness_weights=None,
-                 anneal_config: Optional[AnnealConfig] = None):
+                 anneal_config: Optional[AnnealConfig] = None,
+                 tracer=None):
+        from cruise_control_tpu.obs.tracing import NOOP_TRACER
         self._constraint = constraint or BalancingConstraint()
         self._goals = tuple(goal_names or G.ANOMALY_DETECTION_GOALS)
         self._headroom = float(headroom_margin)
@@ -109,6 +111,10 @@ class Provisioner:
         self._max_removed = int(max_removed_brokers)
         self._balancedness_weights = balancedness_weights
         self._anneal_config = anneal_config
+        #: graftscope tracer — the what-if grid and the rightsize fold
+        #: record `whatif-grid` / `rightsize` spans (and thereby stage
+        #: timers in the registry); None = shared no-op
+        self._tracer = tracer or NOOP_TRACER
 
     # -- ad-hoc what-if (the WHAT_IF endpoint) ---------------------------
 
@@ -116,12 +122,17 @@ class Provisioner:
                 scenarios: Sequence[Scenario], deep: bool = False,
                 headroom: Optional[float] = None,
                 seed: int = 0) -> WhatIfResult:
-        grid = compile_grid(topo, assign, tuple(scenarios))
-        return evaluate_grid(
-            grid, self._constraint, self._goals,
-            headroom=self._headroom if headroom is None else float(headroom),
-            balancedness_weights=self._balancedness_weights,
-            deep=deep, anneal_config=self._anneal_config, seed=seed)
+        with self._tracer.span("whatif-grid",
+                               scenarios=len(scenarios)) as sp:
+            grid = compile_grid(topo, assign, tuple(scenarios))
+            out = evaluate_grid(
+                grid, self._constraint, self._goals,
+                headroom=(self._headroom if headroom is None
+                          else float(headroom)),
+                balancedness_weights=self._balancedness_weights,
+                deep=deep, anneal_config=self._anneal_config, seed=seed)
+            sp.set("deep", bool(deep))
+        return out
 
     # -- rightsizing (detector + RIGHTSIZE endpoint) ---------------------
 
@@ -146,6 +157,14 @@ class Provisioner:
 
         One compiled batch scores the baseline plus every add/remove
         candidate; the fold below is pure host logic."""
+        with self._tracer.span("rightsize"):
+            return self._recommend(topo, assign, headroom_margin,
+                                   max_added_brokers, max_removed_brokers,
+                                   deep, seed)
+
+    def _recommend(self, topo, assign, headroom_margin, max_added_brokers,
+                   max_removed_brokers, deep, seed
+                   ) -> Tuple[ProvisionRecommendation, WhatIfResult]:
         headroom = (self._headroom if headroom_margin is None
                     else float(headroom_margin))
         max_add = (self._max_added if max_added_brokers is None

@@ -1,4 +1,4 @@
-"""REST API: the 24-endpoint servlet over the service facade.
+"""REST API: the 26-endpoint servlet over the service facade.
 
 Rebuild of ``servlet/KafkaCruiseControlServlet.java:95-135`` +
 ``servlet/CruiseControlEndPoint.java:16-36`` on the stdlib threading HTTP
@@ -54,6 +54,8 @@ _ENDPOINT_TABLE = (
     ("REVIEW_BOARD", "GET", "CRUISE_CONTROL_MONITOR"),
     ("METRICS", "GET", "CRUISE_CONTROL_MONITOR"),
     ("OBSERVATORY", "GET", "CRUISE_CONTROL_MONITOR"),
+    ("EXPLAIN", "GET", "KAFKA_MONITOR"),
+    ("FLIGHTRECORDER", "GET", "CRUISE_CONTROL_MONITOR"),
     ("WHAT_IF", "GET", "KAFKA_MONITOR"),
     # -- POST -------------------------------------------------------------
     ("ADD_BROKER", "POST", "KAFKA_ADMIN"),
@@ -407,6 +409,26 @@ class RestApi:
         device dispatches, transfer-guard violations — plus the span
         tracer summary (docs/observability.md)."""
         return 200, self.app.observability_state()
+
+    def _explain(self, params, client_id, request_url):
+        """Per-move goal attribution of the cached proposal (decision
+        provenance, docs/observability.md): per-goal penalty deltas for
+        every move, most beneficial first. ``partition=Topic-3`` filters to
+        one topic-partition. Requires ``obs.provenance.enable``."""
+        partition = params.get("partition")
+        return 200, self.app.explain(
+            partition=str(partition) if partition else None)
+
+    def _flightrecorder(self, params, client_id, request_url):
+        """Tick flight recorder export. Default is the canonical JSONL log
+        (a str payload — served text/plain verbatim, the same bytes
+        replay_tick.py consumes); ``format=json`` — or the common
+        ``json=true`` — wraps the records + ring summary in a JSON body."""
+        if (str(params.get("format", "")).strip().lower() == "json"
+                or _parse_bool(params, "json", False)):
+            return 200, {"summary": self.app.flightrec.summary(),
+                         "records": self.app.flightrec.records()}
+        return 200, self.app.flightrecorder_jsonl()
 
     def _proposals(self, params, client_id, request_url):
         if _parse_bool(params, "kafka_assigner", False):

@@ -94,6 +94,10 @@ class Scorecard:
     #: Chrome-trace export of the measured ticks (virtual-clock µs);
     #: carried out-of-band — not part of to_json()/the /state surface
     trace: Optional[dict] = None
+    #: canonical flight-recorder JSONL of the measured ticks (out-of-band,
+    #: like the trace); the core carries its digest + record count —
+    #: tools/replay_tick.py consumes this log for deterministic replay
+    flight_log: Optional[str] = None
 
     def canonical_json(self) -> str:
         """Byte-stable serialization of the deterministic core — two runs
@@ -405,8 +409,29 @@ def run_scenario(sc: Scenario, use_sentinel: bool = False,
 
     # ---- measurement baselines (warmup movement must not count)
     # warmup spans out of the ring: the scorecard's stage breakdown (and
-    # the exported trace) covers exactly the measured ticks
+    # the exported trace) covers exactly the measured ticks. The flight
+    # recorder resets on the same boundary — its export (and digest) then
+    # covers exactly the measured ticks, and same-seed runs produce
+    # byte-identical logs (everything in a record is a deterministic
+    # function of the seed; timestamps come from the virtual clock).
     app.tracer.clear()
+    app.flightrec.clear()
+    # replay pin: a scenario fully described by scalar spec fields (no
+    # workload object, no faults, no standby) embeds the spec so
+    # tools/replay_tick.py can rebuild it from the log alone
+    replay_spec = None
+    if (sc.workload is None and not sc.faults.events and not sc.warm_standby
+            and sc.expected_provision is None):
+        replay_spec = {
+            "name": sc.name, "seed": sc.seed, "ticks": sc.ticks,
+            "tick_ms": sc.tick_ms, "num_brokers": sc.num_brokers,
+            "num_racks": sc.num_racks, "topics": list(sc.topics),
+            "partitions_per_topic": sc.partitions_per_topic, "rf": sc.rf,
+            "warmup_ticks": sc.warmup_ticks, "latency_polls": sc.latency_polls,
+            "config_overrides": [list(kv) for kv in sc.config_overrides],
+        }
+    app.flightrec.set_context(source=f"scenario:{sc.name}", seed=sc.seed,
+                              scenarioSpec=replay_spec)
     base_moves = cluster.moves_applied
     base_lmoves = cluster.leadership_moves_applied
     base_churn = dict(cluster.move_count_by_tp)
@@ -665,6 +690,12 @@ def run_scenario(sc: Scenario, use_sentinel: bool = False,
         "finalAssignmentDigest": hashlib.sha256(json.dumps(
             {"replicas": cluster.replicas, "leaders": cluster.leaders},
             sort_keys=True, separators=(",", ":")).encode()).hexdigest(),
+        # flight-recorder attachment: record count + digest of the canonical
+        # JSONL export over the measured ticks. In the deterministic core on
+        # purpose — a same-seed rerun must reproduce the decision log
+        # byte-for-byte (tools/replay_tick.py replays individual records)
+        "flightRecorder": {"records": len(app.flightrec.records()),
+                           "digest": app.flightrec.export_digest()},
     }
     walls = np.asarray(tick_walls) if tick_walls else np.zeros(1)
     with app._cache_lock:
@@ -698,7 +729,8 @@ def run_scenario(sc: Scenario, use_sentinel: bool = False,
         core["stageBreakdown"] = TR.stage_breakdown(spans)
         wall["stageWallPercentiles"] = TR.stage_wall_percentiles(spans)
         trace = app.tracer.chrome_trace()
-    card = Scorecard(core=core, wall=wall, trace=trace)
+    card = Scorecard(core=core, wall=wall, trace=trace,
+                     flight_log=app.flightrec.export_jsonl())
     app.record_simulation_scorecard(card.to_json())
     if standby is not None:
         standby.stop()

@@ -466,3 +466,53 @@ def test_pluggable_anomaly_class_registry():
             resolve_anomaly_class("CustomBrokerFailures", GoalViolations)
     finally:
         ANOMALY_CLASS_REGISTRY.pop("CustomBrokerFailures", None)
+
+
+def test_decision_sink_audits_fired_and_selfheal():
+    """The decision sink (the flight recorder's feed, ISSUE 14): a detected
+    anomaly emits a 'fired' record at sweep time and a 'self-heal' record
+    when the notifier routes it to a fix."""
+    clock = FakeTime(1_000_000)
+    notifier = SelfHealingNotifier(
+        broker_failure_alert_threshold_ms=0, self_healing_threshold_ms=0,
+        enabled={t: True for t in AnomalyType}, now_fn=clock)
+    ctx = _Ctx()
+    failures = BrokerFailures(AnomalyType.BROKER_FAILURE, 0,
+                              failed_brokers_by_time={3: 0})
+    decisions = []
+    svc = AnomalyDetectorService(
+        notifier, context=ctx,
+        detectors={"broker_failure": lambda: failures},
+        now_fn=clock, decision_sink=decisions.append)
+    assert svc.sweep() == 1
+    assert svc.handle_pending() == 1
+    assert [d["decision"] for d in decisions] == ["fired", "self-heal"]
+    assert decisions[0]["detector"] == "broker_failure"
+    assert decisions[0]["anomaly"]["type"] == "BROKER_FAILURE"
+    assert decisions[1]["fixResult"] is True
+
+
+def test_decision_sink_audits_suppressed_and_deferred():
+    """IGNORE verdicts audit as 'suppressed'; an ongoing execution audits
+    the deferral itself — the queue is invisible otherwise."""
+    clock = FakeTime(1_000_000)
+    # self-healing disabled => notifier returns IGNORE
+    notifier = SelfHealingNotifier(enabled={t: False for t in AnomalyType},
+                                   now_fn=clock)
+    decisions = []
+    svc = AnomalyDetectorService(notifier, context=_Ctx(), detectors={},
+                                 now_fn=clock, decision_sink=decisions.append)
+    svc.enqueue(GoalViolations(AnomalyType.GOAL_VIOLATION, 0,
+                               fixable_violated_goals=["RackAwareGoal"]))
+    svc.handle_pending()
+    assert [d["decision"] for d in decisions] == ["suppressed"]
+
+    executing = []
+    svc2 = AnomalyDetectorService(
+        notifier, context=_Ctx(), has_ongoing_execution=lambda: True,
+        detectors={}, now_fn=clock, decision_sink=executing.append)
+    svc2.enqueue(GoalViolations(AnomalyType.GOAL_VIOLATION, 0,
+                                fixable_violated_goals=["RackAwareGoal"]))
+    svc2.handle_pending()
+    assert [d["decision"] for d in executing] == ["deferred"]
+    assert executing[0]["reason"] == "ongoing-execution"

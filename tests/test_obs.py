@@ -405,7 +405,7 @@ def _get_raw(srv, path):
 def test_rest_observatory_endpoint(obs_server):
     code, body = _get(obs_server, "/kafkacruisecontrol/observatory")
     assert code == 200
-    assert set(body) == {"tracing", "observatory"}
+    assert set(body) == {"tracing", "observatory", "flightRecorder"}
     obs = body["observatory"]
     assert obs["installed"] is True
     assert obs["steady"] is True               # first proposal computed
@@ -574,13 +574,24 @@ def test_g012_flags_span_outside_with():
 @pytest.mark.lint
 def test_obs_package_is_baseline_free():
     """No baseline entry may suppress a finding under obs/ — the package
-    can only be fixed, never waived."""
+    can only be fixed, never waived. The gate must cover every obs module,
+    including the provenance/flight-recorder additions."""
+    from pathlib import Path
+
     from tools.graftlint import engine
-    f = engine.Finding(code="G012", path="cruise_control_tpu/obs/x.py",
-                       line=1, col=0, message="m", snippet="s")
-    baseline = {f.fingerprint: {"fingerprint": f.fingerprint, "count": 5}}
-    new, suppressed, _ = engine.apply_baseline([f], baseline)
-    assert new == [f] and not suppressed
+    obs_dir = Path(engine.__file__).resolve().parents[2] \
+        / "cruise_control_tpu" / "obs"
+    modules = {p.name for p in obs_dir.glob("*.py")}
+    assert {"tracing.py", "observatory.py", "provenance.py",
+            "flightrec.py"} <= modules
+    for mod in sorted(modules):
+        f = engine.Finding(code="G012",
+                           path=f"cruise_control_tpu/obs/{mod}",
+                           line=1, col=0, message="m", snippet="s")
+        baseline = {f.fingerprint: {"fingerprint": f.fingerprint,
+                                    "count": 5}}
+        new, suppressed, _ = engine.apply_baseline([f], baseline)
+        assert new == [f] and not suppressed, mod
     # and the checked-in baseline carries no obs/ entries at all
     for fp in engine.load_baseline():
         assert "|cruise_control_tpu/obs/" not in fp

@@ -1,0 +1,267 @@
+"""Deterministic audit replay: re-run a flight-recorded tick and assert the
+proposal reproduces bit-identically.
+
+The flight recorder (obs/flightrec.py) pins every tick record to its inputs
+(``inputsDigest`` / the fixture digest) and its outcome (``proposalDigest``,
+a sha256 over the final placement + leadership arrays). This tool closes the
+loop: given an exported log, it rebuilds the recorded inputs, re-runs the
+decision, and compares digests — turning any recorded anomaly into an
+offline repro.
+
+Two record sources replay:
+
+- ``scenario:<name>`` records (exported by the simulator) carrying a
+  ``scenarioSpec`` context — the scenario is rebuilt from the spec and
+  re-run on the virtual clock; the record at the same ``seq`` must
+  reproduce **byte-identically** (the whole canonical JSONL line, digests
+  included).
+- ``fixture:<name>`` records written by this tool's ``record`` mode — the
+  named models.fixtures builder is re-invoked, its content digest checked
+  against the pin, and ``analyzer.optimizer.optimize`` re-run with the
+  recorded settings; the resulting ``proposalDigest`` must match bit-for-bit.
+
+Usage::
+
+    # record one optimizer tick on a fixture (LinkedIn scale: synthetic_cluster)
+    python tools/replay_tick.py record --fixture unbalanced --out /tmp/f.jsonl
+
+    # replay any recorded tick from an exported log
+    python tools/replay_tick.py replay --log /tmp/f.jsonl
+    python tools/replay_tick.py replay --log flight.jsonl --seq 7
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+
+class ReplayError(AssertionError):
+    """A replayed tick failed to reproduce its record."""
+
+
+def _pick_record(records, seq: Optional[int]) -> dict:
+    ticks = [r for r in records if r.get("kind") == "tick"]
+    if not ticks:
+        raise ReplayError("log contains no tick records")
+    if seq is None:
+        return ticks[-1]
+    for r in ticks:
+        if r.get("seq") == seq:
+            return r
+    raise ReplayError(f"no tick record with seq={seq} "
+                      f"(have {[r['seq'] for r in ticks]})")
+
+
+# --------------------------------------------------------------- fixture mode
+
+def _optimize_kwargs(args: dict) -> dict:
+    """Recorded optimizeArgs → OPT.optimize kwargs (shared by record and
+    replay so both sides derive the call the same way)."""
+    kwargs = {"seed": args.get("seed", 0),
+              "engine": args.get("engine", "auto")}
+    if args.get("goals"):
+        kwargs["goal_names"] = tuple(args["goals"])
+    if args.get("anneal"):
+        from cruise_control_tpu.analyzer.annealer import AnnealConfig
+        kwargs["anneal_config"] = AnnealConfig(**args["anneal"])
+    return kwargs
+
+
+def record_fixture_tick(fixture: str, seed: int = 0, engine: str = "auto",
+                        goals=None, fixture_kwargs=None, anneal=None) -> str:
+    """Run one optimizer tick on ``models.fixtures.<fixture>()`` and return
+    a single-record canonical flight-recorder JSONL pinning inputs and
+    proposal. ``fixture_kwargs`` parameterizes the fixture builder (e.g.
+    synthetic_cluster shapes); ``anneal`` is an AnnealConfig field dict."""
+    from cruise_control_tpu.analyzer import optimizer as OPT
+    from cruise_control_tpu.models import fixtures as FX
+    from cruise_control_tpu.obs.flightrec import (FlightRecorder,
+                                                  assignment_digest)
+    import numpy as np
+
+    fixture_kwargs = dict(fixture_kwargs or {})
+    topo, assign = getattr(FX, fixture)(**fixture_kwargs)
+    opt_args = {"seed": seed, "engine": engine,
+                "goals": list(goals) if goals else None,
+                "fixtureKwargs": fixture_kwargs or None,
+                "anneal": dict(anneal) if anneal else None}
+    res = OPT.optimize(topo, assign, **_optimize_kwargs(
+        {**opt_args, "anneal": anneal}))
+    rec = FlightRecorder(now_fn=lambda: 0.0)  # pinned clock: canonical bytes
+    rec.set_context(source=f"fixture:{fixture}",
+                    fixtureDigest=FX.fixture_digest(topo, assign))
+    rec.record("tick", {
+        "outcome": "computed",
+        "engine": res.engine,
+        "decodePath": res.decode_path,
+        "healPath": res.heal_path,
+        "fallbackReason": res.fallback_reason,
+        "violatedGoalsBefore": res.violated_goals_before,
+        "violatedGoalsAfter": res.violated_goals_after,
+        "numReplicaMovements": res.num_replica_movements,
+        "numLeadershipMovements": res.num_leadership_movements,
+        "proposalDigest": assignment_digest(
+            np.asarray(res.final_assignment.broker_of),
+            np.asarray(res.final_assignment.leader_of)),
+        "optimizeArgs": opt_args,
+    })
+    return rec.export_jsonl()
+
+
+def _replay_fixture(record: dict) -> dict:
+    from cruise_control_tpu.analyzer import goals as G
+    from cruise_control_tpu.analyzer import optimizer as OPT
+    from cruise_control_tpu.analyzer import rescore as RS
+    from cruise_control_tpu.models import fixtures as FX
+    from cruise_control_tpu.obs.flightrec import assignment_digest
+    import numpy as np
+
+    name = record["source"].split(":", 1)[1]
+    args = record.get("optimizeArgs") or {}
+    topo, assign = getattr(FX, name)(**(args.get("fixtureKwargs") or {}))
+    got_inputs = FX.fixture_digest(topo, assign)
+    if got_inputs != record.get("fixtureDigest"):
+        raise ReplayError(
+            f"fixture {name!r} no longer matches the recorded inputs: "
+            f"digest {got_inputs} != recorded {record.get('fixtureDigest')} "
+            "(the generator changed — the recorded tick is not replayable "
+            "against it)")
+    res = OPT.optimize(topo, assign, **_optimize_kwargs(args))
+    got = assignment_digest(np.asarray(res.final_assignment.broker_of),
+                            np.asarray(res.final_assignment.leader_of))
+    if got != record["proposalDigest"]:
+        raise ReplayError(
+            f"proposal did NOT reproduce: digest {got} != recorded "
+            f"{record['proposalDigest']}")
+    # independent verdict audit: re-derive the after-state goal verdicts on
+    # rescore's scoring pipeline (thresholds frozen from the INITIAL state,
+    # exactly as the optimizer evaluates a proposal) rather than trusting
+    # the optimizer's own report, and compare with the recorded list
+    goal_names = tuple(args["goals"]) if args.get("goals") else G.DEFAULT_GOALS
+    names_ext, violated, _pen = RS.score_state(
+        topo, res.final_assignment, goal_names, None, initial_assign=assign)
+    audited = [g for g, v in zip(names_ext, violated) if v]
+    recorded = record.get("violatedGoalsAfter")
+    if recorded is not None and audited != list(recorded):
+        raise ReplayError(
+            f"verdict audit mismatch: recomputed {audited} != recorded "
+            f"{list(recorded)}")
+    return {"mode": "fixture", "fixture": name, "seq": record["seq"],
+            "inputsDigest": record.get("fixtureDigest"),
+            "proposalDigest": got, "violatedGoalsAfter": audited,
+            "reproduced": True}
+
+
+# -------------------------------------------------------------- scenario mode
+
+def _replay_scenario(record: dict) -> dict:
+    from cruise_control_tpu.obs.flightrec import canonical_record, load_jsonl
+    from cruise_control_tpu.simulator import Scenario, run_scenario
+
+    spec = record.get("scenarioSpec")
+    if not spec:
+        raise ReplayError(
+            f"record from {record.get('source')!r} carries no scenarioSpec "
+            "(scenarios with custom workloads/faults embed none) — replay "
+            "it by re-running the original scenario code instead")
+    sc = Scenario(
+        name=spec["name"], seed=spec["seed"], ticks=spec["ticks"],
+        tick_ms=spec["tick_ms"], num_brokers=spec["num_brokers"],
+        num_racks=spec["num_racks"], topics=tuple(spec["topics"]),
+        partitions_per_topic=spec["partitions_per_topic"], rf=spec["rf"],
+        warmup_ticks=spec["warmup_ticks"],
+        latency_polls=spec.get("latency_polls", 1),
+        config_overrides=tuple(
+            (k, v) for k, v in spec.get("config_overrides", [])))
+    card = run_scenario(sc)
+    rerun = {r["seq"]: r for r in load_jsonl(card.flight_log or "")}
+    if record["seq"] not in rerun:
+        raise ReplayError(
+            f"re-run produced no record with seq={record['seq']} "
+            f"(have {sorted(rerun)})")
+    got, want = canonical_record(rerun[record["seq"]]), canonical_record(record)
+    if got != want:
+        raise ReplayError(
+            "replayed record is NOT byte-identical:\n"
+            f"  recorded: {want}\n  replayed: {got}")
+    return {"mode": "scenario", "scenario": spec["name"],
+            "seq": record["seq"],
+            "inputsDigest": record.get("inputsDigest"),
+            "proposalDigest": record.get("proposalDigest"),
+            "reproduced": True}
+
+
+def replay_log(text: str, seq: Optional[int] = None) -> dict:
+    """Replay one tick record from an exported log; raises ReplayError if it
+    does not reproduce bit-identically."""
+    from cruise_control_tpu.obs.flightrec import load_jsonl
+
+    record = _pick_record(load_jsonl(text), seq)
+    source = str(record.get("source") or "")
+    if source.startswith("fixture:"):
+        return _replay_fixture(record)
+    if source.startswith("scenario:"):
+        return _replay_scenario(record)
+    raise ReplayError(f"record source {source!r} is not replayable "
+                      "(expected fixture:<name> or scenario:<name>)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="record/replay flight-recorded optimizer ticks")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    rec = sub.add_parser("record", help="record one fixture tick to JSONL")
+    rec.add_argument("--fixture", required=True,
+                     help="models.fixtures builder name "
+                          "(e.g. unbalanced, synthetic_cluster)")
+    rec.add_argument("--seed", type=int, default=0)
+    rec.add_argument("--engine", default="auto")
+    rec.add_argument("--goals", default=None,
+                     help="comma-separated goal list (default goals if unset)")
+    rec.add_argument("--fixture-args", default=None,
+                     help="JSON kwargs for the fixture builder, e.g. "
+                          '\'{"num_brokers": 2600, "num_replicas": 50000}\'')
+    rec.add_argument("--anneal", default=None,
+                     help="JSON AnnealConfig fields, e.g. "
+                          '\'{"num_chains": 8, "steps": 16}\'')
+    rec.add_argument("--out", default="-", help="output path (- = stdout)")
+    rep = sub.add_parser("replay", help="replay a recorded tick from a log")
+    rep.add_argument("--log", required=True,
+                     help="flight-recorder JSONL (exported by GET "
+                          "/flightrecorder, the simulator scorecard, or "
+                          "this tool's record mode)")
+    rep.add_argument("--seq", type=int, default=None,
+                     help="record to replay (default: the last tick record)")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "record":
+        goals = ([g for g in args.goals.split(",") if g.strip()]
+                 if args.goals else None)
+        out = record_fixture_tick(
+            args.fixture, seed=args.seed, engine=args.engine, goals=goals,
+            fixture_kwargs=json.loads(args.fixture_args)
+                           if args.fixture_args else None,
+            anneal=json.loads(args.anneal) if args.anneal else None)
+        if args.out == "-":
+            sys.stdout.write(out)
+        else:
+            with open(args.out, "w") as f:
+                f.write(out)
+        return 0
+
+    with open(args.log) as f:
+        text = f.read()
+    try:
+        verdict = replay_log(text, seq=args.seq)
+    except ReplayError as e:
+        print(f"REPLAY FAILED: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(verdict, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
