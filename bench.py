@@ -583,6 +583,26 @@ def _mem_available_gb():
     return float("inf")     # no meminfo (non-Linux): let the leg try
 
 
+def _xl_headroom_forecast(topo, chains: int) -> dict:
+    """Price the xl model's NEXT bucket-ladder step against this host's
+    free memory (the graftwatch forecaster, run analytically over the
+    logical counts the leg just optimized)."""
+    from cruise_control_tpu.obs import costmodel as CM
+    geom = CM.geometry_from_counts(
+        topo.num_brokers, topo.num_hosts, topo.num_partitions,
+        topo.num_replicas, topo.max_rf, chains=chains)
+    nxt = CM.next_bucket_step(geom)
+    cur_b, nxt_b = CM.model_bytes(geom), CM.model_bytes(nxt)
+    avail = int(_mem_available_gb() * (1 << 30))
+    return {
+        "currentModelBytes": cur_b,
+        "nextModelBytes": nxt_b,
+        "deltaBytes": nxt_b - cur_b,
+        "headroomBytes": avail,
+        "fits": bool(nxt_b <= avail),
+    }
+
+
 def _bench_xl(seed: int):
     """10×-LinkedIn on the 8-device CPU mesh: the sharded PT anneal
     end-to-end at 26K brokers / 5M replicas (fixtures.xl_cluster). Chain
@@ -673,6 +693,10 @@ def _bench_xl(seed: int):
         "decode_path": r.decode_path,
         "proposal_decode_device_s": round(r.decode_device_s, 4),
         "device": r.device,
+        # graftwatch headroom forecast priced against the footprint the
+        # run actually measured: would the NEXT bucket-ladder step (×1.25)
+        # still fit this host's memory? Analytic — no extra compile.
+        "headroom_forecast": _xl_headroom_forecast(topo, cfg.num_chains),
     }))
 
 
@@ -1455,11 +1479,47 @@ def _measure_end_to_end_tick(topo, assign):
         lm._tracer = NOOP_TRACER
     traced_med = float(np.median(lat_traced))
     base_med = float(np.median(lat))
+    # ---- graftwatch-overhead leg: the same five ticks, each followed by
+    # a healthwatch observation (ring push + vmapped burn-rate evaluation
+    # — one compiled program, warmed on an untimed tick). The contract is
+    # < 2% overhead on this leg and zero uncovered retraces while the
+    # ring fills (docs/observability.md).
+    from cruise_control_tpu.obs.healthwatch import HealthWatch, default_rules
+    hw_clock = [0.0]
+
+    def _hw_now():
+        hw_clock[0] += 250.0
+        return hw_clock[0]
+
+    hw = HealthWatch(default_rules(0.02, 8, 32, 10.0, 2.5),
+                     ring_ticks=64, now_ms_fn=_hw_now)
+
+    def hw_sample(tick_s):
+        return {"ok": 1.0, "latencyMs": tick_s * 1000.0,
+                "cacheHitRatio": 1.0}
+
+    hw.observe(hw_sample(base_med))                   # compile push + burn
+    lat_watched = []
+    with SENT.retrace_sentinel() as hw_rl:
+        for k in range(5):
+            t0 = _time.time()
+            tick_s, _, _ = one_tick(101 + k)
+            hw.observe(hw_sample(tick_s))
+            lat_watched.append(_time.time() - t0)
+    hw_uncovered = SENT.check_steady_state(hw_rl)
+    if hw_uncovered:
+        print(f"bench: WARNING healthwatch tick retraced: "
+              f"{hw_rl.summary()}", file=sys.stderr)
+    watched_med = float(np.median(lat_watched))
     return {
         "end_to_end_tick_traced_s": round(traced_med, 3),
         "end_to_end_tick_tracing_overhead_pct": round(
             100.0 * (traced_med - base_med) / max(base_med, 1e-9), 2),
         "end_to_end_tick_traced_span_count": len(tr.finished()),
+        "end_to_end_tick_healthwatch_s": round(watched_med, 3),
+        "healthwatch_overhead_pct": round(
+            100.0 * (watched_med - base_med) / max(base_med, 1e-9), 2),
+        "healthwatch_retraces": len(hw_uncovered),
         "end_to_end_tick_s": round(float(np.median(lat)), 3),
         "end_to_end_tick_max_s": round(float(max(lat)), 3),
         "end_to_end_tick_dirty_partitions": dirty_n,

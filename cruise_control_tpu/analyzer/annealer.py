@@ -45,6 +45,7 @@ from cruise_control_tpu.analyzer import goals as G
 from cruise_control_tpu.analyzer import objective as OBJ
 from cruise_control_tpu.common import resources as res
 from cruise_control_tpu.common import sentinels as SENT
+from cruise_control_tpu.obs import costmodel as CM
 from cruise_control_tpu.models.cluster import (Assignment,
                                                BROKER_BUCKET_FLOOR,
                                                REPLICA_BUCKET_FLOOR,
@@ -954,6 +955,15 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
             chains, temps, tel_dev = out
         else:
             chains, temps = out
+    # graftwatch cost ledger (obs/costmodel.py): one flag check when
+    # disabled; outside the transfer guard because deep pricing lowers
+    CM.capture_program(
+        "anneal-pt", run_pt,
+        (chains, temps, keys, dt, th, weights, opts, movable_idx,
+         dest_idx, initial_broker_of, topic_reps, cfg, topic_mode,
+         n_rounds),
+        out, {"n_movable": n_mov_dev, "n_dest": n_dst_dev,
+              "telemetry": telemetry})
     chain_rows = None
     if mesh is not None and topic_mode in ("dense", "off"):
         # replica-sharded exact rescore (parallel/sharding.py): the per-chain
@@ -972,6 +982,10 @@ def optimize_anneal(dt: DeviceTopology, assign: Assignment,
         rescore = (_rescore_chains_donated
                    if mesh is None and jax.default_backend() != "cpu"
                    else _rescore_chains)
+        CM.capture_program(
+            "anneal-rescore", rescore,
+            (chains, dt, th, weights, initial_broker_of,
+             topic_mode, num_topics))
         energies, bo_all, lo_all = rescore(
             chains, dt, th, weights, initial_broker_of,
             topic_mode, num_topics)                              # f32[C, 2]

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from cruise_control_tpu.common import resources as res
+from cruise_control_tpu.obs import costmodel as CM
 from cruise_control_tpu.models.cluster import Assignment, ClusterTopology
 
 
@@ -269,13 +270,16 @@ def device_diff(dt, initial: Assignment, final: Assignment,
         ids = np.arange(dt.num_brokers, dtype=np.int32)
     else:
         ids = np.asarray(broker_ids, np.int32)
-    return _diff_kernel(dt.replicas_of_partition,
-                        jnp.asarray(initial.broker_of, jnp.int32),
-                        jnp.asarray(final.broker_of, jnp.int32),
-                        jnp.asarray(initial.leader_of, jnp.int32),
-                        jnp.asarray(final.leader_of, jnp.int32),
-                        jax.device_put(ids), dt.replica_base_load,
-                        dt.leader_extra)
+    args = (dt.replicas_of_partition,
+            jnp.asarray(initial.broker_of, jnp.int32),
+            jnp.asarray(final.broker_of, jnp.int32),
+            jnp.asarray(initial.leader_of, jnp.int32),
+            jnp.asarray(final.leader_of, jnp.int32),
+            jax.device_put(ids), dt.replica_base_load,
+            dt.leader_extra)
+    out = _diff_kernel(*args)
+    CM.capture_program("device-decode", _diff_kernel, args, out)
+    return out
 
 
 @jax.jit

@@ -43,6 +43,7 @@ import numpy as np
 from cruise_control_tpu.analyzer import annealer as AN
 from cruise_control_tpu.analyzer import goals as G
 from cruise_control_tpu.analyzer import objective as OBJ
+from cruise_control_tpu.obs import costmodel as CM
 from cruise_control_tpu.models.cluster import (Assignment,
                                                REPLICA_BUCKET_FLOOR,
                                                bucket_size)
@@ -1205,6 +1206,17 @@ def warm_escape_kernels(dt, assign, th, weights, opts, num_topics: int,
                               cfg.lead_inner, cfg.max_lead_sources,
                               src_sharding=src_sharding,
                               flag_sharding=flag_sharding)
+    if CM.COSTS.enabled:
+        # graftwatch: price the fused leadership descent at warm time —
+        # the same compiled program the engaged path dispatches
+        CM.capture_program(
+            "fused-lead", _fused_lead,
+            (dt, th, weights, opts, st, lead_w, blocked,
+             jax.random.PRNGKey(0), jnp.float32(cfg.min_improvement),
+             jnp.int32(cfg.lead_broker_budget), cfg.lead_inner,
+             cfg.max_lead_sources),
+            st.leader_of,
+            {"src_sharding": src_sharding, "flag_sharding": flag_sharding})
     outs.append(st.leader_of)
     if cfg.engages_fused_shed(mesh):
         # the fused shed ladder (remove_broker's engaged path): a real
@@ -1216,6 +1228,13 @@ def warm_escape_kernels(dt, assign, th, weights, opts, num_topics: int,
                                     init, topic_on, cfg.shed_inner,
                                     cfg.shed_sources, cfg.shed_partners,
                                     cfg.escape_max_bad_brokers)
+        if CM.COSTS.enabled:
+            CM.capture_program(
+                "fused-shed", _fused_shed,
+                (dt, th, weights, opts, st_shed, lead_w, init, topic_on,
+                 cfg.shed_inner, cfg.shed_sources, cfg.shed_partners,
+                 cfg.escape_max_bad_brokers),
+                st_shed.leader_of)
         outs.append(st_shed.leader_of)
     jax.block_until_ready(outs)
 

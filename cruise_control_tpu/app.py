@@ -120,6 +120,25 @@ class CruiseControlApp:
             capacity=config.get("obs.flightrec.ticks"),
             enabled=bool(config.get("obs.flightrec.enable")),
             top_moves=config.get("obs.flightrec.top.moves"))
+        # graftwatch cost observatory (obs.costmodel.*): compiled-program
+        # cost/memory ledger + live-buffer census + headroom forecaster.
+        # Process-wide singleton (the OBSERVATORY precedent); the compile
+        # listener feeds per-function compile wall into the ledger.
+        from cruise_control_tpu.obs.costmodel import COSTS
+        self.costmodel = COSTS
+        if config.get("obs.costmodel.enable"):
+            COSTS.configure(
+                enabled=True,
+                deep=bool(config.get("obs.costmodel.deep")),
+                sample_interval_ms=config.get(
+                    "obs.costmodel.sample.interval.ms"),
+                hbm_limit_bytes=config.get("obs.costmodel.hbm.limit.bytes"),
+                registry=REGISTRY, now_ms_fn=self._now_ms_fn)
+            from cruise_control_tpu.obs.observatory import OBSERVATORY
+            OBSERVATORY.add_compile_listener(COSTS.on_compile)
+        #: graftwatch health watch (healthwatch.*) — constructed after the
+        #: anomaly detector so alerts fire through its notifier seam
+        self.healthwatch = None
         self.constraint = config.balancing_constraint()
         self.default_goals = tuple(config.get("default.goals"))
         if mesh is None:
@@ -387,6 +406,22 @@ class CruiseControlApp:
             heartbeat=lambda: self.watchdog.beat("anomaly-detector"),
             decision_sink=lambda payload: self.flightrec.record(
                 "detector", payload))
+        if config.get("healthwatch.enable"):
+            # graftwatch health watch: per-tick health vectors in a device
+            # ring, vmapped burn-rate alerting on the injected clock.
+            # Alert decisions audit to the flight recorder through the
+            # same decision_sink seam the detector uses, and fire through
+            # the detector's notifier.
+            from cruise_control_tpu.obs import healthwatch as HW
+            self.healthwatch = HW.HealthWatch(
+                HW.rules_from_config(config),
+                ring_ticks=config.get("healthwatch.ring.ticks"),
+                tick_slo_ms=float(config.get("healthwatch.tick.slo.ms")),
+                now_ms_fn=self._now_ms_fn,
+                registry=REGISTRY,
+                decision_sink=lambda payload: self.flightrec.record(
+                    "alert", payload),
+                notifier=notifier)
         # heartbeat registry: stall detection is gated on each thread's
         # active predicate, so an idle executor or a not-yet-started (or
         # deliberately paused) loop never reads as stalled
@@ -428,6 +463,9 @@ class CruiseControlApp:
         #: degraded-mode record of the most recent optimize() that fell back
         #: to a lower engine (surfaced in /state AnalyzerState)
         self._last_fallback: Optional[dict] = None
+        #: last fallback record graftwatch saw (edge detection for the
+        #: health vector's per-tick fallback flag)
+        self._health_prev_fallback: Optional[dict] = None
         #: consecutive precompute_tick failures (warning rate is capped)
         self._precompute_failures = 0
         #: incremental tick path (analyzer/rescore.py): the goal-verdict
@@ -559,11 +597,16 @@ class CruiseControlApp:
         Tries the incremental path first: a tick whose load deltas flip no
         goal verdict re-arms the cached proposal without annealing."""
         self.watchdog.beat("proposal-precompute")
+        started_ms = self._now_ms_fn()
         if self._cache_is_fresh():
+            self._observe_health("fresh", started_ms)
             return False
         if not self._compute_gate.acquire(blocking=False):
-            return False         # a request thread is already computing
+            # a request thread is already computing
+            self._observe_health("busy", started_ms)
+            return False
         t0 = time.monotonic()
+        outcome, computed = "failed", False
         # the precompute span is also the tick's AMBIENT parent: spans
         # opened on background threads meanwhile (escape-kernel warm,
         # executor progress) join this tick's tree
@@ -571,23 +614,20 @@ class CruiseControlApp:
             self.tracer.set_ambient(_sp)
             try:
                 if self._cache_is_fresh():
-                    _sp.set("outcome", "fresh")
-                    return False
-                if self._try_incremental_refresh():
+                    outcome = "fresh"
+                elif self._try_incremental_refresh():
                     self._precompute_failures = 0
                     with self._cache_lock:
                         self.last_tick_ms = (time.monotonic() - t0) * 1000.0
-                    _sp.set("outcome", "incremental")
-                    return True
-                self._compute_and_cache()
-                self._precompute_failures = 0
-                with self._cache_lock:
-                    self.last_tick_ms = (time.monotonic() - t0) * 1000.0
-                _sp.set("outcome", "computed")
-                return True
+                    outcome, computed = "incremental", True
+                else:
+                    self._compute_and_cache()
+                    self._precompute_failures = 0
+                    with self._cache_lock:
+                        self.last_tick_ms = (time.monotonic() - t0) * 1000.0
+                    outcome, computed = "computed", True
             except NotEnoughValidWindowsError:
-                _sp.set("outcome", "not-ready")
-                return False     # monitor not ready yet: expected at startup
+                outcome = "not-ready"  # monitor not ready: expected at startup
             except Exception:
                 # a permanently-broken precompute loop must stay visible
                 # without flooding the log: warn on the first few consecutive
@@ -600,11 +640,76 @@ class CruiseControlApp:
                     logger.warning(
                         "proposal precompute failed (%d consecutive)",
                         n, exc_info=True)
-                _sp.set("outcome", "failed")
-                return False
+                outcome = "failed"
             finally:
+                _sp.set("outcome", outcome)
                 self.tracer.clear_ambient()
                 self._compute_gate.release()
+        # graftwatch sees EVERY tick outcome (including the early returns
+        # above): the burn-rate windows are per-tick fractions, so a
+        # skipped observation would silently dilute them
+        self._observe_health(outcome, started_ms)
+        return computed
+
+    def _observe_health(self, outcome: str, started_ms: float) -> None:
+        """Fold one precompute outcome into graftwatch: the bounded-
+        cadence device-memory sample plus one health vector into the
+        burn-rate ring. Pure observation on the injected clock — no-op
+        unless obs.costmodel.enable / healthwatch.enable are set."""
+        if self.costmodel.enabled:
+            self.costmodel.maybe_sample(self._now_ms_fn())
+        hw = self.healthwatch
+        if hw is None:
+            return
+        wall_ms = max(self._now_ms_fn() - started_ms, 0.0)
+        with self._cache_lock:
+            hits, misses = self.proposal_cache_hits, self.proposal_cache_misses
+            heal_ms = self.last_self_heal_ms or 0.0
+            fallback = self._last_fallback
+            cache = self._proposal_cache
+        # fallback is a per-tick edge, not a level: flag only the tick on
+        # which a NEW fallback record appeared
+        fallback_tick = 0.0
+        if fallback is not self._health_prev_fallback:
+            self._health_prev_fallback = fallback
+            if fallback is not None:
+                fallback_tick = 1.0
+        engine = ""
+        hard = soft = 0.0
+        if cache is not None:
+            from cruise_control_tpu.analyzer import goals as G
+            engine = cache.result.engine
+            for name in cache.result.violated_goals_after:
+                if G.is_hard(name):
+                    hard += 1.0
+                else:
+                    soft += 1.0
+        lag = 0.0
+        rep = self.replication_state()
+        records = rep.get("followerLagRecords")
+        if records:
+            try:
+                vals = (records.values()
+                        if hasattr(records, "values") else records)
+                lag = float(max(float(v) for v in vals))
+            except (TypeError, ValueError):
+                lag = 0.0
+        total = hits + misses
+        hw.observe({
+            "ok": (1.0 if outcome in ("fresh", "computed",
+                                      "incremental", "busy") else 0.0),
+            "latencyMs": wall_ms,
+            "notReady": 1.0 if outcome == "not-ready" else 0.0,
+            "failed": 1.0 if outcome == "failed" else 0.0,
+            "fallback": fallback_tick,
+            "engineAnneal": 1.0 if engine == "anneal" else 0.0,
+            "healWallMs": heal_ms,
+            "cacheHitRatio": (hits / total) if total else 1.0,
+            "watchdogRestarts": float(self.watchdog.total_restarts),
+            "replicationLag": lag,
+            "hardViolations": hard,
+            "softViolations": soft,
+        })
 
     def _precompute_loop(self):
         # re-check at a fraction of the expiration so a generation change is
@@ -1762,9 +1867,46 @@ class CruiseControlApp:
         observatory snapshot (ObservabilityState in /state and the body of
         GET /observatory)."""
         from cruise_control_tpu.obs.observatory import OBSERVATORY
-        return {"tracing": self.tracer.summary(),
-                "observatory": OBSERVATORY.snapshot(),
-                "flightRecorder": self.flightrec.summary()}
+        out = {"tracing": self.tracer.summary(),
+               "observatory": OBSERVATORY.snapshot(),
+               "flightRecorder": self.flightrec.summary()}
+        if self.costmodel.enabled:
+            out["costModel"] = self.costmodel.snapshot()
+        if self.healthwatch is not None:
+            out["healthWatch"] = self.healthwatch.snapshot()
+        return out
+
+    def _model_geometry(self) -> Optional[dict]:
+        """Bucketed geometry of the model the service is serving (None
+        while the monitor can't build one): what the headroom forecaster
+        prices."""
+        from cruise_control_tpu.obs import costmodel as CMOD
+        try:
+            topo, _assign = self._model()
+        except NotEnoughValidWindowsError:
+            return None
+        return CMOD.geometry_from_counts(
+            topo.num_brokers, topo.num_hosts, topo.num_partitions,
+            topo.num_replicas, topo.max_rf,
+            chains=int(self.config.get("anneal.num.chains")))
+
+    def headroom_state(self) -> dict:
+        """GET /headroom: current device memory + the bucket-ladder
+        forecast — will the next ×1.25 bucket step fit the remaining
+        device memory? (obs/costmodel.py)"""
+        if not self.costmodel.enabled:
+            return {"enabled": False,
+                    "reason": "obs.costmodel.enable is off"}
+        forecast = self.costmodel.headroom_forecast(self._model_geometry())
+        return {"enabled": True, "forecast": forecast,
+                "census": self.costmodel.live_buffer_census()}
+
+    def alerts_state(self, history: int = 64) -> dict:
+        """GET /alerts: active burn-rate alerts, rule registry, counts
+        and recent decision history (obs/healthwatch.py)."""
+        if self.healthwatch is None:
+            return {"enabled": False, "reason": "healthwatch.enable is off"}
+        return self.healthwatch.snapshot(history=history)
 
     def explain(self, partition: Optional[str] = None) -> dict:
         """Per-move goal attribution of the cached default-goal proposal

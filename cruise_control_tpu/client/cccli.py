@@ -116,6 +116,15 @@ ENDPOINTS: List[Endpoint] = [
         Parameter("format", "format", "string",
                   "json = wrapped records + ring summary "
                   "(default: canonical JSONL)"),)),
+    Endpoint("alerts", "GET",
+             "graftwatch burn-rate alerts: active alerts, rule registry, "
+             "fire/suppress/resolve counts and decision history", (
+                 Parameter("history", "history", "int",
+                           "How many recent alert decisions to include "
+                           "(default 64)"),)),
+    Endpoint("headroom", "GET",
+             "graftwatch headroom forecast: device memory in use and "
+             "whether the next bucket-ladder step fits", ()),
     Endpoint("load", "GET", "Per-broker load", (
         Parameter("time", "time", "int", "Load as of this epoch ms"),)),
     Endpoint("partition_load", "GET", "Top partition loads", (

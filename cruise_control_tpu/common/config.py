@@ -364,6 +364,58 @@ def _service_config_def() -> ConfigDef:
     d.define("obs.flightrec.top.moves", T.INT, 8, I.LOW,
              "How many of the most impactful attributed moves each tick "
              "record keeps (requires obs.provenance.enable).", at_least(0))
+    d.define("obs.costmodel.enable", T.BOOLEAN, False, I.LOW,
+             "graftwatch cost observatory: per-compiled-program cost/"
+             "memory ledger, live device-buffer census, backend memory "
+             "stats sampling and the bucket-ladder headroom forecaster "
+             "(GET /headroom). Off (the default) the capture seam is one "
+             "flag check — bit-identical proposals.")
+    d.define("obs.costmodel.deep", T.BOOLEAN, False, I.LOW,
+             "AOT-lower each newly captured program signature to pull "
+             "XLA cost_analysis (flops, bytes accessed) and "
+             "memory_analysis (arg/output/temp bytes) into the ledger. "
+             "Doubles warmup compile work for the captured programs; "
+             "steady state is untouched (capture memoizes signatures).")
+    d.define("obs.costmodel.sample.interval.ms", T.LONG, 10_000, I.LOW,
+             "Minimum spacing between device-memory samples (live-array "
+             "census + backend memory_stats) on the injected clock.",
+             at_least(1))
+    d.define("obs.costmodel.hbm.limit.bytes", T.LONG, None, I.LOW,
+             "Device memory budget for the headroom forecaster when the "
+             "backend reports no bytes_limit (CPU; TPU/GPU report their "
+             "own). None leaves headroom/fit verdicts null.")
+    d.define("healthwatch.enable", T.BOOLEAN, False, I.LOW,
+             "graftwatch health watch: per-tick health vectors in a "
+             "device ring with vmapped fast/slow burn-rate alerting "
+             "(GET /alerts), alert decisions audited to the flight "
+             "recorder and fired through the anomaly notifier. Off (the "
+             "default) the tick path is bit-identical.")
+    d.define("healthwatch.ring.ticks", T.INT, 512, I.LOW,
+             "Capacity of the device health ring (also the longest "
+             "usable burn window).", at_least(1))
+    d.define("healthwatch.tick.slo.ms", T.LONG, 30_000, I.LOW,
+             "Tick wall-time SLO: ticks slower than this count as "
+             "latency breaches in the health vector (matches the "
+             "simulator SLOBudget default).", at_least(1))
+    d.define("healthwatch.error.budget", T.DOUBLE, 0.02, I.LOW,
+             "Allowed bad-tick fraction for the stock alert rules; burn "
+             "rate = bad fraction / budget.", between(0.0, 1.0))
+    d.define("healthwatch.fast.window.ticks", T.INT, 8, I.LOW,
+             "Fast burn window (ticks) for the stock rules — fires "
+             "quickly on sharp degradation.", at_least(1))
+    d.define("healthwatch.slow.window.ticks", T.INT, 32, I.LOW,
+             "Slow burn window (ticks) for the stock rules — the "
+             "sustained-burn confirmation that keeps blips from paging.",
+             at_least(1))
+    d.define("healthwatch.fast.burn", T.DOUBLE, 10.0, I.LOW,
+             "Burn-rate threshold over the fast window.", at_least(0.0))
+    d.define("healthwatch.slow.burn", T.DOUBLE, 2.5, I.LOW,
+             "Burn-rate threshold over the slow window.", at_least(0.0))
+    d.define("healthwatch.rules", T.STRING, None, I.LOW,
+             "JSON list of AlertRule overrides/additions (keys: name, "
+             "signal, threshold, budget, fastWindowTicks, "
+             "slowWindowTicks, fastBurn, slowBurn); same-name entries "
+             "replace the stock rules.")
     # executor (Executor.java config surface)
     d.define("num.concurrent.partition.movements.per.broker", T.INT, 5,
              I.MEDIUM, "Per-broker reassignment concurrency.", at_least(1))

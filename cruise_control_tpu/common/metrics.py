@@ -202,7 +202,47 @@ SENSOR_DOCS: Dict[str, str] = {
         "Device dispatches of jitted entry points, per callsite.",
     "observatory-transfer-guard-violations":
         "Implicit-transfer violations surfaced, per callsite.",
+    "observatory-compile-wall-seconds":
+        "Cumulative XLA compile wall time, per function (the labeled "
+        "series behind the per-function compile-budget attribution; the "
+        "compile timer histogram buckets the same durations).",
+    "costmodel-programs-captured":
+        "Compiled-program variants captured by the cost observatory, "
+        "per program (one per new argument-shape signature).",
+    "costmodel-device-bytes-in-use":
+        "Device memory in use at the last graftwatch sample (backend "
+        "memory_stats, or the live-array census on backends without "
+        "allocator stats).",
+    "costmodel-headroom-bytes":
+        "Remaining device memory against the configured/backed HBM "
+        "limit at the last headroom forecast.",
+    "costmodel-next-step-bytes":
+        "Forecast footprint of the next bucket-ladder rung (x1.25 "
+        "growth) of the cluster model.",
+    "costmodel-next-step-fits":
+        "1 when the next bucket-ladder rung fits the remaining device "
+        "memory, 0 when it does not (absent while no limit is known).",
+    "healthwatch-active-alerts":
+        "Alert rules currently firing (active, not yet resolved).",
+    "healthwatch-alerts-fired":
+        "Burn-rate alert fire transitions, per rule.",
+    "healthwatch-alerts-suppressed":
+        "Burn-rate alert decisions suppressed while already active, "
+        "per rule.",
+    "healthwatch-alerts-resolved":
+        "Burn-rate alert resolve transitions, per rule.",
 }
+
+#: sensor families registered as callback gauges — the docs generator
+#: (tools/gen_docs.py) classifies kinds by name, and gauges render on
+#: the Prometheus scrape as the bare metric name (no ``_total`` suffix)
+GAUGE_SENSORS = frozenset({
+    "costmodel-device-bytes-in-use",
+    "costmodel-headroom-bytes",
+    "costmodel-next-step-bytes",
+    "costmodel-next-step-fits",
+    "healthwatch-active-alerts",
+})
 
 
 class MetricsRegistry:
@@ -249,9 +289,13 @@ class MetricsRegistry:
     def _read_gauge(self, name: str, key: LabelKey,
                     fn: Callable[[], float]) -> Optional[float]:
         """Read one gauge; on failure count it, warn (capped), skip it.
+        A gauge may return ``None`` to decline reporting (no sample yet,
+        e.g. the headroom forecaster before its first geometry) — skipped
+        without counting as an error.
         Caller holds ``self._lock`` (RLock — the counter bump re-enters)."""
         try:
-            return float(fn())
+            v = fn()
+            return None if v is None else float(v)
         except Exception:
             self.counter("gauge-errors")
             logged = self._gauge_error_logs.get((name, key), 0)

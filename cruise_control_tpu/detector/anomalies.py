@@ -165,6 +165,27 @@ class SlowBrokers(Anomaly):
 #: ``broker.failures.class`` / ``goal.violations.class`` /
 #: ``disk.failures.class`` / ``metric.anomaly.class``): register a subclass
 #: here and select it by name in the config; detectors construct whatever
+@dataclasses.dataclass
+class SLOBurnAnomaly(Anomaly):
+    """graftwatch SLO burn-rate alert (obs/healthwatch.py) — the service
+    itself is degrading (tick SLO, hard violations, fallbacks) faster
+    than its error budget allows.  Alert-only: the anomaly detector's
+    self-healing already owns the fixes for the underlying causes."""
+
+    rule: str = ""
+    signal: str = ""
+    burn_fast: float = 0.0
+    burn_slow: float = 0.0
+
+    def fix(self, context):
+        return None           # burn alerts page; healing stays with fixes
+
+    def summary(self):
+        return {**super().summary(), "rule": self.rule,
+                "signal": self.signal, "burnFast": self.burn_fast,
+                "burnSlow": self.burn_slow}
+
+
 #: class the config resolved.
 ANOMALY_CLASS_REGISTRY: Dict[str, type] = {
     "BrokerFailures": BrokerFailures,
@@ -173,6 +194,7 @@ ANOMALY_CLASS_REGISTRY: Dict[str, type] = {
     "MetricAnomaly": MetricAnomaly,
     "KafkaMetricAnomaly": MetricAnomaly,    # reference default's name
     "SlowBrokers": SlowBrokers,
+    "SLOBurnAnomaly": SLOBurnAnomaly,
 }
 
 

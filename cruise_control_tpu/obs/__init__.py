@@ -13,8 +13,17 @@ Always-available primitives (docs/observability.md):
   trace/compile counts and compile wall-time, steady-state retrace
   accounting, transfer-guard violation and device-dispatch counters,
   surfaced through the metrics registry and ``GET /observatory``.
+- :mod:`~cruise_control_tpu.obs.costmodel` — graftwatch's cost
+  observatory: per-compiled-program cost/memory ledger, live
+  device-buffer census, backend memory-stats sampling, and the
+  bucket-ladder headroom forecaster (``GET /headroom``).
+- :mod:`~cruise_control_tpu.obs.healthwatch` — graftwatch's health
+  watch: a device ring of per-tick health vectors with vmapped
+  fast/slow SRE burn-rate alerting (``GET /alerts``), decisions audited
+  to the flight recorder and fired through the anomaly notifier.
 """
 
+from cruise_control_tpu.obs.costmodel import COSTS, CostObservatory
 from cruise_control_tpu.obs.flightrec import (NOOP_FLIGHT_RECORDER,
                                               FlightRecorder)
 from cruise_control_tpu.obs.observatory import OBSERVATORY, Observatory
@@ -23,7 +32,9 @@ from cruise_control_tpu.obs.tracing import (NOOP_SPAN, NOOP_TRACER, Span,
 
 # obs.provenance is imported lazily by its callers (the optimizer's gated
 # attribution block): it pulls in the analyzer/goal kernels, which this
-# package must not load eagerly.
+# package must not load eagerly.  obs.healthwatch is likewise lazy — it
+# pulls ops/health (jax) and the detector's anomaly vocabulary.
 
 __all__ = ["Tracer", "Span", "NOOP_SPAN", "NOOP_TRACER", "Observatory",
-           "OBSERVATORY", "FlightRecorder", "NOOP_FLIGHT_RECORDER"]
+           "OBSERVATORY", "FlightRecorder", "NOOP_FLIGHT_RECORDER",
+           "CostObservatory", "COSTS"]

@@ -1,0 +1,518 @@
+"""graftwatch cost observatory: compiled-program cost/memory accounting,
+live device-buffer census, and the bucket-ladder headroom forecaster.
+
+Three concerns, one ledger:
+
+- **Program capture.** Every cached compiled program on the hot path
+  (anneal PT run, chain rescore, what-if grid, fused shed/lead escapes,
+  provenance attribution, device proposal decode) reports itself through
+  :func:`capture_program` the first time a given argument-shape signature
+  executes.  The ledger records argument/output bytes from the concrete
+  leaves (``.nbytes`` — no tracing, no transfers, so steady-state stays
+  zero-retrace) and, when ``obs.costmodel.deep`` is set, AOT-lowers the
+  same signature to pull XLA ``cost_analysis()`` (flops, bytes accessed)
+  and ``memory_analysis()`` (argument/output/temp/codegen bytes — the
+  compiler's own peak-footprint estimate).  Compile wall time arrives
+  per function through the PR 13 observatory's compile listener.
+- **Device memory.** :meth:`CostObservatory.live_buffer_census` groups
+  ``jax.live_arrays()`` by (shape, dtype); :meth:`memory_snapshot`
+  prefers the backend's ``memory_stats()`` (HBM ``bytes_in_use`` /
+  ``bytes_limit`` on TPU/GPU) and falls back to the census total plus
+  the configured ``obs.costmodel.hbm.limit.bytes`` on backends (CPU)
+  that report none.  Sampling happens on the injected clock at a
+  bounded cadence (:meth:`maybe_sample`) — never per dispatch.
+- **Headroom forecasting.** The bucket ladder (``models/cluster.py``,
+  ×1.25 growth) means the *next* retrace after cluster drift allocates a
+  predictably larger model.  :func:`model_bytes` prices a bucketed
+  geometry analytically from the ``DeviceTopology`` field table, and
+  :meth:`headroom_forecast` prices the next rung on every axis against
+  ``bytes_limit - bytes_in_use`` — answering "will the next bucket step
+  fit?" *before* anything compiles or allocates.  The transition peak is
+  conservative: the next rung must fit while the current one is still
+  resident, because the old buffers are only freed after the splice.
+
+Everything here is pure observation: with ``obs.costmodel.enable`` off
+(the default) the seam is a single attribute check and the optimizer's
+program is bit-identical to the historical one.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+LOG = logging.getLogger(__name__)
+
+__all__ = [
+    "CostObservatory", "COSTS", "capture_program", "model_bytes",
+    "geometry_from_counts", "geometry_from_topology", "next_bucket_step",
+]
+
+#: bytes per element for the dtypes the model tensors use
+_ITEMSIZE = {"int32": 4, "float32": 4, "bool": 1}
+
+#: analytic footprint table for one bucketed cluster model: every
+#: device-resident ``DeviceTopology`` field plus the assignment arrays,
+#: as (field, axes, dtype) with axes drawn from the bucketed geometry —
+#: B brokers, H hosts, P partitions, R replicas, M max-rf, 4 resources.
+#: Mirrors ``ops/aggregates.DeviceTopology`` / ``models/cluster``; the
+#: LinkedIn-fixture parity test pins this table against the concrete
+#: arrays, so drift between the two fails loudly.
+MODEL_FIELD_TABLE: Tuple[Tuple[str, Tuple[str, ...], str], ...] = (
+    ("rack_of_broker", ("B",), "int32"),
+    ("host_of_broker", ("B",), "int32"),
+    ("capacity", ("B", "RES"), "float32"),
+    ("host_capacity", ("H", "RES"), "float32"),
+    ("broker_alive", ("B",), "bool"),
+    ("broker_new", ("B",), "bool"),
+    ("broker_demoted", ("B",), "bool"),
+    ("partition_of_replica", ("R",), "int32"),
+    ("topic_of_partition", ("P",), "int32"),
+    ("replicas_of_partition", ("P", "M"), "int32"),
+    ("rf_of_partition", ("P",), "int32"),
+    ("replica_offline", ("R",), "bool"),
+    ("replica_base_load", ("R", "RES"), "float32"),
+    ("leader_extra", ("P", "RES"), "float32"),
+    ("leader_bytes_in", ("P",), "float32"),
+    # bucketing sentinels — None on unpadded models, but production
+    # models are always padded, so they price into the footprint
+    ("replica_weight", ("R",), "int32"),
+    ("partition_weight", ("P",), "int32"),
+    ("broker_present", ("B",), "bool"),
+    # assignment (broker_of / leader_of)
+    ("assignment.broker_of", ("R",), "int32"),
+    ("assignment.leader_of", ("P",), "int32"),
+)
+
+#: per-chain annealer working state priced per parallel-tempering chain:
+#: an assignment copy plus per-broker load aggregates
+_CHAIN_FIELD_TABLE: Tuple[Tuple[str, Tuple[str, ...], str], ...] = (
+    ("chain.broker_of", ("R",), "int32"),
+    ("chain.leader_of", ("P",), "int32"),
+    ("chain.broker_load", ("B", "RES"), "float32"),
+)
+
+
+def _axis_size(geom: Dict[str, int], axis: str) -> int:
+    if axis == "RES":
+        return 4
+    key = {"B": "brokers", "H": "hosts", "P": "partitions",
+           "R": "replicas", "M": "maxRf"}[axis]
+    return int(geom[key])
+
+
+def model_bytes(geom: Dict[str, int]) -> int:
+    """Analytic device footprint (bytes) of one bucketed cluster model.
+
+    ``geom`` holds *bucketed* axis sizes (``brokers``/``hosts``/
+    ``partitions``/``replicas``/``maxRf``) plus optional ``chains`` for
+    the annealer's per-chain working state."""
+    total = 0
+    for _name, axes, dtype in MODEL_FIELD_TABLE:
+        n = _ITEMSIZE[dtype]
+        for axis in axes:
+            n *= _axis_size(geom, axis)
+        total += n
+    chains = int(geom.get("chains", 0))
+    if chains:
+        per_chain = 0
+        for _name, axes, dtype in _CHAIN_FIELD_TABLE:
+            n = _ITEMSIZE[dtype]
+            for axis in axes:
+                n *= _axis_size(geom, axis)
+            per_chain += n
+        total += chains * per_chain
+    return total
+
+
+def geometry_from_counts(num_brokers: int, num_hosts: int,
+                         num_partitions: int, num_replicas: int,
+                         max_rf: int, chains: int = 0) -> Dict[str, int]:
+    """Bucketed geometry for a *logical* cluster size — applies the same
+    n+1 bucket-ladder rule ``pad_topology`` uses, so the result matches
+    the shapes the next model build will actually allocate."""
+    from cruise_control_tpu.models import cluster as C
+    b = C.bucket_size(num_brokers + 1, C.BROKER_BUCKET_FLOOR)
+    h = C.bucket_size(num_hosts + 1, C.HOST_BUCKET_FLOOR)
+    p = C.bucket_size(num_partitions + 1, C.PARTITION_BUCKET_FLOOR)
+    n_pp = p - num_partitions
+    r = C.bucket_size(num_replicas + n_pp, C.REPLICA_BUCKET_FLOOR)
+    return {"brokers": b, "hosts": h, "partitions": p, "replicas": r,
+            "maxRf": int(max_rf), "chains": int(chains)}
+
+
+def geometry_from_topology(dt, chains: int = 0) -> Dict[str, int]:
+    """Bucketed geometry read off an already-padded ``DeviceTopology``
+    (array shapes are the buckets — no ladder math needed)."""
+    return {
+        "brokers": int(dt.rack_of_broker.shape[0]),
+        "hosts": int(dt.host_capacity.shape[0]),
+        "partitions": int(dt.topic_of_partition.shape[0]),
+        "replicas": int(dt.partition_of_replica.shape[0]),
+        "maxRf": int(dt.replicas_of_partition.shape[1]),
+        "chains": int(chains),
+    }
+
+
+def next_bucket_step(geom: Dict[str, int]) -> Dict[str, int]:
+    """The geometry one rung up the ladder on every bucketed axis
+    (``ceil(bucket × 1.25)`` — ``BUCKET_GROWTH``); max-rf and chain
+    count carry over unchanged."""
+    from cruise_control_tpu.models.cluster import BUCKET_GROWTH
+    out = dict(geom)
+    for key in ("brokers", "hosts", "partitions", "replicas"):
+        out[key] = int(math.ceil(int(geom[key]) * BUCKET_GROWTH))
+    return out
+
+
+def _leaf_bytes_and_signature(tree) -> Tuple[int, Tuple]:
+    """Sum concrete array bytes and build a hashable shape signature for
+    a pytree of call arguments — reads metadata only, never traces."""
+    import jax
+    total = 0
+    sig: List = []
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        nbytes = getattr(leaf, "nbytes", None)
+        if shape is not None and dtype is not None:
+            sig.append((tuple(int(s) for s in shape), str(dtype)))
+            if nbytes is not None:
+                total += int(nbytes)
+        else:
+            sig.append(("scalar", type(leaf).__name__))
+    return total, tuple(sig)
+
+
+class CostObservatory:
+    """Process-lifetime ledger of compiled-program cost and device memory.
+
+    Disabled (the default) every entry point returns after one flag
+    check; the app enables and configures it from ``obs.costmodel.*``.
+    """
+
+    def __init__(self, registry=None,
+                 now_ms_fn: Optional[Callable[[], float]] = None):
+        self._lock = threading.Lock()
+        self.enabled = False
+        self._deep = False
+        self._sample_interval_ms = 10_000.0
+        self._hbm_limit_bytes: Optional[int] = None
+        self._registry = registry
+        self._now_ms = now_ms_fn or (lambda: 0.0)
+        self._programs: Dict[str, List[dict]] = {}
+        self._signatures: set = set()
+        self._compiles: Dict[str, dict] = {}
+        self._last_census: Optional[dict] = None
+        self._last_memory: Optional[dict] = None
+        self._last_forecast: Optional[dict] = None
+        self._last_sample_ms: Optional[float] = None
+        self._samples = 0
+        self._capture_errors = 0
+
+    # ------------------------------------------------------- lifecycle
+    def configure(self, *, enabled: bool, deep: bool = False,
+                  sample_interval_ms: float = 10_000.0,
+                  hbm_limit_bytes: Optional[int] = None,
+                  registry=None,
+                  now_ms_fn: Optional[Callable[[], float]] = None) -> None:
+        # configuration happens at app startup, before the control loop
+        # spawns — plain assignments, no lock (the lock guards only the
+        # mutable ledger/sample state below)
+        self.enabled = bool(enabled)
+        self._deep = bool(deep)
+        self._sample_interval_ms = float(sample_interval_ms)
+        self._hbm_limit_bytes = (
+            None if hbm_limit_bytes is None else int(hbm_limit_bytes))
+        if registry is not None:
+            self._registry = registry
+        if now_ms_fn is not None:
+            self._now_ms = now_ms_fn
+        if self.enabled and self._registry is not None:
+            self._register_gauges()
+
+    def reset(self) -> None:
+        """Drop all captured state (tests / standby takeover)."""
+        with self._lock:
+            self._programs.clear()
+            self._signatures.clear()
+            self._compiles.clear()
+            self._last_census = None
+            self._last_memory = None
+            self._last_forecast = None
+            self._last_sample_ms = None
+            self._samples = 0
+            self._capture_errors = 0
+
+    def _register_gauges(self) -> None:
+        reg = self._registry
+
+        def _val(key):
+            def read():
+                with self._lock:
+                    mem = self._last_memory or {}
+                    fc = self._last_forecast or {}
+                    vals = {
+                        "inUse": mem.get("bytesInUse"),
+                        "headroom": fc.get("headroomBytes"),
+                        "nextStep": fc.get("nextModelBytes"),
+                        "fits": fc.get("fits"),
+                    }
+                v = vals.get(key)
+                if v is None:
+                    return None
+                return float(v)
+            return read
+
+        reg.gauge("costmodel-device-bytes-in-use", _val("inUse"))
+        reg.gauge("costmodel-headroom-bytes", _val("headroom"))
+        reg.gauge("costmodel-next-step-bytes", _val("nextStep"))
+        reg.gauge("costmodel-next-step-fits", _val("fits"))
+
+    # --------------------------------------------------------- capture
+    def capture(self, name: str, fn: Optional[Callable], args: tuple,
+                out: Any, statics: Optional[dict] = None) -> bool:
+        """Record one compiled-program variant; memoized per (name,
+        argument-shape signature) so steady-state is a set lookup."""
+        if not self.enabled:
+            return False
+        arg_bytes, sig = _leaf_bytes_and_signature(args)
+        # array-valued kwargs (dynamic device scalars like movable
+        # counts) key by shape, not value — a changing count must not
+        # mint a new ledger variant every tick
+        static_sig = tuple(sorted(
+            (k, str(tuple(v.shape)) + str(v.dtype))
+            if hasattr(v, "shape") and hasattr(v, "dtype") else (k, str(v))
+            for k, v in (statics or {}).items()))
+        key = (name, sig, static_sig)
+        with self._lock:
+            if key in self._signatures:
+                return False
+            self._signatures.add(key)
+        out_bytes, _ = _leaf_bytes_and_signature(out)
+        entry = {
+            "signature": [list(map(str, s)) for s in sig[:16]],
+            "argLeaves": len(sig),
+            "argBytes": int(arg_bytes),
+            "outBytes": int(out_bytes),
+        }
+        if static_sig:
+            entry["statics"] = {k: v for k, v in static_sig}
+        if self._deep and fn is not None:
+            entry.update(self._deep_price(fn, args, statics))
+        with self._lock:
+            self._programs.setdefault(name, []).append(entry)
+        if self._registry is not None:
+            self._registry.counter("costmodel-programs-captured",
+                                   labels={"program": name})
+        return True
+
+    def _deep_price(self, fn: Callable, args: tuple,
+                    statics: Optional[dict]) -> dict:
+        """AOT-lower and compile the captured signature to pull XLA's
+        own cost and memory analyses.  A second compile of an
+        already-cached program — warmup-only by construction (capture is
+        memoized per signature), so the steady-state retrace budget is
+        untouched."""
+        try:
+            lowered = fn.lower(*args, **(statics or {}))
+            compiled = lowered.compile()
+            out: dict = {}
+            cost = compiled.cost_analysis()
+            if cost:
+                first = cost[0] if isinstance(cost, (list, tuple)) else cost
+                if "flops" in first:
+                    out["flops"] = float(first["flops"])
+                if "bytes accessed" in first:
+                    out["bytesAccessed"] = float(first["bytes accessed"])
+            mem = compiled.memory_analysis()
+            if mem is not None:
+                out["compiledArgBytes"] = int(mem.argument_size_in_bytes)
+                out["compiledOutBytes"] = int(mem.output_size_in_bytes)
+                out["compiledTempBytes"] = int(mem.temp_size_in_bytes)
+                out["compiledCodeBytes"] = int(
+                    mem.generated_code_size_in_bytes)
+            return out
+        except Exception as exc:  # graftlint: disable=G009 — deep pricing
+            # is best-effort diagnostics; a backend that can't AOT-price a
+            # program must not break the capture path
+            with self._lock:
+                self._capture_errors += 1
+            return {"deepError": f"{type(exc).__name__}: {exc}"}
+
+    def on_compile(self, fn: str, seconds: float) -> None:
+        """Observatory compile-listener sink: per-function compile wall
+        tallies folded into the ledger (the PR 13 hook)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            row = self._compiles.setdefault(fn, {"count": 0, "seconds": 0.0})
+            row["count"] += 1
+            row["seconds"] += float(seconds)
+
+    # --------------------------------------------------- device memory
+    def live_buffer_census(self, top: int = 12) -> dict:
+        """Live device buffers grouped by (shape, dtype), largest first."""
+        import jax
+        groups: Dict[Tuple, List[int]] = {}
+        total = 0
+        count = 0
+        for arr in jax.live_arrays():
+            try:
+                key = (tuple(int(s) for s in arr.shape), str(arr.dtype))
+                nbytes = int(arr.nbytes)
+            except Exception:  # graftlint: disable=G009 — a deleted/donated
+                # buffer mid-iteration must not break the census
+                continue
+            row = groups.setdefault(key, [0, 0])
+            row[0] += 1
+            row[1] += nbytes
+            total += nbytes
+            count += 1
+        rows = sorted(groups.items(), key=lambda kv: (-kv[1][1], kv[0]))
+        return {
+            "totalArrays": count,
+            "totalBytes": total,
+            "groups": [
+                {"shape": list(shape), "dtype": dtype,
+                 "count": c, "bytes": b}
+                for (shape, dtype), (c, b) in rows[:top]],
+        }
+
+    def memory_snapshot(self) -> dict:
+        """Backend ``memory_stats()`` when the platform reports them
+        (TPU/GPU HBM), else the live-array census total with the
+        configured limit — same shape either way."""
+        import jax
+        per_device = []
+        in_use = limit = 0
+        have_backend = False
+        for dev in jax.local_devices():
+            stats = None
+            try:
+                stats = dev.memory_stats()
+            except Exception:  # graftlint: disable=G009 — optional API;
+                # platforms without allocator stats fall through to census
+                stats = None
+            if stats:
+                have_backend = True
+                b = int(stats.get("bytes_in_use", 0))
+                lim = int(stats.get("bytes_limit", 0))
+                in_use += b
+                limit += lim
+                per_device.append({"device": str(dev), "bytesInUse": b,
+                                   "bytesLimit": lim or None})
+        if have_backend:
+            snap = {"source": "backend", "bytesInUse": in_use,
+                    "bytesLimit": limit or self._hbm_limit_bytes,
+                    "perDevice": per_device}
+        else:
+            census = self.live_buffer_census(top=0)
+            snap = {"source": "census",
+                    "bytesInUse": census["totalBytes"],
+                    "bytesLimit": self._hbm_limit_bytes,
+                    "perDevice": []}
+        with self._lock:
+            self._last_memory = snap
+        return snap
+
+    def maybe_sample(self, now_ms: Optional[float] = None) -> bool:
+        """Bounded-cadence sampling hook (the app calls this per tick on
+        the injected clock); returns True when a sample was taken."""
+        if not self.enabled:
+            return False
+        now = self._now_ms() if now_ms is None else float(now_ms)
+        with self._lock:
+            due = (self._last_sample_ms is None or
+                   now - self._last_sample_ms >= self._sample_interval_ms)
+            if not due:
+                return False
+            self._last_sample_ms = now
+            self._samples += 1
+        census = self.live_buffer_census()
+        with self._lock:
+            self._last_census = census
+        self.memory_snapshot()
+        return True
+
+    # ------------------------------------------------------ forecasting
+    def headroom_forecast(self, geom: Optional[Dict[str, int]] = None
+                          ) -> dict:
+        """Price the next bucket-ladder rung against remaining memory.
+
+        ``fits`` is the production question: can the next rung's full
+        model materialize while the current one is still resident (the
+        realistic transition peak — old buffers free only after the
+        splice)?  ``None`` when no byte limit is known."""
+        snap = self.memory_snapshot()
+        fc: dict = {
+            "bytesInUse": snap["bytesInUse"],
+            "bytesLimit": snap["bytesLimit"],
+            "source": snap["source"],
+        }
+        if geom is not None:
+            nxt = next_bucket_step(geom)
+            cur_b = model_bytes(geom)
+            nxt_b = model_bytes(nxt)
+            fc.update({
+                "geometry": dict(geom), "nextGeometry": nxt,
+                "currentModelBytes": cur_b, "nextModelBytes": nxt_b,
+                "deltaBytes": nxt_b - cur_b,
+            })
+            if snap["bytesLimit"]:
+                headroom = int(snap["bytesLimit"]) - int(snap["bytesInUse"])
+                fc["headroomBytes"] = headroom
+                fc["fits"] = bool(nxt_b <= headroom)
+            else:
+                fc["headroomBytes"] = None
+                fc["fits"] = None
+        with self._lock:
+            self._last_forecast = fc
+        return fc
+
+    # ---------------------------------------------------------- reading
+    def snapshot(self) -> dict:
+        """JSON view for ``/state`` and ``GET /observatory``."""
+        with self._lock:
+            programs = {
+                name: [dict(e) for e in entries]
+                for name, entries in sorted(self._programs.items())}
+            compiles = {
+                fn: {"count": row["count"],
+                     "seconds": round(row["seconds"], 3)}
+                for fn, row in sorted(self._compiles.items())}
+            return {
+                "enabled": self.enabled,
+                "deep": self._deep,
+                "programs": programs,
+                "programVariants": sum(
+                    len(v) for v in programs.values()),
+                "compiles": compiles,
+                "census": self._last_census,
+                "memory": self._last_memory,
+                "forecast": self._last_forecast,
+                "samples": self._samples,
+                "captureErrors": self._capture_errors,
+            }
+
+
+#: process-wide cost observatory (configured by the app from
+#: ``obs.costmodel.*``; disabled it never touches the hot path)
+COSTS = CostObservatory()
+
+
+def capture_program(name: str, fn: Optional[Callable] = None,
+                    args: tuple = (), out: Any = None,
+                    statics: Optional[dict] = None) -> None:
+    """Hot-path seam: record a compiled-program execution in the cost
+    ledger.  One flag check when disabled; memoized per argument-shape
+    signature when enabled, so steady-state cost is a set lookup."""
+    if not COSTS.enabled:
+        return
+    try:
+        COSTS.capture(name, fn, args, out, statics)
+    except Exception:  # graftlint: disable=G009 — observation must never
+        # break the optimizer's hot path
+        LOG.debug("costmodel capture failed for %s", name, exc_info=True)

@@ -1,0 +1,311 @@
+"""graftwatch health watch: per-tick health vectors, SRE burn-rate
+alerting, and the deterministic alert timeline.
+
+Every control-loop tick the app reports one health vector (tick latency
+vs SLO, monitor readiness, engine/fallback flags, heal wall, cache hit
+ratio, watchdog restarts, replication lag, goal verdicts — the column
+layout is ``ops/health.HEALTH_FIELDS``).  The vectors land in a
+device-resident ring and an :class:`AlertRule` registry evaluates every
+rule's fast/slow burn windows in one compiled vmapped program
+(``ops/health.burn_rates``) — multiwindow multi-burn-rate alerting in
+the SRE-workbook sense, with config-driven error budgets.
+
+Alert lifecycle (fire → suppress-while-active → resolve) runs on the
+host over the kernel's firing flags.  Every decision:
+
+- lands in the PR 14 flight recorder through the same ``decision_sink``
+  seam the anomaly detector audits through,
+- fires through the existing notifier seam
+  (``detector/anomalies.SelfHealingNotifier.alert``) as a
+  :class:`~cruise_control_tpu.detector.anomalies.SLOBurnAnomaly`,
+- appends to a canonical in-memory timeline (``export_timeline``) —
+  everything is driven by the injected clock, so same-seed simulator
+  scenarios produce byte-identical alert timelines.
+
+Disabled (the default) the watch is never constructed and the tick path
+is bit-identical to the historical program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import threading
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from cruise_control_tpu.ops import health as H
+
+LOG = logging.getLogger(__name__)
+
+__all__ = ["AlertRule", "HealthWatch", "default_rules"]
+
+#: timeline safety cap — a runaway alert storm must not grow host memory
+#: without bound; drops are counted, never silent
+_TIMELINE_CAP = 65_536
+
+
+@dataclasses.dataclass(frozen=True)
+class AlertRule:
+    """One burn-rate alert: fires when the bad-tick fraction of
+    ``signal`` (> ``threshold``) burns the error budget faster than
+    ``fast_burn``× over the fast window AND ``slow_burn``× over the
+    slow window."""
+    name: str
+    signal: str                 # column in ops/health.HEALTH_FIELDS
+    threshold: float = 0.5      # signal > threshold counts as a bad tick
+    budget: float = 0.02        # allowed bad-tick fraction (error budget)
+    fast_window_ticks: int = 8
+    slow_window_ticks: int = 32
+    fast_burn: float = 10.0
+    slow_burn: float = 2.5
+
+    def table_row(self):
+        return (H.FIELD_INDEX[self.signal], self.threshold, self.budget,
+                self.fast_window_ticks, self.slow_window_ticks,
+                self.fast_burn, self.slow_burn)
+
+    def describe(self) -> dict:
+        return {
+            "name": self.name, "signal": self.signal,
+            "threshold": self.threshold, "budget": self.budget,
+            "fastWindowTicks": self.fast_window_ticks,
+            "slowWindowTicks": self.slow_window_ticks,
+            "fastBurn": self.fast_burn, "slowBurn": self.slow_burn,
+        }
+
+
+def default_rules(budget: float, fast_w: int, slow_w: int,
+                  fast_burn: float, slow_burn: float) -> List[AlertRule]:
+    """The stock rule set: tick degradation, hard-goal violations and
+    engine fallbacks, all on the shared windows/budget."""
+    mk = lambda name, signal: AlertRule(  # noqa: E731
+        name=name, signal=signal, budget=budget,
+        fast_window_ticks=fast_w, slow_window_ticks=slow_w,
+        fast_burn=fast_burn, slow_burn=slow_burn)
+    return [
+        mk("tick-slo-burn", "degraded"),
+        mk("hard-violation-burn", "hardViolations"),
+        mk("fallback-burn", "fallback"),
+    ]
+
+
+def rules_from_config(config) -> List[AlertRule]:
+    """Stock rules on the configured windows/budget, plus/overridden by
+    the ``healthwatch.rules`` JSON list (entries are keyword dicts in
+    ``AlertRule.describe`` key style; same-name entries replace)."""
+    budget = float(config.get("healthwatch.error.budget"))
+    fast_w = int(config.get("healthwatch.fast.window.ticks"))
+    slow_w = int(config.get("healthwatch.slow.window.ticks"))
+    fast_b = float(config.get("healthwatch.fast.burn"))
+    slow_b = float(config.get("healthwatch.slow.burn"))
+    rules = {r.name: r for r in default_rules(
+        budget, fast_w, slow_w, fast_b, slow_b)}
+    raw = config.get("healthwatch.rules")
+    if raw:
+        for entry in json.loads(raw):
+            rule = AlertRule(
+                name=str(entry["name"]), signal=str(entry["signal"]),
+                threshold=float(entry.get("threshold", 0.5)),
+                budget=float(entry.get("budget", budget)),
+                fast_window_ticks=int(
+                    entry.get("fastWindowTicks", fast_w)),
+                slow_window_ticks=int(
+                    entry.get("slowWindowTicks", slow_w)),
+                fast_burn=float(entry.get("fastBurn", fast_b)),
+                slow_burn=float(entry.get("slowBurn", slow_b)))
+            if rule.signal not in H.FIELD_INDEX:
+                raise ValueError(
+                    f"healthwatch.rules: unknown signal {rule.signal!r}; "
+                    f"known: {', '.join(H.HEALTH_FIELDS)}")
+            rules[rule.name] = rule
+    return [rules[name] for name in sorted(rules)]
+
+
+class HealthWatch:
+    """Device health ring + alert lifecycle for one app instance."""
+
+    def __init__(self, rules: List[AlertRule], *, ring_ticks: int = 512,
+                 tick_slo_ms: float = 30_000.0,
+                 now_ms_fn: Optional[Callable[[], float]] = None,
+                 registry=None,
+                 decision_sink: Optional[Callable[[dict], None]] = None,
+                 notifier=None):
+        if not rules:
+            raise ValueError("HealthWatch needs at least one AlertRule")
+        self._rules = list(rules)
+        self._ring_ticks = int(ring_ticks)
+        self.tick_slo_ms = float(tick_slo_ms)
+        self._now_ms = now_ms_fn or (lambda: 0.0)
+        self._registry = registry
+        self._decision_sink = decision_sink or (lambda payload: None)
+        self._notifier = notifier
+        self._lock = threading.Lock()
+        self._tables = H.rule_tables(r.table_row() for r in self._rules)
+        self._ring, self._count = H.new_ring(self._ring_ticks)
+        self._active: Dict[str, int] = {}      # rule -> firing-since tick
+        self._fired = 0
+        self._suppressed = 0
+        self._resolved = 0
+        self._first_firing_tick: Optional[int] = None
+        self._timeline: List[dict] = []
+        self._timeline_dropped = 0
+        self._last_burns: Dict[str, dict] = {}
+        if registry is not None:
+            registry.gauge("healthwatch-active-alerts",
+                           lambda: float(len(self._active)))
+
+    # ------------------------------------------------------------ clear
+    def reset(self) -> None:
+        """Fresh ring and empty timeline (simulator measurement
+        baseline — mirrors ``tracer.clear()`` / ``flightrec.clear()``)."""
+        with self._lock:
+            self._ring, self._count = H.new_ring(self._ring_ticks)
+            self._active.clear()
+            self._fired = self._suppressed = self._resolved = 0
+            self._first_firing_tick = None
+            self._timeline.clear()
+            self._timeline_dropped = 0
+            self._last_burns.clear()
+
+    # ---------------------------------------------------------- observe
+    def observe(self, sample: Dict[str, float]) -> List[dict]:
+        """Fold one tick's health sample into the ring and run every
+        alert rule; returns this tick's alert decisions (possibly [])."""
+        vec = np.zeros(len(H.HEALTH_FIELDS), np.float32)
+        for name, value in sample.items():
+            vec[H.FIELD_INDEX[name]] = np.float32(value)
+        latency = float(vec[H.FIELD_INDEX["latencyMs"]])
+        vec[H.FIELD_INDEX["latencyBreach"]] = np.float32(
+            1.0 if latency > self.tick_slo_ms else 0.0)
+        vec[H.FIELD_INDEX["degraded"]] = max(
+            vec[H.FIELD_INDEX["latencyBreach"]],
+            vec[H.FIELD_INDEX["notReady"]],
+            vec[H.FIELD_INDEX["failed"]],
+            vec[H.FIELD_INDEX["fallback"]])
+        with self._lock:
+            tick = int(np.asarray(self._count))
+            self._ring, self._count = H.push(self._ring, self._count, vec)
+            burn_fast, burn_slow, _ff, _fs, firing = (
+                np.asarray(a) for a in H.burn_rates(
+                    self._ring, self._count, *self._tables))
+            decisions = self._transition(tick, burn_fast, burn_slow, firing)
+        for payload in decisions:
+            self._emit(payload)
+        return decisions
+
+    def _transition(self, tick: int, burn_fast, burn_slow,
+                    firing) -> List[dict]:
+        ts_ms = int(self._now_ms())
+        decisions: List[dict] = []
+        for i, rule in enumerate(self._rules):
+            bf = round(float(burn_fast[i]), 6)
+            bs = round(float(burn_slow[i]), 6)
+            self._last_burns[rule.name] = {"fast": bf, "slow": bs}
+            is_firing = bool(firing[i])
+            was_active = rule.name in self._active
+            if is_firing and not was_active:
+                decision = "fired"
+                self._active[rule.name] = tick
+                self._fired += 1
+                if self._first_firing_tick is None:
+                    self._first_firing_tick = tick
+            elif is_firing and was_active:
+                decision = "suppressed"
+                self._suppressed += 1
+            elif was_active:
+                decision = "resolved"
+                del self._active[rule.name]
+                self._resolved += 1
+            else:
+                continue
+            decisions.append({
+                "tick": tick, "rule": rule.name, "signal": rule.signal,
+                "decision": decision, "burnFast": bf, "burnSlow": bs,
+                "tsMs": ts_ms,
+            })
+        for payload in decisions:
+            if len(self._timeline) < _TIMELINE_CAP:
+                self._timeline.append(payload)
+            else:
+                self._timeline_dropped += 1
+        return decisions
+
+    def _emit(self, payload: dict) -> None:
+        if self._registry is not None:
+            self._registry.counter(
+                f"healthwatch-alerts-{payload['decision']}",
+                labels={"rule": payload["rule"]})
+        try:
+            self._decision_sink(dict(payload))
+        except Exception:  # graftlint: disable=G009 — an audit sink must
+            # never break the tick path
+            LOG.debug("healthwatch decision sink failed", exc_info=True)
+        if payload["decision"] == "fired" and self._notifier is not None:
+            try:
+                from cruise_control_tpu.detector.anomalies import (
+                    AnomalyType, SLOBurnAnomaly)
+                anomaly = SLOBurnAnomaly(
+                    anomaly_type=AnomalyType.METRIC_ANOMALY,
+                    detection_time_ms=payload["tsMs"],
+                    rule=payload["rule"], signal=payload["signal"],
+                    burn_fast=payload["burnFast"],
+                    burn_slow=payload["burnSlow"])
+                alert = getattr(self._notifier, "alert", None)
+                if alert is not None:
+                    alert(anomaly, auto_fix_triggered=False)
+            except Exception:  # graftlint: disable=G009 — notification is
+                # fire-and-forget; a broken webhook must not break ticks
+                LOG.warning("healthwatch notifier failed", exc_info=True)
+
+    # ---------------------------------------------------------- reading
+    def alert_counts(self) -> dict:
+        with self._lock:
+            return {
+                "fired": self._fired,
+                "suppressed": self._suppressed,
+                "resolved": self._resolved,
+                "firstFiringTick": self._first_firing_tick,
+            }
+
+    def active_alerts(self) -> List[dict]:
+        with self._lock:
+            return [{"rule": name, "sinceTick": since,
+                     **self._last_burns.get(name, {})}
+                    for name, since in sorted(self._active.items())]
+
+    def snapshot(self, history: int = 32) -> dict:
+        """JSON view for ``/state`` and ``GET /alerts``."""
+        with self._lock:
+            return {
+                "enabled": True,
+                "ticks": int(np.asarray(self._count)),
+                "ringTicks": self._ring_ticks,
+                "tickSloMs": self.tick_slo_ms,
+                "rules": [r.describe() for r in self._rules],
+                "active": [
+                    {"rule": name, "sinceTick": since,
+                     **self._last_burns.get(name, {})}
+                    for name, since in sorted(self._active.items())],
+                "burns": {name: dict(v) for name, v in
+                          sorted(self._last_burns.items())},
+                "counts": {
+                    "fired": self._fired,
+                    "suppressed": self._suppressed,
+                    "resolved": self._resolved,
+                    "firstFiringTick": self._first_firing_tick,
+                },
+                "history": [dict(p) for p in self._timeline[-history:]],
+                "timelineDropped": self._timeline_dropped,
+            }
+
+    def export_timeline(self) -> str:
+        """Canonical JSONL of every alert decision since the last reset —
+        the byte-identical same-seed contract surface."""
+        with self._lock:
+            rows = [dict(p) for p in self._timeline]
+        return "\n".join(
+            json.dumps(r, sort_keys=True, separators=(",", ":"))
+            for r in rows)

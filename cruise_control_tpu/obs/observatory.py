@@ -90,6 +90,9 @@ class Observatory:
         # host-side tallies (callsite label -> count)
         self._dispatches: Dict[str, int] = {}
         self._transfer_violations: Dict[str, int] = {}
+        # compile listeners: (fn, seconds) sinks fed on compile_done —
+        # the costmodel ledger subscribes here
+        self._compile_listeners: list = []
 
     # -------------------------------------------------------- lifecycle
     @property
@@ -175,6 +178,34 @@ class Observatory:
             elif kind == "compile_done" and seconds is not None:
                 self._registry.timer("observatory-compile-timer",
                                      labels={"function": fn}).update(seconds)
+                # labeled cumulative wall-time series: the histogram above
+                # buckets durations per function, this answers "which
+                # function owns the compile budget" in one Prometheus query
+                self._registry.counter("observatory-compile-wall-seconds",
+                                       inc=float(seconds),
+                                       labels={"function": fn})
+        if kind == "compile_done" and seconds is not None:
+            with self._lock:
+                listeners = list(self._compile_listeners)
+            for cb in listeners:
+                try:
+                    cb(fn, seconds)
+                except Exception:  # graftlint: disable=G009 — a listener
+                    # must never break the log-handler path
+                    with self._lock:
+                        self._emit_errors += 1
+
+    def add_compile_listener(self, cb) -> None:
+        """Subscribe a ``(function_name, seconds)`` sink to compile
+        completions (idempotent per callable)."""
+        with self._lock:
+            if cb not in self._compile_listeners:
+                self._compile_listeners.append(cb)
+
+    def remove_compile_listener(self, cb) -> None:
+        with self._lock:
+            if cb in self._compile_listeners:
+                self._compile_listeners.remove(cb)
 
     def mark_steady(self) -> None:
         """Declare warmup over: traces from now on are steady-state

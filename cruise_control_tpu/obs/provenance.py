@@ -55,6 +55,7 @@ from cruise_control_tpu.analyzer import goals as G
 from cruise_control_tpu.analyzer import objective as OBJ
 from cruise_control_tpu.analyzer import proposals as PR
 from cruise_control_tpu.common import resources as res
+from cruise_control_tpu.obs import costmodel as CM
 from cruise_control_tpu.ops import aggregates as AGG
 from cruise_control_tpu.ops.windows import bucket_len
 
@@ -303,6 +304,13 @@ def attribute_proposal(dt: AGG.DeviceTopology, final, base, th, agg,
         init_broker if init_broker is not None else final.broker_of,
         jnp.asarray(padded), num_topics, goal_names, sparse_topic,
         init_broker is not None)
+    CM.capture_program(
+        "provenance-attribution", _attribution_kernel,
+        (dt, final, base, th, agg,
+         init_broker if init_broker is not None else final.broker_of,
+         jnp.asarray(padded), num_topics, goal_names, sparse_topic,
+         init_broker is not None),
+        (vd, cd))
     return AttributionResult(
         goals=names_ext, partitions=pids,
         violations_delta=np.asarray(jax.device_get(vd))[:M],

@@ -1,0 +1,131 @@
+"""Device-resident health ring + vmapped multi-window burn-rate kernels.
+
+The graftwatch layer (``obs/healthwatch.py``) keeps the last N per-tick
+health vectors in a fixed-shape ``[N, F]`` float32 ring that lives on
+device for the process lifetime.  Each tick is one ``push`` dispatch
+(pure ``.at[idx].set`` on the carried ring) and one ``burn_rates``
+dispatch that evaluates *every* alert rule's SRE-style fast/slow burn
+windows in a single compiled program (``vmap`` over the rule axis) —
+zero retraces after warmup because every shape is pinned at ring
+construction and rule tables are baked device arrays.
+
+Burn-rate semantics follow the multiwindow multi-burn-rate alerting
+recipe (Google SRE workbook ch. 5): with an error budget ``b`` (allowed
+bad-tick fraction) and a window of ``w`` ticks, the burn rate is
+``bad_fraction(w) / b``; a rule fires only when *both* its fast and slow
+windows exceed their burn thresholds, which keeps detection fast without
+paging on blips.  All window math is ring-age arithmetic on the modular
+write cursor, so a partially-filled ring never reads stale slots.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "HEALTH_FIELDS", "FIELD_INDEX", "new_ring", "push", "burn_rates",
+]
+
+#: column layout of one health vector (order is the wire format — the
+#: ring, the rule ``signal`` lookup and the timeline export all index it)
+HEALTH_FIELDS = (
+    "ok",              # 1.0 when the tick produced/kept a usable proposal
+    "latencyMs",       # tick wall time on the injected clock
+    "latencyBreach",   # latencyMs > tick SLO
+    "notReady",        # monitor starved (NotEnoughValidWindows)
+    "failed",          # precompute raised
+    "fallback",        # engine fallback engaged this tick
+    "engineAnneal",    # 1.0 while the primary anneal engine is serving
+    "healWallMs",      # last self-heal wall time
+    "cacheHitRatio",   # proposal cache hits / (hits + misses)
+    "watchdogRestarts",  # cumulative watchdog restart count
+    "replicationLag",  # journal-shipping follower lag (records)
+    "hardViolations",  # hard-goal violations on the served proposal
+    "softViolations",  # soft-goal violations on the served proposal
+    "degraded",        # max(latencyBreach, notReady, failed, fallback)
+)
+
+FIELD_INDEX = {name: i for i, name in enumerate(HEALTH_FIELDS)}
+
+
+def new_ring(capacity: int):
+    """Fresh ``([N, F] zeros ring, 0 count)`` pair, both device-resident."""
+    ring = jnp.zeros((int(capacity), len(HEALTH_FIELDS)), jnp.float32)
+    count = jnp.zeros((), jnp.int32)
+    return ring, count
+
+
+@jax.jit
+def push(ring, count, vec):
+    """Append one health vector; returns the updated ``(ring, count)``.
+
+    The write cursor is ``count mod N`` so the ring wraps in place; the
+    count itself grows without bound (age arithmetic in the burn kernel
+    uses it to mask slots that were never written).
+    """
+    n = ring.shape[0]
+    idx = jnp.mod(count, n)
+    return ring.at[idx].set(vec.astype(ring.dtype)), count + 1
+
+
+def _one_rule(ring, count, col, threshold, budget,
+              fast_w, slow_w, fast_burn, slow_burn):
+    """Burn-rate evaluation of a single rule (vmapped over rules)."""
+    n = ring.shape[0]
+    slots = jnp.arange(n, dtype=jnp.int32)
+    # age 0 = the most recently written slot; never-written slots get an
+    # age >= min(count, n) and fall out of every window mask below
+    age = jnp.mod(count - 1 - slots, n)
+    written = jnp.minimum(count, n)
+    signal = jnp.take(ring, col, axis=1)              # [N]
+    bad = (signal > threshold).astype(jnp.float32)
+
+    def bad_fraction(window):
+        span = jnp.minimum(written, window)
+        mask = (age < span).astype(jnp.float32)
+        return jnp.sum(bad * mask) / jnp.maximum(span, 1).astype(jnp.float32)
+
+    safe_budget = jnp.maximum(budget, 1e-9)
+    frac_fast = bad_fraction(fast_w)
+    frac_slow = bad_fraction(slow_w)
+    burn_fast = frac_fast / safe_budget
+    burn_slow = frac_slow / safe_budget
+    # a rule is not evaluable before its fast window has filled once —
+    # firing off two warmup ticks would page on every cold start
+    ready = count >= fast_w
+    firing = ready & (burn_fast >= fast_burn) & (burn_slow >= slow_burn)
+    return burn_fast, burn_slow, frac_fast, frac_slow, firing
+
+
+@jax.jit
+def burn_rates(ring, count, cols, thresholds, budgets,
+               fast_windows, slow_windows, fast_burns, slow_burns):
+    """Evaluate every rule's fast/slow burn in one compiled program.
+
+    All rule tables are ``[K]`` device arrays baked once at registry
+    build; the only per-tick inputs are the carried ``(ring, count)``.
+    Returns ``(burn_fast[K], burn_slow[K], frac_fast[K], frac_slow[K],
+    firing[K])``.
+    """
+    return jax.vmap(partial(_one_rule, ring, count))(
+        cols, thresholds, budgets,
+        fast_windows, slow_windows, fast_burns, slow_burns)
+
+
+def rule_tables(rules):
+    """Bake an iterable of rule tuples into the device arrays that
+    :func:`burn_rates` consumes.  Each rule is ``(col, threshold, budget,
+    fast_w, slow_w, fast_burn, slow_burn)``."""
+    rows = list(rules)
+    cols = jnp.asarray(np.array([r[0] for r in rows], np.int32))
+    thr = jnp.asarray(np.array([r[1] for r in rows], np.float32))
+    bud = jnp.asarray(np.array([r[2] for r in rows], np.float32))
+    fw = jnp.asarray(np.array([r[3] for r in rows], np.int32))
+    sw = jnp.asarray(np.array([r[4] for r in rows], np.int32))
+    fb = jnp.asarray(np.array([r[5] for r in rows], np.float32))
+    sb = jnp.asarray(np.array([r[6] for r in rows], np.float32))
+    return cols, thr, bud, fw, sw, fb, sb

@@ -40,6 +40,7 @@ from cruise_control_tpu.analyzer.optimizer import (
     balancedness_cost_by_goal,
 )
 from cruise_control_tpu.common import resources as res
+from cruise_control_tpu.obs import costmodel as CM
 from cruise_control_tpu.common.resources import BalancingConstraint
 from cruise_control_tpu.ops.aggregates import (
     compute_aggregates,
@@ -225,6 +226,12 @@ def evaluate_grid(grid: ScenarioGrid, constraint: BalancingConstraint,
         grid.dts, grid.assigns, jnp.float32(headroom),
         num_topics=grid.num_topics, goal_names=goal_names,
         constraint=constraint, sparse_topic=bool(sparse_topic))
+    CM.capture_program(
+        "whatif-grid", _eval_grid,
+        (grid.dts, grid.assigns, jnp.float32(headroom)),
+        (viol, cost, bounds),
+        {"num_topics": grid.num_topics, "goal_names": goal_names,
+         "constraint": constraint, "sparse_topic": bool(sparse_topic)})
     viol = np.asarray(jax.device_get(viol))      # f32[S, G+1]
     cost = np.asarray(jax.device_get(cost))
     bounds = np.asarray(jax.device_get(bounds))  # f32[S, 6]

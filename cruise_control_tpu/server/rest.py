@@ -56,6 +56,8 @@ _ENDPOINT_TABLE = (
     ("OBSERVATORY", "GET", "CRUISE_CONTROL_MONITOR"),
     ("EXPLAIN", "GET", "KAFKA_MONITOR"),
     ("FLIGHTRECORDER", "GET", "CRUISE_CONTROL_MONITOR"),
+    ("ALERTS", "GET", "CRUISE_CONTROL_MONITOR"),
+    ("HEADROOM", "GET", "CRUISE_CONTROL_MONITOR"),
     ("WHAT_IF", "GET", "KAFKA_MONITOR"),
     # -- POST -------------------------------------------------------------
     ("ADD_BROKER", "POST", "KAFKA_ADMIN"),
@@ -429,6 +431,25 @@ class RestApi:
             return 200, {"summary": self.app.flightrec.summary(),
                          "records": self.app.flightrec.records()}
         return 200, self.app.flightrecorder_jsonl()
+
+    def _alerts(self, params, client_id, request_url):
+        """graftwatch burn-rate alerts (obs/healthwatch.py): active
+        alerts, the rule registry, fire/suppress/resolve counts and —
+        with ``history=N`` — the last N alert decisions. Requires
+        ``healthwatch.enable``."""
+        history = params.get("history")
+        try:
+            n = max(0, int(history)) if history is not None else 64
+        except (TypeError, ValueError):
+            return 400, {"errorMessage": f"bad history: {history!r}"}
+        return 200, self.app.alerts_state(history=n)
+
+    def _headroom(self, params, client_id, request_url):
+        """graftwatch headroom forecast (obs/costmodel.py): device memory
+        in use, the live-buffer census, and whether the next bucket-ladder
+        step (×1.25 growth) fits the remaining device memory. Requires
+        ``obs.costmodel.enable``."""
+        return 200, self.app.headroom_state()
 
     def _proposals(self, params, client_id, request_url):
         if _parse_bool(params, "kafka_assigner", False):

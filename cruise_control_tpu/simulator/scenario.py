@@ -149,6 +149,12 @@ def _scenario_config(sc: Scenario):
         # scorecard's per-stage breakdown (and the exported Chrome trace)
         # is a deterministic function of the scenario
         "obs.tracing.enable": True,
+        # graftwatch: burn-rate alerting on the virtual clock — the
+        # scorecard's alert timeline is a deterministic function of the
+        # seed (fast window in tick units so short scenarios can fire)
+        "healthwatch.enable": True,
+        "healthwatch.fast.window.ticks": 4,
+        "healthwatch.slow.window.ticks": 16,
     }
     if sc.warm_standby:
         # lease timing in tick units: the leader renews every tick, so a
@@ -416,6 +422,10 @@ def run_scenario(sc: Scenario, use_sentinel: bool = False,
     # function of the seed; timestamps come from the virtual clock).
     app.tracer.clear()
     app.flightrec.clear()
+    # graftwatch shares the boundary: the alert timeline (and its digest
+    # in the scorecard core) covers exactly the measured ticks
+    if app.healthwatch is not None:
+        app.healthwatch.reset()
     # replay pin: a scenario fully described by scalar spec fields (no
     # workload object, no faults, no standby) embeds the spec so
     # tools/replay_tick.py can rebuild it from the log alone
@@ -696,6 +706,18 @@ def run_scenario(sc: Scenario, use_sentinel: bool = False,
         # byte-for-byte (tools/replay_tick.py replays individual records)
         "flightRecorder": {"records": len(app.flightrec.records()),
                            "digest": app.flightrec.export_digest()},
+        # graftwatch attachment: burn-rate alert counts + digest of the
+        # canonical alert timeline. Also in the deterministic core — every
+        # signal in a health vector derives from seed-determined state and
+        # the virtual clock, so same-seed runs reproduce the timeline
+        # byte-for-byte
+        "alerts": (dict(app.healthwatch.alert_counts(),
+                        timelineDigest=hashlib.sha256(
+                            app.healthwatch.export_timeline().encode()
+                        ).hexdigest())
+                   if app.healthwatch is not None else
+                   {"fired": 0, "suppressed": 0, "resolved": 0,
+                    "firstFiringTick": None, "timelineDigest": None}),
     }
     walls = np.asarray(tick_walls) if tick_walls else np.zeros(1)
     with app._cache_lock:

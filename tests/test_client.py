@@ -209,9 +209,10 @@ def test_cli_int_and_csv_int_validation():
 def test_cli_parser_covers_all_endpoints():
     parser = cccli.build_parser()
     names = {e.name for e in cccli.ENDPOINTS}
-    assert len(cccli.ENDPOINTS) == 25
+    assert len(cccli.ENDPOINTS) == 27
     assert {"rebalance", "proposals", "state", "remove_broker",
-            "topic_configuration", "review", "what_if", "rightsize"} <= names
+            "topic_configuration", "review", "what_if", "rightsize",
+            "alerts", "headroom"} <= names
     # every endpoint subcommand parses
     for e in cccli.ENDPOINTS:
         args = parser.parse_args(["-a", "x:1", e.name])

@@ -1,0 +1,468 @@
+"""graftwatch suite: device memory/cost observatory + SLO burn-rate alerts.
+
+Covers the ISSUE 15 acceptance surface:
+
+- the headroom forecaster's analytic per-bucket footprint tracks the
+  *measured* device residency of the LinkedIn fixture within a pinned
+  tolerance, and flags the xl (26K-broker) footprint against a small
+  configured byte limit;
+- a latency-storm + broker-death scenario produces a byte-identical
+  same-seed alert timeline, with the burn-rate alert firing before the
+  first hard-violation tick;
+- graftwatch disabled (and enabled!) leaves the optimizer bit-identical
+  on the three parity fixtures;
+- the alert lifecycle (fired -> suppressed -> resolved) lands in the
+  decision sink and the notifier seam, mirroring test_detector.py.
+"""
+
+import gc
+import json
+import logging
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import optimizer as OPT
+from cruise_control_tpu.analyzer.annealer import AnnealConfig
+from cruise_control_tpu.common.metrics import MetricsRegistry
+from cruise_control_tpu.models import cluster as C
+from cruise_control_tpu.models import fixtures
+from cruise_control_tpu.obs import costmodel as CM
+from cruise_control_tpu.obs import healthwatch as HW
+from cruise_control_tpu.obs.observatory import Observatory
+from cruise_control_tpu.ops import health as H
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture()
+def costs():
+    """The process-wide cost observatory, enabled for one test and
+    restored to cold afterwards (it is a module singleton)."""
+    CM.COSTS.reset()
+    yield CM.COSTS
+    CM.COSTS.configure(enabled=False)
+    CM.COSTS.reset()
+
+
+# ------------------------------------------------------------- geometry
+
+
+def test_geometry_matches_padded_device_shapes():
+    """The forecaster's ladder math must agree with pad_topology — the
+    analytic geometry IS the shapes the next build will allocate."""
+    topo, assign = fixtures.unbalanced()
+    geom = CM.geometry_from_counts(topo.num_brokers, topo.num_hosts,
+                                   topo.num_partitions, topo.num_replicas,
+                                   topo.max_rf)
+    ptopo, passign, _info = C.pad_topology(topo, assign)
+    assert geom["brokers"] == ptopo.num_brokers
+    assert geom["hosts"] == ptopo.num_hosts
+    assert geom["partitions"] == ptopo.num_partitions
+    assert geom["replicas"] == ptopo.num_replicas
+    assert geom["maxRf"] == ptopo.max_rf
+    # next rung grows every bucketed axis by the ladder factor
+    nxt = CM.next_bucket_step(geom)
+    for axis in ("brokers", "hosts", "partitions", "replicas"):
+        assert nxt[axis] > geom[axis]
+    assert nxt["maxRf"] == geom["maxRf"]
+    assert CM.model_bytes(nxt) > CM.model_bytes(geom)
+    # chain working state prices in on top of the base model
+    with_chains = CM.model_bytes(dict(geom, chains=8))
+    assert with_chains > CM.model_bytes(geom)
+
+
+@pytest.mark.slow
+def test_headroom_forecast_tracks_measured_footprint_linkedin(costs):
+    """Acceptance: the analytic per-bucket footprint must land within a
+    pinned tolerance of the *measured* device residency delta when the
+    LinkedIn fixture's padded model materializes (census-backed
+    memory_stats on CPU).  LinkedIn-scale model build — slow tier, like
+    the provenance suite's LinkedIn-shape attribution test; the fast
+    tier pins the same ladder math via geometry parity + the xl flag."""
+    import jax
+    import jax.numpy as jnp
+
+    from cruise_control_tpu.ops import aggregates as A
+
+    costs.configure(enabled=True)
+    topo, assign = fixtures.synthetic_cluster(num_brokers=2_600,
+                                              num_replicas=500_000)
+    gc.collect()
+    before = costs.memory_snapshot()["bytesInUse"]
+    ptopo, passign, _ = C.pad_topology(topo, assign)
+    dt = A.device_topology(ptopo)
+    da_broker = jnp.asarray(passign.broker_of, jnp.int32)
+    da_leader = jnp.asarray(passign.leader_of, jnp.int32)
+    jax.block_until_ready(
+        ([x for x in dt if x is not None], da_broker, da_leader))
+    after = costs.memory_snapshot()["bytesInUse"]
+    measured = after - before
+    geom = CM.geometry_from_topology(dt)
+    predicted = CM.model_bytes(geom)
+    assert measured > 0
+    # pinned tolerance: the ledger tables mirror the model field-for-field
+    assert abs(predicted - measured) / measured < 0.15, \
+        (predicted, measured)
+    # and the forecast built on this geometry reports the same numbers
+    fc = costs.headroom_forecast(geom)
+    assert fc["currentModelBytes"] == predicted
+    assert fc["nextModelBytes"] > predicted
+    del dt, da_broker, da_leader
+
+
+def test_xl_footprint_flagged_before_compile(costs):
+    """Acceptance: the forecaster must flag the xl 26K-broker fixture's
+    footprint against a small byte budget BEFORE anything compiles or
+    allocates — pure ladder math over the logical counts."""
+    costs.configure(enabled=True, hbm_limit_bytes=256 << 20)
+    # xl_cluster logical counts (fixtures.xl_cluster) without building it
+    geom = CM.geometry_from_counts(num_brokers=26_000, num_hosts=26_000,
+                                   num_partitions=5_000_000 // 3,
+                                   num_replicas=5_000_000, max_rf=3,
+                                   chains=8)
+    fc = costs.headroom_forecast(geom)
+    assert fc["nextModelBytes"] > fc["currentModelBytes"] > 256 << 20
+    assert fc["fits"] is False
+    # a generous budget clears the same forecast
+    costs.configure(enabled=True, hbm_limit_bytes=1 << 40)
+    fc2 = costs.headroom_forecast(geom)
+    assert fc2["fits"] is True
+    assert fc2["nextModelBytes"] == fc["nextModelBytes"]
+
+
+# ------------------------------------------------------------ cost ledger
+
+
+def test_capture_ledger_and_deep_pricing(costs):
+    """Deep pricing pulls XLA's own cost/memory analyses for a captured
+    program; the ledger memoizes per argument-shape signature."""
+    import jax
+    import jax.numpy as jnp
+
+    costs.configure(enabled=True, deep=True)
+    f = jax.jit(lambda x: (x * 2.0).sum())
+    x = jnp.arange(64, dtype=jnp.float32)
+    out = f(x)
+    assert costs.capture("toy", f, (x,), out) is True
+    assert costs.capture("toy", f, (x,), out) is False      # memoized
+    # a changing device-scalar static keys by shape, not value
+    s1, s2 = jnp.int32(3), jnp.int32(9)
+    assert costs.capture("toy2", None, (x,), out,
+                         statics={"n": s1}) is True
+    assert costs.capture("toy2", None, (x,), out,
+                         statics={"n": s2}) is False
+    snap = costs.snapshot()
+    assert set(snap["programs"]) == {"toy", "toy2"}
+    entry = snap["programs"]["toy"][0]
+    assert entry["argBytes"] == 64 * 4
+    assert entry["flops"] > 0
+    assert entry["bytesAccessed"] > 0
+    assert "compiledTempBytes" in entry
+    # a new shape is a new variant
+    y = jnp.arange(128, dtype=jnp.float32)
+    assert costs.capture("toy", f, (y,), f(y)) is True
+    assert len(costs.snapshot()["programs"]["toy"]) == 2
+
+
+def test_compile_wall_series_is_labeled_and_feeds_ledger():
+    """Satellite 2: per-kernel compile wall-time surfaces as a labeled
+    Prometheus counter, and the observatory's compile listener folds the
+    same events into the cost ledger."""
+    reg = MetricsRegistry()
+    obs = Observatory(registry=reg)
+    costs = CM.CostObservatory()
+    costs.configure(enabled=True)
+    obs.add_compile_listener(costs.on_compile)
+    obs.install()
+    try:
+        jlog = logging.getLogger("jax._src.dispatch")
+        jlog.warning("Finished XLA compilation of jit(foo) in 0.25 sec")
+        jlog.warning("Finished XLA compilation of jit(foo) in 0.75 sec")
+    finally:
+        obs.remove_compile_listener(costs.on_compile)
+        obs.uninstall()
+    prom = reg.prometheus()
+    assert ('kafka_cruisecontrol_observatory_compile_wall_seconds_total'
+            '{function="foo"} 1\n') in prom
+    snap = costs.snapshot()
+    assert snap["compiles"]["foo"] == {"count": 2, "seconds": 1.0}
+
+
+@pytest.mark.parametrize("fixture", ["unbalanced", "small_cluster_model",
+                                     "dead_broker"])
+def test_costmodel_off_and_on_are_bit_identical(fixture, costs):
+    """The observation contract: the cost observatory must not perturb
+    the optimizer by one bit — captures read array metadata only."""
+    cfg = AnnealConfig(num_chains=8, steps=128, swap_interval=32,
+                       tries_move=8, tries_lead=4, tries_swap=4)
+    topo, assign = getattr(fixtures, fixture)()
+    plain = OPT.optimize(topo, assign, engine="anneal", anneal_config=cfg,
+                         seed=5, polish_cycles=0)
+    costs.configure(enabled=True)            # shallow capture on hot path
+    watched = OPT.optimize(topo, assign, engine="anneal", anneal_config=cfg,
+                           seed=5, polish_cycles=0)
+    a, b = plain.final_assignment, watched.final_assignment
+    assert np.array_equal(np.asarray(a.broker_of), np.asarray(b.broker_of))
+    assert np.array_equal(np.asarray(a.leader_of), np.asarray(b.leader_of))
+    assert plain.violated_goals_after == watched.violated_goals_after
+    # and the run actually landed in the ledger
+    snap = costs.snapshot()
+    assert "anneal-pt" in snap["programs"]
+    assert "anneal-rescore" in snap["programs"]
+
+
+# ------------------------------------------------------- burn-rate kernel
+
+
+def test_burn_rate_kernel_windows_and_readiness():
+    """The vmapped multi-window evaluator: readiness gates cold starts,
+    the fast window reacts first, and both windows must breach to fire."""
+    rules = [HW.AlertRule(name="r", signal="degraded", budget=0.02,
+                          fast_window_ticks=4, slow_window_ticks=16,
+                          fast_burn=10.0, slow_burn=2.5)]
+    tables = H.rule_tables(r.table_row() for r in rules)
+    ring, count = H.new_ring(32)
+    vec_ok = np.zeros(len(H.HEALTH_FIELDS), np.float32)
+    vec_bad = vec_ok.copy()
+    vec_bad[H.FIELD_INDEX["degraded"]] = 1.0
+
+    def step(ring, count, vec):
+        ring, count = H.push(ring, count, vec)
+        bf, bs, _, _, firing = (np.asarray(a) for a in
+                                H.burn_rates(ring, count, *tables))
+        return ring, count, float(bf[0]), float(bs[0]), bool(firing[0])
+
+    # all-bad from tick 0: burns are instantly over threshold but the
+    # readiness gate (count >= fast window) holds the page until tick 3
+    fired_at = None
+    for t in range(6):
+        ring, count, bf, bs, firing = step(ring, count, vec_bad)
+        if firing and fired_at is None:
+            fired_at = t
+    assert fired_at == 3                      # first tick with count >= 4
+    assert bf == pytest.approx(1.0 / 0.02)    # fully-bad fast window
+    # healthy ticks wash the fast window first; firing needs BOTH windows
+    for _ in range(4):
+        ring, count, bf, bs, firing = step(ring, count, vec_ok)
+    assert bf == 0.0 and not firing
+    assert bs > 0.0                           # slow window still remembers
+
+
+def test_alert_lifecycle_through_decision_sink_and_notifier():
+    """Mirrors test_detector's decision-sink audit: a burn breach emits
+    'fired' once, 'suppressed' while it holds, 'resolved' on recovery —
+    and the fired edge routes an SLOBurnAnomaly through the notifier."""
+    from cruise_control_tpu.detector.anomalies import SLOBurnAnomaly
+
+    clock = [1_000_000.0]
+    decisions = []
+    alerts = []
+
+    class Notifier:
+        def alert(self, anomaly, auto_fix_triggered=False):
+            alerts.append((anomaly, auto_fix_triggered))
+
+    hw = HW.HealthWatch(
+        [HW.AlertRule(name="tick-slo-burn", signal="degraded",
+                      fast_window_ticks=4, slow_window_ticks=8)],
+        ring_ticks=64, now_ms_fn=lambda: clock[0],
+        decision_sink=decisions.append, notifier=Notifier())
+
+    def tick(bad):
+        clock[0] += 1_000.0
+        hw.observe({"ok": 0.0 if bad else 1.0,
+                    "failed": 1.0 if bad else 0.0})
+
+    for _ in range(6):
+        tick(bad=True)
+    for _ in range(10):
+        tick(bad=False)
+    kinds = [d["decision"] for d in decisions]
+    assert kinds[0] == "fired"
+    assert kinds[-1] == "resolved"
+    assert set(kinds[1:-1]) == {"suppressed"}
+    counts = hw.alert_counts()
+    assert counts["fired"] == 1
+    assert counts["resolved"] == 1
+    assert counts["suppressed"] == len(kinds) - 2
+    assert counts["firstFiringTick"] == 3
+    assert hw.active_alerts() == []
+    # the notifier saw exactly the firing edge, as a registered anomaly
+    assert len(alerts) == 1
+    anomaly, auto_fix = alerts[0]
+    assert isinstance(anomaly, SLOBurnAnomaly)
+    assert anomaly.rule == "tick-slo-burn"
+    assert anomaly.signal == "degraded"
+    assert auto_fix is False
+    # timeline is canonical JSONL and replays the same decisions
+    rows = [json.loads(line)
+            for line in hw.export_timeline().splitlines()]
+    assert [r["decision"] for r in rows] == kinds
+    assert all(set(r) == {"tick", "rule", "signal", "decision",
+                          "burnFast", "burnSlow", "tsMs"} for r in rows)
+
+
+def test_rules_from_config_overrides_and_rejects_unknown_signal():
+    from cruise_control_tpu.common.config import CruiseControlConfig
+    cfg = CruiseControlConfig({
+        "healthwatch.error.budget": 0.05,
+        "healthwatch.fast.window.ticks": 3,
+        "healthwatch.rules": json.dumps([
+            {"name": "lag-burn", "signal": "replicationLag",
+             "threshold": 100.0},
+            {"name": "tick-slo-burn", "signal": "degraded",
+             "fastBurn": 5.0},
+        ]),
+    })
+    rules = {r.name: r for r in HW.rules_from_config(cfg)}
+    assert set(rules) == {"tick-slo-burn", "hard-violation-burn",
+                          "fallback-burn", "lag-burn"}
+    assert rules["lag-burn"].threshold == 100.0
+    assert rules["lag-burn"].budget == 0.05
+    assert rules["tick-slo-burn"].fast_burn == 5.0   # same-name override
+    assert rules["fallback-burn"].fast_window_ticks == 3
+    bad = CruiseControlConfig({
+        "healthwatch.rules": json.dumps(
+            [{"name": "x", "signal": "nope"}])})
+    with pytest.raises(ValueError, match="unknown signal"):
+        HW.rules_from_config(bad)
+
+
+# ------------------------------------------------------ scenario contract
+
+
+@pytest.mark.slow
+def test_scenario_alert_timeline_byte_identical_and_fires_first():
+    """Acceptance: a latency-storm + broker-death scenario produces a
+    byte-identical same-seed alert timeline, and the tick-SLO burn alert
+    fires during the storm — before the broker death can create the
+    scorecard's first hard-violation tick.  Two full fault scenarios —
+    slow tier, like the starvation scenario in test_simulator; the fast
+    tier covers timeline determinism via the lifecycle test and the
+    simulator marker's own byte-identity scenarios (which now carry the
+    alerts attachment in their deterministic core)."""
+    from cruise_control_tpu.simulator.faults import FaultEvent, FaultSchedule
+    from cruise_control_tpu.simulator.scenario import Scenario, run_scenario
+
+    warmup, storm_tick, kill_tick = 2, 2, 10
+    # 3 racks / rf=3: the broker death leaves a 2-rack remainder, so its
+    # replicas CANNOT evacuate — the violation (offline replicas) stays
+    # on the scorecard for every scored tick after the kill
+    sc = Scenario(
+        name="storm-then-death", seed=7, ticks=14, num_brokers=3,
+        num_racks=3, rf=3, warmup_ticks=warmup,
+        faults=FaultSchedule(events=(
+            FaultEvent(tick=storm_tick, kind="latency_storm",
+                       latency_s=30.0, duration_ticks=3),
+            FaultEvent(tick=kill_tick, kind="kill_broker", broker_id=2),
+        ), seed=7))
+    r1 = run_scenario(sc)
+    r2 = run_scenario(sc)
+    alerts = r1.core["alerts"]
+    # byte-identity: digest of the canonical JSONL timeline matches, and
+    # the whole deterministic core round-trips identically
+    assert alerts == r2.core["alerts"]
+    assert alerts["timelineDigest"] is not None
+    assert r1.canonical_json() == r2.canonical_json()
+    # the burn alert fired during the storm — before the broker death
+    # could put the first violation tick (offline replicas) on the
+    # scorecard (timeline ticks are measured ticks: scenario tick minus
+    # warmup, and violations can only begin at the kill tick)
+    assert alerts["fired"] >= 1
+    assert alerts["firstFiringTick"] is not None
+    assert alerts["firstFiringTick"] < kill_tick - warmup
+    assert r1.core["offlineTicks"] > 0
+
+
+def test_rest_alerts_and_headroom_endpoints(costs):
+    """Satellite 1: GET /alerts and GET /headroom serve the graftwatch
+    surfaces; disabled installs answer with their disabled shape."""
+    from cruise_control_tpu.app import CruiseControlApp
+    from cruise_control_tpu.common.config import CruiseControlConfig
+    from cruise_control_tpu.executor.executor import FakeClusterAdapter
+    from cruise_control_tpu.monitor.load_monitor import StaticMetadataSource
+    from cruise_control_tpu.monitor.sampler import (
+        BrokerMetadata, ClusterMetadata, PartitionMetadata,
+        SyntheticLoadSampler)
+    from cruise_control_tpu.server import rest
+
+    W = 60_000
+    brokers = [BrokerMetadata(i, rack=f"r{i % 3}", host=f"h{i}")
+               for i in range(6)]
+    parts = [PartitionMetadata("T", p, leader=p % 6,
+                               replicas=(p % 6, (p + 1) % 6))
+             for p in range(30)]
+    md = ClusterMetadata(brokers=brokers, partitions=parts, generation=1)
+    cfg = CruiseControlConfig({
+        "optimizer.engine": "greedy",
+        "partition.metrics.window.ms": W,
+        "num.partition.metrics.windows": 3,
+        "min.valid.partition.ratio": 0.0,
+        "execution.progress.check.interval.ms": 1,
+        "failed.brokers.file.path": "",
+        "healthwatch.enable": True,
+        "healthwatch.fast.window.ticks": 2,
+        "healthwatch.slow.window.ticks": 4,
+        "obs.costmodel.enable": True,
+    })
+    adapter = FakeClusterAdapter(
+        {f"{p.topic}-{p.partition}": tuple(p.replicas)
+         for p in md.partitions}, latency_polls=1)
+    app = CruiseControlApp(cfg, StaticMetadataSource(md),
+                           SyntheticLoadSampler(seed=4),
+                           cluster_adapter=adapter)
+    app.load_monitor._now = lambda: 4 * W
+    for w in range(4):
+        app.load_monitor.sample_once(now_ms=w * W + 30_000)
+    assert app.healthwatch is not None
+    app.precompute_tick()
+    srv = rest.serve(app, port=0)
+    try:
+        port = srv.server_address[1]
+
+        def get(path):
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{path}") as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        code, body = get("/kafkacruisecontrol/alerts?history=5")
+        assert code == 200
+        assert body["enabled"] is True
+        assert body["ticks"] >= 1
+        assert {r["name"] for r in body["rules"]} >= {
+            "tick-slo-burn", "hard-violation-burn", "fallback-burn"}
+        assert "counts" in body and "history" in body
+        code, body = get("/kafkacruisecontrol/alerts?history=zap")
+        assert code == 400
+        code, body = get("/kafkacruisecontrol/headroom")
+        assert code == 200
+        assert body["enabled"] is True
+        fc = body["forecast"]
+        assert fc["nextModelBytes"] > fc["currentModelBytes"] > 0
+        assert body["census"]["totalBytes"] > 0
+        # the tick's health vector landed in /state's observability block
+        state = app.observability_state()
+        assert state["healthWatch"]["ticks"] >= 1
+        assert state["costModel"]["enabled"] is True
+    finally:
+        srv.shutdown()
+
+
+@pytest.mark.slow
+def test_scenario_healthwatch_disabled_keeps_core_shape():
+    """healthwatch.enable=False still yields a stable scorecard core —
+    the alerts attachment degrades to its disabled shape."""
+    from cruise_control_tpu.simulator.scenario import Scenario, run_scenario
+    sc = Scenario(name="quiet", seed=3, ticks=4, num_brokers=4,
+                  warmup_ticks=1,
+                  config_overrides=(("healthwatch.enable", False),))
+    r = run_scenario(sc)
+    assert r.core["alerts"] == {
+        "fired": 0, "suppressed": 0, "resolved": 0,
+        "firstFiringTick": None, "timelineDigest": None}

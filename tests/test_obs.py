@@ -583,7 +583,7 @@ def test_obs_package_is_baseline_free():
         / "cruise_control_tpu" / "obs"
     modules = {p.name for p in obs_dir.glob("*.py")}
     assert {"tracing.py", "observatory.py", "provenance.py",
-            "flightrec.py"} <= modules
+            "flightrec.py", "costmodel.py", "healthwatch.py"} <= modules
     for mod in sorted(modules):
         f = engine.Finding(code="G012",
                            path=f"cruise_control_tpu/obs/{mod}",

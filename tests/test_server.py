@@ -333,7 +333,7 @@ def test_rest_endpoint_method_matrix(server):
     """Every endpoint x {GET, POST}: the supported method never 404s/405s,
     the wrong method 405s with the list of endpoints valid FOR the method
     attempted, and unknown paths 404 with the full table."""
-    assert len(rest.ALL_ENDPOINTS) == 26
+    assert len(rest.ALL_ENDPOINTS) == 28
     assert set(rest.GET_ENDPOINTS) | set(rest.POST_ENDPOINTS) == set(
         rest.ALL_ENDPOINTS)
     assert not set(rest.GET_ENDPOINTS) & set(rest.POST_ENDPOINTS)
